@@ -1,0 +1,24 @@
+"""nos_tpu — a TPU-native dynamic-partitioning and elastic-quota framework.
+
+A ground-up rebuild of the capabilities of Nebuly `nos` (reference:
+/root/reference, a Go Kubernetes operator suite) for Cloud TPU:
+
+- **Dynamic TPU partitioning**: a cluster-scoped planner watches pending pods
+  requesting TPU slices and carves TPU pods (v4/v5e/v5p) into right-sized
+  sub-slices (the analog of dynamic MIG partitioning; reference
+  internal/partitioning/), actuated by per-node agents through a native
+  C++ device shim (the analog of the NVML CGo boundary,
+  reference pkg/gpu/nvml/client.go).
+- **Fractional chip sharing**: MPS-analog time-shared chip profiles sized in
+  HBM gigabytes (reference pkg/gpu/slicing/).
+- **Elastic resource quotas**: ElasticQuota / CompositeElasticQuota with
+  min/max, quota borrowing, over-quota preemption and guaranteed-over-quota
+  fair sharing, denominated in `google.com/tpu` chips and TPU memory
+  (reference pkg/scheduler/plugins/capacityscheduling/).
+- **Gang scheduling**: all-or-nothing PodGroup admission across multi-host
+  slices with ICI-contiguity topology filtering (new; no reference analog).
+- **JAX compute path**: mesh/sharding utilities and reference workloads
+  (Llama-style FSDP training, small inference) that run on carved slices.
+"""
+
+__version__ = "0.1.0"

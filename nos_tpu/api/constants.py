@@ -1,0 +1,117 @@
+"""API contracts: labels, annotations, resource names.
+
+TPU-native analog of reference pkg/api/nos.nebuly.com/v1alpha1/{labels.go:19-24,
+annotations.go:21-58} and pkg/constant/constants.go.  Everything that crosses a
+process boundary (node annotations, labels, extended resource names) is defined
+here and nowhere else.
+"""
+
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# Group / prefixes
+# ---------------------------------------------------------------------------
+
+GROUP = "nos.tpu"
+
+# ---------------------------------------------------------------------------
+# Labels
+# ---------------------------------------------------------------------------
+
+# Partitioning mode of a node: "slice" (MIG analog), "timeshare" (MPS analog),
+# or "hybrid".  Reference: label nos.nebuly.com/gpu-partitioning
+# (pkg/gpu/partitioning.go:81-135).
+LABEL_PARTITIONING = f"{GROUP}/tpu-partitioning"
+
+# Quota standing of a running pod, stamped by the ElasticQuota reconciler.
+# Reference: nos.nebuly.com/capacity (pkg/api/.../labels.go:19-24).
+LABEL_CAPACITY = f"{GROUP}/capacity"
+CAPACITY_IN_QUOTA = "in-quota"
+CAPACITY_OVER_QUOTA = "over-quota"
+
+# Node hardware topology labels (the analog of the GPU-operator labels
+# nvidia.com/gpu.{product,count,memory} read in reference pkg/gpu/util.go:30-73).
+# On GKE these would be mirrored from cloud.google.com/gke-tpu-accelerator and
+# cloud.google.com/gke-tpu-topology; we define our own canonical keys.
+LABEL_ACCELERATOR = f"{GROUP}/accelerator"          # e.g. "tpu-v5e"
+LABEL_POD_TOPOLOGY = f"{GROUP}/pod-topology"        # physical pod mesh, e.g. "8x8"
+LABEL_HOST_TOPOLOGY = f"{GROUP}/host-topology"      # this host's sub-mesh, e.g. "2x4"
+LABEL_CHIP_COUNT = f"{GROUP}/chip-count"            # chips on this host
+LABEL_POD_ID = f"{GROUP}/pod-id"                    # physical TPU pod identity
+LABEL_HOST_INDEX = f"{GROUP}/host-index"            # host ordinal within the pod
+LABEL_HOST_COORDS = f"{GROUP}/host-coords"          # host origin in pod mesh, "x,y[,z]"
+
+# Timeshare device-plugin config selector (analog of
+# nvidia.com/device-plugin.config, reference internal/partitioning/mps/partitioner.go:103-110).
+LABEL_DEVICE_PLUGIN_CONFIG = f"{GROUP}/device-plugin.config"
+
+# Gang scheduling: pods carrying the same pod-group label are admitted
+# all-or-nothing (new; no reference analog — SURVEY.md §2.8).
+LABEL_POD_GROUP = f"{GROUP}/pod-group"
+
+# ---------------------------------------------------------------------------
+# Annotations
+# ---------------------------------------------------------------------------
+
+# Desired partitioning, written per node by the cluster-scoped partitioner:
+#   nos.tpu/spec-tpu-<index>-<profile> = <quantity>
+# Reference: nos.nebuly.com/spec-gpu-<idx>-<profile>
+# (pkg/api/.../annotations.go:21-58).  <index> is the ASIC/partition-root
+# ordinal on the host; <profile> a slice profile ("2x2") or timeshare
+# profile ("8gb").
+ANNOT_SPEC_PREFIX = f"{GROUP}/spec-tpu-"
+SPEC_ANNOT_RE = re.compile(
+    rf"^{re.escape(ANNOT_SPEC_PREFIX)}(?P<index>\d+)-(?P<profile>[0-9a-zx.]+)$"
+)
+
+# Observed partitioning, reported per node by the node agent:
+#   nos.tpu/status-tpu-<index>-<profile>-<free|used> = <quantity>
+ANNOT_STATUS_PREFIX = f"{GROUP}/status-tpu-"
+STATUS_ANNOT_RE = re.compile(
+    rf"^{re.escape(ANNOT_STATUS_PREFIX)}(?P<index>\d+)-(?P<profile>[0-9a-zx.]+)-(?P<status>free|used)$"
+)
+
+# Plan-id handshake between decision plane and actuation plane
+# (reference annotations.go:21-58, partitioner_controller.go:212-232).
+ANNOT_SPEC_PLAN = f"{GROUP}/spec-partitioning-plan"
+ANNOT_STATUS_PLAN = f"{GROUP}/status-partitioning-plan"
+
+# Requested JAX mesh shape for a workload pod, e.g. "2x2x4" — lets the slice
+# shape chooser carve slices with usable ICI topology (SURVEY.md §2.8).
+ANNOT_MESH = f"{GROUP}/mesh"
+
+# Reported device-plugin generation for timeshare nodes: replaces the
+# reference's blind time.Sleep(devicePluginDelaySeconds)
+# (mps/partitioner.go:99-100) with a generation-stamped readiness handshake.
+ANNOT_PLUGIN_GENERATION = f"{GROUP}/device-plugin-generation"
+
+# ---------------------------------------------------------------------------
+# Resource names
+# ---------------------------------------------------------------------------
+
+# Whole chips — the standard Cloud TPU extended resource.
+RESOURCE_TPU = "google.com/tpu"
+
+# Slice sub-resources (MIG-profile analog, reference pkg/gpu/mig/util.go:36-66):
+#   nos.tpu/slice-<XxY[xZ]>   e.g. nos.tpu/slice-2x2
+RESOURCE_SLICE_PREFIX = f"{GROUP}/slice-"
+SLICE_RESOURCE_RE = re.compile(
+    rf"^{re.escape(RESOURCE_SLICE_PREFIX)}(?P<shape>\d+x\d+(?:x\d+)?)$"
+)
+
+# Timeshare sub-resources (MPS analog, reference pkg/gpu/slicing/profile.go:29-64):
+#   nos.tpu/tpu-<N>gb
+RESOURCE_TIMESHARE_PREFIX = f"{GROUP}/tpu-"
+TIMESHARE_RESOURCE_RE = re.compile(
+    rf"^{re.escape(RESOURCE_TIMESHARE_PREFIX)}(?P<gb>\d+)gb$"
+)
+
+# Synthetic quota currency derived from TPU requests (reference
+# nos.nebuly.com/gpu-memory, pkg/gpu/util/resource.go:28-86).
+RESOURCE_TPU_MEMORY = f"{GROUP}/tpu-memory"
+
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"

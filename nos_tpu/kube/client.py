@@ -1,0 +1,178 @@
+"""In-memory Kubernetes API substrate.
+
+The reference runs against a real API server (controller-runtime client) and
+tests against envtest (SURVEY.md §4).  Here the same role — the durable store
+and watch bus through which the decision plane and actuation plane exchange
+annotations — is played by an in-memory, thread-safe object store with
+watch callbacks and field indexes (analog of the field indexers registered in
+reference cmd/gpupartitioner/gpupartitioner.go:270-292).
+
+All durable state lives here (annotations, labels, ConfigMaps, CRD status);
+every controller is stateless-restartable, mirroring the reference's
+checkpoint/resume story (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from collections import defaultdict
+from typing import Any, Callable, Iterable
+
+from .objects import ConfigMap, Node, Pod
+
+WatchFn = Callable[[str, Any], None]  # (event_type, object) — "ADDED"/"MODIFIED"/"DELETED"
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+class APIServer:
+    """Typed object store: kind -> key -> object.
+
+    Keys are "namespace/name" for namespaced kinds, "name" for cluster kinds.
+    Reads return deep copies (as a real API server serialises); writes bump
+    resource_version and fan out to watchers synchronously.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._stores: dict[str, dict[str, Any]] = defaultdict(dict)
+        self._watchers: dict[str, list[WatchFn]] = defaultdict(list)
+        self._rv = 0
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _key(obj: Any) -> str:
+        ns = getattr(obj.metadata, "namespace", "")
+        return f"{ns}/{obj.metadata.name}" if ns else obj.metadata.name
+
+    def _notify(self, kind: str, event: str, obj: Any) -> None:
+        for fn in list(self._watchers.get(kind, [])):
+            fn(event, copy.deepcopy(obj))
+
+    # -- CRUD -------------------------------------------------------------
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = self._key(obj)
+            store = self._stores[kind]
+            if key in store:
+                raise Conflict(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            store[key] = copy.deepcopy(obj)
+            self._notify(kind, "ADDED", store[key])
+            return copy.deepcopy(store[key])
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            store = self._stores[kind]
+            if key not in store:
+                raise NotFound(f"{kind} {key}")
+            return copy.deepcopy(store[key])
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Any | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = self._key(obj)
+            store = self._stores[kind]
+            if key not in store:
+                raise NotFound(f"{kind} {key}")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            store[key] = copy.deepcopy(obj)
+            self._notify(kind, "MODIFIED", store[key])
+            return copy.deepcopy(store[key])
+
+    def patch(self, kind: str, name: str, namespace: str = "",
+              *, mutate: Callable[[Any], None]) -> Any:
+        """Read-modify-write under the store lock (strategic-merge-patch
+        analog; the reference patches node annotations this way,
+        e.g. internal/partitioning/slicepart partitioner)."""
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            store = self._stores[kind]
+            if key not in store:
+                raise NotFound(f"{kind} {key}")
+            obj = copy.deepcopy(store[key])
+            mutate(obj)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            store[key] = obj
+            self._notify(kind, "MODIFIED", copy.deepcopy(obj))
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            store = self._stores[kind]
+            if key not in store:
+                raise NotFound(f"{kind} {key}")
+            obj = store.pop(key)
+            self._notify(kind, "DELETED", obj)
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None,
+             filter_fn: Callable[[Any], bool] | None = None) -> list[Any]:
+        with self._lock:
+            out = []
+            for key, obj in self._stores[kind].items():
+                if namespace is not None and getattr(obj.metadata, "namespace", "") != namespace:
+                    continue
+                if label_selector is not None and any(
+                    obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                if filter_fn is not None and not filter_fn(obj):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+        """Register a watcher; returns an unsubscribe function.  New watchers
+        receive synthetic ADDED events for existing objects (informer sync)."""
+        with self._lock:
+            self._watchers[kind].append(fn)
+            for obj in list(self._stores[kind].values()):
+                fn("ADDED", copy.deepcopy(obj))
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if fn in self._watchers[kind]:
+                    self._watchers[kind].remove(fn)
+
+        return unsubscribe
+
+    # -- field-index style helpers (reference gpupartitioner.go:270-292) ---
+    def pods_by_phase(self, phase: str) -> list[Pod]:
+        return self.list("Pod", filter_fn=lambda p: p.status.phase == phase)
+
+    def pods_on_node(self, node_name: str) -> list[Pod]:
+        return self.list("Pod", filter_fn=lambda p: p.spec.node_name == node_name)
+
+
+# Canonical kind names used across the framework.
+KIND_POD = "Pod"
+KIND_NODE = "Node"
+KIND_CONFIGMAP = "ConfigMap"
+KIND_ELASTIC_QUOTA = "ElasticQuota"
+KIND_COMPOSITE_ELASTIC_QUOTA = "CompositeElasticQuota"
+KIND_POD_GROUP = "PodGroup"
+
+__all__ = [
+    "APIServer", "NotFound", "Conflict",
+    "KIND_POD", "KIND_NODE", "KIND_CONFIGMAP",
+    "KIND_ELASTIC_QUOTA", "KIND_COMPOSITE_ELASTIC_QUOTA", "KIND_POD_GROUP",
+    "Node", "Pod", "ConfigMap",
+]

@@ -1,0 +1,127 @@
+"""Minimal Kubernetes object model.
+
+The control plane in this framework is cluster-API-shaped (level-triggered
+reconcilers exchanging state through node annotations — SURVEY.md §1 "the two
+planes"), so we carry a small, typed object model rather than raw dicts.
+Analog of the corev1 types used throughout the reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+
+from .resources import ResourceList
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = field(default_factory=new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+    owner_kind: str = ""          # e.g. "DaemonSet" — used by pod predicates
+    resource_version: int = 0
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    resources: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    overhead: ResourceList = field(default_factory=dict)
+    node_name: str = ""
+    priority: int = 0
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    scheduler_name: str = "nos-tpu-scheduler"
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+
+
+# Pod phases
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    phase: str = PENDING
+    conditions: list[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def is_unschedulable(self) -> bool:
+        """Pod marked unschedulable by the scheduler (condition
+        PodScheduled=False/Unschedulable).  Reference pkg/util/pod/pod.go:31-39."""
+        return any(
+            c.type == "PodScheduled" and c.status == "False" and c.reason == "Unschedulable"
+            for c in self.status.conditions
+        )
+
+    def mark_unschedulable(self, message: str = "") -> None:
+        self.status.conditions = [
+            c for c in self.status.conditions if c.type != "PodScheduled"
+        ]
+        self.status.conditions.append(
+            PodCondition("PodScheduled", "False", "Unschedulable", message)
+        )
+
+
+@dataclass
+class NodeStatus:
+    allocatable: ResourceList = field(default_factory=dict)
+    capacity: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+
+def clone_meta(meta: ObjectMeta) -> ObjectMeta:
+    return replace(
+        meta, labels=dict(meta.labels), annotations=dict(meta.annotations)
+    )

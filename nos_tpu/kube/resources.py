@@ -1,0 +1,101 @@
+"""Resource-list arithmetic.
+
+Analog of reference pkg/resource/resource.go:57-146 (framework.Resource
+Sum/Subtract/SubtractNonNegative/Abs and pod request math).  A ResourceList is
+a plain ``dict[str, float]``; helpers are pure functions returning new dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+ResourceList = dict[str, float]
+
+
+def parse_quantity(q: str | int | float) -> float:
+    """Parse a Kubernetes quantity ("500m", "2", "16Gi") into a float.
+
+    Memory suffixes normalise to bytes; "m" is milli (cpu).
+    """
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = q.strip()
+    suffixes = {
+        "Ki": 1024.0, "Mi": 1024.0**2, "Gi": 1024.0**3, "Ti": 1024.0**4,
+        "Pi": 1024.0**5, "Ei": 1024.0**6,
+        "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    }
+    for suf, mul in suffixes.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mul
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
+def sum_resources(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def subtract(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    """a - b, keeping negative values (used for lacking-resource detection,
+    reference snapshot.go:132-165)."""
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0.0) - v
+    return out
+
+
+def subtract_non_negative(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    return {k: max(0.0, v) for k, v in subtract(a, b).items()}
+
+
+def abs_resources(a: Mapping[str, float]) -> ResourceList:
+    return {k: abs(v) for k, v in a.items()}
+
+
+def max_resources(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = max(out.get(k, 0.0), v)
+    return out
+
+
+def negatives_only(a: Mapping[str, float]) -> ResourceList:
+    """Keep only strictly negative entries, as positive magnitudes."""
+    return {k: -v for k, v in a.items() if v < 0}
+
+
+def fits(request: Mapping[str, float], available: Mapping[str, float]) -> bool:
+    return all(available.get(k, 0.0) >= v for k, v in request.items() if v > 0)
+
+
+def less_or_equal(a: Mapping[str, float], b: Mapping[str, float]) -> bool:
+    """True iff a[k] <= b[k] for every resource in a (missing in b == 0)."""
+    return all(v <= b.get(k, 0.0) for k, v in a.items())
+
+
+def any_greater(a: Mapping[str, float], b: Mapping[str, float]) -> bool:
+    """True iff a exceeds b in at least one resource."""
+    return any(v > b.get(k, 0.0) for k, v in a.items())
+
+
+def nonzero(a: Mapping[str, float]) -> ResourceList:
+    return {k: v for k, v in a.items() if v != 0}
+
+
+def pod_request(pod) -> ResourceList:
+    """Effective pod resource request: max(max(initContainers), sum(containers))
+    + overhead.  Reference pkg/resource/resource.go:127-146.
+    """
+    total: ResourceList = {}
+    for c in pod.spec.containers:
+        total = sum_resources(total, c.resources)
+    for ic in pod.spec.init_containers:
+        total = max_resources(total, ic.resources)
+    if pod.spec.overhead:
+        total = sum_resources(total, pod.spec.overhead)
+    return total

@@ -1,0 +1,24 @@
+"""TPU topology domain model (the analog of reference pkg/gpu/)."""
+
+from .shape import Shape
+from .known import Generation, TopologyRegistry, DEFAULT_REGISTRY, V4, V5E, V5P, GENERATIONS
+from .geometry import (
+    Geometry, geometry_equal, num_slices, fewest_slices_geometry,
+    shapes_geometry, named_geometry,
+)
+from .packing import Placement, pack, feasible, extend, enumerate_tilings
+from .slice_unit import SliceUnit
+from .timeshare_unit import TimeshareUnit
+from .device import Device, DeviceList, USED, FREE, make_device_id
+from . import annotations, profile, errors
+
+__all__ = [
+    "Shape", "Generation", "TopologyRegistry", "DEFAULT_REGISTRY",
+    "V4", "V5E", "V5P", "GENERATIONS",
+    "Geometry", "geometry_equal", "num_slices", "fewest_slices_geometry",
+    "shapes_geometry", "named_geometry",
+    "Placement", "pack", "feasible", "extend", "enumerate_tilings",
+    "SliceUnit", "TimeshareUnit",
+    "Device", "DeviceList", "USED", "FREE", "make_device_id",
+    "annotations", "profile", "errors",
+]

@@ -1,0 +1,124 @@
+"""Spec/status node-annotation codec.
+
+The single most important architectural contract (SURVEY.md §1): the
+cluster-scoped decision plane writes *desired* partitioning as
+`nos.tpu/spec-tpu-<index>-<profile>=<qty>` node annotations plus a plan id;
+the node-scoped actuation plane reports *observed* state as
+`nos.tpu/status-tpu-<index>-<profile>-<free|used>=<qty>` plus the last
+applied plan id.  Analog of reference pkg/gpu/annotation.go:29-224 and
+pkg/api/nos.nebuly.com/v1alpha1/annotations.go:21-58.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping
+
+from nos_tpu.api import constants as C
+
+
+@dataclass(frozen=True)
+class SpecAnnotation:
+    index: int
+    profile: str
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{C.ANNOT_SPEC_PREFIX}{self.index}-{self.profile}"
+
+
+@dataclass(frozen=True)
+class StatusAnnotation:
+    index: int
+    profile: str
+    status: str            # "free" | "used"
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{C.ANNOT_STATUS_PREFIX}{self.index}-{self.profile}-{self.status}"
+
+
+def _parse_qty(v: str) -> int | None:
+    """Annotations come from the API server and may be corrupt; skip
+    unparseable quantities rather than crash the reconcile loop."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def parse_spec_annotations(annotations: Mapping[str, str]) -> list[SpecAnnotation]:
+    out = []
+    for k, v in annotations.items():
+        m = C.SPEC_ANNOT_RE.match(k)
+        qty = _parse_qty(v) if m else None
+        if m and qty is not None:
+            out.append(SpecAnnotation(int(m.group("index")), m.group("profile"), qty))
+    return sorted(out, key=lambda a: (a.index, a.profile))
+
+
+def parse_status_annotations(annotations: Mapping[str, str]) -> list[StatusAnnotation]:
+    out = []
+    for k, v in annotations.items():
+        m = C.STATUS_ANNOT_RE.match(k)
+        qty = _parse_qty(v) if m else None
+        if m and qty is not None:
+            out.append(StatusAnnotation(
+                int(m.group("index")), m.group("profile"), m.group("status"), qty
+            ))
+    return sorted(out, key=lambda a: (a.index, a.profile, a.status))
+
+
+def spec_from_geometries(geometries: Mapping[int, Mapping[str, int]]) -> dict[str, str]:
+    """index -> (profile -> qty)  ==>  annotation map."""
+    out: dict[str, str] = {}
+    for idx, geo in geometries.items():
+        for profile, qty in geo.items():
+            if qty > 0:
+                out[SpecAnnotation(idx, profile, qty).key] = str(qty)
+    return out
+
+
+def status_from_units(units: Iterable) -> dict[str, str]:
+    """Render used/free annotations from SliceUnit/TimeshareUnit objects."""
+    out: dict[str, str] = {}
+    for u in units:
+        for profile, qty in u.used_names().items():
+            out[StatusAnnotation(u.index, profile, "used", qty).key] = str(qty)
+        for profile, qty in u.free_names().items():
+            out[StatusAnnotation(u.index, profile, "free", qty).key] = str(qty)
+    return out
+
+
+def spec_matches_status(annotations: Mapping[str, str]) -> bool:
+    """Desired == observed, per index+profile (reference
+    pkg/gpu/mig/annotation.go:24 SpecMatchesStatus)."""
+    spec: dict[tuple[int, str], int] = {}
+    for a in parse_spec_annotations(annotations):
+        spec[(a.index, a.profile)] = spec.get((a.index, a.profile), 0) + a.quantity
+    status: dict[tuple[int, str], int] = {}
+    for a in parse_status_annotations(annotations):
+        key = (a.index, a.profile)
+        status[key] = status.get(key, 0) + a.quantity
+    return ({k: v for k, v in spec.items() if v > 0}
+            == {k: v for k, v in status.items() if v > 0})
+
+
+def strip_spec_annotations(annotations: dict[str, str]) -> None:
+    for k in [k for k in annotations if C.SPEC_ANNOT_RE.match(k)]:
+        del annotations[k]
+
+
+def strip_status_annotations(annotations: dict[str, str]) -> None:
+    for k in [k for k in annotations if C.STATUS_ANNOT_RE.match(k)]:
+        del annotations[k]
+
+
+def spec_plan_id(annotations: Mapping[str, str]) -> str:
+    return annotations.get(C.ANNOT_SPEC_PLAN, "")
+
+
+def status_plan_id(annotations: Mapping[str, str]) -> str:
+    return annotations.get(C.ANNOT_STATUS_PLAN, "")

@@ -1,0 +1,47 @@
+"""Device: one allocatable sub-resource instance on a node.
+
+Analog of reference pkg/gpu/device.go:26-137 (`gpu.Device`/`DeviceList`):
+a device couples an extended resource name with a concrete device id and the
+partition-root index it was carved from, plus a used/free status derived from
+the kubelet pod-resources view.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable
+
+USED = "used"
+FREE = "free"
+
+
+@dataclass(frozen=True)
+class Device:
+    resource_name: str      # e.g. "nos.tpu/slice-2x2"
+    device_id: str          # runtime device id, e.g. "tpu-0-slice-2x2-0"
+    status: str             # USED | FREE
+    unit_index: int         # partition root (slicepart) or chip (timeshare)
+
+
+class DeviceList(list):
+    def group_by_unit(self) -> dict[int, "DeviceList"]:
+        out: dict[int, DeviceList] = {}
+        for d in self:
+            out.setdefault(d.unit_index, DeviceList()).append(d)
+        return out
+
+    def group_by_resource(self) -> dict[str, "DeviceList"]:
+        out: dict[str, DeviceList] = {}
+        for d in self:
+            out.setdefault(d.resource_name, DeviceList()).append(d)
+        return out
+
+    def with_status(self, status: str) -> "DeviceList":
+        return DeviceList(d for d in self if d.status == status)
+
+    def ids(self) -> list[str]:
+        return [d.device_id for d in self]
+
+
+def make_device_id(unit_index: int, resource_suffix: str, ordinal: int) -> str:
+    return f"tpu-{unit_index}-{resource_suffix}-{ordinal}"

@@ -1,0 +1,36 @@
+"""Typed errors for the TPU domain layer.
+
+Analog of reference pkg/gpu/errors.go:17-99 (NotFoundErr/GenericErr with
+IsNotFound, ErrorList).
+"""
+
+from __future__ import annotations
+
+
+class TopologyError(Exception):
+    """Base class for TPU domain errors."""
+
+
+class DeviceNotFoundError(TopologyError):
+    pass
+
+
+class InvalidGeometryError(TopologyError):
+    pass
+
+
+class InvalidProfileError(TopologyError):
+    pass
+
+
+class ErrorList(TopologyError):
+    def __init__(self, errors: list[Exception]):
+        self.errors = errors
+        super().__init__("; ".join(str(e) for e in errors))
+
+    def __bool__(self) -> bool:
+        return bool(self.errors)
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, DeviceNotFoundError)

@@ -1,0 +1,145 @@
+"""Known TPU topologies per accelerator generation.
+
+The analog of the reference's hard-coded allowed MIG geometry tables per GPU
+model (pkg/gpu/mig/known_configs.go:24-142) plus the boot-time YAML override
+(SetKnownGeometries, known_configs.go:144-150).  Differences, by design:
+
+- A GPU model's geometry table is a hand-maintained list of multisets; a TPU
+  generation's is *derived* — the valid host-level geometries are exactly the
+  multisets of sub-host shapes that tile the host chip block, computed by the
+  exact packer (`nos_tpu.topology.packing`) and cached.  An operator can still
+  restrict/override the table from JSON, mirroring the reference's file hook.
+- Each generation also carries the table of valid *multi-host* slice
+  topologies (chips + host count + ICI mesh), which the pod-scope planner and
+  the gang scheduler use for ICI-contiguity (SURVEY.md §2.8 topology model).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .shape import Shape
+
+
+@dataclass(frozen=True)
+class Generation:
+    """One TPU generation's physical parameters."""
+
+    name: str                     # accelerator label value, e.g. "tpu-v5e"
+    ndims: int                    # ICI mesh rank (2 for v5e, 3 for v4/v5p)
+    host_block: Shape             # one host's chip block within the pod mesh
+    hbm_gb_per_chip: int
+    # All slice topologies this generation supports (single- and multi-host).
+    slice_shapes: tuple[Shape, ...] = ()
+    # Largest physical pod mesh.
+    max_pod: Shape = None  # type: ignore[assignment]
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.host_block.chips
+
+    def subhost_shapes(self) -> list[Shape]:
+        """Shapes that fit within one host block — the partitionable profiles
+        (MIG-profile analog)."""
+        return [s for s in self.slice_shapes if s.chips <= self.chips_per_host
+                and s.fits_in(self.host_block)]
+
+    def multihost_shapes(self) -> list[Shape]:
+        return [s for s in self.slice_shapes if s.chips > self.chips_per_host]
+
+    def hosts_for(self, shape: Shape) -> int:
+        if shape.chips <= self.chips_per_host:
+            return 1
+        return shape.chips // self.chips_per_host
+
+    def host_grid(self, pod_mesh: Shape) -> Shape:
+        """The pod mesh measured in host-block units (used by the pod-scope
+        packer and the ICI-contiguity filter)."""
+        hb = tuple(self.host_block.dims) + (1,) * (self.ndims - len(self.host_block.dims))
+        pm = tuple(pod_mesh.dims) + (1,) * (self.ndims - len(pod_mesh.dims))
+        if any(p % h for p, h in zip(pm, hb)):
+            raise ValueError(f"pod mesh {pod_mesh} not divisible by host block {self.host_block}")
+        return Shape(tuple(p // h for p, h in zip(pm, hb)))
+
+
+def _shapes(*names: str) -> tuple[Shape, ...]:
+    return tuple(Shape.parse(n) for n in names)
+
+
+# Cloud TPU slice topology tables.  Sources: public Cloud TPU docs
+# (v5e: 2D mesh, 8 chips/host in a 2x4 block; v4/v5p: 3D torus, 4 chips/host
+# in a 2x2x1 block).  These replace known_configs.go's per-model tables.
+V5E = Generation(
+    name="tpu-v5e",
+    ndims=2,
+    host_block=Shape.parse("2x4"),
+    hbm_gb_per_chip=16,
+    slice_shapes=_shapes(
+        "1x1", "1x2", "2x2", "2x4",                    # single-host
+        "4x4", "4x8", "8x8", "8x16", "16x16",          # multi-host
+    ),
+    max_pod=Shape.parse("16x16"),
+)
+
+V4 = Generation(
+    name="tpu-v4",
+    ndims=3,
+    host_block=Shape.parse("1x2x2"),
+    hbm_gb_per_chip=32,
+    slice_shapes=_shapes(
+        "1x1x1", "1x1x2", "1x2x2",                     # single-host
+        "2x2x2", "2x2x4", "2x4x4", "4x4x4",
+        "4x4x8", "4x8x8", "8x8x8", "8x8x12", "8x8x16",
+    ),
+    max_pod=Shape.parse("12x16x16"),
+)
+
+V5P = Generation(
+    name="tpu-v5p",
+    ndims=3,
+    host_block=Shape.parse("1x2x2"),
+    hbm_gb_per_chip=95,
+    slice_shapes=_shapes(
+        "1x1x1", "1x1x2", "1x2x2",
+        "2x2x2", "2x2x4", "2x4x4", "4x4x4",
+        "4x4x8", "4x8x8", "8x8x8", "8x8x16", "8x16x16",
+    ),
+    max_pod=Shape.parse("16x16x24"),
+)
+
+GENERATIONS: dict[str, Generation] = {g.name: g for g in (V5E, V4, V5P)}
+
+
+@dataclass
+class TopologyRegistry:
+    """Mutable registry consulted by the planner; supports operator override
+    from JSON (the SetKnownGeometries analog, known_configs.go:144-150)."""
+
+    generations: dict[str, Generation] = field(
+        default_factory=lambda: dict(GENERATIONS)
+    )
+
+    def get(self, accelerator: str) -> Generation:
+        try:
+            return self.generations[accelerator]
+        except KeyError:
+            raise KeyError(f"unknown accelerator {accelerator!r}; "
+                           f"known: {sorted(self.generations)}") from None
+
+    def load_overrides(self, path: str) -> None:
+        """JSON: {"tpu-v5e": {"slice_shapes": ["1x1", "2x2", ...]}}.
+        Restricting the shape table restricts the derived geometry tables."""
+        with open(path) as f:
+            data = json.load(f)
+        for name, spec in data.items():
+            base = self.get(name)
+            shapes = tuple(Shape.parse(s) for s in spec["slice_shapes"])
+            self.generations[name] = Generation(
+                name=base.name, ndims=base.ndims, host_block=base.host_block,
+                hbm_gb_per_chip=base.hbm_gb_per_chip,
+                slice_shapes=shapes, max_pod=base.max_pod,
+            )
+
+
+DEFAULT_REGISTRY = TopologyRegistry()

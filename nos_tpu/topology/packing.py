@@ -1,0 +1,204 @@
+"""Exact cuboid packing of slice shapes into a chip block.
+
+This is the re-derivation SURVEY.md §7 flags as hard part (1): MIG profiles
+form a flat multiset, but TPU slices are *placed* sub-meshes, so geometry
+validity ("CanApplyGeometry") becomes a small 3-D packing problem.  Key
+design decision: placements are **shape-aligned** — an oriented shape with
+dims d may sit only at offsets o with o[i] % d[i] == 0 (mirroring how real
+TPU sub-slices are carved on ICI boundaries).  Aligned placement gives a
+clean hierarchy (any aligned packing can be refined/coarsened in place),
+which makes multiset-level reasoning sound: if per-profile counts are
+feasible, concrete placements exist (see `extend`).
+
+Blocks are tiny (a v5e host block is 2x4 = 8 cells; v4/v5p is 1x2x2 = 4), so
+the exact search is cheap; results are memoised.  A native C++ implementation
+of the same search can be plugged in via `set_native_packer` (the hot-loop
+analog of the NVML permutation search, reference pkg/gpu/nvml/client.go:286-340).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Callable, Iterable, Mapping
+
+from .shape import Shape
+
+# A placement: offset and oriented dims, both padded to the block's rank.
+@dataclass(frozen=True)
+class Placement:
+    shape: Shape                  # canonical shape (sorted dims)
+    offset: tuple[int, ...]
+    dims: tuple[int, ...]         # oriented dims actually placed
+
+
+def _pad(dims: tuple[int, ...], n: int) -> tuple[int, ...]:
+    return tuple(dims) + (1,) * (n - len(dims))
+
+
+def _cell_id(coord: tuple[int, ...], block: tuple[int, ...]) -> int:
+    cid = 0
+    for c, b in zip(coord, block):
+        cid = cid * b + c
+    return cid
+
+
+@lru_cache(maxsize=None)
+def _candidate_placements(block: Shape, shape: Shape) -> tuple[tuple[int, Placement], ...]:
+    """All aligned placements of `shape` in `block` as (bitmask, Placement)."""
+    n = len(block.dims)
+    bdims = block.dims
+    out: list[tuple[int, Placement]] = []
+    seen_masks: set[int] = set()
+    for odims in {(_pad(o, n)) for o in shape.orientations()}:
+        if any(d > b for d, b in zip(odims, bdims)):
+            continue
+        ranges = [range(0, b - d + 1, d) for d, b in zip(odims, bdims)]
+        for offset in itertools.product(*ranges):
+            mask = 0
+            for cell in itertools.product(*[range(o, o + d) for o, d in zip(offset, odims)]):
+                mask |= 1 << _cell_id(cell, bdims)
+            if mask in seen_masks:
+                continue
+            seen_masks.add(mask)
+            out.append((mask, Placement(shape.canonical(), offset, odims)))
+    return tuple(out)
+
+
+def _first_empty_cell(occupied: int, total: int) -> int:
+    for i in range(total):
+        if not occupied & (1 << i):
+            return i
+    return -1
+
+
+def _pack_masks(block: Shape, counts: tuple[tuple[Shape, int], ...],
+                occupied: int, require_full: bool) -> list[Placement] | None:
+    """Backtracking exact packer over bitmasks."""
+    total = block.chips
+    remaining = dict(counts)
+
+    def rec(occ: int, rem: dict[Shape, int], acc: list[Placement]) -> list[Placement] | None:
+        if all(v == 0 for v in rem.values()):
+            if require_full and occ != (1 << total) - 1:
+                return None
+            return acc
+        cell = _first_empty_cell(occ, total)
+        if cell == -1:
+            return None
+        cell_bit = 1 << cell
+        for shape, cnt in sorted(rem.items(), key=lambda kv: -kv[0].chips):
+            if cnt == 0:
+                continue
+            for mask, pl in _candidate_placements(block, shape):
+                if not mask & cell_bit or mask & occ:
+                    continue
+                rem[shape] -= 1
+                res = rec(occ | mask, rem, acc + [pl])
+                if res is not None:
+                    return res
+                rem[shape] += 1
+        if not require_full:
+            # The first empty cell may legitimately stay empty: mark it
+            # occupied-by-nothing and continue.
+            return rec(occ | cell_bit, rem, acc)
+        return None
+
+    return rec(occupied, remaining, [])
+
+
+# Optional native accelerator (C++; see nos_tpu/native and device/native.py).
+# Signature: fn(block, counts_key, occupied_mask, require_full) ->
+# tuple[Placement] | None | NotImplemented.  Consulted by both pack() and
+# extend() ahead of the Python search; the lru cache only ever stores Python
+# results computed while no native packer was installed for that call.
+_native_packer: Callable | None = None
+
+
+def set_native_packer(fn: Callable | None) -> None:
+    global _native_packer
+    _native_packer = fn
+
+
+def _counts_key(counts: Mapping[Shape, int]) -> tuple[tuple[Shape, int], ...]:
+    return tuple(sorted(((s.canonical(), c) for s, c in counts.items() if c > 0),
+                        key=lambda kv: (kv[0].chips, kv[0].dims)))
+
+
+def _try_native(block: Shape, key: tuple[tuple[Shape, int], ...],
+                occupied: int, require_full: bool):
+    if _native_packer is None:
+        return NotImplemented
+    return _native_packer(block, key, occupied, require_full)
+
+
+@lru_cache(maxsize=65536)
+def _pack_cached(block: Shape, key: tuple[tuple[Shape, int], ...],
+                 require_full: bool) -> tuple[Placement, ...] | None:
+    res = _pack_masks(block, key, occupied=0, require_full=require_full)
+    return tuple(res) if res is not None else None
+
+
+def pack(block: Shape, counts: Mapping[Shape, int],
+         require_full: bool = False) -> list[Placement] | None:
+    """Place the multiset `counts` into `block` without overlap (aligned).
+    Returns placements or None if infeasible.  `require_full` demands an
+    exact tiling (used when deriving geometry tables)."""
+    key = _counts_key(counts)
+    native = _try_native(block, key, 0, require_full)
+    if native is not NotImplemented:
+        return list(native) if native is not None else None
+    res = _pack_cached(block, key, require_full)
+    return list(res) if res is not None else None
+
+
+def feasible(block: Shape, counts: Mapping[Shape, int]) -> bool:
+    return pack(block, counts) is not None
+
+
+def extend(block: Shape, fixed: Iterable[Placement],
+           counts: Mapping[Shape, int]) -> list[Placement] | None:
+    """Pack `counts` around already-placed `fixed` slices (the actuator's
+    create path: used devices must keep their placement — the analog of the
+    delete-free-then-create plan, reference internal/controllers/migagent/plan/plan.go:31-92)."""
+    occ = 0
+    bdims = block.dims
+    for pl in fixed:
+        for cell in itertools.product(
+            *[range(o, o + d) for o, d in zip(pl.offset, pl.dims)]
+        ):
+            occ |= 1 << _cell_id(cell, bdims)
+    key = _counts_key(counts)
+    native = _try_native(block, key, occ, False)
+    if native is not NotImplemented:
+        return list(native) if native is not None else None
+    return _pack_masks(block, key, occupied=occ, require_full=False)
+
+
+@lru_cache(maxsize=None)
+def enumerate_tilings(block: Shape, shapes: tuple[Shape, ...]) -> tuple[tuple[tuple[Shape, int], ...], ...]:
+    """All distinct multisets of `shapes` that exactly tile `block` — the
+    derived allowed-geometry table (replaces the reference's hand-maintained
+    known_configs.go:24-142)."""
+    total = block.chips
+    results: set[tuple[tuple[Shape, int], ...]] = set()
+    cands: dict[Shape, tuple[tuple[int, Placement], ...]] = {
+        s.canonical(): _candidate_placements(block, s) for s in shapes
+    }
+
+    def rec(occ: int, counts: dict[Shape, int]) -> None:
+        if occ == (1 << total) - 1:
+            results.add(_counts_key(counts))
+            return
+        cell_bit = 1 << _first_empty_cell(occ, total)
+        for shape, places in cands.items():
+            for mask, _ in places:
+                if not mask & cell_bit or mask & occ:
+                    continue
+                counts[shape] = counts.get(shape, 0) + 1
+                rec(occ | mask, counts)
+                counts[shape] -= 1
+
+    rec(0, {})
+    return tuple(sorted(results))

@@ -1,0 +1,86 @@
+"""Profiles: the schedulable sub-resources carved from TPU hardware.
+
+Two families, mirroring the reference's two partitioning modes:
+
+- **Slice profiles** — named by shape ("2x2"); extended resource
+  `nos.tpu/slice-2x2`.  Analog of MIG profiles `<N>g.<M>gb` ↔
+  `nvidia.com/mig-*` (reference pkg/gpu/mig/profile.go:29-47, util.go:36-66).
+- **Timeshare profiles** — named by HBM gigabytes ("8gb"); extended resource
+  `nos.tpu/tpu-8gb`.  Analog of MPS slicing profiles `<N>gb` ↔
+  `nvidia.com/gpu-<N>gb` (reference pkg/gpu/slicing/profile.go:29-64).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.resources import ResourceList
+
+from .shape import Shape
+
+# ---------------------------------------------------------------------------
+# Slice profiles
+# ---------------------------------------------------------------------------
+
+
+def slice_resource_name(shape: Shape | str) -> str:
+    s = shape if isinstance(shape, Shape) else Shape.parse(shape)
+    return f"{C.RESOURCE_SLICE_PREFIX}{s.canonical().name}"
+
+
+def shape_from_resource(resource: str) -> Shape | None:
+    m = C.SLICE_RESOURCE_RE.match(resource)
+    return Shape.parse(m.group("shape")) if m else None
+
+
+def is_slice_resource(resource: str) -> bool:
+    return C.SLICE_RESOURCE_RE.match(resource) is not None
+
+
+def extract_slice_requests(request: ResourceList) -> dict[Shape, int]:
+    out: dict[Shape, int] = {}
+    for res, qty in request.items():
+        shape = shape_from_resource(res)
+        if shape is not None and qty > 0:
+            s = shape.canonical()
+            out[s] = out.get(s, 0) + int(qty)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Timeshare profiles
+# ---------------------------------------------------------------------------
+
+
+def timeshare_resource_name(gb: int) -> str:
+    return f"{C.RESOURCE_TIMESHARE_PREFIX}{gb}gb"
+
+
+def gb_from_resource(resource: str) -> int | None:
+    m = C.TIMESHARE_RESOURCE_RE.match(resource)
+    return int(m.group("gb")) if m else None
+
+
+def is_timeshare_resource(resource: str) -> bool:
+    return C.TIMESHARE_RESOURCE_RE.match(resource) is not None
+
+
+def extract_timeshare_requests(request: ResourceList) -> dict[int, int]:
+    out: dict[int, int] = {}
+    for res, qty in request.items():
+        gb = gb_from_resource(res)
+        if gb is not None and qty > 0:
+            out[gb] = out.get(gb, 0) + int(qty)
+    return out
+
+
+def profile_sort_key(profile: str) -> tuple[int, str]:
+    """Smaller-profile-first ordering across both families (the pod sorter's
+    tiebreak, reference internal/partitioning/core/util.go:34-71):
+    by chip-equivalent size, then name."""
+    shape = shape_from_resource(C.RESOURCE_SLICE_PREFIX + profile) \
+        if "x" in profile else None
+    if shape is not None:
+        return (shape.chips * 1000, profile)
+    if profile.endswith("gb"):
+        return (int(profile[:-2]), profile)
+    return (10**9, profile)

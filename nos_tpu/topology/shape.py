@@ -1,0 +1,69 @@
+"""Slice shapes: axis-aligned ICI sub-meshes.
+
+The TPU re-derivation of the reference's flat MIG profile concept
+(pkg/gpu/partitioning.go:28-79, pkg/gpu/mig/profile.go:29-96): where a MIG
+profile is `<N>g.<M>gb`, a TPU slice shape is a cuboid `XxY[xZ]` of chips with
+ICI connectivity.  Shapes are canonicalised with sorted dims ("2x4", never
+"4x2"); placement may use any axis permutation (the ICI mesh is isotropic
+within a host block).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from functools import reduce, total_ordering
+from operator import mul
+
+
+@total_ordering
+@dataclass(frozen=True)
+class Shape:
+    dims: tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        if not self.dims or any(d < 1 for d in self.dims):
+            raise ValueError(f"invalid shape dims {self.dims}")
+
+    @staticmethod
+    def parse(s: str) -> "Shape":
+        try:
+            dims = tuple(int(d) for d in s.lower().split("x"))
+        except ValueError as e:
+            raise ValueError(f"invalid shape {s!r}") from e
+        return Shape(dims)
+
+    @property
+    def chips(self) -> int:
+        return reduce(mul, self.dims, 1)
+
+    @property
+    def name(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    def canonical(self) -> "Shape":
+        return Shape(tuple(sorted(self.dims)))
+
+    def orientations(self) -> list[tuple[int, ...]]:
+        """All distinct axis permutations (placement orientations)."""
+        return sorted(set(itertools.permutations(self.dims)))
+
+    def smaller_than(self, other: "Shape") -> bool:
+        """Ordering analog of mig.ProfileName ordering (profile.go:84-96):
+        by chip count, then lexicographic dims."""
+        return (self.chips, self.dims) < (other.chips, other.dims)
+
+    def __lt__(self, other: "Shape") -> bool:
+        return self.smaller_than(other)
+
+    def __str__(self) -> str:
+        return self.name
+
+    def fits_in(self, block: "Shape") -> bool:
+        """Some orientation fits inside `block` (dims padded with 1s)."""
+        n = max(len(self.dims), len(block.dims))
+        bd = tuple(block.dims) + (1,) * (n - len(block.dims))
+        return any(
+            all(o[i] <= bd[i] for i in range(n))
+            for o in Shape(tuple(self.dims) + (1,) * (n - len(self.dims))).orientations()
+        )

@@ -1,0 +1,135 @@
+"""SliceUnit: the geometry state machine for one partition root.
+
+Analog of reference pkg/gpu/mig/gpu.go:27-259 (`mig.GPU`): tracks used/free
+slice devices on one host chip block and answers `CanApplyGeometry` /
+`ApplyGeometry` / `InitGeometry` / `UpdateGeometryFor`.  Where the MIG version
+consults a hand-maintained allowed-geometry table, this one consults the
+tilings derived by the exact packer (nos_tpu/topology/packing.py) — geometry
+validity *is* packing feasibility (SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from .errors import InvalidGeometryError
+from .geometry import Geometry, named_geometry
+from .known import Generation
+from .packing import enumerate_tilings, feasible
+from .shape import Shape
+
+
+@dataclass
+class SliceUnit:
+    generation: Generation
+    index: int = 0
+    used: dict[Shape, int] = field(default_factory=dict)
+    free: dict[Shape, int] = field(default_factory=dict)
+
+    # -- derived tables ----------------------------------------------------
+    def allowed_geometries(self) -> list[dict[Shape, int]]:
+        table = enumerate_tilings(
+            self.generation.host_block, tuple(self.generation.subhost_shapes())
+        )
+        return [dict(t) for t in table]
+
+    # -- views -------------------------------------------------------------
+    def current_geometry(self) -> dict[Shape, int]:
+        geo: dict[Shape, int] = {}
+        for src in (self.used, self.free):
+            for s, c in src.items():
+                if c > 0:
+                    geo[s] = geo.get(s, 0) + c
+        return geo
+
+    def geometry_names(self) -> Geometry:
+        return named_geometry(self.current_geometry())
+
+    def used_names(self) -> Geometry:
+        return named_geometry(self.used)
+
+    def free_names(self) -> Geometry:
+        return named_geometry(self.free)
+
+    # -- geometry transitions ----------------------------------------------
+    @staticmethod
+    def _canon(geometry: Mapping[Shape, int]) -> dict[Shape, int]:
+        out: dict[Shape, int] = {}
+        for s, c in geometry.items():
+            if c > 0:
+                k = s.canonical()
+                out[k] = out.get(k, 0) + c
+        return out
+
+    def can_apply_geometry(self, geometry: Mapping[Shape, int]) -> bool:
+        """Geometry must be an exact tiling of the host block and must not
+        delete any used slice (reference mig/gpu.go CanApplyGeometry)."""
+        geometry = self._canon(geometry)
+        if not feasible(self.generation.host_block, geometry):
+            return False
+        total = sum(s.chips * c for s, c in geometry.items())
+        if total != self.generation.host_block.chips:
+            return False
+        return all(geometry.get(s, 0) >= c for s, c in self.used.items() if c > 0)
+
+    def apply_geometry(self, geometry: Mapping[Shape, int]) -> None:
+        geometry = self._canon(geometry)
+        if not self.can_apply_geometry(geometry):
+            raise InvalidGeometryError(
+                f"geometry {named_geometry(dict(geometry))} not applicable to "
+                f"unit {self.index} (used={self.used_names()})"
+            )
+        self.free = {
+            s: geometry.get(s, 0) - self.used.get(s, 0)
+            for s in set(geometry) | set(self.used)
+        }
+        self.free = {s: c for s, c in self.free.items() if c > 0}
+
+    def init_geometry(self) -> None:
+        """Virgin unit: fewest-slices geometry == one whole-block slice
+        (reference mig/gpu.go InitGeometry via GetFewestSlicesGeometry)."""
+        self.apply_geometry({self.generation.host_block.canonical(): 1})
+
+    def update_geometry_for(self, lacking: Mapping[Shape, int]) -> bool:
+        """Re-carve free capacity to provide as many lacking slices as
+        possible; keep the current geometry if no candidate strictly
+        improves.  Hot loop #1 (reference mig/gpu.go:158-212: score every
+        allowed geometry against the lacking profiles)."""
+
+        def score(free: Mapping[Shape, int]) -> int:
+            return sum(min(free.get(s, 0), n) for s, n in lacking.items())
+
+        current = score(self.free)
+        best_geo: dict[Shape, int] | None = None
+        best = current
+        for geo in self.allowed_geometries():
+            if not all(geo.get(s, 0) >= c for s, c in self.used.items() if c > 0):
+                continue
+            cand_free = {s: geo.get(s, 0) - self.used.get(s, 0) for s in geo}
+            sc = score(cand_free)
+            if sc > best or (sc == best and best_geo is not None
+                             and sum(geo.values()) < sum(best_geo.values())):
+                best, best_geo = sc, dict(geo)
+        if best_geo is None:
+            return False
+        self.apply_geometry(best_geo)
+        return True
+
+    # -- allocation --------------------------------------------------------
+    def allocate(self, shape: Shape) -> bool:
+        """Move one free slice to used (reference mig/gpu.go AddPod)."""
+        s = shape.canonical()
+        if self.free.get(s, 0) <= 0:
+            return False
+        self.free[s] -= 1
+        self.used[s] = self.used.get(s, 0) + 1
+        return True
+
+    def release(self, shape: Shape) -> bool:
+        s = shape.canonical()
+        if self.used.get(s, 0) <= 0:
+            return False
+        self.used[s] -= 1
+        self.free[s] = self.free.get(s, 0) + 1
+        return True

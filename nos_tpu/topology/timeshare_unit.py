@@ -1,0 +1,128 @@
+"""TimeshareUnit: fractional-chip sharing state machine for one TPU chip.
+
+Analog of reference pkg/gpu/slicing/gpu.go:27-265 (`slicing.GPU`): one chip's
+HBM is carved into memory-sized timeshare profiles (`nos.tpu/tpu-<N>gb`).
+`update_geometry_for` creates requested slices from spare memory, sacrificing
+existing *free* slices when needed and restoring what still fits afterwards
+(reference gpu.go:162-265).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from .geometry import Geometry
+
+
+@dataclass
+class TimeshareUnit:
+    hbm_gb: int
+    index: int = 0                      # chip ordinal on the host
+    used: dict[int, int] = field(default_factory=dict)   # gb -> count
+    free: dict[int, int] = field(default_factory=dict)
+
+    def _gb(self, table: Mapping[int, int]) -> int:
+        return sum(gb * c for gb, c in table.items())
+
+    @property
+    def used_gb(self) -> int:
+        return self._gb(self.used)
+
+    @property
+    def spare_gb(self) -> int:
+        return self.hbm_gb - self.used_gb - self._gb(self.free)
+
+    def geometry_names(self) -> Geometry:
+        geo: dict[str, int] = {}
+        for src in (self.used, self.free):
+            for gb, c in src.items():
+                if c > 0:
+                    geo[f"{gb}gb"] = geo.get(f"{gb}gb", 0) + c
+        return geo
+
+    def used_names(self) -> Geometry:
+        return {f"{gb}gb": c for gb, c in self.used.items() if c > 0}
+
+    def free_names(self) -> Geometry:
+        return {f"{gb}gb": c for gb, c in self.free.items() if c > 0}
+
+    def can_apply_geometry(self, geometry: Mapping[int, int]) -> bool:
+        if self._gb(geometry) > self.hbm_gb:
+            return False
+        return all(geometry.get(gb, 0) >= c for gb, c in self.used.items() if c > 0)
+
+    def apply_geometry(self, geometry: Mapping[int, int]) -> None:
+        if not self.can_apply_geometry(geometry):
+            raise ValueError(
+                f"timeshare geometry {dict(geometry)} not applicable "
+                f"(hbm={self.hbm_gb}gb, used={self.used})"
+            )
+        self.free = {
+            gb: geometry.get(gb, 0) - self.used.get(gb, 0)
+            for gb in set(geometry) | set(self.used)
+        }
+        self.free = {gb: c for gb, c in self.free.items() if c > 0}
+
+    def update_geometry_for(self, lacking: Mapping[int, int]) -> bool:
+        """Provide as many lacking profiles as possible.  Mirrors reference
+        slicing gpu.go:162-265: create from spare memory first; if spare is
+        short, sacrifice free slices and restore whatever still fits.  A plan
+        is only accepted if it does not lower the overall number of lacking
+        profiles satisfied — otherwise reconciles could oscillate between two
+        partial satisfactions forever."""
+
+        def satisfaction(free: Mapping[int, int]) -> int:
+            return sum(min(free.get(gb, 0), n) for gb, n in lacking.items())
+
+        before_free = dict(self.free)
+        created: dict[int, int] = {}
+        sacrificable = dict(self.free)
+        spare = self.spare_gb
+        changed = False
+        for gb, want in sorted(lacking.items()):
+            need = max(0, want - self.free.get(gb, 0))
+            for _ in range(need):
+                if spare < gb:
+                    # Sacrifice free slices (largest first) until we can fit.
+                    for fgb in sorted(sacrificable, reverse=True):
+                        while spare < gb and sacrificable.get(fgb, 0) > 0:
+                            sacrificable[fgb] -= 1
+                            spare += fgb
+                if spare < gb:
+                    break
+                spare -= gb
+                created[gb] = created.get(gb, 0) + 1
+                changed = True
+        if not changed:
+            return False
+        # Restore sacrificed capacity into its original profile sizes where
+        # spare memory still allows (reference "restore what fits").
+        new_free: dict[int, int] = {gb: c for gb, c in sacrificable.items() if c > 0}
+        for gb, c in created.items():
+            new_free[gb] = new_free.get(gb, 0) + c
+        restored_spare = self.hbm_gb - self.used_gb - self._gb(new_free)
+        for fgb in sorted(self.free, reverse=True):
+            lost = self.free.get(fgb, 0) - sacrificable.get(fgb, 0)
+            while lost > 0 and restored_spare >= fgb:
+                new_free[fgb] = new_free.get(fgb, 0) + 1
+                restored_spare -= fgb
+                lost -= 1
+        if satisfaction(new_free) < satisfaction(before_free):
+            return False
+        self.free = new_free
+        return True
+
+    def allocate(self, gb: int) -> bool:
+        if self.free.get(gb, 0) <= 0:
+            return False
+        self.free[gb] -= 1
+        self.used[gb] = self.used.get(gb, 0) + 1
+        return True
+
+    def release(self, gb: int) -> bool:
+        if self.used.get(gb, 0) <= 0:
+            return False
+        self.used[gb] -= 1
+        self.free[gb] = self.free.get(gb, 0) + 1
+        return True

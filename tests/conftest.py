@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device platform so multi-chip sharding
+paths (mesh creation, pjit shardings, collectives) execute without TPU
+hardware — the analog of the reference's envtest-without-GPUs strategy
+(SURVEY.md §4).  Set NOS_TPU_TEST_REAL=1 to run against real devices.
+"""
+
+import os
+
+if not os.environ.get("NOS_TPU_TEST_REAL"):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()

@@ -1,0 +1,317 @@
+"""Unit tests for the TPU topology domain model.
+
+Mirrors the coverage of reference pkg/gpu/mig/gpu_test.go (geometry algebra),
+profile_test.go, annotation tests, and slicing/gpu_test.go (timeshare),
+table-driven where the reference is.
+"""
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.topology import (
+    DEFAULT_REGISTRY, Shape, SliceUnit, TimeshareUnit, V4, V5E,
+    annotations as ann, enumerate_tilings, extend, feasible,
+    fewest_slices_geometry, named_geometry, pack, profile,
+)
+from nos_tpu.topology.errors import InvalidGeometryError
+
+
+# ---------------------------------------------------------------------------
+# Shape
+# ---------------------------------------------------------------------------
+
+class TestShape:
+    def test_parse_and_chips(self):
+        s = Shape.parse("2x4")
+        assert s.dims == (2, 4)
+        assert s.chips == 8
+        assert s.name == "2x4"
+        assert Shape.parse("2x2x4").chips == 16
+
+    def test_ordering_smaller_first(self):
+        shapes = [Shape.parse(x) for x in ["2x4", "1x1", "2x2", "1x2"]]
+        assert [s.name for s in sorted(shapes)] == ["1x1", "1x2", "2x2", "2x4"]
+
+    def test_canonical(self):
+        assert Shape((4, 2)).canonical().name == "2x4"
+
+    def test_fits_in_any_orientation(self):
+        assert Shape.parse("1x2").fits_in(Shape.parse("2x1"))
+        assert Shape.parse("2x2").fits_in(Shape.parse("2x4"))
+        assert not Shape.parse("4x4").fits_in(Shape.parse("2x4"))
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            Shape.parse("2xh")
+        with pytest.raises(ValueError):
+            Shape((0, 2))
+
+
+# ---------------------------------------------------------------------------
+# Known topologies
+# ---------------------------------------------------------------------------
+
+class TestGenerations:
+    def test_v5e_parameters(self):
+        assert V5E.chips_per_host == 8
+        assert V5E.hbm_gb_per_chip == 16
+        assert {s.name for s in V5E.subhost_shapes()} == {"1x1", "1x2", "2x2", "2x4"}
+        assert Shape.parse("4x4") in V5E.multihost_shapes()
+
+    def test_hosts_for(self):
+        assert V5E.hosts_for(Shape.parse("2x2")) == 1
+        assert V5E.hosts_for(Shape.parse("4x4")) == 2
+        assert V5E.hosts_for(Shape.parse("8x8")) == 8
+        assert V5E.hosts_for(Shape.parse("16x16")) == 32
+        assert V4.hosts_for(Shape.parse("2x2x4")) == 4
+
+    def test_host_grid(self):
+        assert V5E.host_grid(Shape.parse("8x8")).dims == (4, 2)
+        assert V5E.host_grid(Shape.parse("16x16")).dims == (8, 4)
+        with pytest.raises(ValueError):
+            V5E.host_grid(Shape.parse("3x5"))
+
+    def test_registry_lookup(self):
+        assert DEFAULT_REGISTRY.get("tpu-v5e") is V5E
+        with pytest.raises(KeyError):
+            DEFAULT_REGISTRY.get("tpu-v9")
+
+
+# ---------------------------------------------------------------------------
+# Packing
+# ---------------------------------------------------------------------------
+
+class TestPacking:
+    def test_exact_tiling_v5e_host(self):
+        block = V5E.host_block
+        assert pack(block, {Shape.parse("2x2"): 2}, require_full=True) is not None
+        assert pack(block, {Shape.parse("1x1"): 8}, require_full=True) is not None
+        assert pack(block, {Shape.parse("2x4"): 1}, require_full=True) is not None
+        mixed = {Shape.parse("2x2"): 1, Shape.parse("1x2"): 2}
+        assert pack(block, mixed, require_full=True) is not None
+
+    def test_infeasible(self):
+        block = V5E.host_block
+        assert pack(block, {Shape.parse("2x2"): 3}) is None          # 12 > 8 chips
+        assert not feasible(block, {Shape.parse("2x4"): 2})
+
+    def test_partial_pack(self):
+        block = V5E.host_block
+        res = pack(block, {Shape.parse("2x2"): 1})
+        assert res is not None and len(res) == 1
+
+    def test_extend_around_used(self):
+        block = V5E.host_block
+        fixed = pack(block, {Shape.parse("2x2"): 1})
+        assert fixed is not None
+        more = extend(block, fixed, {Shape.parse("2x2"): 1, Shape.parse("1x1"): 0})
+        assert more is not None and len(more) == 1
+        assert extend(block, fixed, {Shape.parse("2x4"): 1}) is None
+
+    def test_enumerate_tilings_derived_table(self):
+        tilings = enumerate_tilings(
+            V5E.host_block, tuple(V5E.subhost_shapes())
+        )
+        as_named = [dict((s.name, c) for s, c in t) for t in tilings]
+        assert {"2x4": 1} in as_named
+        assert {"2x2": 2} in as_named
+        assert {"1x1": 8} in as_named
+        assert {"2x2": 1, "1x2": 2} in as_named
+        # every tiling covers exactly 8 chips
+        for t in tilings:
+            assert sum(Shape(s.dims).chips * c for s, c in t) == 8
+
+    def test_enumerate_tilings_v4_host(self):
+        tilings = enumerate_tilings(V4.host_block, tuple(V4.subhost_shapes()))
+        as_named = [dict((s.name, c) for s, c in t) for t in tilings]
+        assert {"1x2x2": 1} in as_named
+        assert {"1x1x1": 4} in as_named
+
+
+# ---------------------------------------------------------------------------
+# SliceUnit geometry state machine
+# ---------------------------------------------------------------------------
+
+class TestSliceUnit:
+    def unit(self):
+        return SliceUnit(generation=V5E)
+
+    def test_init_geometry_is_fewest_slices(self):
+        u = self.unit()
+        u.init_geometry()
+        assert u.geometry_names() == {"2x4": 1}
+
+    def test_apply_and_allocate(self):
+        u = self.unit()
+        u.apply_geometry({Shape.parse("2x2"): 2})
+        assert u.free_names() == {"2x2": 2}
+        assert u.allocate(Shape.parse("2x2"))
+        assert u.used_names() == {"2x2": 1}
+        assert not u.allocate(Shape.parse("1x1"))
+
+    def test_cannot_delete_used(self):
+        u = self.unit()
+        u.apply_geometry({Shape.parse("2x2"): 2})
+        u.allocate(Shape.parse("2x2"))
+        with pytest.raises(InvalidGeometryError):
+            u.apply_geometry({Shape.parse("2x4"): 1})
+        # but refining the free half is fine
+        u.apply_geometry({Shape.parse("2x2"): 1, Shape.parse("1x1"): 4})
+        assert u.used_names() == {"2x2": 1}
+        assert u.free_names() == {"1x1": 4}
+
+    def test_update_geometry_for_lacking(self):
+        u = self.unit()
+        u.init_geometry()                      # one 2x4, nothing used
+        changed = u.update_geometry_for({Shape.parse("2x2"): 2})
+        assert changed
+        assert u.free_names() == {"2x2": 2}
+
+    def test_update_geometry_respects_used(self):
+        u = self.unit()
+        u.apply_geometry({Shape.parse("2x2"): 2})
+        u.allocate(Shape.parse("2x2"))
+        changed = u.update_geometry_for({Shape.parse("1x1"): 4})
+        assert changed
+        assert u.used_names() == {"2x2": 1}
+        assert u.free_names() == {"1x1": 4}
+
+    def test_update_noop_when_no_improvement(self):
+        u = self.unit()
+        u.apply_geometry({Shape.parse("2x2"): 2})
+        assert not u.update_geometry_for({Shape.parse("2x2"): 1})
+
+    def test_non_canonical_shapes_are_canonicalised(self):
+        # review regression: apply/allocate/profile paths must canonicalise
+        u = self.unit()
+        u.apply_geometry({Shape((4, 2)): 1})
+        assert u.allocate(Shape.parse("2x4"))
+        assert u.used_names() == {"2x4": 1}
+        assert profile.slice_resource_name(Shape((4, 2))) == "nos.tpu/slice-2x4"
+        assert profile.extract_slice_requests({"nos.tpu/slice-4x2": 1}) == {
+            Shape.parse("2x4"): 1
+        }
+
+    def test_fewest_slices_helper(self):
+        best = fewest_slices_geometry([{"1x1": 8}, {"2x4": 1}, {"2x2": 2}])
+        assert best == {"2x4": 1}
+
+
+# ---------------------------------------------------------------------------
+# TimeshareUnit
+# ---------------------------------------------------------------------------
+
+class TestTimeshareUnit:
+    def test_create_from_spare(self):
+        u = TimeshareUnit(hbm_gb=16)
+        assert u.update_geometry_for({8: 2})
+        assert u.free_names() == {"8gb": 2}
+        assert u.spare_gb == 0
+
+    def test_sacrifice_free_and_restore(self):
+        u = TimeshareUnit(hbm_gb=16)
+        u.update_geometry_for({16: 1})
+        assert u.free_names() == {"16gb": 1}
+        # need two 8gb: must sacrifice the free 16gb
+        assert u.update_geometry_for({8: 2})
+        assert u.free_names() == {"8gb": 2}
+
+    def test_used_never_sacrificed(self):
+        u = TimeshareUnit(hbm_gb=16)
+        u.update_geometry_for({8: 1})
+        u.allocate(8)
+        assert u.update_geometry_for({4: 2})
+        assert u.used_names() == {"8gb": 1}
+        assert u.free_names() == {"4gb": 2}
+        # free slices may be sacrificed for new requests...
+        assert u.update_geometry_for({8: 1})
+        assert u.free_names() == {"8gb": 1}
+        assert u.used_names() == {"8gb": 1}
+        # ...but a request exceeding hbm minus used capacity cannot be met
+        assert not u.update_geometry_for({16: 1})
+        assert u.used_names() == {"8gb": 1}
+
+    def test_apply_geometry_bounds(self):
+        u = TimeshareUnit(hbm_gb=16)
+        with pytest.raises(ValueError):
+            u.apply_geometry({16: 2})
+
+    def test_no_oscillating_sacrifice(self):
+        # review regression: a sacrifice plan that lowers overall lacking
+        # satisfaction must be rejected, else reconciles flip-flop forever
+        u = TimeshareUnit(hbm_gb=16)
+        u.free = {8: 2}
+        assert not u.update_geometry_for({8: 2, 16: 1})
+        assert u.free_names() == {"8gb": 2}
+
+
+# ---------------------------------------------------------------------------
+# Profiles / resource names
+# ---------------------------------------------------------------------------
+
+class TestProfiles:
+    def test_slice_roundtrip(self):
+        name = profile.slice_resource_name(Shape.parse("2x2"))
+        assert name == "nos.tpu/slice-2x2"
+        assert profile.shape_from_resource(name) == Shape.parse("2x2")
+        assert profile.shape_from_resource("nvidia.com/mig-1g.5gb") is None
+
+    def test_timeshare_roundtrip(self):
+        name = profile.timeshare_resource_name(8)
+        assert name == "nos.tpu/tpu-8gb"
+        assert profile.gb_from_resource(name) == 8
+        assert profile.gb_from_resource("nos.tpu/slice-2x2") is None
+
+    def test_extract_requests(self):
+        req = {"cpu": 1.0, "nos.tpu/slice-2x2": 2, "nos.tpu/tpu-8gb": 1}
+        assert profile.extract_slice_requests(req) == {Shape.parse("2x2"): 2}
+        assert profile.extract_timeshare_requests(req) == {8: 1}
+
+
+# ---------------------------------------------------------------------------
+# Annotation codec
+# ---------------------------------------------------------------------------
+
+class TestAnnotations:
+    def test_spec_roundtrip(self):
+        annots = ann.spec_from_geometries({0: {"2x2": 2}, 1: {"8gb": 3}})
+        assert annots == {
+            "nos.tpu/spec-tpu-0-2x2": "2",
+            "nos.tpu/spec-tpu-1-8gb": "3",
+        }
+        parsed = ann.parse_spec_annotations(annots)
+        assert [(a.index, a.profile, a.quantity) for a in parsed] == [
+            (0, "2x2", 2), (1, "8gb", 3),
+        ]
+
+    def test_status_from_units(self):
+        u = SliceUnit(generation=V5E)
+        u.apply_geometry({Shape.parse("2x2"): 2})
+        u.allocate(Shape.parse("2x2"))
+        annots = ann.status_from_units([u])
+        assert annots == {
+            "nos.tpu/status-tpu-0-2x2-used": "1",
+            "nos.tpu/status-tpu-0-2x2-free": "1",
+        }
+
+    def test_corrupt_and_zero_annotations(self):
+        # review regressions: corrupt values are skipped; zero-quantity spec
+        # entries do not block convergence
+        assert ann.parse_spec_annotations({"nos.tpu/spec-tpu-0-2x2": "banana"}) == []
+        assert ann.spec_matches_status({"nos.tpu/spec-tpu-0-2x2": "0"})
+
+    def test_spec_matches_status(self):
+        annots = {
+            "nos.tpu/spec-tpu-0-2x2": "2",
+            "nos.tpu/status-tpu-0-2x2-used": "1",
+            "nos.tpu/status-tpu-0-2x2-free": "1",
+        }
+        assert ann.spec_matches_status(annots)
+        annots["nos.tpu/spec-tpu-0-2x2"] = "1"
+        assert not ann.spec_matches_status(annots)
+        assert ann.spec_matches_status({})
+
+    def test_ignores_foreign_annotations(self):
+        annots = {"foo/bar": "1", C.ANNOT_SPEC_PLAN: "abc"}
+        assert ann.parse_spec_annotations(annots) == []
+        assert ann.spec_plan_id(annots) == "abc"
