@@ -1,0 +1,57 @@
+"""Node controller: cluster-state sync + virgin-node initialization.
+
+Analog of reference internal/controllers/gpupartitioner/node_controller.go:60-135:
+tracks only nodes carrying the partitioning label; triggers slice-node
+initialization for uninitialized nodes; keeps ClusterState in sync.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer
+from nos_tpu.kube.objects import Node
+from nos_tpu.partitioning.core import NodeInitializer
+from nos_tpu.kube.objects import FAILED, SUCCEEDED
+from nos_tpu.partitioning.slicepart import (
+    HYBRID_KIND, SLICE_KIND, is_node_initialized,
+)
+from nos_tpu.partitioning.state import ClusterState
+
+logger = logging.getLogger(__name__)
+
+
+class NodeController:
+    def __init__(self, api: APIServer, cluster_state: ClusterState,
+                 initializer: NodeInitializer | None = None) -> None:
+        self._api = api
+        self._state = cluster_state
+        self._initializer = initializer
+
+    def reconcile(self, event: str, node: Node) -> None:
+        name = node.metadata.name
+        if event == "DELETED":
+            self._state.delete_node(name)
+            return
+        kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+        if not kind:
+            self._state.delete_node(name)
+            return
+        if (kind in (SLICE_KIND, HYBRID_KIND) and self._initializer is not None
+                and not is_node_initialized(node)):
+            try:
+                self._initializer.init_node_partitioning(name)
+                node = self._api.get("Node", name)
+            except Exception as e:
+                logger.warning("node %s init failed: %s", name, e)
+        # only live pods consume capacity: completed pods keep their
+        # node_name set, and re-adding them would inflate requested forever
+        live = [
+            p for p in self._api.pods_on_node(name)
+            if p.status.phase not in (SUCCEEDED, FAILED)
+        ]
+        self._state.update_node(node, live)
+
+    def bind(self) -> None:
+        self._api.watch("Node", self.reconcile)

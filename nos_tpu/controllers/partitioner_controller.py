@@ -1,0 +1,94 @@
+"""The cluster-scoped partitioning controller.
+
+Analog of reference internal/controllers/gpupartitioner/partitioner_controller.go:81-239
+(generic Controller, instantiated once per partitioning kind — slice and
+timeshare — exactly as the reference instantiates it for MIG and MPS):
+
+- pod events are ignored unless a repartition could help the pod schedule
+  (ExtraResourcesCouldHelpScheduling) and the kind is enabled on some node;
+- interesting pods feed a Batcher (timeout/idle windows);
+- when the batch is ready AND every node has reported the previous plan
+  (spec vs status plan-id handshake, :212-232), fetch ALL pending pods,
+  snapshot cluster state, Plan, and Apply.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer
+from nos_tpu.kube.objects import PENDING, Pod
+from nos_tpu.partitioning.core import Actuator, Planner, SnapshotTaker
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.utils.batcher import Batcher
+from nos_tpu.utils.pod_util import extra_resources_could_help_scheduling
+from nos_tpu.topology.annotations import spec_plan_id, status_plan_id
+
+logger = logging.getLogger(__name__)
+
+
+class PartitionerController:
+    def __init__(self, api: APIServer, cluster_state: ClusterState,
+                 kind: str, planner: Planner, actuator: Actuator,
+                 snapshot_taker: SnapshotTaker,
+                 batcher: Batcher[Pod]) -> None:
+        self._api = api
+        self._state = cluster_state
+        self._kind = kind
+        self._planner = planner
+        self._actuator = actuator
+        self._snapshot_taker = snapshot_taker
+        self._batcher = batcher
+
+    # -- event path ---------------------------------------------------------
+    def reconcile_pod(self, pod: Pod) -> None:
+        if not self._state.is_partitioning_enabled(self._kind):
+            return
+        if not extra_resources_could_help_scheduling(pod):
+            return
+        self._batcher.add(pod.key, pod)
+
+    def bind(self) -> None:
+        self._api.watch(
+            "Pod",
+            lambda ev, pod: self.reconcile_pod(pod) if ev != "DELETED" else None,
+        )
+
+    # -- batch path ---------------------------------------------------------
+    def process_if_ready(self) -> bool:
+        """Poll from the run loop; returns True if a plan cycle ran."""
+        if not self._batcher.ready():
+            return False
+        if self._waiting_for_nodes_to_report_plan():
+            # defer new plans until all nodes report the previous one
+            # (reference :118-124 requeues after 10 s)
+            logger.debug("partitioner[%s]: waiting for plan reports", self._kind)
+            return False
+        self._batcher.drain()
+        self.process_pending_pods()
+        return True
+
+    def process_pending_pods(self) -> None:
+        pods = [
+            p for p in self._api.pods_by_phase(PENDING)
+            if extra_resources_could_help_scheduling(p)
+        ]
+        snapshot = self._snapshot_taker.take_snapshot(self._state)
+        if not snapshot.nodes():
+            return
+        desired = self._planner.plan(snapshot.clone(), pods)
+        self._actuator.apply(snapshot, desired)
+
+    def _waiting_for_nodes_to_report_plan(self) -> bool:
+        """spec-partitioning-plan vs status-partitioning-plan per node
+        (reference :212-232)."""
+        for node in self._state.nodes().values():
+            kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+            if kind not in (self._kind, "hybrid"):
+                continue
+            annots = node.metadata.annotations
+            spec_id = spec_plan_id(annots)
+            if spec_id and status_plan_id(annots) != spec_id:
+                return True
+        return False

@@ -1,0 +1,26 @@
+"""Pod controller: per-pod usage sync into ClusterState.
+
+Analog of reference internal/controllers/gpupartitioner/pod_controller.go:47-112.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.client import APIServer
+from nos_tpu.kube.objects import FAILED, SUCCEEDED, Pod
+from nos_tpu.partitioning.state import ClusterState
+
+
+class PodController:
+    def __init__(self, api: APIServer, cluster_state: ClusterState) -> None:
+        self._api = api
+        self._state = cluster_state
+
+    def reconcile(self, event: str, pod: Pod) -> None:
+        if event == "DELETED" or pod.status.phase in (SUCCEEDED, FAILED):
+            self._state.delete_pod(pod.key)
+            return
+        if pod.spec.node_name:
+            self._state.update_pod(pod)
+
+    def bind(self) -> None:
+        self._api.watch("Pod", self.reconcile)

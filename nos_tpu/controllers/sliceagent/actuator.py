@@ -1,0 +1,141 @@
+"""SliceActuator: the node agent's actuation half.
+
+Analog of reference internal/controllers/migagent/actuator.go:71-292: on a
+node-annotation change, diff spec vs observed devices into a ConfigPlan
+(delete-free-then-create), drive the device client, tolerate partial failure
+with per-operation status, and trigger device-plugin re-advertisement when
+anything changed.  Guards: wait for at least one report since the last apply
+(:74-78); skip no-op and duplicate plans (:109-116).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.topology.annotations import (
+    parse_spec_annotations, spec_matches_status, spec_plan_id,
+)
+
+from nos_tpu.device.plugin import DevicePluginClient
+from nos_tpu.device.tpuclient import SliceDeviceClient
+
+from .plan import ConfigPlan, SliceState, new_config_plan
+from .shared import SharedState
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class OperationStatus:
+    """Per-operation outcome (reference plan/operation.go:25-54)."""
+
+    op: object
+    error: Exception | None = None
+    plugin_refresh_required: bool = False
+
+
+@dataclass
+class ApplyResult:
+    statuses: list[OperationStatus] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(s.error is None for s in self.statuses)
+
+    @property
+    def changed(self) -> bool:
+        return any(s.plugin_refresh_required for s in self.statuses)
+
+
+class SliceActuator:
+    def __init__(self, api: APIServer, node_name: str,
+                 client: SliceDeviceClient, shared: SharedState,
+                 plugin: DevicePluginClient) -> None:
+        self._api = api
+        self._node_name = node_name
+        self._client = client
+        self._shared = shared
+        self._plugin = plugin
+
+    def reconcile(self) -> bool:
+        """Returns True if devices changed (plugin refreshed)."""
+        if not self._shared.at_least_one_report_since_last_apply:
+            logger.debug("sliceagent actuator: waiting for a fresh report")
+            return False
+        node = self._api.get(KIND_NODE, self._node_name)
+        annots = node.metadata.annotations
+        self._shared.last_parsed_plan_id = spec_plan_id(annots)
+        if spec_matches_status(annots):
+            logger.debug("sliceagent actuator: spec matches status, nothing to do")
+            return False
+
+        spec: dict[int, dict[str, int]] = {}
+        for a in parse_spec_annotations(annots):
+            if "x" not in a.profile:
+                continue
+            spec.setdefault(a.index, {})[a.profile] = a.quantity
+
+        devices = self._client.get_devices()
+        plan = new_config_plan(SliceState.from_devices(devices), spec)
+        if plan.empty:
+            return False
+        if self._shared.is_duplicate(plan.signature()):
+            logger.debug("sliceagent actuator: duplicate plan, skipping")
+            return False
+
+        result = self._apply(plan)
+        if result.ok:
+            # a failed plan must NOT be recorded, or the duplicate-skip guard
+            # would block the retry forever (found by fault-injection probe)
+            self._shared.record_applied(plan.signature())
+        self._shared.on_apply_done()
+        if result.changed:
+            self._plugin.refresh()
+        if not result.ok:
+            errs = [str(s.error) for s in result.statuses if s.error]
+            logger.warning("sliceagent actuator: partial failure on %s: %s",
+                           self._node_name, "; ".join(errs))
+        return result.changed
+
+    def _apply(self, plan: ConfigPlan) -> ApplyResult:
+        """Deletes first, then creates (reference actuator.go:152-201).
+        Creates are grouped per unit into ONE placement call so the packer
+        places the whole set jointly — issuing per-profile calls would let
+        small slices fragment the block before large ones are placed (the
+        TPU analog of why NVML creation searches permutations,
+        reference pkg/gpu/nvml/client.go:286-340)."""
+        result = ApplyResult()
+        for op in plan.deletes:
+            for did in op.device_ids:
+                st = OperationStatus(op=op)
+                try:
+                    self._client.delete_slice(did)
+                    st.plugin_refresh_required = True
+                except Exception as e:          # tolerate partial failure
+                    st.error = e
+                result.statuses.append(st)
+        by_unit: dict[int, list] = {}
+        for op in plan.creates:
+            by_unit.setdefault(op.unit_index, []).append(op)
+        for unit_index, ops in sorted(by_unit.items()):
+            shapes = [s for op in ops for s in [op.shape] * op.quantity]
+            st = OperationStatus(op=tuple(ops))
+            try:
+                self._client.create_slices(unit_index, shapes)
+                st.plugin_refresh_required = True
+            except Exception as e:
+                st.error = e
+            result.statuses.append(st)
+        return result
+
+    def startup_cleanup(self) -> list[str]:
+        """Delete carved devices not allocated to any pod (reference
+        cmd/migagent/migagent.go:190-199 cleanupUnusedMigResources)."""
+        used = self._client.pod_resources.used_device_ids()
+        doomed = self._client.delete_all_except(used)
+        if doomed:
+            self._plugin.refresh()
+        return doomed

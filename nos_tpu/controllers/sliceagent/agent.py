@@ -1,0 +1,51 @@
+"""SliceAgent: the per-node DaemonSet bundle (reporter + actuator + shared
+state + startup cleanup).
+
+Analog of reference cmd/migagent/migagent.go:56-199: wires the
+reporter/actuator pair around one SharedState, runs startup cleanup of
+carved-but-unused devices, and exposes a tick() the run loop drives (standing
+in for the controller-runtime manager + 10 s report interval).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.client import APIServer
+
+from nos_tpu.device.plugin import DevicePluginClient
+from nos_tpu.device.tpuclient import (
+    PodResourcesClient, SliceDeviceClient, TpuRuntimeClient,
+)
+
+from .actuator import SliceActuator
+from .reporter import SliceReporter
+from .shared import SharedState
+
+
+class SliceAgent:
+    def __init__(self, api: APIServer, node_name: str,
+                 runtime: TpuRuntimeClient,
+                 pod_resources: PodResourcesClient) -> None:
+        self.node_name = node_name
+        self.runtime = runtime
+        self.pod_resources = pod_resources
+        self.client = SliceDeviceClient(runtime, pod_resources)
+        self.shared = SharedState()
+        self.plugin = DevicePluginClient(api, node_name, runtime)
+        self.reporter = SliceReporter(api, node_name, self.client, self.shared)
+        self.actuator = SliceActuator(api, node_name, self.client, self.shared,
+                                      self.plugin)
+
+    def start(self) -> None:
+        """Startup: cleanup orphaned devices, then first report."""
+        self.actuator.startup_cleanup()
+        self.reporter.reconcile()
+
+    def tick(self) -> bool:
+        """One report+actuate cycle; returns True if devices changed."""
+        self.reporter.reconcile()
+        changed = self.actuator.reconcile()
+        if changed:
+            # reflect the new devices immediately so the decision plane sees
+            # status==spec without waiting another report interval
+            self.reporter.reconcile()
+        return changed

@@ -1,0 +1,115 @@
+"""Pure diff algebra: observed devices vs desired spec -> create/delete ops.
+
+Analog of reference internal/controllers/migagent/plan/ (mig_state.go:29-87,
+plan.go:31-92, operation.go:25-54):
+
+- delete profiles absent from the spec (free devices only — used are never
+  deleted);
+- per-unit per-profile quantity diff -> create/delete operations;
+- on units that have create ops, re-create the untouched *free* devices too,
+  widening the placement search space (the TPU analog of widening the NVML
+  permutation space, plan.go:63-92).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from nos_tpu.topology import DeviceList, Shape, USED
+from nos_tpu.topology.profile import shape_from_resource
+
+
+@dataclass
+class ProfileDevices:
+    used: list[str] = field(default_factory=list)
+    free: list[str] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return len(self.used) + len(self.free)
+
+
+class SliceState(dict):
+    """unit index -> profile name -> ProfileDevices (mig_state.go analog)."""
+
+    @staticmethod
+    def from_devices(devices: DeviceList) -> "SliceState":
+        state = SliceState()
+        for d in devices:
+            shape = shape_from_resource(d.resource_name)
+            if shape is None:
+                continue
+            unit = state.setdefault(d.unit_index, {})
+            pd = unit.setdefault(shape.name, ProfileDevices())
+            (pd.used if d.status == USED else pd.free).append(d.device_id)
+        return state
+
+
+@dataclass(frozen=True)
+class CreateOperation:
+    unit_index: int
+    shape: Shape
+    quantity: int
+
+
+@dataclass(frozen=True)
+class DeleteOperation:
+    unit_index: int
+    device_ids: tuple[str, ...]
+
+
+@dataclass
+class ConfigPlan:
+    deletes: list[DeleteOperation] = field(default_factory=list)
+    creates: list[CreateOperation] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.deletes and not self.creates
+
+    def signature(self) -> tuple:
+        """Stable identity for duplicate-plan skipping
+        (reference actuator.go:109-116)."""
+        return (
+            tuple(sorted((d.unit_index, d.device_ids) for d in self.deletes)),
+            tuple(sorted(
+                (c.unit_index, c.shape.name, c.quantity) for c in self.creates
+            )),
+        )
+
+
+def new_config_plan(state: SliceState,
+                    spec: dict[int, dict[str, int]]) -> ConfigPlan:
+    """Compute the delete-free-then-create plan (plan.go:31-92)."""
+    plan = ConfigPlan()
+    units = set(state) | set(spec)
+    for unit in sorted(units):
+        current = state.get(unit, {})
+        desired = {p: q for p, q in spec.get(unit, {}).items() if q > 0}
+        doomed: list[str] = []
+        creates: dict[str, int] = {}
+        survivors_free: dict[str, list[str]] = {}
+        for profile in set(current) | set(desired):
+            pd = current.get(profile, ProfileDevices())
+            want = desired.get(profile, 0)
+            have = pd.total
+            if have > want:
+                excess = min(have - want, len(pd.free))
+                doomed.extend(pd.free[:excess])
+                survivors_free[profile] = pd.free[excess:]
+            else:
+                survivors_free[profile] = list(pd.free)
+                if want > have:
+                    creates[profile] = want - have
+        if creates:
+            # widening: re-create surviving free devices so the placement
+            # search may move them (plan.go:63-92)
+            for profile, ids in survivors_free.items():
+                if ids:
+                    doomed.extend(ids)
+                    creates[profile] = creates.get(profile, 0) + len(ids)
+        if doomed:
+            plan.deletes.append(DeleteOperation(unit, tuple(sorted(doomed))))
+        for profile, qty in sorted(creates.items()):
+            plan.creates.append(CreateOperation(unit, Shape.parse(profile), qty))
+    return plan

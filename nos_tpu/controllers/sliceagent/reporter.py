@@ -1,0 +1,60 @@
+"""SliceReporter: the node agent's observation half.
+
+Analog of reference internal/controllers/migagent/reporter.go:54-123:
+periodically (and on device events) read actual carved devices through the
+device client, render them as status annotations, stamp the last parsed plan
+id, and patch the node.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.kube.objects import Node
+from nos_tpu.topology import USED
+from nos_tpu.topology.annotations import strip_status_annotations
+from nos_tpu.topology.profile import shape_from_resource
+
+from nos_tpu.device.tpuclient import SliceDeviceClient
+
+from .shared import SharedState
+
+logger = logging.getLogger(__name__)
+
+
+class SliceReporter:
+    def __init__(self, api: APIServer, node_name: str,
+                 client: SliceDeviceClient, shared: SharedState) -> None:
+        self._api = api
+        self._node_name = node_name
+        self._client = client
+        self._shared = shared
+
+    def reconcile(self) -> None:
+        devices = self._client.get_devices()
+        annotations: dict[str, str] = {}
+        counts: dict[tuple[int, str, str], int] = {}
+        for d in devices:
+            shape = shape_from_resource(d.resource_name)
+            if shape is None:
+                continue
+            status = "used" if d.status == USED else "free"
+            key = (d.unit_index, shape.name, status)
+            counts[key] = counts.get(key, 0) + 1
+        for (idx, profile, status), qty in counts.items():
+            annotations[f"{C.ANNOT_STATUS_PREFIX}{idx}-{profile}-{status}"] = str(qty)
+
+        plan_id = self._shared.last_parsed_plan_id
+
+        def mutate(node: Node) -> None:
+            strip_status_annotations(node.metadata.annotations)
+            node.metadata.annotations.update(annotations)
+            if plan_id:
+                node.metadata.annotations[C.ANNOT_STATUS_PLAN] = plan_id
+
+        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        self._shared.on_report_done()
+        logger.debug("sliceagent reporter: node %s reported %d devices",
+                     self._node_name, len(devices))

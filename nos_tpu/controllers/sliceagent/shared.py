@@ -1,0 +1,50 @@
+"""Reporter/actuator handshake state.
+
+Analog of reference internal/controllers/migagent/shared.go:24-57: the
+actuator refuses to act until the reporter has observed the node at least
+once since the last apply (so plans are computed against fresh state), and
+the reporter stamps the last plan id the actuator parsed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class SharedState:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._report_since_apply = False
+        self._last_parsed_plan_id = ""
+        self._last_applied_signature: tuple | None = None
+
+    def on_report_done(self) -> None:
+        with self._lock:
+            self._report_since_apply = True
+
+    def on_apply_done(self) -> None:
+        with self._lock:
+            self._report_since_apply = False
+
+    @property
+    def at_least_one_report_since_last_apply(self) -> bool:
+        with self._lock:
+            return self._report_since_apply
+
+    @property
+    def last_parsed_plan_id(self) -> str:
+        with self._lock:
+            return self._last_parsed_plan_id
+
+    @last_parsed_plan_id.setter
+    def last_parsed_plan_id(self, value: str) -> None:
+        with self._lock:
+            self._last_parsed_plan_id = value
+
+    def is_duplicate(self, signature: tuple) -> bool:
+        with self._lock:
+            return self._last_applied_signature == signature
+
+    def record_applied(self, signature: tuple) -> None:
+        with self._lock:
+            self._last_applied_signature = signature

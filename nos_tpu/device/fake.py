@@ -1,0 +1,110 @@
+"""Fake device layer for tests and the hardware-free simulator.
+
+The analog of the mockery-generated nvml/mig/resource mocks (reference
+pkg/test/mocks/**) — but stateful: FakeTpuRuntime actually maintains carved
+devices with placements and enforces packing feasibility, so agent tests
+exercise the same geometry constraints the native shim would.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from nos_tpu.topology import (
+    Device, DeviceList, FREE, Placement, Shape, V5E, Generation,
+    extend,
+)
+from nos_tpu.topology.profile import slice_resource_name
+
+from .tpuclient import PodResourcesClient, TpuRuntimeClient
+
+
+class SliceCreationError(Exception):
+    pass
+
+
+class FakeTpuRuntime(TpuRuntimeClient):
+    def __init__(self, generation: Generation = V5E,
+                 fail_creates: bool = False) -> None:
+        self._gen = generation
+        self._lock = threading.RLock()
+        self._devices: dict[str, tuple[int, Shape, Placement]] = {}
+        self._ids = itertools.count(1)
+        self.fail_creates = fail_creates      # fault injection hook
+        self.create_calls = 0
+        self.delete_calls = 0
+
+    # -- TpuRuntimeClient ---------------------------------------------------
+    def topology(self) -> tuple[str, Shape]:
+        return self._gen.name, self._gen.host_block
+
+    def list_devices(self) -> DeviceList:
+        with self._lock:
+            out = DeviceList()
+            for did, (unit, shape, _) in sorted(self._devices.items()):
+                out.append(Device(slice_resource_name(shape), did, FREE, unit))
+            return out
+
+    def placements(self) -> dict[str, Placement]:
+        with self._lock:
+            return {did: pl for did, (_, _, pl) in self._devices.items()}
+
+    def create_slices(self, unit_index: int, shapes: list[Shape]) -> list[str]:
+        with self._lock:
+            self.create_calls += 1
+            if self.fail_creates:
+                raise SliceCreationError("injected create failure")
+            fixed = [pl for _, (u, _, pl) in self._devices.items()
+                     if u == unit_index]
+            counts: dict[Shape, int] = {}
+            for s in shapes:
+                counts[s.canonical()] = counts.get(s.canonical(), 0) + 1
+            placements = extend(self._gen.host_block, fixed, counts)
+            if placements is None:
+                # all-or-nothing: nothing was created, nothing to clean up
+                raise SliceCreationError(
+                    f"cannot place {[s.name for s in shapes]} on unit "
+                    f"{unit_index} around {len(fixed)} existing devices"
+                )
+            created = []
+            for pl in placements:
+                did = f"tpu-{unit_index}-{pl.shape.name}-{next(self._ids)}"
+                self._devices[did] = (unit_index, pl.shape, pl)
+                created.append(did)
+            return created
+
+    def delete_slice(self, device_id: str) -> None:
+        with self._lock:
+            self.delete_calls += 1
+            if device_id not in self._devices:
+                from nos_tpu.topology.errors import DeviceNotFoundError
+                raise DeviceNotFoundError(device_id)
+            del self._devices[device_id]
+
+    def delete_all_except(self, keep: set[str]) -> list[str]:
+        with self._lock:
+            doomed = [d for d in self._devices if d not in keep]
+            for d in doomed:
+                del self._devices[d]
+            return doomed
+
+
+class FakePodResources(PodResourcesClient):
+    """Used-device tracking; the simulator marks devices used/free as pods
+    bind/terminate (standing in for the kubelet pod-resources socket)."""
+
+    def __init__(self) -> None:
+        self._used: dict[str, set[str]] = {}      # pod key -> device ids
+
+    def allocate(self, pod_key: str, device_ids: set[str]) -> None:
+        self._used[pod_key] = set(device_ids)
+
+    def release(self, pod_key: str) -> None:
+        self._used.pop(pod_key, None)
+
+    def used_device_ids(self) -> set[str]:
+        out: set[str] = set()
+        for ids in self._used.values():
+            out |= ids
+        return out

@@ -1,0 +1,52 @@
+"""Device-plugin re-advertisement.
+
+The reference reloads device inventory by *deleting the device-plugin pod*
+and waiting for recreation (pkg/gpu/client.go:37-135 — the "restart hammer").
+SURVEY.md §2.8 calls out config-driven re-advertisement as the better
+template; here the plugin client recomputes the node's extended-resource
+allocatable directly from the runtime's device list and stamps a
+generation annotation, giving the decision plane a readiness signal instead
+of the reference's blind sleep (mps/partitioner.go:99-100).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.kube.objects import Node
+
+from .tpuclient import TpuRuntimeClient
+
+
+class DevicePluginClient:
+    def __init__(self, api: APIServer, node_name: str,
+                 runtime: TpuRuntimeClient) -> None:
+        self._api = api
+        self._node_name = node_name
+        self._runtime = runtime
+
+    def refresh(self) -> int:
+        """Re-advertise slice resources from carved devices; returns the new
+        plugin generation."""
+        counts: dict[str, int] = {}
+        for d in self._runtime.list_devices():
+            counts[d.resource_name] = counts.get(d.resource_name, 0) + 1
+
+        new_gen = 0
+
+        def mutate(node: Node) -> None:
+            nonlocal new_gen
+            for table in (node.status.allocatable, node.status.capacity):
+                for res in [r for r in table
+                            if r.startswith(C.RESOURCE_SLICE_PREFIX)]:
+                    del table[res]
+            for res, qty in counts.items():
+                node.status.allocatable[res] = float(qty)
+            node.status.capacity.update(node.status.allocatable)
+            new_gen = int(
+                node.metadata.annotations.get(C.ANNOT_PLUGIN_GENERATION, "0")
+            ) + 1
+            node.metadata.annotations[C.ANNOT_PLUGIN_GENERATION] = str(new_gen)
+
+        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        return new_gen

@@ -1,0 +1,85 @@
+"""Device-layer interfaces: the native boundary seam.
+
+Analogs of reference pkg/gpu/nvml/interface.go:23-35 (`nvml.Client` — the CGo
+boundary), pkg/gpu/mig/client.go:28-35 (`mig.Client` — node-local
+orchestration of NVML ∩ kubelet pod-resources), and pkg/resource/client.go:26-29
+(`resource.Client` — kubelet pod-resources gRPC).
+
+Everything above this seam is testable with fakes (nos_tpu/device/fake.py),
+exactly as the reference hides NVML behind `nvml.Client` so the whole control
+plane runs hardware-free (SURVEY.md §2, §4).  The production implementation
+is the C++ shim in nos_tpu/native loaded via ctypes (nos_tpu/device/native.py),
+standing in for the Cloud TPU API + libtpu topology introspection.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from nos_tpu.topology import DeviceList, Placement, Shape
+
+
+class TpuRuntimeClient(ABC):
+    """The native boundary: slice device lifecycle on one host."""
+
+    @abstractmethod
+    def topology(self) -> tuple[str, Shape]:
+        """(accelerator name, host chip block) from libtpu metadata."""
+
+    @abstractmethod
+    def list_devices(self) -> DeviceList:
+        """All carved slice devices on this host (no used/free knowledge)."""
+
+    @abstractmethod
+    def placements(self) -> dict[str, Placement]:
+        """device id -> placement within the host block."""
+
+    @abstractmethod
+    def create_slices(self, unit_index: int, shapes: list[Shape]) -> list[str]:
+        """Carve new slice devices, searching placements around existing
+        ones; all-or-nothing with cleanup on failure (the analog of the NVML
+        permutation search, reference pkg/gpu/nvml/client.go:286-340)."""
+
+    @abstractmethod
+    def delete_slice(self, device_id: str) -> None: ...
+
+    @abstractmethod
+    def delete_all_except(self, device_ids: set[str]) -> list[str]:
+        """Startup cleanup (reference cmd/migagent/migagent.go:190-199)."""
+
+
+class PodResourcesClient(ABC):
+    """Which device ids are allocated to running pods (kubelet
+    pod-resources socket analog, reference pkg/resource/lister.go:28)."""
+
+    @abstractmethod
+    def used_device_ids(self) -> set[str]: ...
+
+
+class SliceDeviceClient:
+    """mig.Client analog: runtime devices ∩ pod-resources usage ->
+    used/free DeviceList (reference pkg/gpu/mig/client.go:28-174)."""
+
+    def __init__(self, runtime: TpuRuntimeClient,
+                 pod_resources: PodResourcesClient) -> None:
+        self.runtime = runtime
+        self.pod_resources = pod_resources
+
+    def get_devices(self) -> DeviceList:
+        from nos_tpu.topology import Device, FREE, USED
+
+        used_ids = self.pod_resources.used_device_ids()
+        out = DeviceList()
+        for d in self.runtime.list_devices():
+            status = USED if d.device_id in used_ids else FREE
+            out.append(Device(d.resource_name, d.device_id, status, d.unit_index))
+        return out
+
+    def create_slices(self, unit_index: int, shapes: list[Shape]) -> list[str]:
+        return self.runtime.create_slices(unit_index, shapes)
+
+    def delete_slice(self, device_id: str) -> None:
+        self.runtime.delete_slice(device_id)
+
+    def delete_all_except(self, keep: set[str]) -> list[str]:
+        return self.runtime.delete_all_except(keep)

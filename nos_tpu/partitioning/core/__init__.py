@@ -1,0 +1,20 @@
+"""Device-agnostic partitioning engine core (reference internal/partitioning/core/)."""
+
+from .interfaces import (
+    Actuator, NodeInitializer, PartitionableNode, PartitionCalculator,
+    Partitioner, Planner, ProfileRequest, SliceCalculator, SliceFilter,
+    SnapshotTaker, Sorter,
+)
+from .snapshot import ClusterSnapshot, SnapshotError
+from .tracker import SliceTracker
+from .sorter import ProfileAwareSorter
+from .planner import GeometryPlanner
+from .actuator import GeometryActuator, new_plan_id
+
+__all__ = [
+    "Actuator", "NodeInitializer", "PartitionableNode", "PartitionCalculator",
+    "Partitioner", "Planner", "ProfileRequest", "SliceCalculator",
+    "SliceFilter", "SnapshotTaker", "Sorter",
+    "ClusterSnapshot", "SnapshotError", "SliceTracker", "ProfileAwareSorter",
+    "GeometryPlanner", "GeometryActuator", "new_plan_id",
+]

@@ -1,0 +1,64 @@
+"""The partitioning actuator: diff desired vs current, delegate to strategy.
+
+Analog of reference internal/partitioning/core/actuator.go:39-66: skip if the
+desired state is empty or equals the current state; otherwise call the
+strategy Partitioner per changed node under a fresh plan id.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+
+from ..state import PartitioningState
+from .interfaces import Actuator, PartitionCalculator, Partitioner
+from .snapshot import ClusterSnapshot
+
+logger = logging.getLogger(__name__)
+
+
+def new_plan_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+def compute_partitioning_state(
+    snapshot: ClusterSnapshot,
+    partition_calculator: PartitionCalculator,
+) -> PartitioningState:
+    """Desired-state derivation shared by planner and actuator — a single
+    implementation so their desired-vs-current diff can never drift."""
+    state = PartitioningState()
+    for name, node in snapshot.nodes().items():
+        state[name] = partition_calculator.node_partitioning(node)
+    return state
+
+
+class GeometryActuator(Actuator):
+    def __init__(self, partitioner: Partitioner,
+                 partition_calculator: PartitionCalculator) -> None:
+        self._partitioner = partitioner
+        self._partition_calculator = partition_calculator
+
+    def current_state(self, snapshot: ClusterSnapshot) -> PartitioningState:
+        return compute_partitioning_state(snapshot, self._partition_calculator)
+
+    def apply(self, snapshot: ClusterSnapshot,
+              desired: PartitioningState) -> bool:
+        """Returns True if anything was actuated."""
+        if desired.empty:
+            logger.debug("actuator: desired state empty, skipping")
+            return False
+        current = self.current_state(snapshot)
+        if desired.equal(current):
+            logger.debug("actuator: desired equals current, skipping")
+            return False
+        plan_id = new_plan_id()
+        changed = False
+        for node_name, node_partitioning in desired.items():
+            if node_name in current and current[node_name] == node_partitioning:
+                continue
+            self._partitioner.apply_partitioning(
+                node_name, plan_id, node_partitioning
+            )
+            changed = True
+        return changed

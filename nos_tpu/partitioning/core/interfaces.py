@@ -1,0 +1,123 @@
+"""The strategy seam of the partitioning engine.
+
+Direct analog of reference internal/partitioning/core/interface.go:27-77 —
+these interfaces are deliberately device-agnostic (nothing in core/ imports a
+concrete strategy), so the slice (MIG-analog) and timeshare (MPS-analog)
+strategies plug in the same way mig/mps do in the reference.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import TYPE_CHECKING, Mapping
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.kube.resources import ResourceList
+
+if TYPE_CHECKING:
+    from nos_tpu.scheduler.framework import NodeInfo
+    from ..state import PartitioningState
+    from .snapshot import ClusterSnapshot
+
+# Profile names are strings ("2x2" slice shape or "8gb" timeshare size).
+ProfileRequest = dict[str, int]
+
+
+class PartitionableNode(ABC):
+    """A node whose accelerator geometry can be re-carved.  For multi-host
+    TPU slices the same protocol is implemented by a group facade spanning
+    hosts (SURVEY.md §7 hard part 4) while annotations stay per-node."""
+
+    @property
+    @abstractmethod
+    def name(self) -> str: ...
+
+    @abstractmethod
+    def node_info(self) -> "NodeInfo":
+        """The scheduling view; update_geometry_for must mutate its
+        allocatable scalars so the simulation sees hypothetical geometry
+        (reference pkg/gpu/mig/node.go:171-195)."""
+
+    @abstractmethod
+    def update_geometry_for(self, lacking: ProfileRequest) -> bool: ...
+
+    @abstractmethod
+    def add_pod(self, pod: Pod) -> bool:
+        """First-fit the pod's profile requests onto free devices."""
+
+    @abstractmethod
+    def geometries(self) -> dict[int, dict[str, int]]:
+        """unit index -> profile -> quantity (desired geometry view)."""
+
+    @abstractmethod
+    def clone(self) -> "PartitionableNode": ...
+
+
+class SliceCalculator(ABC):
+    """Pod -> requested profiles (reference mig/slice_calculator.go:30-37)."""
+
+    @abstractmethod
+    def requested_profiles(self, pod: Pod) -> ProfileRequest: ...
+
+
+class SliceFilter(ABC):
+    """Restrict a resource list to this strategy's profile resources
+    (reference mig/slice_filter.go:30-39)."""
+
+    @abstractmethod
+    def extract_profiles(self, resources: ResourceList) -> ProfileRequest: ...
+
+
+class PartitionCalculator(ABC):
+    """Node geometry -> desired NodePartitioning
+    (reference mig/partitition_calculator.go:30-46)."""
+
+    @abstractmethod
+    def node_partitioning(self, node: PartitionableNode) -> "NodePartitioning": ...
+
+
+class Partitioner(ABC):
+    """Actuation strategy: write the desired partitioning where the node
+    agents (or device plugin) will pick it up
+    (reference mig/partitioner.go:43-75, mps/partitioner.go:61-157)."""
+
+    @abstractmethod
+    def apply_partitioning(self, node_name: str, plan_id: str,
+                           partitioning: "NodePartitioning") -> None: ...
+
+
+class NodeInitializer(ABC):
+    """Apply the fewest-slices geometry to virgin nodes
+    (reference mig/initializer.go:44-83)."""
+
+    @abstractmethod
+    def init_node_partitioning(self, node_name: str) -> None: ...
+
+
+class SnapshotTaker(ABC):
+    """Build a strategy-specific snapshot from cluster state
+    (reference mig/snapshot_taker.go:31-53)."""
+
+    @abstractmethod
+    def take_snapshot(self, cluster_state) -> "ClusterSnapshot": ...
+
+
+class Sorter(ABC):
+    @abstractmethod
+    def sort(self, pods: list[Pod]) -> list[Pod]: ...
+
+
+class Planner(ABC):
+    @abstractmethod
+    def plan(self, snapshot: "ClusterSnapshot",
+             pending_pods: list[Pod]) -> "PartitioningState": ...
+
+
+class Actuator(ABC):
+    @abstractmethod
+    def apply(self, snapshot: "ClusterSnapshot",
+              desired: "PartitioningState") -> bool: ...
+
+
+# Re-exported here to keep the interface module self-contained for readers.
+from ..state import NodePartitioning  # noqa: E402  (cycle-free: state has no core imports)

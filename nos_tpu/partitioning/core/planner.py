@@ -1,0 +1,105 @@
+"""The partitioning planner: greedy geometry search with scheduler simulation.
+
+Analog of reference internal/partitioning/core/planner.go:67-207.  The loop:
+
+1. Track the profiles the pending batch lacks cluster-wide (SliceTracker).
+2. Sort pods: priority desc, smaller-profile-first (ProfileAwareSorter).
+3. For each candidate node: fork the snapshot, re-carve the node's geometry
+   toward the lacking profiles (`update_geometry_for` — hot loop #1), then
+   try each pending pod through the real scheduler framework's
+   PreFilter+Filter pipeline against the hypothetical NodeInfo (hot loop #2).
+   Commit the fork if at least one pod became schedulable, else revert.
+4. Return the desired PartitioningState for every node.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.scheduler.framework import CycleState, Framework, SharedLister
+
+from ..state import PartitioningState
+from .actuator import compute_partitioning_state
+from .interfaces import (
+    PartitionCalculator, Planner, SliceCalculator, Sorter,
+)
+from .snapshot import ClusterSnapshot
+from .sorter import ProfileAwareSorter
+from .tracker import SliceTracker
+
+logger = logging.getLogger(__name__)
+
+
+class GeometryPlanner(Planner):
+    def __init__(self, framework: Framework, calculator: SliceCalculator,
+                 partition_calculator: PartitionCalculator,
+                 sorter: Sorter | None = None) -> None:
+        self._framework = framework
+        self._calculator = calculator
+        self._partition_calculator = partition_calculator
+        self._sorter = sorter or ProfileAwareSorter(calculator)
+
+    # -- public ------------------------------------------------------------
+    def plan(self, snapshot: ClusterSnapshot,
+             pending_pods: list[Pod]) -> PartitioningState:
+        tracker = SliceTracker(snapshot, self._calculator, pending_pods)
+        if tracker.empty:
+            return compute_partitioning_state(snapshot, self._partition_calculator)
+
+        pods = [
+            p for p in self._sorter.sort(pending_pods)
+            if self._calculator.requested_profiles(p)
+        ]
+        # iterate by NAME and re-fetch after fork/revert: revert() swaps the
+        # snapshot's node objects, so a captured reference would be detached
+        candidate_names = [n.name for n in snapshot.get_candidate_nodes()]
+        for node_name in candidate_names:
+            if tracker.empty:
+                break
+            snapshot.fork()
+            node = snapshot.get_node(node_name)
+            changed = node.update_geometry_for(tracker.lacking)
+            # build the what-if lister once per fork; NodeInfos are live
+            # references, so later add_pods stay visible (hot loop #2)
+            lister = SharedLister(
+                pn.node_info() for pn in snapshot.nodes().values()
+            )
+            placed = 0
+            for pod in list(pods):
+                if tracker.empty:
+                    break
+                if self._try_add_pod(snapshot, lister, node_name, pod):
+                    tracker.remove(pod)
+                    pods.remove(pod)
+                    placed += 1
+            if placed > 0:
+                snapshot.commit()
+                logger.debug("planner: node %s re-carved (changed=%s, placed=%d)",
+                             node_name, changed, placed)
+            else:
+                snapshot.revert()
+        return compute_partitioning_state(snapshot, self._partition_calculator)
+
+    # -- internals ----------------------------------------------------------
+    def _try_add_pod(self, snapshot: ClusterSnapshot, lister: SharedLister,
+                     node_name: str, pod: Pod) -> bool:
+        if not self._can_schedule(snapshot, lister, node_name, pod):
+            return False
+        try:
+            snapshot.add_pod(node_name, pod)
+        except Exception:
+            return False
+        return True
+
+    def _can_schedule(self, snapshot: ClusterSnapshot, lister: SharedLister,
+                      node_name: str, pod: Pod) -> bool:
+        """Run the real framework's PreFilter + Filter against the
+        hypothetical NodeInfo (reference planner.go:178-207)."""
+        node = snapshot.get_node(node_name)
+        state = CycleState()
+        status = self._framework.run_pre_filter_plugins(state, pod, lister)
+        if not status.is_success:
+            return False
+        status = self._framework.run_filter_plugins(state, pod, node.node_info())
+        return status.is_success

@@ -1,0 +1,93 @@
+"""Forkable in-memory cluster snapshot.
+
+Analog of reference internal/partitioning/core/snapshot.go:43-191
+(clusterSnapshot): the planner forks the snapshot per candidate node, mutates
+geometry hypothetically, simulates scheduling, then commits or reverts.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.kube.resources import (
+    negatives_only, pod_request, subtract, sum_resources,
+)
+
+from .interfaces import PartitionableNode, SliceFilter
+
+
+class SnapshotError(Exception):
+    pass
+
+
+class ClusterSnapshot:
+    def __init__(self, nodes: Mapping[str, PartitionableNode],
+                 slice_filter: SliceFilter) -> None:
+        self._nodes: dict[str, PartitionableNode] = dict(nodes)
+        self._filter = slice_filter
+        self._forked: dict[str, PartitionableNode] | None = None
+
+    # -- fork/commit/revert (snapshot.go:85-117) ---------------------------
+    def fork(self) -> None:
+        if self._forked is not None:
+            raise SnapshotError("snapshot already forked")
+        self._forked = {n: pn.clone() for n, pn in self._nodes.items()}
+
+    def commit(self) -> None:
+        self._forked = None
+
+    def revert(self) -> None:
+        if self._forked is None:
+            raise SnapshotError("snapshot not forked")
+        self._nodes = self._forked
+        self._forked = None
+
+    @property
+    def forked(self) -> bool:
+        return self._forked is not None
+
+    def clone(self) -> "ClusterSnapshot":
+        """Independent copy — the controller plans on a clone so the actuator
+        can diff desired against the unmutated current state (reference
+        partitioner_controller.go:178-193 planning on snapshot.Clone())."""
+        return ClusterSnapshot(
+            {n: pn.clone() for n, pn in self._nodes.items()}, self._filter
+        )
+
+    # -- views -------------------------------------------------------------
+    def nodes(self) -> dict[str, PartitionableNode]:
+        return dict(self._nodes)
+
+    def get_node(self, name: str) -> PartitionableNode:
+        return self._nodes[name]
+
+    def get_candidate_nodes(self) -> list[PartitionableNode]:
+        """Nodes with any free (unrequested) capacity, sorted by name for
+        determinism (reference snapshot.go:119-130)."""
+        out = []
+        for name in sorted(self._nodes):
+            ni = self._nodes[name].node_info()
+            if any(v > 0 for v in ni.free().values()):
+                out.append(self._nodes[name])
+        return out
+
+    def get_lacking_slices(self, pod: Pod) -> dict[str, int]:
+        """Cluster-wide: (allocatable - requested) - podRequest, negatives
+        only, restricted to profile resources (reference snapshot.go:132-165).
+        Returned as profile name -> missing quantity."""
+        free: dict[str, float] = {}
+        for pn in self._nodes.values():
+            free = sum_resources(free, pn.node_info().free())
+        free = {k: max(0.0, v) for k, v in free.items()}
+        lacking_resources = negatives_only(subtract(free, pod_request(pod)))
+        return self._filter.extract_profiles(lacking_resources)
+
+    def add_pod(self, node_name: str, pod: Pod) -> None:
+        """Bind the pod in the snapshot (snapshot.go AddPod): the node's
+        first-fit device accounting plus NodeInfo bookkeeping."""
+        node = self._nodes.get(node_name)
+        if node is None:
+            raise SnapshotError(f"unknown node {node_name}")
+        if not node.add_pod(pod):
+            raise SnapshotError(f"pod {pod.key} does not fit node {node_name}")

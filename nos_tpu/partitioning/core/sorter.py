@@ -1,0 +1,33 @@
+"""Pod ordering for the planner.
+
+Analog of reference internal/partitioning/core/util.go:34-71: priority
+descending, then smaller-profile-first (so small pods pack before large ones
+fragment the geometry), then creation time, then name for determinism.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.topology.profile import profile_sort_key
+
+from .interfaces import SliceCalculator, Sorter
+
+
+class ProfileAwareSorter(Sorter):
+    def __init__(self, calculator: SliceCalculator) -> None:
+        self._calculator = calculator
+
+    def sort(self, pods: list[Pod]) -> list[Pod]:
+        def key(pod: Pod):
+            requested = self._calculator.requested_profiles(pod)
+            smallest = min(
+                (profile_sort_key(p) for p in requested), default=(0, "")
+            )
+            return (
+                -pod.spec.priority,
+                smallest,
+                pod.metadata.creation_timestamp,
+                pod.key,
+            )
+
+        return sorted(pods, key=key)

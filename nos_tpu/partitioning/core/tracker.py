@@ -1,0 +1,51 @@
+"""SliceTracker: bookkeeping of requested/lacking profiles per pod batch.
+
+Analog of reference internal/partitioning/core/tracker.go:26-88.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.objects import Pod
+
+from .interfaces import SliceCalculator
+from .snapshot import ClusterSnapshot
+
+
+class SliceTracker:
+    def __init__(self, snapshot: ClusterSnapshot, calculator: SliceCalculator,
+                 pods: list[Pod]) -> None:
+        self._calculator = calculator
+        self._requested: dict[str, int] = {}
+        self._lacking: dict[str, int] = {}
+        self._pod_lacking: dict[str, dict[str, int]] = {}
+        for pod in pods:
+            requested = calculator.requested_profiles(pod)
+            if not requested:
+                continue
+            for profile, qty in requested.items():
+                self._requested[profile] = self._requested.get(profile, 0) + qty
+            lacking = snapshot.get_lacking_slices(pod)
+            if lacking:
+                self._pod_lacking[pod.key] = lacking
+                for profile, qty in lacking.items():
+                    self._lacking[profile] = self._lacking.get(profile, 0) + qty
+
+    @property
+    def requested(self) -> dict[str, int]:
+        return dict(self._requested)
+
+    @property
+    def lacking(self) -> dict[str, int]:
+        return {k: v for k, v in self._lacking.items() if v > 0}
+
+    @property
+    def empty(self) -> bool:
+        return not self.lacking
+
+    def remove(self, pod: Pod) -> None:
+        """Decrement on successful placement (tracker.go Remove)."""
+        lacking = self._pod_lacking.pop(pod.key, None)
+        if not lacking:
+            return
+        for profile, qty in lacking.items():
+            self._lacking[profile] = max(0, self._lacking.get(profile, 0) - qty)

@@ -1,0 +1,19 @@
+"""Slice partitioning strategy — the MIG analog (reference internal/partitioning/mig/)."""
+
+from .node import SliceNode, units_from_node
+from .calculators import (
+    SliceProfileCalculator, SliceProfileFilter, SlicePartitionCalculator,
+)
+from .partitioner import (
+    SlicePartitioner, SliceNodeInitializer, is_node_initialized,
+)
+from .snapshot_taker import (
+    SliceSnapshotTaker, SLICE_KIND, TIMESHARE_KIND, HYBRID_KIND,
+)
+
+__all__ = [
+    "SliceNode", "units_from_node",
+    "SliceProfileCalculator", "SliceProfileFilter", "SlicePartitionCalculator",
+    "SlicePartitioner", "SliceNodeInitializer", "is_node_initialized",
+    "SliceSnapshotTaker", "SLICE_KIND", "TIMESHARE_KIND", "HYBRID_KIND",
+]

@@ -1,0 +1,48 @@
+"""Slice strategy calculators and filters.
+
+Analogs of reference internal/partitioning/mig/{slice_calculator.go:30-37,
+slice_filter.go:30-39, partitition_calculator.go:30-46}.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.kube.resources import ResourceList, pod_request
+from nos_tpu.topology.profile import (
+    extract_slice_requests, slice_resource_name,
+)
+
+from ..core.interfaces import (
+    PartitionableNode, PartitionCalculator, ProfileRequest,
+    SliceCalculator, SliceFilter,
+)
+from ..state import NodePartitioning, UnitPartitioning
+
+
+class SliceProfileCalculator(SliceCalculator):
+    def requested_profiles(self, pod: Pod) -> ProfileRequest:
+        return {
+            s.name: q for s, q in extract_slice_requests(pod_request(pod)).items()
+        }
+
+
+class SliceProfileFilter(SliceFilter):
+    def extract_profiles(self, resources: ResourceList) -> ProfileRequest:
+        return {
+            s.name: int(q)
+            for s, q in extract_slice_requests(dict(resources)).items()
+        }
+
+
+class SlicePartitionCalculator(PartitionCalculator):
+    def node_partitioning(self, node: PartitionableNode) -> NodePartitioning:
+        units = []
+        for idx, geometry in sorted(node.geometries().items()):
+            units.append(UnitPartitioning(
+                index=idx,
+                resources={
+                    slice_resource_name(profile): qty
+                    for profile, qty in geometry.items() if qty > 0
+                },
+            ))
+        return NodePartitioning(units=units)

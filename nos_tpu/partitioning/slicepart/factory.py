@@ -1,0 +1,46 @@
+"""Wiring for the slice partitioning controller.
+
+Analog of reference internal/partitioning/mig/factory.go:31-64.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.client import APIServer
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.utils.batcher import Batcher
+
+from ..core import GeometryActuator, GeometryPlanner
+from ..state import ClusterState
+from .calculators import SlicePartitionCalculator, SliceProfileCalculator
+from .partitioner import SliceNodeInitializer, SlicePartitioner
+from .snapshot_taker import SLICE_KIND, SliceSnapshotTaker
+
+
+def new_slice_partitioner_controller(
+    api: APIServer, cluster_state: ClusterState,
+    framework: Framework | None = None,
+    batch_timeout_s: float = 60.0, batch_idle_s: float = 10.0,
+    clock=None,
+):
+    from nos_tpu.controllers.partitioner_controller import PartitionerController
+
+    partition_calculator = SlicePartitionCalculator()
+    planner = GeometryPlanner(
+        framework=framework or Framework(),
+        calculator=SliceProfileCalculator(),
+        partition_calculator=partition_calculator,
+    )
+    actuator = GeometryActuator(SlicePartitioner(api), partition_calculator)
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+    return PartitionerController(
+        api=api, cluster_state=cluster_state, kind=SLICE_KIND,
+        planner=planner, actuator=actuator,
+        snapshot_taker=SliceSnapshotTaker(), batcher=batcher,
+    )
+
+
+def new_slice_initializer(api: APIServer) -> SliceNodeInitializer:
+    return SliceNodeInitializer(api)

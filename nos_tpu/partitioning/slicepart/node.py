@@ -1,0 +1,128 @@
+"""slicepart.Node: PartitionableNode implementation for slice partitioning.
+
+Analog of reference pkg/gpu/mig/node.go:26-222: builds SliceUnits from the
+node's status annotations + topology labels, and keeps the embedded
+NodeInfo's allocatable scalars in sync with the (possibly hypothetical)
+geometry so the scheduler simulation sees it (node.go:171-195).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.objects import Node, Pod
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.scheduler.framework import NodeInfo
+from nos_tpu.topology import Shape, SliceUnit, TopologyRegistry, DEFAULT_REGISTRY
+from nos_tpu.topology.annotations import parse_status_annotations
+from nos_tpu.topology.profile import (
+    extract_slice_requests, slice_resource_name,
+)
+
+from ..core.interfaces import PartitionableNode, ProfileRequest
+
+
+def units_from_node(node: Node,
+                    registry: TopologyRegistry = DEFAULT_REGISTRY) -> list[SliceUnit]:
+    """Reconstruct per-unit used/free state from status annotations
+    (the agent-reported observed geometry)."""
+    accel = node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
+    gen = registry.get(accel)
+    units: dict[int, SliceUnit] = {}
+    for a in parse_status_annotations(node.metadata.annotations):
+        if "x" not in a.profile:
+            continue  # timeshare annotation on a hybrid node
+        unit = units.setdefault(a.index, SliceUnit(generation=gen, index=a.index))
+        shape = Shape.parse(a.profile).canonical()
+        table = unit.used if a.status == "used" else unit.free
+        table[shape] = table.get(shape, 0) + a.quantity
+    if not units:
+        units[0] = SliceUnit(generation=gen, index=0)
+    return [units[i] for i in sorted(units)]
+
+
+class SliceNode(PartitionableNode):
+    def __init__(self, node: Node, node_info: NodeInfo,
+                 registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
+        self._name = node.metadata.name
+        self._node_info = node_info
+        self._registry = registry
+        self.units = units_from_node(node, registry)
+        self.generation = registry.get(
+            node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
+        )
+        self._sync_allocatable()
+
+    # -- PartitionableNode --------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def node_info(self) -> NodeInfo:
+        return self._node_info
+
+    def update_geometry_for(self, lacking: ProfileRequest) -> bool:
+        remaining = {
+            Shape.parse(p).canonical(): q for p, q in lacking.items()
+            if "x" in p and q > 0
+        }
+        changed = False
+        for unit in self.units:
+            if not remaining:
+                break
+            if unit.update_geometry_for(remaining):
+                changed = True
+            for shape in list(remaining):
+                provided = unit.free.get(shape, 0)
+                if provided:
+                    remaining[shape] -= provided
+                    if remaining[shape] <= 0:
+                        del remaining[shape]
+        if changed:
+            self._sync_allocatable()
+        return changed
+
+    def add_pod(self, pod: Pod) -> bool:
+        requests = extract_slice_requests(pod_request(pod))
+        # all-or-nothing first-fit across units (reference node.go AddPod)
+        staged: list[tuple[SliceUnit, Shape]] = []
+        for shape, qty in requests.items():
+            for _ in range(qty):
+                for unit in self.units:
+                    if unit.allocate(shape):
+                        staged.append((unit, shape))
+                        break
+                else:
+                    for u, s in staged:
+                        u.release(s)
+                    return False
+        self._node_info.add_pod(pod)
+        return True
+
+    def geometries(self) -> dict[int, dict[str, int]]:
+        return {u.index: u.geometry_names() for u in self.units}
+
+    def clone(self) -> "SliceNode":
+        c = object.__new__(SliceNode)
+        c._name = self._name
+        c._node_info = self._node_info.clone()
+        c._registry = self._registry
+        c.units = copy.deepcopy(self.units)
+        c.generation = self.generation
+        return c
+
+    # -- internals ----------------------------------------------------------
+    def _sync_allocatable(self) -> None:
+        """Recompute slice-resource allocatables from unit geometry so the
+        embedded NodeInfo reflects the hypothetical state
+        (reference node.go:171-195)."""
+        alloc = self._node_info.node.status.allocatable
+        for res in [r for r in alloc if r.startswith(C.RESOURCE_SLICE_PREFIX)]:
+            del alloc[res]
+        totals: dict[str, int] = {}
+        for unit in self.units:
+            for profile, qty in unit.geometry_names().items():
+                res = slice_resource_name(profile)
+                totals[res] = totals.get(res, 0) + qty
+        alloc.update(totals)

@@ -1,0 +1,81 @@
+"""Slice strategy actuation: write spec annotations + plan id to the node.
+
+Analog of reference internal/partitioning/mig/partitioner.go:43-75 and
+initializer.go:44-83.  The decision plane never touches devices — it patches
+node annotations; the node agent (controllers/sliceagent) actuates.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.kube.objects import Node
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+from nos_tpu.topology.annotations import (
+    spec_from_geometries, strip_spec_annotations,
+)
+from nos_tpu.topology.profile import shape_from_resource
+
+from ..core.actuator import new_plan_id
+from ..core.interfaces import NodeInitializer, Partitioner
+from ..state import NodePartitioning
+
+logger = logging.getLogger(__name__)
+
+
+class SlicePartitioner(Partitioner):
+    def __init__(self, api: APIServer) -> None:
+        self._api = api
+
+    def apply_partitioning(self, node_name: str, plan_id: str,
+                           partitioning: NodePartitioning) -> None:
+        geometries: dict[int, dict[str, int]] = {}
+        for unit in partitioning.units:
+            profiles: dict[str, int] = {}
+            for res, qty in unit.resources.items():
+                shape = shape_from_resource(res)
+                if shape is not None and qty > 0:
+                    profiles[shape.name] = profiles.get(shape.name, 0) + qty
+            geometries[unit.index] = profiles
+
+        def mutate(node: Node) -> None:
+            strip_spec_annotations(node.metadata.annotations)
+            node.metadata.annotations.update(spec_from_geometries(geometries))
+            node.metadata.annotations[C.ANNOT_SPEC_PLAN] = plan_id
+
+        self._api.patch(KIND_NODE, node_name, mutate=mutate)
+        logger.info("slicepart: node %s spec updated (plan %s)", node_name, plan_id)
+
+
+class SliceNodeInitializer(NodeInitializer):
+    """Virgin nodes get the fewest-slices geometry — one whole-block slice
+    per unit (reference mig/initializer.go:58-83)."""
+
+    def __init__(self, api: APIServer,
+                 registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
+        self._api = api
+        self._registry = registry
+
+    def init_node_partitioning(self, node_name: str) -> None:
+        node = self._api.get(KIND_NODE, node_name)
+        accel = node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
+        gen = self._registry.get(accel)
+        geometries = {0: {gen.host_block.canonical().name: 1}}
+
+        def mutate(n: Node) -> None:
+            strip_spec_annotations(n.metadata.annotations)
+            n.metadata.annotations.update(spec_from_geometries(geometries))
+            n.metadata.annotations[C.ANNOT_SPEC_PLAN] = new_plan_id()
+
+        self._api.patch(KIND_NODE, node_name, mutate=mutate)
+        logger.info("slicepart: initialized virgin node %s", node_name)
+
+
+def is_node_initialized(node: Node) -> bool:
+    """A node is initialized once it carries any spec annotation
+    (reference core/util.go:76-83)."""
+    return any(
+        C.SPEC_ANNOT_RE.match(k) for k in node.metadata.annotations
+    )

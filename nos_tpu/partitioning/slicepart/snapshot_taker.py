@@ -1,0 +1,41 @@
+"""Slice strategy snapshot taker.
+
+Analog of reference internal/partitioning/mig/snapshot_taker.go:31-53:
+filter cluster nodes labeled for slice partitioning and wrap them as
+PartitionableNodes around the live NodeInfo view.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+
+from ..core.interfaces import SnapshotTaker
+from ..core.snapshot import ClusterSnapshot
+from ..state import ClusterState
+from .calculators import SliceProfileFilter
+from .node import SliceNode
+
+SLICE_KIND = "slice"
+TIMESHARE_KIND = "timeshare"
+HYBRID_KIND = "hybrid"
+
+
+class SliceSnapshotTaker(SnapshotTaker):
+    def __init__(self, registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
+        self._registry = registry
+
+    def take_snapshot(self, cluster_state: ClusterState) -> ClusterSnapshot:
+        infos = cluster_state.node_infos()
+        nodes = {}
+        for name, node in cluster_state.nodes().items():
+            kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+            if kind not in (SLICE_KIND, HYBRID_KIND):
+                continue
+            if node.metadata.labels.get(C.LABEL_ACCELERATOR, "") not in \
+                    self._registry.generations:
+                continue
+            # build from the deep-copied NodeInfo's node: SliceNode mutates
+            # allocatable, which must never write through to ClusterState
+            nodes[name] = SliceNode(infos[name].node, infos[name], self._registry)
+        return ClusterSnapshot(nodes, SliceProfileFilter())

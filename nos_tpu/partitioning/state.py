@@ -1,0 +1,151 @@
+"""Cluster state and desired-partitioning state types.
+
+Analogs of reference internal/partitioning/state/state.go:29-222
+(`ClusterState`: mutex-guarded node/pod bookkeeping fed by controllers) and
+partitioning.go:24-56 (`PartitioningState` with order-insensitive equality).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.objects import Node, Pod
+from nos_tpu.scheduler.framework import NodeInfo
+
+# ---------------------------------------------------------------------------
+# Desired state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class UnitPartitioning:
+    """Desired profile quantities for one partition root (GPUPartitioning
+    analog: GPUIndex + Resources)."""
+
+    index: int
+    resources: dict[str, int] = field(default_factory=dict)  # resource name -> qty
+
+
+@dataclass
+class NodePartitioning:
+    units: list[UnitPartitioning] = field(default_factory=list)
+
+    def _canon(self) -> dict[int, dict[str, int]]:
+        out: dict[int, dict[str, int]] = {}
+        for u in self.units:
+            res = out.setdefault(u.index, {})
+            for k, v in u.resources.items():
+                if v > 0:
+                    res[k] = res.get(k, 0) + v
+        return {i: r for i, r in out.items() if r}
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, NodePartitioning):
+            return NotImplemented
+        return self._canon() == other._canon()
+
+
+class PartitioningState(dict):
+    """node name -> NodePartitioning, order-insensitive equality
+    (reference partitioning.go:40-56)."""
+
+    def equal(self, other: "PartitioningState") -> bool:
+        a = {k: v for k, v in self.items() if v.units}
+        b = {k: v for k, v in other.items() if v.units}
+        return a.keys() == b.keys() and all(a[k] == b[k] for k in a)
+
+    @property
+    def empty(self) -> bool:
+        return not any(v.units for v in self.values())
+
+
+# ---------------------------------------------------------------------------
+# Live cluster state
+# ---------------------------------------------------------------------------
+
+
+class ClusterState:
+    """Thread-safe view of nodes + pod bindings, maintained by the node/pod
+    controllers; the partitioner snapshots it per batch."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._node_pods: dict[str, dict[str, Pod]] = {}
+        self._partitioning_counts: dict[str, int] = {}
+
+    # -- nodes ------------------------------------------------------------
+    def update_node(self, node: Node, pods: list[Pod] | None = None) -> None:
+        with self._lock:
+            old = self._nodes.get(node.name)
+            if old is not None:
+                self._bump_kind(old, -1)
+            self._nodes[node.name] = node
+            self._bump_kind(node, +1)
+            if pods is not None:
+                self._node_pods[node.name] = {p.key: p for p in pods}
+            else:
+                self._node_pods.setdefault(node.name, {})
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is not None:
+                self._bump_kind(node, -1)
+            self._node_pods.pop(name, None)
+
+    def _bump_kind(self, node: Node, delta: int) -> None:
+        kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+        if kind:
+            self._partitioning_counts[kind] = (
+                self._partitioning_counts.get(kind, 0) + delta
+            )
+
+    def is_partitioning_enabled(self, kind: str) -> bool:
+        """Gate: at least one node opted into this partitioning kind;
+        hybrid nodes count toward every kind
+        (reference state.go IsPartitioningEnabled, partitioning.go:81-135)."""
+        with self._lock:
+            return (self._partitioning_counts.get(kind, 0) > 0
+                    or self._partitioning_counts.get("hybrid", 0) > 0)
+
+    # -- pods -------------------------------------------------------------
+    def update_pod(self, pod: Pod) -> None:
+        """Track/move a bound pod (reference state.go update/move/delete;
+        nodes unseen by the node controller are ignored — it owns node
+        lifecycle, matching the lazy-add handled by the pod controller)."""
+        with self._lock:
+            for pods in self._node_pods.values():
+                pods.pop(pod.key, None)
+            if pod.spec.node_name and pod.spec.node_name in self._nodes:
+                self._node_pods[pod.spec.node_name][pod.key] = pod
+
+    def delete_pod(self, pod_key: str) -> None:
+        with self._lock:
+            for pods in self._node_pods.values():
+                pods.pop(pod_key, None)
+
+    # -- snapshot access ---------------------------------------------------
+    def nodes(self) -> dict[str, Node]:
+        with self._lock:
+            return dict(self._nodes)
+
+    def pods_on(self, node_name: str) -> list[Pod]:
+        with self._lock:
+            return list(self._node_pods.get(node_name, {}).values())
+
+    def node_infos(self) -> dict[str, NodeInfo]:
+        """Deep-copied scheduling views: snapshot consumers (e.g.
+        SliceNode._sync_allocatable) mutate NodeInfo.node.allocatable, and
+        that must never write through to the live ClusterState objects."""
+        import copy
+        with self._lock:
+            out: dict[str, NodeInfo] = {}
+            for name, node in self._nodes.items():
+                ni = NodeInfo(node=copy.deepcopy(node))
+                for pod in self._node_pods.get(name, {}).values():
+                    ni.add_pod(pod)
+                out[name] = ni
+            return out

@@ -1,0 +1,268 @@
+"""Scheduler framework: plugin pipeline + NodeInfo snapshots.
+
+The reference embeds the *real* kube-scheduler framework in-process and runs
+its PreFilter/Filter pipeline against hypothetical node states — both inside
+the partitioning planner (reference internal/partitioning/core/planner.go:178-207,
+wired with a fake shared lister at cmd/gpupartitioner/gpupartitioner.go:294-318)
+and as the actual scheduler (cmd/scheduler).  This module is our equivalent
+framework: the same object serves (a) the planner's what-if simulation and
+(b) the real scheduling loop in the simulator — exactly the reference's trick
+of production code reusing the test fake (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Protocol, runtime_checkable
+
+from nos_tpu.kube.objects import Node, Pod
+from nos_tpu.kube.resources import (
+    ResourceList, fits, pod_request, subtract, sum_resources,
+)
+
+# ---------------------------------------------------------------------------
+# Status codes
+# ---------------------------------------------------------------------------
+
+SUCCESS = "Success"
+UNSCHEDULABLE = "Unschedulable"
+ERROR = "Error"
+
+
+@dataclass
+class Status:
+    code: str = SUCCESS
+    message: str = ""
+
+    @property
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status(SUCCESS)
+
+    @staticmethod
+    def unschedulable(msg: str) -> "Status":
+        return Status(UNSCHEDULABLE, msg)
+
+    @staticmethod
+    def error(msg: str) -> "Status":
+        return Status(ERROR, msg)
+
+
+# ---------------------------------------------------------------------------
+# NodeInfo
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeInfo:
+    """In-memory scheduling view of one node (the framework.NodeInfo analog).
+    `allocatable` includes extended resources; partitioning strategies mutate
+    it when simulating hypothetical geometries (reference
+    pkg/gpu/mig/node.go:171-195 recomputing ScalarResources)."""
+
+    node: Node
+    pods: list[Pod] = field(default_factory=list)
+    requested: ResourceList = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.node.metadata.name
+
+    @property
+    def allocatable(self) -> ResourceList:
+        return self.node.status.allocatable
+
+    def free(self) -> ResourceList:
+        return subtract(self.allocatable, self.requested)
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+        self.requested = sum_resources(self.requested, pod_request(pod))
+
+    def remove_pod(self, pod: Pod) -> bool:
+        for i, p in enumerate(self.pods):
+            if p.metadata.uid == pod.metadata.uid or p.key == pod.key:
+                self.pods.pop(i)
+                self.requested = subtract(self.requested, pod_request(p))
+                return True
+        return False
+
+    def clone(self) -> "NodeInfo":
+        import copy
+        return NodeInfo(
+            node=copy.deepcopy(self.node),
+            pods=list(self.pods),
+            requested=dict(self.requested),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Cycle state
+# ---------------------------------------------------------------------------
+
+
+class CycleState(dict):
+    """Per-scheduling-cycle scratch space shared across plugins."""
+
+
+# ---------------------------------------------------------------------------
+# Plugin protocols
+# ---------------------------------------------------------------------------
+
+
+@runtime_checkable
+class PreFilterPlugin(Protocol):
+    name: str
+
+    def pre_filter(self, state: CycleState, pod: Pod,
+                   nodes: "SharedLister") -> Status: ...
+
+
+@runtime_checkable
+class FilterPlugin(Protocol):
+    name: str
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status: ...
+
+
+@runtime_checkable
+class PostFilterPlugin(Protocol):
+    name: str
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    nodes: "SharedLister") -> tuple[str, Status]: ...
+
+
+@runtime_checkable
+class ReservePlugin(Protocol):
+    name: str
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
+
+
+@runtime_checkable
+class PreFilterExtensions(Protocol):
+    """AddPod/RemovePod extensions keeping cycle-state snapshots coherent
+    during preemption what-ifs (reference capacity_scheduling.go:286-321)."""
+
+    def add_pod(self, state: CycleState, pod_to_schedule: Pod,
+                pod_to_add: Pod, node_info: NodeInfo) -> Status: ...
+
+    def remove_pod(self, state: CycleState, pod_to_schedule: Pod,
+                   pod_to_remove: Pod, node_info: NodeInfo) -> Status: ...
+
+
+# ---------------------------------------------------------------------------
+# Shared lister (the fake-shared-lister trick)
+# ---------------------------------------------------------------------------
+
+
+class SharedLister:
+    """Holds the NodeInfo snapshot the framework schedules against.  The
+    planner passes explicit hypothetical snapshots (reference
+    pkg/test/util/fake.go:38-251, reused by production)."""
+
+    def __init__(self, node_infos: Iterable[NodeInfo] = ()) -> None:
+        self._infos: dict[str, NodeInfo] = {ni.name: ni for ni in node_infos}
+
+    def list(self) -> list[NodeInfo]:
+        return list(self._infos.values())
+
+    def get(self, name: str) -> NodeInfo | None:
+        return self._infos.get(name)
+
+    def set(self, ni: NodeInfo) -> None:
+        self._infos[ni.name] = ni
+
+
+# ---------------------------------------------------------------------------
+# Built-in plugin: NodeResourcesFit
+# ---------------------------------------------------------------------------
+
+
+class NodeResourcesFit:
+    """The in-tree fit plugin: pod request must fit node free capacity."""
+
+    name = "NodeResourcesFit"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        req = pod_request(pod)
+        if fits(req, node_info.free()):
+            return Status.ok()
+        missing = [
+            k for k, v in req.items()
+            if v > 0 and node_info.free().get(k, 0.0) < v
+        ]
+        return Status.unschedulable(
+            f"insufficient {', '.join(sorted(missing))}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Framework
+# ---------------------------------------------------------------------------
+
+
+class Framework:
+    """Ordered plugin runner (the schedulerruntime.NewFramework analog)."""
+
+    def __init__(self, plugins: Iterable[object] = ()) -> None:
+        self._plugins = list(plugins) or [NodeResourcesFit()]
+        self._lock = threading.RLock()
+
+    @property
+    def plugins(self) -> list[object]:
+        return list(self._plugins)
+
+    def run_pre_filter_plugins(self, state: CycleState, pod: Pod,
+                               nodes: SharedLister) -> Status:
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, PreFilterPlugin) and hasattr(p, "pre_filter"):
+                    st = p.pre_filter(state, pod, nodes)
+                    if not st.is_success:
+                        return st
+            return Status.ok()
+
+    def run_filter_plugins(self, state: CycleState, pod: Pod,
+                           node_info: NodeInfo) -> Status:
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, FilterPlugin) and hasattr(p, "filter"):
+                    st = p.filter(state, pod, node_info)
+                    if not st.is_success:
+                        return st
+            return Status.ok()
+
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod,
+                                nodes: SharedLister) -> tuple[str, Status]:
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, PostFilterPlugin) and hasattr(p, "post_filter"):
+                    nominated, st = p.post_filter(state, pod, nodes)
+                    if st.is_success:
+                        return nominated, st
+            return "", Status.unschedulable("no postfilter plugin succeeded")
+
+    def run_reserve_plugins(self, state: CycleState, pod: Pod,
+                            node_name: str) -> Status:
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, ReservePlugin) and hasattr(p, "reserve"):
+                    st = p.reserve(state, pod, node_name)
+                    if not st.is_success:
+                        return st
+            return Status.ok()
+
+    def run_unreserve_plugins(self, state: CycleState, pod: Pod,
+                              node_name: str) -> None:
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, ReservePlugin) and hasattr(p, "unreserve"):
+                    p.unreserve(state, pod, node_name)

@@ -1,0 +1,124 @@
+"""The scheduling loop: the cmd/scheduler analog.
+
+The reference recompiles the stock kube-scheduler with the CapacityScheduling
+plugin registered (cmd/scheduler/scheduler.go:43-59).  Here the Scheduler
+drives the same Framework used by the planner's simulation over the live
+cluster view: PreFilter -> Filter (all nodes) -> score (least-requested on
+TPU resources) -> Reserve -> bind; on no fit, PostFilter (preemption) then
+mark the pod unschedulable so the partitioner notices it
+(ExtraResourcesCouldHelpScheduling).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING, Pod
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.scheduler.framework import (
+    CycleState, Framework, NodeInfo, SharedLister, Status,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(self, api: APIServer, framework: Framework,
+                 name: str = "nos-tpu-scheduler") -> None:
+        self._api = api
+        self._framework = framework
+        self.name = name
+
+    # -- cluster view -------------------------------------------------------
+    def snapshot(self) -> SharedLister:
+        infos: dict[str, NodeInfo] = {}
+        for node in self._api.list(KIND_NODE):
+            infos[node.metadata.name] = NodeInfo(node=node)
+        for pod in self._api.list(KIND_POD):
+            if pod.spec.node_name and pod.spec.node_name in infos \
+                    and pod.status.phase in (PENDING, RUNNING):
+                infos[pod.spec.node_name].add_pod(pod)
+        return SharedLister(infos.values())
+
+    # -- one scheduling cycle ----------------------------------------------
+    def schedule_one(self, pod: Pod) -> str | None:
+        """Try to place one pod; returns the node name or None."""
+        lister = self.snapshot()
+        state = CycleState()
+        status = self._framework.run_pre_filter_plugins(state, pod, lister)
+        if not status.is_success:
+            self._mark_unschedulable(pod, status)
+            return None
+        feasible: list[NodeInfo] = []
+        for ni in lister.list():
+            if self._framework.run_filter_plugins(state, pod, ni).is_success:
+                feasible.append(ni)
+        if not feasible:
+            nominated, post = self._framework.run_post_filter_plugins(
+                state, pod, lister
+            )
+            if post.is_success and nominated:
+                self._nominate(pod, nominated)
+            else:
+                self._mark_unschedulable(pod, Status.unschedulable("no fit"))
+            return None
+        chosen = min(feasible, key=self._score_key(pod))
+        status = self._framework.run_reserve_plugins(state, pod, chosen.name)
+        if not status.is_success:
+            self._framework.run_unreserve_plugins(state, pod, chosen.name)
+            self._mark_unschedulable(pod, status)
+            return None
+        self._bind(pod, chosen.name)
+        return chosen.name
+
+    def run_cycle(self) -> int:
+        """Schedule all pending, not-yet-bound pods for this scheduler;
+        returns number of pods bound."""
+        bound = 0
+        pods = [
+            p for p in self._api.pods_by_phase(PENDING)
+            if not p.spec.node_name and p.spec.scheduler_name == self.name
+        ]
+        pods.sort(key=lambda p: (-p.spec.priority,
+                                 p.metadata.creation_timestamp, p.key))
+        for pod in pods:
+            if self.schedule_one(pod) is not None:
+                bound += 1
+        return bound
+
+    # -- internals ----------------------------------------------------------
+    def _score_key(self, pod: Pod):
+        """Least-requested on the pod's own resources: packs TPU profiles
+        tightly (utilization) while spreading nothing else."""
+        req = pod_request(pod)
+
+        def key(ni: NodeInfo):
+            free = ni.free()
+            headroom = sum(free.get(r, 0.0) for r in req)
+            return (headroom, ni.name)
+
+        return key
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        def mutate(p: Pod) -> None:
+            p.spec.node_name = node_name
+            p.status.phase = RUNNING
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                        mutate=mutate)
+        logger.debug("scheduler: bound %s -> %s", pod.key, node_name)
+
+    def _nominate(self, pod: Pod, node_name: str) -> None:
+        def mutate(p: Pod) -> None:
+            p.status.nominated_node_name = node_name
+        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                        mutate=mutate)
+
+    def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
+        def mutate(p: Pod) -> None:
+            p.mark_unschedulable(status.message)
+        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                        mutate=mutate)

@@ -1,0 +1,92 @@
+"""Fluent object factories for tests and the simulator.
+
+Analog of reference pkg/test/factory/core_factory.go:27-229 (builders for
+Node/Pod/Container/Namespace with GPU-resource helpers).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.objects import (
+    Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec, PodStatus, PENDING,
+)
+from nos_tpu.topology import Generation, Shape, V5E
+from nos_tpu.topology.profile import slice_resource_name, timeshare_resource_name
+
+_name_counter = itertools.count(1)
+
+
+def make_node(name: str = "", labels: dict | None = None,
+              annotations: dict | None = None,
+              allocatable: dict | None = None) -> Node:
+    name = name or f"node-{next(_name_counter)}"
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {},
+                            annotations=annotations or {}),
+        status=NodeStatus(allocatable=dict(allocatable or {}),
+                          capacity=dict(allocatable or {})),
+    )
+
+
+def make_tpu_node(name: str = "", generation: Generation = V5E,
+                  partitioning: str = "slice",
+                  pod_id: str = "pod-0", host_index: int = 0,
+                  host_coords: tuple[int, ...] | None = None,
+                  status_geometry: dict[str, dict[str, int]] | None = None,
+                  extra_labels: dict | None = None) -> Node:
+    """A TPU host node.  `status_geometry` is {"free": {...}, "used": {...}}
+    profile->qty for unit 0, rendered as agent status annotations."""
+    labels = {
+        C.LABEL_ACCELERATOR: generation.name,
+        C.LABEL_PARTITIONING: partitioning,
+        C.LABEL_CHIP_COUNT: str(generation.chips_per_host),
+        C.LABEL_POD_ID: pod_id,
+        C.LABEL_HOST_INDEX: str(host_index),
+    }
+    if host_coords is not None:
+        labels[C.LABEL_HOST_COORDS] = ",".join(str(c) for c in host_coords)
+    labels.update(extra_labels or {})
+    annotations: dict[str, str] = {}
+    allocatable: dict[str, float] = {
+        "cpu": 64.0, "memory": 256 * 1024.0**3,
+        C.RESOURCE_TPU: float(generation.chips_per_host),
+    }
+    for status, table in (status_geometry or {}).items():
+        for profile, qty in table.items():
+            annotations[f"{C.ANNOT_STATUS_PREFIX}0-{profile}-{status}"] = str(qty)
+            if "x" in profile:
+                res = slice_resource_name(profile)
+            else:
+                res = timeshare_resource_name(int(profile[:-2]))
+            allocatable[res] = allocatable.get(res, 0.0) + qty
+    return make_node(name, labels, annotations, allocatable)
+
+
+def make_pod(name: str = "", namespace: str = "default",
+             resources: dict | None = None, priority: int = 0,
+             node_name: str = "", phase: str = PENDING,
+             labels: dict | None = None, annotations: dict | None = None,
+             creation_timestamp: float = 0.0,
+             owner_kind: str = "") -> Pod:
+    name = name or f"pod-{next(_name_counter)}"
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace,
+                            labels=labels or {}, annotations=annotations or {},
+                            creation_timestamp=creation_timestamp,
+                            owner_kind=owner_kind),
+        spec=PodSpec(containers=[Container(resources=dict(resources or {}))],
+                     priority=priority, node_name=node_name),
+        status=PodStatus(phase=phase),
+    )
+
+
+def make_slice_pod(shape: str | Shape, qty: int = 1, **kw) -> Pod:
+    res = {slice_resource_name(shape): qty, "cpu": 1.0}
+    return make_pod(resources=res, **kw)
+
+
+def make_timeshare_pod(gb: int, qty: int = 1, **kw) -> Pod:
+    res = {timeshare_resource_name(gb): qty, "cpu": 1.0}
+    return make_pod(resources=res, **kw)

@@ -1,0 +1,69 @@
+"""Generic pod batcher with timeout + idle windows.
+
+Analog of reference pkg/util/batcher.go:25-130 (`util.Batcher[T]`): a batch
+becomes ready when either `timeout` has elapsed since the first add, or
+`idle` has elapsed since the last add.  The reference uses goroutines and
+channels; here the clock is injected and `ready()` is polled by the
+controller loop, which keeps the whole control plane deterministic in tests
+and in the simulator (and lets the simulator compress time).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Batcher(Generic[T]):
+    def __init__(self, timeout_s: float, idle_s: float,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.timeout_s = timeout_s
+        self.idle_s = idle_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._items: dict[str, T] = {}
+        self._first_add: float | None = None
+        self._last_add: float | None = None
+
+    def add(self, key: str, item: T) -> None:
+        """Non-blocking add; duplicate keys refresh the item but the idle
+        window restarts either way (batcher.go Add).  add() runs on watch
+        fan-out threads while ready()/drain() run on the controller loop."""
+        with self._lock:
+            now = self._clock()
+            if self._first_add is None:
+                self._first_add = now
+            self._last_add = now
+            self._items[key] = item
+
+    def ready(self) -> bool:
+        with self._lock:
+            if self._first_add is None:
+                return False
+            now = self._clock()
+            if now - self._first_add >= self.timeout_s:
+                return True
+            last = self._last_add if self._last_add is not None else now
+            return now - last >= self.idle_s
+
+    def drain(self) -> list[T]:
+        with self._lock:
+            items = list(self._items.values())
+            self._reset_locked()
+            return items
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self._items.clear()
+        self._first_add = None
+        self._last_add = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

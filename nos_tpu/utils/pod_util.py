@@ -1,0 +1,33 @@
+"""Pod predicates.
+
+Analog of reference pkg/util/pod/pod.go:31-101.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.objects import PENDING, Pod
+
+
+def extra_resources_could_help_scheduling(pod: Pod) -> bool:
+    """Pending + marked unschedulable + not preempting + not owned by a
+    DaemonSet (reference pod.go:41-48): these are the pods a repartition
+    could rescue."""
+    return (
+        pod.status.phase == PENDING
+        and pod.is_unschedulable()
+        and not pod.status.nominated_node_name
+        and pod.metadata.owner_kind != "DaemonSet"
+    )
+
+
+def is_over_quota(pod: Pod) -> bool:
+    return pod.metadata.labels.get(C.LABEL_CAPACITY) == C.CAPACITY_OVER_QUOTA
+
+
+def is_in_quota(pod: Pod) -> bool:
+    return pod.metadata.labels.get(C.LABEL_CAPACITY) == C.CAPACITY_IN_QUOTA
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return bool(pod.spec.node_name)

@@ -1,0 +1,283 @@
+"""Unit tests for the partitioning engine core.
+
+Mirrors reference internal/partitioning/core/{planner,tracker,snapshot}_test.go
+coverage: snapshot fork/commit/revert, lacking-slice math, tracker
+bookkeeping, and planner behavior against fake v5e nodes (the SURVEY.md §7
+step-3 milestone gate).
+"""
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot, GeometryActuator, GeometryPlanner, SliceTracker,
+    SnapshotError,
+)
+from nos_tpu.partitioning.slicepart import (
+    SliceNodeInitializer, SlicePartitionCalculator, SlicePartitioner,
+    SliceProfileCalculator, SliceProfileFilter, SliceSnapshotTaker,
+    is_node_initialized,
+)
+from nos_tpu.partitioning.state import (
+    ClusterState, NodePartitioning, PartitioningState, UnitPartitioning,
+)
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology.annotations import parse_spec_annotations
+
+
+def snapshot_for(nodes):
+    state = ClusterState()
+    for n in nodes:
+        state.update_node(n, [])
+    return SliceSnapshotTaker().take_snapshot(state), state
+
+
+def virgin_v5e(name="n1", **kw):
+    return make_tpu_node(name, status_geometry={"free": {"2x4": 1}}, **kw)
+
+
+class TestSnapshot:
+    def test_fork_commit_revert(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        node = snap.get_node("n1")
+        snap.fork()
+        assert node.update_geometry_for({"2x2": 2})
+        snap.revert()
+        geo = snap.get_node("n1").geometries()
+        assert geo == {0: {"2x4": 1}}
+        snap.fork()
+        snap.get_node("n1").update_geometry_for({"2x2": 2})
+        snap.commit()
+        assert snap.get_node("n1").geometries() == {0: {"2x2": 2}}
+
+    def test_double_fork_rejected(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        snap.fork()
+        with pytest.raises(SnapshotError):
+            snap.fork()
+
+    def test_lacking_slices(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pod = make_slice_pod("2x2", 2)
+        # node only advertises one free 2x4 -> lacking two 2x2
+        assert snap.get_lacking_slices(pod) == {"2x2": 2}
+        pod2 = make_slice_pod("2x4", 1)
+        assert snap.get_lacking_slices(pod2) == {}
+
+    def test_candidate_nodes_sorted_with_free_capacity(self):
+        snap, _ = snapshot_for([virgin_v5e("b"), virgin_v5e("a")])
+        names = [n.name for n in snap.get_candidate_nodes()]
+        assert names == ["a", "b"]
+
+
+class TestTracker:
+    def test_tracks_and_removes(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("2x2", 2, name="p1"),
+                make_slice_pod("1x1", 1, name="p2")]
+        tracker = SliceTracker(snap, SliceProfileCalculator(), pods)
+        assert tracker.lacking == {"2x2": 2, "1x1": 1}
+        assert not tracker.empty
+        tracker.remove(pods[0])
+        assert tracker.lacking == {"1x1": 1}
+        tracker.remove(pods[1])
+        assert tracker.empty
+
+    def test_non_tpu_pods_ignored(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        from nos_tpu.testing.factory import make_pod
+        tracker = SliceTracker(
+            snap, SliceProfileCalculator(), [make_pod(resources={"cpu": 1})]
+        )
+        assert tracker.empty
+
+
+def make_planner():
+    return GeometryPlanner(
+        framework=Framework(),
+        calculator=SliceProfileCalculator(),
+        partition_calculator=SlicePartitionCalculator(),
+    )
+
+
+class TestPlanner:
+    def test_recarve_for_pending_pod(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("2x2", 1, name="p1")]
+        state = make_planner().plan(snap, pods)
+        resources = state["n1"].units[0].resources
+        assert resources.get("nos.tpu/slice-2x2", 0) >= 1
+
+    def test_no_pending_no_change(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        state = make_planner().plan(snap, [])
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x4": 1}
+
+    def test_plan_packs_multiple_pods_one_node(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("2x2", 1, name=f"p{i}") for i in range(2)]
+        state = make_planner().plan(snap, pods)
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x2": 2}
+
+    def test_plan_spreads_over_nodes_when_needed(self):
+        snap, _ = snapshot_for([virgin_v5e("n1"), virgin_v5e("n2")])
+        pods = [make_slice_pod("2x4", 1, name=f"p{i}", priority=10 - i)
+                for i in range(2)]
+        # both nodes already offer 2x4; no geometry change needed, no lack
+        state = make_planner().plan(snap, pods)
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x4": 1}
+        assert state["n2"].units[0].resources == {"nos.tpu/slice-2x4": 1}
+
+    def test_mixed_profiles_carved_on_one_host(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("2x2", 1, name="big"),
+                make_slice_pod("1x1", 4, name="small")]
+        state = make_planner().plan(snap, pods)
+        res = state["n1"].units[0].resources
+        assert res.get("nos.tpu/slice-2x2") == 1
+        assert res.get("nos.tpu/slice-1x1") == 4
+
+    def test_unsatisfiable_keeps_geometry(self):
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("4x4", 1, name="toolarge")]
+        state = make_planner().plan(snap, pods)
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x4": 1}
+
+    def test_priority_order_wins_contention(self):
+        # one host (8 chips), three pods each lacking a 2x2 — only two fit;
+        # the higher-priority pods must win (reference core/util.go:34-71)
+        snap, _ = snapshot_for([virgin_v5e()])
+        pods = [make_slice_pod("2x2", 1, name="lo", priority=1),
+                make_slice_pod("2x2", 1, name="hi", priority=100),
+                make_slice_pod("2x2", 1, name="mid", priority=50)]
+        state = make_planner().plan(snap, pods)
+        node = snap.get_node("n1")
+        placed = {p.metadata.name for p in node.node_info().pods}
+        assert placed == {"hi", "mid"}
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x2": 2}
+
+    def test_pods_lacking_nothing_are_not_planned(self):
+        # the node already advertises the needed profile: the planner leaves
+        # placement to the scheduler (tracker empty -> unchanged state)
+        snap, _ = snapshot_for([virgin_v5e()])
+        state = make_planner().plan(snap, [make_slice_pod("2x4", 1)])
+        assert state["n1"].units[0].resources == {"nos.tpu/slice-2x4": 1}
+        assert snap.get_node("n1").node_info().pods == []
+
+
+class TestReviewRegressions:
+    def test_later_candidate_recarves_after_earlier_revert(self):
+        # review regression: revert() swaps snapshot node objects; the
+        # planner must re-fetch candidates by name or later re-carves are
+        # lost on detached objects
+        n1 = make_tpu_node("n1", status_geometry={
+            "used": {"1x1": 7}, "free": {"1x1": 1}})
+        n2 = make_tpu_node("n2", status_geometry={"free": {"2x4": 1}})
+        snap, _ = snapshot_for([n1, n2])
+        desired = make_planner().plan(snap, [make_slice_pod("2x2", 1)])
+        assert desired["n2"].units[0].resources.get("nos.tpu/slice-2x2", 0) >= 1
+
+    def test_snapshot_does_not_mutate_cluster_state(self):
+        # review regression: SliceNode syncs allocatable on construction;
+        # that must happen on deep copies, not the live ClusterState node
+        node = make_tpu_node("n1", status_geometry={"free": {"2x4": 1}})
+        node.status.allocatable["nos.tpu/slice-2x2"] = 2.0
+        state = ClusterState()
+        state.update_node(node, [])
+        SliceSnapshotTaker().take_snapshot(state)
+        assert state.nodes()["n1"].status.allocatable.get(
+            "nos.tpu/slice-2x2") == 2.0
+
+    def test_completed_pods_do_not_consume_capacity(self):
+        # review regression: NodeController must drop Succeeded/Failed pods
+        from nos_tpu.controllers.node_controller import NodeController
+        from nos_tpu.kube.client import KIND_POD
+        from nos_tpu.kube.objects import SUCCEEDED
+        from nos_tpu.testing.factory import make_pod
+        api = APIServer()
+        state = ClusterState()
+        node = make_tpu_node("n1", status_geometry={"free": {"2x4": 1}})
+        api.create(KIND_NODE, node)
+        dead = make_pod(name="done", resources={"nos.tpu/slice-2x4": 1},
+                        node_name="n1", phase=SUCCEEDED)
+        api.create(KIND_POD, dead)
+        NodeController(api, state).reconcile("MODIFIED", node)
+        ni = state.node_infos()["n1"]
+        assert ni.requested.get("nos.tpu/slice-2x4", 0) == 0
+
+    def test_hybrid_nodes_enable_slice_partitioning(self):
+        state = ClusterState()
+        state.update_node(make_tpu_node("h", partitioning="hybrid"), [])
+        assert state.is_partitioning_enabled("slice")
+        assert state.is_partitioning_enabled("timeshare")
+
+
+class TestActuatorAndPartitioner:
+    def setup_method(self):
+        self.api = APIServer()
+        self.node = virgin_v5e("n1")
+        self.api.create(KIND_NODE, self.node)
+        self.partitioner = SlicePartitioner(self.api)
+        self.actuator = GeometryActuator(
+            self.partitioner, SlicePartitionCalculator()
+        )
+
+    def test_apply_writes_spec_annotations(self):
+        snap, _ = snapshot_for([self.node])
+        desired = PartitioningState({
+            "n1": NodePartitioning(units=[
+                UnitPartitioning(0, {"nos.tpu/slice-2x2": 2})
+            ])
+        })
+        assert self.actuator.apply(snap, desired)
+        node = self.api.get(KIND_NODE, "n1")
+        parsed = parse_spec_annotations(node.metadata.annotations)
+        assert [(a.index, a.profile, a.quantity) for a in parsed] == [(0, "2x2", 2)]
+        assert node.metadata.annotations[C.ANNOT_SPEC_PLAN]
+
+    def test_apply_skips_when_equal(self):
+        snap, _ = snapshot_for([self.node])
+        desired = PartitioningState({
+            "n1": NodePartitioning(units=[
+                UnitPartitioning(0, {"nos.tpu/slice-2x4": 1})
+            ])
+        })
+        assert not self.actuator.apply(snap, desired)
+        node = self.api.get(KIND_NODE, "n1")
+        assert C.ANNOT_SPEC_PLAN not in node.metadata.annotations
+
+    def test_apply_skips_empty(self):
+        snap, _ = snapshot_for([self.node])
+        assert not self.actuator.apply(snap, PartitioningState())
+
+
+class TestInitializer:
+    def test_init_virgin_node(self):
+        api = APIServer()
+        node = make_tpu_node("n1")          # no status annotations at all
+        api.create(KIND_NODE, node)
+        assert not is_node_initialized(node)
+        SliceNodeInitializer(api).init_node_partitioning("n1")
+        node = api.get(KIND_NODE, "n1")
+        assert is_node_initialized(node)
+        parsed = parse_spec_annotations(node.metadata.annotations)
+        assert [(a.index, a.profile, a.quantity) for a in parsed] == [(0, "2x4", 1)]
+
+
+class TestPartitioningState:
+    def test_order_insensitive_equality(self):
+        a = PartitioningState({
+            "n1": NodePartitioning(units=[
+                UnitPartitioning(0, {"r": 1}), UnitPartitioning(1, {"s": 2}),
+            ])
+        })
+        b = PartitioningState({
+            "n1": NodePartitioning(units=[
+                UnitPartitioning(1, {"s": 2}), UnitPartitioning(0, {"r": 1}),
+            ])
+        })
+        assert a.equal(b)
+        b["n1"].units[0].resources["s"] = 3
+        assert not a.equal(b)
