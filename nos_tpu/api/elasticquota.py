@@ -1,0 +1,111 @@
+"""ElasticQuota / CompositeElasticQuota CRD types and admission webhooks.
+
+TPU-native analog of reference pkg/api/nos.nebuly.com/v1alpha1/
+{elasticquota_types.go:29-71, compositeelasticquota_types.go:29-66,
+elasticquota_webhook.go:48-97, compositeelasticquota_webhook.go}.
+
+Semantics preserved:
+- spec.min: guaranteed resources; spec.max: optional ceiling.
+- Namespaces may *borrow* unused min from other quotas (enforced by the
+  CapacityScheduling plugin, nos_tpu/scheduler/capacityscheduling.py).
+- At most one ElasticQuota per namespace; a namespace covered by a
+  CompositeElasticQuota may not also have an ElasticQuota.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA,
+)
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.resources import ResourceList
+
+
+@dataclass
+class ElasticQuotaSpec:
+    # min is the quantity of resources guaranteed to the namespace.
+    min: ResourceList = field(default_factory=dict)
+    # max is the upper bound of consumable resources; empty = unbounded
+    # (MaxEnforced=false in the reference, elasticquotainfo.go:214-219).
+    max: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuotaStatus:
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    @property
+    def namespaces(self) -> list[str]:
+        """An ElasticQuota governs exactly its own namespace."""
+        return [self.metadata.namespace]
+
+
+@dataclass
+class CompositeElasticQuotaSpec:
+    # namespaces this quota spans (≥1 — compositeelasticquota_types.go:40).
+    namespaces: list[str] = field(default_factory=list)
+    min: ResourceList = field(default_factory=dict)
+    max: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class CompositeElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CompositeElasticQuotaSpec = field(default_factory=CompositeElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    @property
+    def namespaces(self) -> list[str]:
+        return list(self.spec.namespaces)
+
+
+class AdmissionError(Exception):
+    """Webhook rejection (the analog of a denied AdmissionReview)."""
+
+
+def validate_elastic_quota(api: APIServer, eq: ElasticQuota) -> None:
+    """Create/update validation for ElasticQuota (reference
+    elasticquota_webhook.go:48-97): at most one EQ per namespace, and the
+    namespace must not be covered by any CompositeElasticQuota."""
+    ns = eq.metadata.namespace
+    for other in api.list(KIND_ELASTIC_QUOTA, namespace=ns):
+        if other.metadata.name != eq.metadata.name:
+            raise AdmissionError(
+                f"namespace {ns!r} already has ElasticQuota "
+                f"{other.metadata.name!r}; only one is allowed"
+            )
+    for ceq in api.list(KIND_COMPOSITE_ELASTIC_QUOTA):
+        if ns in ceq.spec.namespaces:
+            raise AdmissionError(
+                f"namespace {ns!r} is governed by CompositeElasticQuota "
+                f"{ceq.metadata.name!r}; an ElasticQuota may not overlap"
+            )
+
+
+def validate_composite_elastic_quota(api: APIServer,
+                                     ceq: CompositeElasticQuota) -> None:
+    """Mirror validation for CompositeElasticQuota: its namespaces must not
+    overlap another CompositeElasticQuota.  (Overlapping plain ElasticQuotas
+    are *deleted* by the CEQ reconciler rather than rejected — reference
+    compositeelasticquota_controller.go:112-137.)"""
+    if not ceq.spec.namespaces:
+        raise AdmissionError("spec.namespaces must contain at least one namespace")
+    for other in api.list(KIND_COMPOSITE_ELASTIC_QUOTA):
+        if other.metadata.name == ceq.metadata.name and \
+                other.metadata.namespace == ceq.metadata.namespace:
+            continue
+        overlap = set(other.spec.namespaces) & set(ceq.spec.namespaces)
+        if overlap:
+            raise AdmissionError(
+                f"namespaces {sorted(overlap)} already governed by "
+                f"CompositeElasticQuota {other.metadata.name!r}"
+            )

@@ -1,0 +1,10 @@
+"""ElasticQuota controllers.
+
+Analog of reference internal/controllers/elasticquota/.
+"""
+
+from .controller import (
+    CompositeElasticQuotaReconciler, ElasticQuotaReconciler,
+)
+
+__all__ = ["ElasticQuotaReconciler", "CompositeElasticQuotaReconciler"]

@@ -1,0 +1,190 @@
+"""ElasticQuota / CompositeElasticQuota reconcilers.
+
+Analog of reference internal/controllers/elasticquota/
+{elasticquota_controller.go:66-189, compositeelasticquota_controller.go:70-244,
+elasticquota.go:38-149}.
+
+Each reconcile walks the quota's running pods in a canonical order (creation
+timestamp, priority, request size, name), accumulates `used`, and labels each
+pod `nos.tpu/capacity=in-quota` while the running total stays within min,
+`over-quota` after — the label the preemptor keys on.  Resources not named by
+min/max are dropped from status.used (non-enforced).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.elasticquota import CompositeElasticQuota, ElasticQuota
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_POD,
+    NotFound,
+)
+from nos_tpu.kube.objects import RUNNING, Pod
+from nos_tpu.kube.resources import ResourceList, sum_resources
+from nos_tpu.quota import TPUResourceCalculator
+
+logger = logging.getLogger(__name__)
+
+
+class _PodsReconciler:
+    """Shared pods walk (reference elasticquota.go:38-149)."""
+
+    def __init__(self, api: APIServer,
+                 calculator: TPUResourceCalculator) -> None:
+        self._api = api
+        self._calculator = calculator
+
+    def patch_pods_and_compute_used(self, pods: list[Pod],
+                                    quota_min: ResourceList,
+                                    quota_max: ResourceList) -> ResourceList:
+        pods = sorted(pods, key=self._sort_key)
+        used: ResourceList = {r: 0.0 for r in (*quota_min, *quota_max)}
+        for pod in pods:
+            req = self._calculator.compute_pod_request(pod)
+            used = sum_resources(used, req)
+            # in-quota while cumulative used <= min on every resource *named
+            # by min* (first-come basis).  Resources min doesn't mention are
+            # not enforced here — the reference compares with
+            # quota.LessThanOrEqual (elasticquota.go:53), which only checks
+            # keys present in both operands; the scheduler plugin's stricter
+            # cpu/memory-always semantics do NOT apply to labeling.
+            over = any(used.get(r, 0.0) > quota_min[r] for r in quota_min)
+            desired = C.CAPACITY_OVER_QUOTA if over else C.CAPACITY_IN_QUOTA
+            self._patch_capacity_label(pod, desired)
+        # Drop resources not enforced by the quota
+        # (reference elasticquota.go:64-69).
+        return {r: v for r, v in used.items() if r in quota_min}
+
+    def _sort_key(self, pod: Pod):
+        req = self._calculator.compute_pod_request(pod)
+        return (
+            pod.metadata.creation_timestamp,
+            pod.spec.priority,
+            sorted(req.items()),
+            pod.metadata.name,
+        )
+
+    def _patch_capacity_label(self, pod: Pod, desired: str) -> None:
+        if pod.metadata.labels.get(C.LABEL_CAPACITY) == desired:
+            return
+        try:
+            self._api.patch(
+                KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                mutate=lambda p: p.metadata.labels.__setitem__(
+                    C.LABEL_CAPACITY, desired),
+            )
+        except NotFound:
+            pass
+
+
+class ElasticQuotaReconciler:
+    """Per-EQ reconcile (reference elasticquota_controller.go:66-189)."""
+
+    def __init__(self, api: APIServer,
+                 calculator: TPUResourceCalculator | None = None) -> None:
+        self._api = api
+        self._calculator = calculator or TPUResourceCalculator()
+        self._pods = _PodsReconciler(api, self._calculator)
+
+    def reconcile(self, name: str, namespace: str) -> None:
+        try:
+            eq: ElasticQuota = self._api.get(KIND_ELASTIC_QUOTA, name, namespace)
+        except NotFound:
+            return
+        pods = self._api.list(
+            KIND_POD, namespace=namespace,
+            filter_fn=lambda p: p.status.phase == RUNNING,
+        )
+        used = self._pods.patch_pods_and_compute_used(
+            pods, eq.spec.min, eq.spec.max)
+        self._update_status(eq, used)
+
+    def _update_status(self, eq: ElasticQuota, used: ResourceList) -> None:
+        if eq.status.used == used:
+            return
+        self._api.patch(
+            KIND_ELASTIC_QUOTA, eq.metadata.name, eq.metadata.namespace,
+            mutate=lambda o: setattr(o.status, "used", dict(used)),
+        )
+
+    def reconcile_all(self) -> None:
+        for eq in self._api.list(KIND_ELASTIC_QUOTA):
+            self.reconcile(eq.metadata.name, eq.metadata.namespace)
+
+    def bind(self) -> None:
+        """Re-reconcile on quota or pod churn (the controller-runtime
+        watches of the reference operator, cmd/operator/operator.go:50-126)."""
+        self._api.watch(KIND_ELASTIC_QUOTA, lambda e, o: self.reconcile(
+            o.metadata.name, o.metadata.namespace))
+
+        def on_pod(event: str, pod: Pod) -> None:
+            ns = pod.metadata.namespace
+            for eq in self._api.list(KIND_ELASTIC_QUOTA, namespace=ns):
+                self.reconcile(eq.metadata.name, eq.metadata.namespace)
+
+        self._api.watch(KIND_POD, on_pod)
+
+
+class CompositeElasticQuotaReconciler:
+    """Per-CEQ reconcile spanning spec.namespaces; deletes any overlapping
+    plain ElasticQuota (reference compositeelasticquota_controller.go:112-137).
+    """
+
+    def __init__(self, api: APIServer,
+                 calculator: TPUResourceCalculator | None = None) -> None:
+        self._api = api
+        self._calculator = calculator or TPUResourceCalculator()
+        self._pods = _PodsReconciler(api, self._calculator)
+
+    def reconcile(self, name: str, namespace: str) -> None:
+        try:
+            ceq: CompositeElasticQuota = self._api.get(
+                KIND_COMPOSITE_ELASTIC_QUOTA, name, namespace)
+        except NotFound:
+            return
+        self._delete_overlapping_elastic_quotas(ceq)
+        pods: list[Pod] = []
+        for ns in ceq.spec.namespaces:
+            pods.extend(self._api.list(
+                KIND_POD, namespace=ns,
+                filter_fn=lambda p: p.status.phase == RUNNING,
+            ))
+        used = self._pods.patch_pods_and_compute_used(
+            pods, ceq.spec.min, ceq.spec.max)
+        if ceq.status.used != used:
+            self._api.patch(
+                KIND_COMPOSITE_ELASTIC_QUOTA, name, namespace,
+                mutate=lambda o: setattr(o.status, "used", dict(used)),
+            )
+
+    def _delete_overlapping_elastic_quotas(self,
+                                           ceq: CompositeElasticQuota) -> None:
+        for ns in ceq.spec.namespaces:
+            for eq in self._api.list(KIND_ELASTIC_QUOTA, namespace=ns):
+                logger.warning(
+                    "deleting ElasticQuota %s/%s overlapping "
+                    "CompositeElasticQuota %s",
+                    ns, eq.metadata.name, ceq.metadata.name,
+                )
+                try:
+                    self._api.delete(KIND_ELASTIC_QUOTA, eq.metadata.name, ns)
+                except NotFound:
+                    pass
+
+    def reconcile_all(self) -> None:
+        for ceq in self._api.list(KIND_COMPOSITE_ELASTIC_QUOTA):
+            self.reconcile(ceq.metadata.name, ceq.metadata.namespace)
+
+    def bind(self) -> None:
+        self._api.watch(KIND_COMPOSITE_ELASTIC_QUOTA, lambda e, o: self.reconcile(
+            o.metadata.name, o.metadata.namespace))
+
+        def on_pod(event: str, pod: Pod) -> None:
+            ns = pod.metadata.namespace
+            for ceq in self._api.list(KIND_COMPOSITE_ELASTIC_QUOTA):
+                if ns in ceq.spec.namespaces:
+                    self.reconcile(ceq.metadata.name, ceq.metadata.namespace)
+
+        self._api.watch(KIND_POD, on_pod)

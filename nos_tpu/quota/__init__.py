@@ -1,0 +1,14 @@
+"""Elastic-quota bookkeeping: the TPU-memory currency and the quota ledger.
+
+Analog of reference pkg/gpu/util/resource.go (ResourceCalculator) and
+pkg/scheduler/plugins/capacityscheduling/elasticquotainfo.go.
+"""
+
+from .calculator import TPUResourceCalculator
+from .info import ElasticQuotaInfo, ElasticQuotaInfos, greater_than, sum_greater_than
+
+__all__ = [
+    "TPUResourceCalculator",
+    "ElasticQuotaInfo", "ElasticQuotaInfos",
+    "greater_than", "sum_greater_than",
+]

@@ -1,0 +1,51 @@
+"""TPU resource calculator: pod requests in the quota currency.
+
+The reference derives a synthetic `nos.nebuly.com/gpu-memory` scalar from GPU
+requests so quotas can be denominated in one fungible unit across whole GPUs
+and MIG profiles (pkg/gpu/util/resource.go:28-86).  The TPU analog derives
+`nos.tpu/tpu-memory` (HBM gigabytes) from:
+
+- whole chips (`google.com/tpu`): chips x hbm_gb_per_chip
+- slice profiles (`nos.tpu/slice-<XxY[xZ]>`): shape.chips x hbm_gb_per_chip
+- timeshare profiles (`nos.tpu/tpu-<N>gb`): N directly
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.resources import ResourceList, pod_request
+from nos_tpu.topology.profile import gb_from_resource, shape_from_resource
+
+
+class TPUResourceCalculator:
+    """Computes effective pod requests with the tpu-memory scalar added.
+
+    `hbm_gb_per_chip` plays the role of the reference's
+    `nvidiaGpuResourceMemoryGB` operator config (default 32 GB there;
+    16 GB here = v5e chip HBM).
+    """
+
+    def __init__(self, hbm_gb_per_chip: int = 16) -> None:
+        self.hbm_gb_per_chip = hbm_gb_per_chip
+
+    def compute_pod_request(self, pod) -> ResourceList:
+        req = pod_request(pod)
+        req[C.RESOURCE_TPU_MEMORY] = float(self.compute_required_tpu_memory_gb(req))
+        return req
+
+    def compute_required_tpu_memory_gb(self, request: ResourceList) -> int:
+        total = 0
+        for resource, qty in request.items():
+            if qty <= 0:
+                continue
+            if resource == C.RESOURCE_TPU:
+                total += self.hbm_gb_per_chip * int(qty)
+                continue
+            shape = shape_from_resource(resource)
+            if shape is not None:
+                total += shape.chips * self.hbm_gb_per_chip * int(qty)
+                continue
+            gb = gb_from_resource(resource)
+            if gb is not None:
+                total += gb * int(qty)
+        return total

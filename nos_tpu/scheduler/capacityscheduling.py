@@ -1,0 +1,422 @@
+"""CapacityScheduling: elastic-quota enforcement + over-quota preemption.
+
+Re-derivation of reference
+pkg/scheduler/plugins/capacityscheduling/capacity_scheduling.go for the
+nos_tpu scheduler framework, with quota currency `nos.tpu/tpu-memory`
+(see nos_tpu/quota/calculator.py).
+
+Plugin points (reference capacity_scheduling.go:92-95):
+- PreFilter (:190-278): snapshot quota ledger into cycle state; account
+  nominated pods; reject if used+req > max, or aggregate used+req > aggregate
+  min.
+- AddPod/RemovePod extensions (:286-321): keep the cycle-state snapshot
+  coherent during preemption what-ifs.
+- PostFilter (:323-341): preemption — over-quota-aware victim selection with
+  guaranteed-overquota fair sharing (SelectVictimsOnNode :468-675).
+- Reserve/Unreserve (:343-369): book usage on the live ledger.
+
+One deliberate divergence from the reference: quota aggregates
+(aggregated min/used/overquotas) count each CompositeElasticQuota once,
+not once per spanned namespace (the reference's map-range aggregation
+counts a CEQ's min N times for N namespaces — elasticquotainfo.go:154-174).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_POD,
+    NotFound,
+)
+from nos_tpu.kube.objects import PENDING, RUNNING, Pod
+from nos_tpu.kube.resources import ResourceList, sum_resources
+from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalculator
+from nos_tpu.scheduler.framework import (
+    CycleState, Framework, NodeInfo, SharedLister, Status,
+)
+from nos_tpu.utils.pod_util import is_over_quota
+
+logger = logging.getLogger(__name__)
+
+PRE_FILTER_STATE_KEY = "PreFilterCapacityScheduling"
+ELASTIC_QUOTA_SNAPSHOT_KEY = "ElasticQuotaSnapshot"
+
+
+class PreFilterState:
+    """Reference capacity_scheduling.go:61-73."""
+
+    def __init__(self, pod_req: ResourceList,
+                 nominated_in_eq_with_req: ResourceList | None = None,
+                 nominated_with_req: ResourceList | None = None) -> None:
+        self.pod_req = pod_req
+        # podReq + requests of nominated pods in the same quota with
+        # priority >= preemptor.
+        self.nominated_in_eq_with_req = nominated_in_eq_with_req or dict(pod_req)
+        # podReq + requests of nominated pods across all quotas (same-quota
+        # higher-priority ones, plus other-quota ones whose quota is
+        # within min).
+        self.nominated_with_req = nominated_with_req or dict(pod_req)
+
+
+def info_from_quota(obj, calculator, composite: bool = False) -> ElasticQuotaInfo:
+    """Build the ledger entry for an ElasticQuota/CompositeElasticQuota
+    (the informer's mapping, reference informer.go:139-260)."""
+    return ElasticQuotaInfo(
+        resource_name=obj.metadata.name,
+        resource_namespace=obj.metadata.namespace,
+        namespaces=obj.namespaces,
+        min=obj.spec.min,
+        max=obj.spec.max or None,
+        calculator=calculator,
+        composite=composite,
+    )
+
+
+class CapacityScheduling:
+    """The plugin.  Construct, then `attach(api)` to sync the ledger from
+    the API server (the informer analog); inside planner simulations it can
+    run detached with an empty ledger, exactly as the embedded framework in
+    reference cmd/gpupartitioner/gpupartitioner.go:294-318 starts empty."""
+
+    name = "CapacityScheduling"
+
+    def __init__(self, calculator: TPUResourceCalculator | None = None) -> None:
+        self.calculator = calculator or TPUResourceCalculator()
+        self.elastic_quota_infos = ElasticQuotaInfos()
+        self._api: APIServer | None = None
+        self._framework: Framework | None = None
+
+    # ------------------------------------------------------------------
+    # Wiring
+    # ------------------------------------------------------------------
+    def set_framework(self, fw: Framework) -> None:
+        """Handle used to re-run Filter during preemption what-ifs
+        (RunFilterPluginsWithNominatedPods, reference :610,639)."""
+        self._framework = fw
+
+    def attach(self, api: APIServer) -> None:
+        """Subscribe to EQ/CEQ and Pod events (the informer handlers,
+        reference capacity_scheduling.go:131-172)."""
+        self._api = api
+        api.watch(KIND_ELASTIC_QUOTA, self._on_eq_event)
+        api.watch(KIND_COMPOSITE_ELASTIC_QUOTA, self._on_ceq_event)
+        api.watch(KIND_POD, self._on_pod_event)
+
+    def _on_eq_event(self, event: str, eq) -> None:
+        # A namespace covered by a composite quota is shadowed by it
+        # (reference informer.go:139-260).
+        ns = eq.metadata.namespace
+        existing = self.elastic_quota_infos.get(ns)
+        if event == "DELETED":
+            if existing is not None and not existing.composite \
+                    and existing.resource_name == eq.metadata.name:
+                self.elastic_quota_infos.delete(existing)
+            return
+        if existing is not None and existing.composite:
+            return
+        new = info_from_quota(eq, self.calculator)
+        if existing is not None:
+            self.elastic_quota_infos.update_info(existing, new)
+        else:
+            self.elastic_quota_infos.add(new)
+        self._recount(new)
+
+    def _on_ceq_event(self, event: str, ceq) -> None:
+        new = info_from_quota(ceq, self.calculator, composite=True)
+        existing = None
+        for info in self.elastic_quota_infos.values():
+            if info.composite and info.resource_name == ceq.metadata.name \
+                    and info.resource_namespace == ceq.metadata.namespace:
+                existing = info
+                break
+        if event == "DELETED":
+            if existing is not None:
+                self.elastic_quota_infos.delete(existing)
+            return
+        if existing is not None:
+            self.elastic_quota_infos.update_info(existing, new)
+        else:
+            # Composite shadows any plain EQ on its namespaces.
+            for ns in new.namespaces:
+                shadowed = self.elastic_quota_infos.get(ns)
+                if shadowed is not None:
+                    self.elastic_quota_infos.delete(shadowed)
+            self.elastic_quota_infos.add(new)
+        self._recount(new)
+
+    def _recount(self, info: ElasticQuotaInfo) -> None:
+        """Seed usage from already-assigned pods when a quota appears."""
+        if self._api is None:
+            return
+        for pod in self._api.list(KIND_POD):
+            if pod.metadata.namespace in info.namespaces \
+                    and pod.spec.node_name \
+                    and pod.status.phase in (PENDING, RUNNING):
+                info.add_pod_if_not_present(pod)
+
+    def _on_pod_event(self, event: str, pod: Pod) -> None:
+        info = self.elastic_quota_infos.get(pod.metadata.namespace)
+        if info is None:
+            return
+        assigned = bool(pod.spec.node_name)
+        if event == "DELETED" or pod.status.phase not in (PENDING, RUNNING):
+            info.delete_pod_if_present(pod)
+        elif assigned:
+            info.add_pod_if_not_present(pod)
+
+    # ------------------------------------------------------------------
+    # PreFilter
+    # ------------------------------------------------------------------
+    def pre_filter(self, state: CycleState, pod: Pod,
+                   nodes: SharedLister) -> Status:
+        snapshot = self.elastic_quota_infos.clone()
+        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = snapshot
+        pod_req = self.calculator.compute_pod_request(pod)
+
+        eq = snapshot.get(pod.metadata.namespace)
+        if eq is None:
+            state[PRE_FILTER_STATE_KEY] = PreFilterState(pod_req)
+            return Status.ok()
+
+        nominated_in_eq: ResourceList = {}
+        nominated_all: ResourceList = {}
+        for np in self._nominated_pods():
+            if np.metadata.uid == pod.metadata.uid:
+                continue
+            ns = np.metadata.namespace
+            info = self.elastic_quota_infos.get(ns)
+            if info is None:
+                continue
+            req = self.calculator.compute_pod_request(np)
+            if ns == pod.metadata.namespace \
+                    and np.spec.priority >= pod.spec.priority:
+                nominated_in_eq = sum_resources(nominated_in_eq, req)
+                nominated_all = sum_resources(nominated_all, req)
+            elif ns != pod.metadata.namespace and not info.used_over_min():
+                nominated_all = sum_resources(nominated_all, req)
+
+        pfs = PreFilterState(
+            pod_req,
+            sum_resources(nominated_in_eq, pod_req),
+            sum_resources(nominated_all, pod_req),
+        )
+        state[PRE_FILTER_STATE_KEY] = pfs
+
+        if eq.used_over_max_with(pfs.nominated_in_eq_with_req):
+            return Status.unschedulable(
+                f"quota {eq.resource_namespace}/{eq.resource_name} "
+                f"used more than max"
+            )
+        if snapshot.aggregated_used_over_min_with(pfs.nominated_with_req):
+            return Status.unschedulable("total quota used is more than min")
+        return Status.ok()
+
+    def _nominated_pods(self) -> list[Pod]:
+        if self._api is None:
+            return []
+        return self._api.list(
+            KIND_POD,
+            filter_fn=lambda p: (p.status.nominated_node_name
+                                 and not p.spec.node_name
+                                 and p.status.phase == PENDING),
+        )
+
+    # ------------------------------------------------------------------
+    # PreFilter extensions (preemption what-if coherence)
+    # ------------------------------------------------------------------
+    def add_pod(self, state: CycleState, pod_to_schedule: Pod,
+                pod_to_add: Pod, node_info: NodeInfo) -> Status:
+        snapshot: ElasticQuotaInfos | None = state.get(ELASTIC_QUOTA_SNAPSHOT_KEY)
+        if snapshot is None:
+            return Status.error("no ElasticQuotaSnapshot in cycle state")
+        info = snapshot.get(pod_to_add.metadata.namespace)
+        if info is not None:
+            info.add_pod_if_not_present(pod_to_add)
+        return Status.ok()
+
+    def remove_pod(self, state: CycleState, pod_to_schedule: Pod,
+                   pod_to_remove: Pod, node_info: NodeInfo) -> Status:
+        snapshot: ElasticQuotaInfos | None = state.get(ELASTIC_QUOTA_SNAPSHOT_KEY)
+        if snapshot is None:
+            return Status.error("no ElasticQuotaSnapshot in cycle state")
+        info = snapshot.get(pod_to_remove.metadata.namespace)
+        if info is not None:
+            info.delete_pod_if_present(pod_to_remove)
+        return Status.ok()
+
+    # ------------------------------------------------------------------
+    # Reserve / Unreserve
+    # ------------------------------------------------------------------
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        info = self.elastic_quota_infos.get(pod.metadata.namespace)
+        if info is not None:
+            info.add_pod_if_not_present(pod)
+        return Status.ok()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        info = self.elastic_quota_infos.get(pod.metadata.namespace)
+        if info is not None:
+            info.delete_pod_if_present(pod)
+
+    # ------------------------------------------------------------------
+    # PostFilter: preemption
+    # ------------------------------------------------------------------
+    def post_filter(self, state: CycleState, pod: Pod,
+                    nodes: SharedLister) -> tuple[str, Status]:
+        if pod.spec.preemption_policy == "Never":
+            return "", Status.unschedulable(
+                "not eligible due to preemptionPolicy=Never"
+            )
+        if PRE_FILTER_STATE_KEY not in state:
+            return "", Status.unschedulable("PreFilter was not run")
+
+        candidates: list[tuple[str, list[Pod], int]] = []
+        for ni in nodes.list():
+            victims, num_violating, st = self._select_victims_on_node(
+                state, pod, ni)
+            if st.is_success and victims:
+                candidates.append((ni.name, victims, num_violating))
+        if not candidates:
+            return "", Status.unschedulable("preemption found no candidates")
+
+        best = min(candidates, key=self._candidate_key)
+        node_name, victims, _ = best
+        for v in victims:
+            self._evict(v)
+        logger.info("preempting %d pod(s) on %s for %s",
+                    len(victims), node_name, pod.key)
+        return node_name, Status.ok()
+
+    @staticmethod
+    def _candidate_key(cand: tuple[str, list[Pod], int]):
+        """Node choice mirrors upstream pickOneNodeForPreemption: fewest PDB
+        violations, lowest max victim priority, lowest priority sum, fewest
+        victims, then name."""
+        name, victims, num_violating = cand
+        priorities = [v.spec.priority for v in victims]
+        return (num_violating, max(priorities), sum(priorities),
+                len(victims), name)
+
+    def _evict(self, victim: Pod) -> None:
+        if self._api is None:
+            return
+        try:
+            self._api.delete(KIND_POD, victim.metadata.name,
+                             victim.metadata.namespace)
+        except NotFound:
+            pass
+
+    def _select_victims_on_node(
+            self, state: CycleState, pod: Pod, node_info: NodeInfo,
+            pdbs: list | None = None) -> tuple[list[Pod], int, Status]:
+        """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675),
+        run against clones so failed candidates leave no trace."""
+        base_snapshot: ElasticQuotaInfos = state[ELASTIC_QUOTA_SNAPSHOT_KEY]
+        pfs: PreFilterState = state[PRE_FILTER_STATE_KEY]
+
+        # Candidate-local what-if copies.
+        snapshot = base_snapshot.clone()
+        ni = node_info.clone()
+        wstate = CycleState(state)
+        wstate[ELASTIC_QUOTA_SNAPSHOT_KEY] = snapshot
+
+        pod_req = pfs.pod_req
+        nominated_in_eq = pfs.nominated_in_eq_with_req
+        nominated_all = pfs.nominated_with_req
+        preemptor_info = snapshot.get(pod.metadata.namespace)
+
+        def remove(p: Pod) -> None:
+            ni.remove_pod(p)
+            self.remove_pod(wstate, pod, p, ni)
+
+        def add(p: Pod) -> None:
+            ni.add_pod(p)
+            self.add_pod(wstate, pod, p, ni)
+
+        potential: list[Pod] = []
+        # Walk victims lowest-priority first (reference sorts ascending :516).
+        node_pods = sorted(
+            ni.pods, key=lambda p: (p.spec.priority,
+                                    -p.metadata.creation_timestamp))
+        if preemptor_info is not None:
+            more_than_min = preemptor_info.used_over_min_with(nominated_in_eq)
+            for pv in node_pods:
+                pv_info = snapshot.get(pv.metadata.namespace)
+                if pv_info is None:
+                    continue
+                if more_than_min:
+                    # Preemptor would run over-quota: same-namespace
+                    # lower-priority victims...
+                    if pv.metadata.namespace == pod.metadata.namespace:
+                        if pv.spec.priority < pod.spec.priority:
+                            potential.append(pv)
+                            remove(pv)
+                        continue
+                    # ...or cross-namespace over-quota pods, but only while
+                    # the preemptor stays within min + its guaranteed share
+                    # of the aggregate unused min, and the victim's quota
+                    # exceeds its own guaranteed share (:547-564).
+                    if not is_over_quota(pv):
+                        continue
+                    g = snapshot.get_guaranteed_overquotas(pod.metadata.namespace)
+                    min_plus_g = sum_resources(g, preemptor_info.min)
+                    if preemptor_info.used_lte_with(min_plus_g, nominated_in_eq):
+                        pv_g = snapshot.get_guaranteed_overquotas(
+                            pv.metadata.namespace)
+                        pv_min_plus_g = sum_resources(pv_g, pv_info.min)
+                        if pv_info.used_over(pv_min_plus_g):
+                            potential.append(pv)
+                            remove(pv)
+                else:
+                    # Preemptor within min: its guaranteed quota is borrowed
+                    # elsewhere — only cross-namespace over-quota-labelled
+                    # pods from borrowing quotas are eligible (:566-581).
+                    if pv.metadata.namespace != pod.metadata.namespace \
+                            and pv_info.used_over_min() and is_over_quota(pv):
+                        potential.append(pv)
+                        remove(pv)
+        else:
+            # Preemptor not governed by any quota: classic priority
+            # preemption among quota-less pods (:583-596).
+            for pv in node_pods:
+                if snapshot.get(pv.metadata.namespace) is not None:
+                    continue
+                if pv.spec.priority < pod.spec.priority:
+                    potential.append(pv)
+                    remove(pv)
+
+        if not potential:
+            return [], 0, Status.unschedulable("no victims found")
+
+        fw = self._framework
+        if fw is None:
+            return [], 0, Status.error("framework handle not set")
+        if not fw.run_filter_plugins(wstate, pod, ni).is_success:
+            return [], 0, Status.unschedulable(
+                "pod does not fit even after removing all candidates")
+        if preemptor_info is not None:
+            if preemptor_info.used_over_max_with(pod_req):
+                return [], 0, Status.unschedulable("max quota exceeded")
+            if snapshot.aggregated_used_over_min_with(pod_req):
+                return [], 0, Status.unschedulable("total min quota exceeded")
+
+        # Reprieve as many victims as possible, highest priority first
+        # (:626-673).  No PDB objects exist in this object model yet, so all
+        # victims are non-violating.
+        victims: list[Pod] = []
+        num_violating = 0
+        for pv in sorted(potential,
+                         key=lambda p: (-p.spec.priority,
+                                        p.metadata.creation_timestamp)):
+            add(pv)
+            fits = fw.run_filter_plugins(wstate, pod, ni).is_success
+            over_quota = preemptor_info is not None and (
+                preemptor_info.used_over_max_with(nominated_in_eq)
+                or snapshot.aggregated_used_over_min_with(nominated_all)
+            )
+            if not fits or over_quota:
+                remove(pv)
+                victims.append(pv)
+        return victims, num_violating, Status.ok()

@@ -17,7 +17,7 @@ from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.scheduler.framework import (
-    CycleState, Framework, NodeInfo, SharedLister, Status,
+    CycleState, Framework, NodeInfo, SharedLister, Status, UNSCHEDULABLE,
 )
 
 logger = logging.getLogger(__name__)
@@ -48,6 +48,17 @@ class Scheduler:
         state = CycleState()
         status = self._framework.run_pre_filter_plugins(state, pod, lister)
         if not status.is_success:
+            # An unschedulable PreFilter verdict still gets a preemption
+            # attempt, exactly like kube-scheduler: quota rejections are
+            # resolved by evicting over-quota borrowers (reference
+            # capacity_scheduling.go:323-341).
+            if status.code == UNSCHEDULABLE:
+                nominated, post = self._framework.run_post_filter_plugins(
+                    state, pod, lister
+                )
+                if post.is_success and nominated:
+                    self._nominate(pod, nominated)
+                    return None
             self._mark_unschedulable(pod, status)
             return None
         feasible: list[NodeInfo] = []

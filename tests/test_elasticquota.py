@@ -1,0 +1,447 @@
+"""Elastic-quota subsystem tests.
+
+Modeled on reference test strategy (SURVEY.md §4): quota arithmetic
+(elasticquotainfo_test.go), plugin behavior driven through the real framework
+(capacity_scheduling_test.go), and reconciler behavior against the API
+substrate (elasticquota_controller_int_test.go).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.elasticquota import (
+    AdmissionError, CompositeElasticQuota, CompositeElasticQuotaSpec,
+    ElasticQuota, ElasticQuotaSpec, validate_composite_elastic_quota,
+    validate_elastic_quota,
+)
+from nos_tpu.controllers.elasticquota import (
+    CompositeElasticQuotaReconciler, ElasticQuotaReconciler,
+)
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_NODE,
+    KIND_POD, NotFound,
+)
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalculator
+from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+from nos_tpu.scheduler.framework import CycleState, Framework, NodeResourcesFit, SharedLister
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_node, make_pod
+
+TPU_MEM = C.RESOURCE_TPU_MEMORY
+CALC = TPUResourceCalculator(hbm_gb_per_chip=16)
+
+
+def make_eq(name: str, namespace: str, min: dict, max: dict | None = None) -> ElasticQuota:
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ElasticQuotaSpec(min=dict(min), max=dict(max or {})),
+    )
+
+
+def make_info(name: str, ns: str, min: dict, max: dict | None = None,
+              used: dict | None = None) -> ElasticQuotaInfo:
+    info = ElasticQuotaInfo(name, ns, [ns], min, max, CALC)
+    info.used = dict(used or {})
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Resource calculator
+# ---------------------------------------------------------------------------
+
+
+class TestTPUResourceCalculator:
+    def test_whole_chips(self):
+        pod = make_pod(resources={C.RESOURCE_TPU: 4, "cpu": 2})
+        req = CALC.compute_pod_request(pod)
+        assert req[TPU_MEM] == 4 * 16
+
+    def test_slice_profile(self):
+        pod = make_pod(resources={f"{C.RESOURCE_SLICE_PREFIX}2x2": 1})
+        req = CALC.compute_pod_request(pod)
+        assert req[TPU_MEM] == 4 * 16
+
+    def test_timeshare_profile(self):
+        pod = make_pod(resources={f"{C.RESOURCE_TIMESHARE_PREFIX}8gb": 2})
+        req = CALC.compute_pod_request(pod)
+        assert req[TPU_MEM] == 16
+
+    def test_mixed(self):
+        pod = make_pod(resources={
+            C.RESOURCE_TPU: 1,
+            f"{C.RESOURCE_SLICE_PREFIX}1x1": 1,
+            f"{C.RESOURCE_TIMESHARE_PREFIX}4gb": 1,
+        })
+        assert CALC.compute_pod_request(pod)[TPU_MEM] == 16 + 16 + 4
+
+
+# ---------------------------------------------------------------------------
+# Quota ledger arithmetic (reference elasticquotainfo_test.go)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticQuotaInfo:
+    def test_used_over_min_with(self):
+        info = make_info("eq", "ns", {TPU_MEM: 100}, used={TPU_MEM: 90})
+        assert not info.used_over_min_with({TPU_MEM: 10})
+        assert info.used_over_min_with({TPU_MEM: 11})
+
+    def test_max_not_enforced_when_absent(self):
+        info = make_info("eq", "ns", {TPU_MEM: 100})
+        assert not info.used_over_max_with({TPU_MEM: 10**9})
+
+    def test_max_enforced(self):
+        info = make_info("eq", "ns", {TPU_MEM: 100}, max={TPU_MEM: 200},
+                         used={TPU_MEM: 150})
+        assert not info.used_over_max_with({TPU_MEM: 50})
+        assert info.used_over_max_with({TPU_MEM: 51})
+
+    def test_unenforced_scalar_resource_ignored(self):
+        # A resource absent from min does not bound usage...
+        info = make_info("eq", "ns", {TPU_MEM: 100},
+                         used={"google.com/tpu": 999})
+        assert not info.used_over_min()
+        # ...but cpu and memory are always enforced (framework.Resource
+        # first-class fields, reference elasticquotainfo.go:319-338).
+        info.used = {"cpu": 1}
+        assert info.used_over_min()
+
+    def test_add_delete_pod_idempotent(self):
+        info = make_info("eq", "ns", {TPU_MEM: 100})
+        pod = make_pod(namespace="ns", resources={C.RESOURCE_TPU: 2})
+        info.add_pod_if_not_present(pod)
+        info.add_pod_if_not_present(pod)
+        assert info.used[TPU_MEM] == 32
+        info.delete_pod_if_present(pod)
+        info.delete_pod_if_present(pod)
+        assert info.used[TPU_MEM] == 0
+
+    def test_guaranteed_overquotas_proportional_to_min(self):
+        # The worked example in reference elasticquotainfo.go:121-152:
+        # A(min=100, used=350), B(min=50, used=0), C(min=200, used=50)
+        # -> aggregate overquotas = 0 + 50 + 150 = 200.
+        infos = ElasticQuotaInfos()
+        infos.add(make_info("a", "ns-a", {"cpu": 100}, used={"cpu": 350}))
+        infos.add(make_info("b", "ns-b", {"cpu": 50}, used={"cpu": 0}))
+        infos.add(make_info("c", "ns-c", {"cpu": 200}, used={"cpu": 50}))
+        assert infos.aggregated_overquotas() == {"cpu": 200}
+        # Guaranteed shares are proportional to min (100:50:200 of 350).
+        assert infos.get_guaranteed_overquotas("ns-a") == {"cpu": 57.0}
+        assert infos.get_guaranteed_overquotas("ns-b") == {"cpu": 28.0}
+        assert infos.get_guaranteed_overquotas("ns-c") == {"cpu": 114.0}
+
+    def test_aggregated_used_over_min_with(self):
+        infos = ElasticQuotaInfos()
+        infos.add(make_info("a", "ns-a", {TPU_MEM: 64}, used={TPU_MEM: 64}))
+        infos.add(make_info("b", "ns-b", {TPU_MEM: 64}, used={TPU_MEM: 32}))
+        assert not infos.aggregated_used_over_min_with({TPU_MEM: 32})
+        assert infos.aggregated_used_over_min_with({TPU_MEM: 33})
+
+    def test_composite_counted_once_in_aggregates(self):
+        infos = ElasticQuotaInfos()
+        ceq = ElasticQuotaInfo("team", "default", ["ns-1", "ns-2"],
+                               {TPU_MEM: 100}, None, CALC, composite=True)
+        infos.add(ceq)
+        assert infos.aggregated_min() == {TPU_MEM: 100}
+
+    def test_clone_preserves_composite_identity(self):
+        infos = ElasticQuotaInfos()
+        ceq = ElasticQuotaInfo("team", "default", ["ns-1", "ns-2"],
+                               {TPU_MEM: 100}, None, CALC, composite=True)
+        infos.add(ceq)
+        cloned = infos.clone()
+        assert cloned["ns-1"] is cloned["ns-2"]
+        pod = make_pod(namespace="ns-1", resources={C.RESOURCE_TPU: 1})
+        cloned["ns-1"].add_pod_if_not_present(pod)
+        assert cloned["ns-2"].used[TPU_MEM] == 16
+        assert ceq.used == {}  # original untouched
+
+
+# ---------------------------------------------------------------------------
+# Plugin through the framework
+# ---------------------------------------------------------------------------
+
+
+def quota_cluster(*, nodes=2, chips_per_node=8):
+    """API + scheduler wiring with CapacityScheduling registered."""
+    api = APIServer()
+    plugin = CapacityScheduling(CALC)
+    fw = Framework([NodeResourcesFit(), plugin])
+    plugin.set_framework(fw)
+    plugin.attach(api)
+    for i in range(nodes):
+        api.create(KIND_NODE, make_node(
+            f"node-{i}",
+            allocatable={"cpu": 64.0, C.RESOURCE_TPU: float(chips_per_node),
+                         TPU_MEM: chips_per_node * 16.0},
+        ))
+    sched = Scheduler(api, fw)
+    return api, plugin, fw, sched
+
+
+class TestCapacitySchedulingPreFilter:
+    def test_no_quota_passes(self):
+        api, plugin, fw, sched = quota_cluster()
+        pod = make_pod(namespace="free", resources={C.RESOURCE_TPU: 2})
+        st = plugin.pre_filter(CycleState(), pod, SharedLister())
+        assert st.is_success
+
+    def test_rejects_over_max(self):
+        api, plugin, fw, sched = quota_cluster()
+        api.create(KIND_ELASTIC_QUOTA, make_eq(
+            "eq-a", "ns-a", min={TPU_MEM: 32}, max={TPU_MEM: 48}))
+        pod = make_pod(namespace="ns-a", resources={C.RESOURCE_TPU: 4})  # 64GB
+        st = plugin.pre_filter(CycleState(), pod, SharedLister())
+        assert not st.is_success and "max" in st.message
+
+    def test_allows_borrowing_within_aggregate_min(self):
+        api, plugin, fw, sched = quota_cluster()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 32}))
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-b", "ns-b", min={TPU_MEM: 96}))
+        # ns-a requests 64GB > its own min 32, but aggregate min 128 has room.
+        pod = make_pod(namespace="ns-a", resources={C.RESOURCE_TPU: 4})
+        st = plugin.pre_filter(CycleState(), pod, SharedLister())
+        assert st.is_success
+
+    def test_rejects_when_aggregate_min_exhausted(self):
+        api, plugin, fw, sched = quota_cluster()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 32}))
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-b", "ns-b", min={TPU_MEM: 32}))
+        # ns-b is using its whole min.
+        api.create(KIND_POD, make_pod(
+            name="b-1", namespace="ns-b", resources={C.RESOURCE_TPU: 2},
+            node_name="node-0", phase=RUNNING))
+        pod = make_pod(namespace="ns-a", resources={C.RESOURCE_TPU: 3})  # 48GB
+        st = plugin.pre_filter(CycleState(), pod, SharedLister())
+        assert not st.is_success and "min" in st.message
+
+    def test_reserve_unreserve_bookkeeping(self):
+        api, plugin, fw, sched = quota_cluster()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 64}))
+        pod = make_pod(namespace="ns-a", resources={C.RESOURCE_TPU: 2})
+        plugin.reserve(CycleState(), pod, "node-0")
+        assert plugin.elastic_quota_infos["ns-a"].used[TPU_MEM] == 32
+        plugin.unreserve(CycleState(), pod, "node-0")
+        assert plugin.elastic_quota_infos["ns-a"].used[TPU_MEM] == 0
+
+
+class TestEndToEndSchedulingWithQuota:
+    def test_borrow_then_preempt_over_quota_pod(self):
+        """BASELINE config #5 shape: ns-b borrows ns-a's unused quota; when
+        ns-a claims its min back, the scheduler preempts ns-b's over-quota
+        pod (reference SelectVictimsOnNode :566-581)."""
+        api, plugin, fw, sched = quota_cluster(nodes=1, chips_per_node=8)
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 64}))
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-b", "ns-b", min={TPU_MEM: 64}))
+        eq_rec = ElasticQuotaReconciler(api, CALC)
+
+        # ns-b fills the whole node (8 chips = 128GB), borrowing 64GB.
+        for i in range(2):
+            api.create(KIND_POD, make_pod(
+                name=f"b-{i}", namespace="ns-b",
+                resources={C.RESOURCE_TPU: 4}, creation_timestamp=float(i)))
+        assert sched.run_cycle() == 2
+        eq_rec.reconcile_all()
+        labels = {p.metadata.name: p.metadata.labels.get(C.LABEL_CAPACITY)
+                  for p in api.list(KIND_POD, namespace="ns-b")}
+        assert sorted(labels.values()) == ["in-quota", "over-quota"]
+
+        # ns-a now claims its guaranteed min: 4 chips = 64GB.
+        a_pod = make_pod(name="a-0", namespace="ns-a",
+                         resources={C.RESOURCE_TPU: 4})
+        api.create(KIND_POD, a_pod)
+        assert sched.run_cycle() == 0  # first cycle: preempts + nominates
+        remaining_b = api.list(KIND_POD, namespace="ns-b")
+        assert len(remaining_b) == 1  # over-quota borrower evicted
+        assert remaining_b[0].metadata.labels[C.LABEL_CAPACITY] == "in-quota"
+        nominated = api.get(KIND_POD, "a-0", "ns-a")
+        assert nominated.status.nominated_node_name == "node-0"
+
+        # Next cycle the freed capacity admits the pod.
+        assert sched.run_cycle() == 1
+        assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name == "node-0"
+
+    def test_same_namespace_priority_preemption(self):
+        """Over-min preemptor evicts same-namespace lower-priority pods
+        (reference :529-541)."""
+        api, plugin, fw, sched = quota_cluster(nodes=1, chips_per_node=8)
+        api.create(KIND_ELASTIC_QUOTA, make_eq(
+            "eq-a", "ns-a", min={TPU_MEM: 64}))
+        # Idle quota providing the aggregate-min headroom ns-a borrows.
+        api.create(KIND_ELASTIC_QUOTA, make_eq(
+            "eq-b", "ns-b", min={TPU_MEM: 64}))
+        # Low-priority pod fills the node, running over-quota.
+        api.create(KIND_POD, make_pod(
+            name="low", namespace="ns-a", priority=0,
+            resources={C.RESOURCE_TPU: 8}))
+        assert sched.run_cycle() == 1
+        ElasticQuotaReconciler(api, CALC).reconcile_all()
+        # High-priority pod displaces it.
+        api.create(KIND_POD, make_pod(
+            name="high", namespace="ns-a", priority=100,
+            resources={C.RESOURCE_TPU: 4}))
+        sched.run_cycle()
+        assert api.try_get(KIND_POD, "low", "ns-a") is None
+        sched.run_cycle()
+        assert api.get(KIND_POD, "high", "ns-a").spec.node_name == "node-0"
+
+    def test_no_preemption_of_in_quota_pods(self):
+        """A borrower cannot evict pods that are within their own min."""
+        api, plugin, fw, sched = quota_cluster(nodes=1, chips_per_node=8)
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 32}))
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-b", "ns-b", min={TPU_MEM: 96}))
+        api.create(KIND_POD, make_pod(
+            name="b-0", namespace="ns-b", resources={C.RESOURCE_TPU: 6}))
+        assert sched.run_cycle() == 1
+        ElasticQuotaReconciler(api, CALC).reconcile_all()
+        # ns-a wants 4 chips: 2 over its min — no over-quota victims exist.
+        api.create(KIND_POD, make_pod(
+            name="a-0", namespace="ns-a", resources={C.RESOURCE_TPU: 4}))
+        sched.run_cycle()
+        assert api.try_get(KIND_POD, "b-0", "ns-b") is not None
+        assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name == ""
+
+
+# ---------------------------------------------------------------------------
+# Reconcilers
+# ---------------------------------------------------------------------------
+
+
+class TestElasticQuotaReconciler:
+    def test_status_used_and_labels(self):
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq(
+            "eq-a", "ns-a", min={TPU_MEM: 64}))
+        # Three running pods of 2 chips (32GB) each: first two in-quota.
+        for i in range(3):
+            api.create(KIND_POD, make_pod(
+                name=f"p-{i}", namespace="ns-a",
+                resources={C.RESOURCE_TPU: 2}, node_name="node-0",
+                phase=RUNNING, creation_timestamp=float(i)))
+        rec = ElasticQuotaReconciler(api, CALC)
+        rec.reconcile("eq-a", "ns-a")
+        eq = api.get(KIND_ELASTIC_QUOTA, "eq-a", "ns-a")
+        assert eq.status.used == {TPU_MEM: 96.0}
+        labels = [api.get(KIND_POD, f"p-{i}", "ns-a").metadata.labels[C.LABEL_CAPACITY]
+                  for i in range(3)]
+        assert labels == ["in-quota", "in-quota", "over-quota"]
+
+    def test_labeling_ignores_resources_absent_from_min(self):
+        """Regression: a pod requesting cpu under a quota whose min omits cpu
+        must stay in-quota (labeling enforces only min's named resources,
+        unlike the scheduler plugin's cpu/memory-always comparison)."""
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 64}))
+        api.create(KIND_POD, make_pod(
+            name="p", namespace="ns-a",
+            resources={"cpu": 4, C.RESOURCE_TPU: 1},
+            node_name="n", phase=RUNNING))
+        ElasticQuotaReconciler(api, CALC).reconcile("eq-a", "ns-a")
+        pod = api.get(KIND_POD, "p", "ns-a")
+        assert pod.metadata.labels[C.LABEL_CAPACITY] == C.CAPACITY_IN_QUOTA
+
+    def test_drops_non_enforced_resources(self):
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 64}))
+        api.create(KIND_POD, make_pod(
+            name="p", namespace="ns-a",
+            resources={"cpu": 4, C.RESOURCE_TPU: 1},
+            node_name="n", phase=RUNNING))
+        rec = ElasticQuotaReconciler(api, CALC)
+        rec.reconcile("eq-a", "ns-a")
+        eq = api.get(KIND_ELASTIC_QUOTA, "eq-a", "ns-a")
+        assert "cpu" not in eq.status.used
+        assert eq.status.used[TPU_MEM] == 16.0
+
+
+class TestCompositeElasticQuota:
+    def test_spans_namespaces_and_deletes_overlapping_eq(self):
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-1", "ns-1", min={TPU_MEM: 16}))
+        ceq = CompositeElasticQuota(
+            metadata=ObjectMeta(name="team", namespace="default"),
+            spec=CompositeElasticQuotaSpec(
+                namespaces=["ns-1", "ns-2"], min={TPU_MEM: 64}),
+        )
+        api.create(KIND_COMPOSITE_ELASTIC_QUOTA, ceq)
+        for ns in ("ns-1", "ns-2"):
+            api.create(KIND_POD, make_pod(
+                name=f"p-{ns}", namespace=ns,
+                resources={C.RESOURCE_TPU: 1}, node_name="n", phase=RUNNING))
+        rec = CompositeElasticQuotaReconciler(api, CALC)
+        rec.reconcile("team", "default")
+        assert api.try_get(KIND_ELASTIC_QUOTA, "eq-1", "ns-1") is None
+        out = api.get(KIND_COMPOSITE_ELASTIC_QUOTA, "team", "default")
+        assert out.status.used == {TPU_MEM: 32.0}
+
+
+    def test_ceq_namespace_growth_keeps_ledger(self):
+        """Regression: expanding a CompositeElasticQuota over a namespace
+        that had its own ElasticQuota must keep the CEQ's tracked usage and
+        absorb the newly covered namespace's assigned pods."""
+        api = APIServer()
+        plugin = CapacityScheduling(CALC)
+        plugin.attach(api)
+        api.create(KIND_COMPOSITE_ELASTIC_QUOTA, CompositeElasticQuota(
+            metadata=ObjectMeta(name="team", namespace="default"),
+            spec=CompositeElasticQuotaSpec(
+                namespaces=["ns-1", "ns-2"], min={TPU_MEM: 128})))
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-3", "ns-3", min={TPU_MEM: 32}))
+        api.create(KIND_POD, make_pod(
+            name="a", namespace="ns-1", resources={C.RESOURCE_TPU: 4},
+            node_name="n", phase=RUNNING))
+        api.create(KIND_POD, make_pod(
+            name="b", namespace="ns-3", resources={C.RESOURCE_TPU: 1},
+            node_name="n", phase=RUNNING))
+        assert plugin.elastic_quota_infos["ns-1"].used[TPU_MEM] == 64
+        # Expand the CEQ to also cover ns-3.
+        api.patch(KIND_COMPOSITE_ELASTIC_QUOTA, "team", "default",
+                  mutate=lambda o: o.spec.namespaces.append("ns-3"))
+        info = plugin.elastic_quota_infos["ns-3"]
+        assert info.composite
+        assert info is plugin.elastic_quota_infos["ns-1"]
+        # 64GB carried + 16GB from ns-3's pod recounted.
+        assert info.used[TPU_MEM] == 80
+        assert info.pods == {"ns-1/a", "ns-3/b"}
+
+
+# ---------------------------------------------------------------------------
+# Webhooks
+# ---------------------------------------------------------------------------
+
+
+class TestWebhooks:
+    def test_one_eq_per_namespace(self):
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-1", "ns-1", min={}))
+        with pytest.raises(AdmissionError):
+            validate_elastic_quota(api, make_eq("eq-2", "ns-1", min={}))
+        # update of the same EQ passes
+        validate_elastic_quota(api, make_eq("eq-1", "ns-1", min={TPU_MEM: 1}))
+
+    def test_eq_rejected_in_ceq_namespace(self):
+        api = APIServer()
+        api.create(KIND_COMPOSITE_ELASTIC_QUOTA, CompositeElasticQuota(
+            metadata=ObjectMeta(name="team", namespace="default"),
+            spec=CompositeElasticQuotaSpec(namespaces=["ns-1"], min={})))
+        with pytest.raises(AdmissionError):
+            validate_elastic_quota(api, make_eq("eq-1", "ns-1", min={}))
+
+    def test_ceq_overlap_rejected(self):
+        api = APIServer()
+        api.create(KIND_COMPOSITE_ELASTIC_QUOTA, CompositeElasticQuota(
+            metadata=ObjectMeta(name="team-a", namespace="default"),
+            spec=CompositeElasticQuotaSpec(namespaces=["ns-1", "ns-2"], min={})))
+        with pytest.raises(AdmissionError):
+            validate_composite_elastic_quota(api, CompositeElasticQuota(
+                metadata=ObjectMeta(name="team-b", namespace="default"),
+                spec=CompositeElasticQuotaSpec(namespaces=["ns-2"], min={})))
+
+    def test_ceq_requires_namespaces(self):
+        with pytest.raises(AdmissionError):
+            validate_composite_elastic_quota(APIServer(), CompositeElasticQuota(
+                metadata=ObjectMeta(name="x", namespace="default"),
+                spec=CompositeElasticQuotaSpec(namespaces=[], min={})))
