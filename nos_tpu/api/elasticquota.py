@@ -72,6 +72,15 @@ class AdmissionError(Exception):
     """Webhook rejection (the analog of a denied AdmissionReview)."""
 
 
+def install_quota_webhooks(api: APIServer) -> None:
+    """Register both validating webhooks on the API substrate — the operator
+    main does this at boot (reference cmd/operator/operator.go:50-126 wires
+    SetupWebhookWithManager)."""
+    api.register_admission(KIND_ELASTIC_QUOTA, validate_elastic_quota)
+    api.register_admission(KIND_COMPOSITE_ELASTIC_QUOTA,
+                           validate_composite_elastic_quota)
+
+
 def validate_elastic_quota(api: APIServer, eq: ElasticQuota) -> None:
     """Create/update validation for ElasticQuota (reference
     elasticquota_webhook.go:48-97): at most one EQ per namespace, and the

@@ -28,6 +28,33 @@ from nos_tpu.quota import TPUResourceCalculator
 logger = logging.getLogger(__name__)
 
 
+class _ReentrancyGuard:
+    """The APIServer fans watch events out synchronously, so a reconcile
+    that patches pods/status re-triggers itself through its own watches.
+    Nested triggers are deferred and drained iteratively — bounded stack
+    regardless of how many pods flip labels."""
+
+    def __init__(self) -> None:
+        self._active = False
+        self._pending: list[tuple[str, str]] = []
+
+    def run(self, name: str, namespace: str, fn) -> None:
+        self._pending.append((name, namespace))
+        if self._active:
+            return
+        self._active = True
+        try:
+            seen_idle = 0
+            while self._pending and seen_idle < 1000:
+                batch = dict.fromkeys(self._pending)
+                self._pending.clear()
+                for n, ns in batch:
+                    fn(n, ns)
+                seen_idle += 1
+        finally:
+            self._active = False
+
+
 class _PodsReconciler:
     """Shared pods walk (reference elasticquota.go:38-149)."""
 
@@ -87,8 +114,12 @@ class ElasticQuotaReconciler:
         self._api = api
         self._calculator = calculator or TPUResourceCalculator()
         self._pods = _PodsReconciler(api, self._calculator)
+        self._guard = _ReentrancyGuard()
 
     def reconcile(self, name: str, namespace: str) -> None:
+        self._guard.run(name, namespace, self._reconcile)
+
+    def _reconcile(self, name: str, namespace: str) -> None:
         try:
             eq: ElasticQuota = self._api.get(KIND_ELASTIC_QUOTA, name, namespace)
         except NotFound:
@@ -137,8 +168,12 @@ class CompositeElasticQuotaReconciler:
         self._api = api
         self._calculator = calculator or TPUResourceCalculator()
         self._pods = _PodsReconciler(api, self._calculator)
+        self._guard = _ReentrancyGuard()
 
     def reconcile(self, name: str, namespace: str) -> None:
+        self._guard.run(name, namespace, self._reconcile)
+
+    def _reconcile(self, name: str, namespace: str) -> None:
         try:
             ceq: CompositeElasticQuota = self._api.get(
                 KIND_COMPOSITE_ELASTIC_QUOTA, name, namespace)

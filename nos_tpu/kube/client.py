@@ -44,7 +44,22 @@ class APIServer:
         self._lock = threading.RLock()
         self._stores: dict[str, dict[str, Any]] = defaultdict(dict)
         self._watchers: dict[str, list[WatchFn]] = defaultdict(list)
+        self._admission: dict[str, list[Callable[["APIServer", Any], None]]] = \
+            defaultdict(list)
         self._rv = 0
+
+    # -- admission (validating webhooks) -----------------------------------
+    def register_admission(self, kind: str,
+                           fn: Callable[["APIServer", Any], None]) -> None:
+        """Register a validating webhook for a kind; `fn(api, obj)` raises
+        to deny the write (create/update/patch).  The analog of the
+        reference's controller-runtime webhooks
+        (pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:48-97)."""
+        self._admission[kind].append(fn)
+
+    def _admit(self, kind: str, obj: Any) -> None:
+        for fn in self._admission.get(kind, []):
+            fn(self, obj)
 
     # -- helpers ----------------------------------------------------------
     @staticmethod
@@ -63,6 +78,7 @@ class APIServer:
             store = self._stores[kind]
             if key in store:
                 raise Conflict(f"{kind} {key} already exists")
+            self._admit(kind, obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             store[key] = copy.deepcopy(obj)
@@ -89,6 +105,7 @@ class APIServer:
             store = self._stores[kind]
             if key not in store:
                 raise NotFound(f"{kind} {key}")
+            self._admit(kind, obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             store[key] = copy.deepcopy(obj)
@@ -107,6 +124,7 @@ class APIServer:
                 raise NotFound(f"{kind} {key}")
             obj = copy.deepcopy(store[key])
             mutate(obj)
+            self._admit(kind, obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             store[key] = obj

@@ -2,14 +2,16 @@
 
 Re-derivation of reference
 pkg/scheduler/plugins/capacityscheduling/elasticquotainfo.go:30-361 with
-ResourceLists as plain dicts.  Comparison semantics preserved exactly:
+ResourceLists as plain dicts.
 
-- `cpu` and `memory` are compared unconditionally (they are first-class
-  fields of the Go framework.Resource, defaulting to 0 — sumGreaterThan,
-  elasticquotainfo.go:319-338).
-- every other (scalar) resource is compared only when present in the limit
-  being checked — a quota that doesn't mention `google.com/tpu` doesn't
-  bound it.
+Enforcement semantics — one deliberate divergence from the reference: a
+limit bounds ONLY the resources it names.  The reference's Go
+framework.Resource compares cpu/memory unconditionally (sumGreaterThan,
+elasticquotainfo.go:319-338), which makes any pod with a cpu request
+permanently unschedulable under a quota denominated purely in
+`nos.tpu/tpu-memory` — while its own reconciler labels the same pod
+in-quota via quota.LessThanOrEqual (elasticquota.go:53), which checks only
+named resources.  We use the reconciler's (coherent) semantics everywhere.
 """
 
 from __future__ import annotations
@@ -18,26 +20,15 @@ import math
 from typing import Iterable, Mapping
 
 from nos_tpu.kube.resources import (
-    ResourceList, subtract_non_negative, sum_resources,
+    ResourceList, subtract, subtract_non_negative, sum_resources,
 )
-
-# Resources compared unconditionally against a limit (missing == 0).
-_ALWAYS_ENFORCED = ("cpu", "memory")
 
 
 def sum_greater_than(x1: Mapping[str, float], x2: Mapping[str, float],
                      y: Mapping[str, float]) -> bool:
-    """True iff any resource of (x1+x2) that y enforces exceeds y.
-    Reference elasticquotainfo.go:319-338."""
-    for r in _ALWAYS_ENFORCED:
-        if x1.get(r, 0.0) + x2.get(r, 0.0) > y.get(r, 0.0):
-            return True
-    for r in set(x1) | set(x2):
-        if r in _ALWAYS_ENFORCED:
-            continue
-        if r in y and x1.get(r, 0.0) + x2.get(r, 0.0) > y[r]:
-            return True
-    return False
+    """True iff any resource of (x1+x2) that y names exceeds y."""
+    return any(x1.get(r, 0.0) + x2.get(r, 0.0) > limit
+               for r, limit in y.items())
 
 
 def greater_than(x: Mapping[str, float], y: Mapping[str, float]) -> bool:
@@ -64,7 +55,10 @@ class ElasticQuotaInfo:
         self.max: ResourceList = dict(max or {})
         self.max_enforced = bool(max)
         self.used: ResourceList = {}
-        self.pods: set[str] = set()
+        # pod key ("ns/name") -> the request booked for it, so usage can be
+        # reclaimed without the pod object (e.g. when a composite quota's
+        # namespace set shrinks and the pod leaves the ledger's view).
+        self.pods: dict[str, ResourceList] = {}
         self.calculator = calculator
         self.composite = composite
 
@@ -73,17 +67,17 @@ class ElasticQuotaInfo:
         key = pod.key
         if key in self.pods:
             return
-        self.pods.add(key)
-        self.used = sum_resources(self.used, self.calculator.compute_pod_request(pod))
+        req = self.calculator.compute_pod_request(pod)
+        self.pods[key] = req
+        self.used = sum_resources(self.used, req)
 
     def delete_pod_if_present(self, pod) -> None:
-        key = pod.key
-        if key not in self.pods:
-            return
-        self.pods.discard(key)
-        req = self.calculator.compute_pod_request(pod)
-        self.used = {k: self.used.get(k, 0.0) - req.get(k, 0.0)
-                     for k in set(self.used) | set(req)}
+        self._release(pod.key)
+
+    def _release(self, key: str) -> None:
+        req = self.pods.pop(key, None)
+        if req is not None:
+            self.used = subtract(self.used, req)
 
     # -- limit checks -------------------------------------------------------
     def used_over_min_with(self, pod_request: ResourceList) -> bool:
@@ -111,7 +105,7 @@ class ElasticQuotaInfo:
         )
         out.max_enforced = self.max_enforced
         out.used = dict(self.used)
-        out.pods = set(self.pods)
+        out.pods = {k: dict(v) for k, v in self.pods.items()}
         return out
 
 
@@ -142,11 +136,16 @@ class ElasticQuotaInfos(dict):
         (the reference's per-namespace carry, elasticquotainfo.go:51-66, is
         last-wins over map iteration and corrupts a CompositeElasticQuota's
         ledger when its namespace set grows to cover a plain ElasticQuota).
-        Pods in newly-covered namespaces are picked up by the caller's
-        recount (CapacityScheduling._recount); add_pod_if_not_present makes
-        that idempotent."""
-        new.pods = set(old.pods)
+        Pods whose namespace left the quota are released (their booked
+        request is subtracted); pods in newly-covered namespaces are picked
+        up by the caller's recount (CapacityScheduling._recount), which
+        add_pod_if_not_present makes idempotent."""
+        new.pods = {k: dict(v) for k, v in old.pods.items()}
         new.used = dict(old.used)
+        for key in list(new.pods):
+            ns = key.split("/", 1)[0]
+            if ns not in new.namespaces:
+                new._release(key)
         for ns in old.namespaces:
             if ns not in new.namespaces and self.get(ns) is old:
                 del self[ns]

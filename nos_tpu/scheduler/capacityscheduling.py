@@ -60,6 +60,13 @@ class PreFilterState:
         self.nominated_with_req = nominated_with_req or dict(pod_req)
 
 
+def _spec_unchanged(old: ElasticQuotaInfo, new: ElasticQuotaInfo) -> bool:
+    """True when nothing the ledger cares about changed — skips the
+    O(pods) recount on the status-only updates reconcilers emit."""
+    return (old.namespaces == new.namespaces and old.min == new.min
+            and old.max == new.max and old.max_enforced == new.max_enforced)
+
+
 def info_from_quota(obj, calculator, composite: bool = False) -> ElasticQuotaInfo:
     """Build the ledger entry for an ElasticQuota/CompositeElasticQuota
     (the informer's mapping, reference informer.go:139-260)."""
@@ -117,6 +124,8 @@ class CapacityScheduling:
         if existing is not None and existing.composite:
             return
         new = info_from_quota(eq, self.calculator)
+        if existing is not None and _spec_unchanged(existing, new):
+            return  # status-only update (e.g. the reconciler's used patch)
         if existing is not None:
             self.elastic_quota_infos.update_info(existing, new)
         else:
@@ -134,6 +143,8 @@ class CapacityScheduling:
         if event == "DELETED":
             if existing is not None:
                 self.elastic_quota_infos.delete(existing)
+            return
+        if existing is not None and _spec_unchanged(existing, new):
             return
         if existing is not None:
             self.elastic_quota_infos.update_info(existing, new)

@@ -99,15 +99,17 @@ class TestElasticQuotaInfo:
         assert not info.used_over_max_with({TPU_MEM: 50})
         assert info.used_over_max_with({TPU_MEM: 51})
 
-    def test_unenforced_scalar_resource_ignored(self):
-        # A resource absent from min does not bound usage...
+    def test_unenforced_resources_ignored(self):
+        # A resource absent from min does not bound usage — including cpu
+        # (deliberate divergence from the reference scheduler plugin, whose
+        # always-on cpu/memory comparison contradicts its own reconciler;
+        # see nos_tpu/quota/info.py module docstring).
         info = make_info("eq", "ns", {TPU_MEM: 100},
-                         used={"google.com/tpu": 999})
+                         used={"google.com/tpu": 999, "cpu": 4})
         assert not info.used_over_min()
-        # ...but cpu and memory are always enforced (framework.Resource
-        # first-class fields, reference elasticquotainfo.go:319-338).
-        info.used = {"cpu": 1}
-        assert info.used_over_min()
+        info2 = make_info("eq", "ns", {TPU_MEM: 100, "cpu": 2},
+                          used={"cpu": 4})
+        assert info2.used_over_min()
 
     def test_add_delete_pod_idempotent(self):
         info = make_info("eq", "ns", {TPU_MEM: 100})
@@ -405,7 +407,31 @@ class TestCompositeElasticQuota:
         assert info is plugin.elastic_quota_infos["ns-1"]
         # 64GB carried + 16GB from ns-3's pod recounted.
         assert info.used[TPU_MEM] == 80
-        assert info.pods == {"ns-1/a", "ns-3/b"}
+        assert set(info.pods) == {"ns-1/a", "ns-3/b"}
+
+    def test_ceq_namespace_shrink_releases_usage(self):
+        """Regression: dropping a namespace from a CompositeElasticQuota
+        must release the booked usage of that namespace's pods."""
+        api = APIServer()
+        plugin = CapacityScheduling(CALC)
+        plugin.attach(api)
+        api.create(KIND_COMPOSITE_ELASTIC_QUOTA, CompositeElasticQuota(
+            metadata=ObjectMeta(name="team", namespace="default"),
+            spec=CompositeElasticQuotaSpec(
+                namespaces=["ns-1", "ns-2"], min={TPU_MEM: 128})))
+        api.create(KIND_POD, make_pod(
+            name="a", namespace="ns-1", resources={C.RESOURCE_TPU: 2},
+            node_name="n", phase=RUNNING))
+        api.create(KIND_POD, make_pod(
+            name="b", namespace="ns-2", resources={C.RESOURCE_TPU: 4},
+            node_name="n", phase=RUNNING))
+        assert plugin.elastic_quota_infos["ns-1"].used[TPU_MEM] == 96
+        api.patch(KIND_COMPOSITE_ELASTIC_QUOTA, "team", "default",
+                  mutate=lambda o: setattr(o.spec, "namespaces", ["ns-1"]))
+        info = plugin.elastic_quota_infos["ns-1"]
+        assert info.used[TPU_MEM] == 32
+        assert set(info.pods) == {"ns-1/a"}
+        assert "ns-2" not in plugin.elastic_quota_infos
 
 
 # ---------------------------------------------------------------------------
@@ -445,3 +471,35 @@ class TestWebhooks:
             validate_composite_elastic_quota(APIServer(), CompositeElasticQuota(
                 metadata=ObjectMeta(name="x", namespace="default"),
                 spec=CompositeElasticQuotaSpec(namespaces=[], min={})))
+
+    def test_webhooks_enforced_at_api_level(self):
+        """install_quota_webhooks makes the API substrate itself reject
+        invalid quota writes — the runtime admission path."""
+        from nos_tpu.api.elasticquota import install_quota_webhooks
+        api = APIServer()
+        install_quota_webhooks(api)
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-1", "ns-1", min={}))
+        with pytest.raises(AdmissionError):
+            api.create(KIND_ELASTIC_QUOTA, make_eq("eq-2", "ns-1", min={}))
+
+
+class TestReconcileReentrancy:
+    def test_many_pods_label_flip_no_recursion(self):
+        """Regression: with watches bound, relabeling many pods must not
+        recurse through the synchronous watch fan-out."""
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA, make_eq("eq-a", "ns-a", min={TPU_MEM: 16}))
+        rec = ElasticQuotaReconciler(api, CALC)
+        rec.bind()
+        import sys
+        limit = sys.getrecursionlimit()
+        n = limit // 3  # enough pods that naive recursion would blow the stack
+        for i in range(n):
+            api.create(KIND_POD, make_pod(
+                name=f"p-{i}", namespace="ns-a",
+                resources={C.RESOURCE_TPU: 1}, node_name="n",
+                phase=RUNNING, creation_timestamp=float(i)))
+        labels = [p.metadata.labels.get(C.LABEL_CAPACITY)
+                  for p in api.list(KIND_POD, namespace="ns-a")]
+        assert labels.count(C.CAPACITY_IN_QUOTA) == 1
+        assert labels.count(C.CAPACITY_OVER_QUOTA) == n - 1
