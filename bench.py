@@ -1,0 +1,105 @@
+"""Benchmark: end-to-end dynamic repartitioning latency.
+
+Scenario (BASELINE.md target: repartition < 30 s end-to-end; the reference's
+defaults alone spend up to 70 s batching): a simulated v5e-64 — 8 hosts x 8
+chips — boots carved as one 2x4 slice per host; a mixed burst of pending
+pods (2x4 / 2x2 / 1x1 profiles) then forces the planner to re-carve every
+host, the slice agents to actuate, and the scheduler to bind.  Everything
+runs through the real control-plane code paths (batcher, planner with
+scheduler simulation, packer, annotation protocol, fake TPU runtime);
+measured time is wall-clock from pod submission to the last pod bound.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = value / 30 s (lower is better, < 1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import RUNNING
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+
+HOSTS = 8
+BATCH_IDLE_S = 0.5     # tightened vs the reference's 10 s idle window
+BATCH_TIMEOUT_S = 2.0  # vs the reference's 60 s
+BASELINE_S = 30.0
+
+
+def build_cluster():
+    api = APIServer()
+    state = ClusterState()
+    NodeController(api, state, SliceNodeInitializer(api)).bind()
+    PodController(api, state).bind()
+    partitioner = new_slice_partitioner_controller(
+        api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+        batch_idle_s=BATCH_IDLE_S)
+    partitioner.bind()
+    agents = []
+    for i in range(HOSTS):
+        name = f"host-{i}"
+        api.create(KIND_NODE, make_tpu_node(name, host_index=i))
+        agent = SliceAgent(api, name, FakeTpuRuntime(V5E), FakePodResources())
+        agent.start()
+        agents.append(agent)
+    scheduler = Scheduler(api, Framework())
+    return api, partitioner, agents, scheduler
+
+
+def run_scenario() -> float:
+    api, partitioner, agents, scheduler = build_cluster()
+    for a in agents:
+        a.tick()   # actuate initial geometry
+
+    # Mixed pressure: 4 full-host slices, 8 half-host, 16 quarter-host.
+    pods = (
+        [make_slice_pod("2x4", 1, name=f"train-{i}") for i in range(4)]
+        + [make_slice_pod("2x2", 1, name=f"mid-{i}") for i in range(8)]
+        + [make_slice_pod("1x1", 1, name=f"serve-{i}") for i in range(16)]
+    )
+    t0 = time.monotonic()
+    for p in pods:
+        api.create(KIND_POD, p)
+
+    deadline = t0 + 120.0
+    total = len(pods)
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        partitioner.process_if_ready()
+        for a in agents:
+            a.tick()
+        bound = sum(
+            1 for p in api.list(KIND_POD)
+            if p.spec.node_name and p.status.phase == RUNNING)
+        if bound == total:
+            return time.monotonic() - t0
+        time.sleep(0.02)
+    raise RuntimeError(
+        f"bench did not converge: "
+        f"{sum(1 for p in api.list(KIND_POD) if p.spec.node_name)}/{total}")
+
+
+def main() -> None:
+    latency = run_scenario()
+    print(json.dumps({
+        "metric": "repartition_latency_v5e64_mixed_burst",
+        "value": round(latency, 3),
+        "unit": "s",
+        "vs_baseline": round(latency / BASELINE_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

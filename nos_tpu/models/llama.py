@@ -1,0 +1,227 @@
+"""Llama-style decoder-only transformer — the flagship validation workload.
+
+The partitioner's job is to carve TPU slices that multi-host JAX jobs can
+use; this model is the job (BASELINE config #4: Llama-3-8B FSDP training on
+a v5e-32).  Architecture: RMSNorm, rotary embeddings, grouped-query
+attention, SwiGLU MLP — written TPU-first:
+
+- bf16 activations, fp32 params/softmax; matmuls hit the MXU via
+  einsum/dot with fp32 accumulation.
+- every weight/activation carries flax *logical* axis names mapped to mesh
+  axes (dp/fsdp/tp/sp) by nos_tpu.parallel.mesh.DEFAULT_RULES — XLA inserts
+  the collectives.
+- layers run under nn.scan + nn.remat: one compiled block, activations
+  rematerialized in backward (HBM for FLOPs).
+- attention is pluggable: "dense" (XLA), "flash" (pallas kernel,
+  nos_tpu/ops/attention.py), "ring" (sequence-parallel over the sp axis,
+  nos_tpu/parallel/ring.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nos_tpu.ops.attention import flash_attention, repeat_kv
+from nos_tpu.parallel.ring import dense_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16     # activation dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "dense"      # "dense" | "flash" | "ring"
+    remat: bool = True
+    scan_layers: bool = True
+
+
+# Llama-3-8B (meta-llama/Meta-Llama-3-8B) — the BASELINE config #4 workload.
+LLAMA3_8B = LlamaConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+)
+
+# Small configs for tests and the single-chip bench.
+TINY = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+    dtype=jnp.float32,
+)
+
+BENCH_350M = LlamaConfig(
+    vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+    num_layers=24, num_heads=16, num_kv_heads=8, head_dim=64,
+    max_seq_len=2048,
+)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last dim of [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,S,1,d/2
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones,
+                                                  ("embed",)),
+            (x.shape[-1],), jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical))
+        q = dense((cfg.num_heads, cfg.head_dim),
+                  ("embed", "heads", "head_dim"), "q_proj")(x)
+        k = dense((cfg.num_kv_heads, cfg.head_dim),
+                  ("embed", "kv_heads", "head_dim"), "k_proj")(x)
+        v = dense((cfg.num_kv_heads, cfg.head_dim),
+                  ("embed", "kv_heads", "head_dim"), "v_proj")(x)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        n_rep = cfg.num_heads // cfg.num_kv_heads
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+
+        if cfg.attn_impl == "ring":
+            if self.mesh is None:
+                raise ValueError("ring attention needs a mesh")
+            out = ring_attention(self.mesh, q, k, v, causal=True)
+        elif cfg.attn_impl == "flash":
+            out = flash_attention(q, k, v, True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        out = nn.with_logical_constraint(
+            out, ("batch", "seq", "heads", "head_dim"))
+        proj = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                ("heads", "head_dim", "embed")))
+        return proj(out)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical))
+        gate = dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(x)
+        up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        return dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        x = x + Attention(cfg, self.mesh, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions)
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Llama(nn.Module):
+    """Decoder-only LM.  __call__(tokens [B, S] int32) -> logits
+    [B, S, vocab]."""
+
+    cfg: LlamaConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        embed = self.param(
+            "embed", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed[tokens].astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None], tokens.shape)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block, prevent_cse=not cfg.scan_layers,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, self.mesh, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = jnp.einsum(
+            "bse,ve->bsv", x.astype(jnp.float32),
+            embed.astype(jnp.float32),
+            preferred_element_type=jnp.float32)  # tied embeddings
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+    def param_count(self) -> int:
+        cfg = self.cfg
+        per_layer = (
+            cfg.hidden_size * cfg.num_heads * cfg.head_dim
+            + 2 * cfg.hidden_size * cfg.num_kv_heads * cfg.head_dim
+            + cfg.num_heads * cfg.head_dim * cfg.hidden_size
+            + 3 * cfg.hidden_size * cfg.intermediate_size
+            + 2 * cfg.hidden_size
+        )
+        return (cfg.vocab_size * cfg.hidden_size
+                + cfg.num_layers * per_layer + cfg.hidden_size)

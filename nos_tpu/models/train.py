@@ -1,0 +1,117 @@
+"""Sharded training: state creation and the pjit train step.
+
+The end-to-end FSDP/TP/SP training loop the partitioner's carved slices are
+validated against (BASELINE config #4).  Pattern: eval_shape the full train
+state (params stay boxed as nn.Partitioned so logical axis names ride along
+— including through optax, whose mu/nu trees mirror the boxed params), turn
+the logical specs into NamedShardings via the mesh rules, then jit state
+creation and the train step with explicit in/out shardings.  XLA inserts
+all-gathers/reduce-scatters for the fsdp axis, all-reduces for tp, and the
+ring collectives for sp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nos_tpu.models.llama import Llama, LlamaConfig
+from nos_tpu.parallel.mesh import DEFAULT_RULES
+
+
+def cross_entropy_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token loss: logits [B, S, V] vs tokens [B, S] (shift inside)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                      warmup: int = 100, clip: float = 1.0):
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup, 10_000, end_value=lr * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+class ShardedTrainer:
+    """Builds sharded state + train step for a Llama model over a mesh."""
+
+    def __init__(self, cfg: LlamaConfig, mesh: Mesh,
+                 rules=DEFAULT_RULES, optimizer=None,
+                 example_tokens: jax.Array | None = None,
+                 batch_size: int = 8, seq_len: int | None = None) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules
+        self.model = Llama(cfg, mesh=mesh if cfg.attn_impl == "ring" else None)
+        self.tx = optimizer or default_optimizer()
+        seq_len = seq_len or min(cfg.max_seq_len, 2048)
+        self.example_tokens = (
+            example_tokens if example_tokens is not None
+            else jnp.zeros((batch_size, seq_len), jnp.int32))
+        self.batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+        self.state_shardings = self._infer_state_shardings()
+
+    # -- state --------------------------------------------------------------
+    def _create_state(self, rng) -> TrainState:
+        variables = self.model.init(rng, self.example_tokens)
+        return TrainState.create(
+            apply_fn=self.model.apply, params=variables["params"], tx=self.tx)
+
+    def _infer_state_shardings(self):
+        with self.mesh, nn.logical_axis_rules(self.rules):
+            abstract = jax.eval_shape(
+                self._create_state, jax.random.PRNGKey(0))
+        logical = nn.get_partition_spec(abstract)
+        return nn.logical_to_mesh_sharding(logical, self.mesh, self.rules)
+
+    def init_state(self, seed: int = 0) -> TrainState:
+        def make(rng):
+            with self.mesh, nn.logical_axis_rules(self.rules):
+                return self._create_state(rng)
+        return jax.jit(make, out_shardings=self.state_shardings)(
+            jax.random.PRNGKey(seed))
+
+    # -- step ---------------------------------------------------------------
+    def _step(self, state: TrainState, tokens: jax.Array):
+        with self.mesh, nn.logical_axis_rules(self.rules):
+            def loss_fn(params):
+                logits = state.apply_fn({"params": params}, tokens)
+                return cross_entropy_loss(logits, tokens)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            return new_state, loss
+
+    def train_step(self) -> Callable:
+        """The jitted SPMD train step: (state, tokens [B, S]) ->
+        (state, loss)."""
+        return jax.jit(
+            self._step,
+            in_shardings=(self.state_shardings, self.batch_sharding),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    # -- inference ----------------------------------------------------------
+    def forward(self) -> Callable:
+        """Jitted forward pass: (params, tokens) -> logits."""
+        def fwd(params, tokens):
+            with self.mesh, nn.logical_axis_rules(self.rules):
+                return self.model.apply({"params": params}, tokens)
+        return jax.jit(
+            fwd,
+            in_shardings=(self.state_shardings.params, self.batch_sharding),
+        )

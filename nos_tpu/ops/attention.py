@@ -1,0 +1,163 @@
+"""Flash attention: fused pallas TPU kernel + pure-XLA fallback.
+
+The kernel streams K/V blocks through VMEM with online-softmax accumulation
+so the [S, S] score matrix never hits HBM (HBM bandwidth, not FLOPs, bounds
+naive attention).  Grid is (batch, heads, q-blocks); the causal variant
+skips K/V blocks entirely above the diagonal.  Written per
+/opt/skills/guides/pallas_guide.md: fp32 accumulation on the MXU
+(preferred_element_type), (block, 128)-aligned tiles, broadcasted_iota for
+position masks.
+
+Training: the op carries a custom VJP whose backward recomputes attention
+with the XLA fallback (pallas kernels are not auto-differentiable);
+dedicated backward kernels are a later optimization.
+
+Layout convention everywhere in nos_tpu: [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nos_tpu.parallel.ring import dense_attention
+
+_NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, causal):
+    return dense_attention(q, k, v, causal=causal)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                  block_q, block_k):
+    # refs are [1, block, D] slices of the [B*H, S, D] folded layout.
+    qi = pl.program_id(1)
+    seq_k = k_ref.shape[1]
+    num_k_blocks = seq_k // block_k
+    q = q_ref[0].astype(jnp.float32) * scale               # [bq, D]
+    head_dim = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    if causal:
+        # blocks fully above the diagonal contribute nothing
+        hi = jnp.minimum(num_k_blocks,
+                         pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        hi = num_k_blocks
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, vb, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    scale = head_dim ** -0.5
+
+    # Fold batch*heads into the leading dim: TPU block shapes constrain
+    # only the last two dims, which become (seq-block, head_dim).
+    def fold(x):
+        b, s, h, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (batch * heads, seq_q // block_q)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * batch * heads * seq_q * seq_k * head_dim,
+            bytes_accessed=2 * (q.size + k.size + v.size),
+            transcendentals=batch * heads * seq_q * seq_k,
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+
+
+def _supported(q, k, block_q, block_k) -> bool:
+    _, seq_q, _, head_dim = q.shape
+    seq_k = k.shape[1]
+    return (seq_q % block_q == 0 and seq_k % block_k == 0
+            and head_dim % 128 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 256, block_k: int = 512,
+                    interpret: bool = False):
+    """Fused attention, [B, S, H, D], K/V already at full head count
+    (repeat grouped KV heads first — see repeat_kv).  Falls back to the
+    XLA implementation off-TPU or for unaligned shapes."""
+    on_tpu = jax.default_backend() == "tpu"
+    if (on_tpu or interpret) and _supported(q, k, block_q, block_k):
+        return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _xla_attention(q, k, v, causal)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """Expand grouped KV heads to the full head count ([B, S, Hkv, D] ->
+    [B, S, Hkv*n_rep, D])."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)

@@ -1,0 +1,125 @@
+"""Device mesh construction for the JAX compute path.
+
+The partitioner carves TPU slices whose ICI topology must match the mesh a
+workload requests (`nos.tpu/mesh` annotation — SURVEY.md §2.8); this module is
+the workload-side counterpart that turns the carved slice's devices into a
+`jax.sharding.Mesh` with the canonical axis names used throughout nos_tpu:
+
+- ``dp``   — pure data parallelism (replicated params)
+- ``fsdp`` — data parallelism with sharded params/optimizer (ZeRO-3 style)
+- ``tp``   — tensor parallelism (megatron-style within attention/MLP)
+- ``sp``   — sequence/context parallelism (ring attention over ICI)
+
+XLA inserts the collectives; shardings are expressed as NamedSharding /
+PartitionSpec over these axes (the scaling-book recipe: pick a mesh, annotate,
+let the compiler do the rest).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+# Logical (model) axes -> mesh axes.  The flax logical-partitioning rules
+# used by all nos_tpu models (nos_tpu/models/).
+DEFAULT_RULES = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", "fsdp"),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("vocab", "tp"),
+    ("layers", None),
+    ("head_dim", None),
+)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A named mesh shape, e.g. MeshSpec(dp=1, fsdp=2, tp=2, sp=2)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def shape(self) -> dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+
+    @staticmethod
+    def parse(text: str) -> "MeshSpec":
+        """Parse 'dp=2,fsdp=4' or a bare topology '2x2x4' (mapped onto
+        (fsdp, tp, sp) largest-first) into a MeshSpec."""
+        text = text.strip()
+        if "=" in text:
+            kv = dict(part.split("=") for part in text.split(","))
+            return MeshSpec(**{k.strip(): int(v) for k, v in kv.items()})
+        dims = sorted((int(d) for d in text.split("x")), reverse=True)
+        axes = ["fsdp", "tp", "sp"]
+        out = {"dp": 1, "fsdp": 1, "tp": 1, "sp": 1}
+        for ax, d in zip(axes, dims):
+            out[ax] = d
+        for d in dims[len(axes):]:
+            out["dp"] *= d
+        return MeshSpec(**out)
+
+    @staticmethod
+    def for_device_count(n: int, *, want_sp: bool = True,
+                         want_tp: bool = True) -> "MeshSpec":
+        """A sensible default factorization of n devices exercising every
+        parallelism the count allows: sp=2 and tp=2 when divisible, the
+        remainder on fsdp."""
+        sp = 2 if (want_sp and n % 2 == 0 and n >= 4) else 1
+        tp = 2 if (want_tp and n % (2 * sp) == 0 and n // sp >= 2) else 1
+        fsdp = n // (sp * tp)
+        return MeshSpec(dp=1, fsdp=fsdp, tp=tp, sp=sp)
+
+
+def make_mesh(spec: MeshSpec | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build the Mesh.  Device order follows jax.devices(), which on TPU
+    enumerates in ICI-contiguous order, so the trailing mesh axis (`sp`,
+    the ring) lands on nearest neighbours."""
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec.for_device_count(len(devices))
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec.shape()} needs {spec.size} devices, "
+            f"got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(spec.dp, spec.fsdp, spec.tp, spec.sp)
+    return Mesh(arr, AXES)
+
+
+def sharding(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[batch, seq, ...] input sharding: batch over dp+fsdp, seq over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def factorize_pow2(n: int, parts: int) -> list[int]:
+    """Split n (a power of two) into `parts` factors, largest first."""
+    if n & (n - 1):
+        raise ValueError(f"{n} is not a power of two")
+    out = [1] * parts
+    i = 0
+    while n > 1:
+        out[i % parts] *= 2
+        n //= 2
+        i += 1
+    return sorted(out, reverse=True)

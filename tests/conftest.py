@@ -4,6 +4,10 @@ Tests run on CPU with a virtual 8-device platform so multi-chip sharding
 paths (mesh creation, pjit shardings, collectives) execute without TPU
 hardware — the analog of the reference's envtest-without-GPUs strategy
 (SURVEY.md §4).  Set NOS_TPU_TEST_REAL=1 to run against real devices.
+
+The environment may pre-import jax with a TPU platform pinned (a
+sitecustomize registering a PJRT plugin), so plain env vars can be too
+late; `jax.config.update` works any time before first backend use.
 """
 
 import os
@@ -15,3 +19,9 @@ if not os.environ.get("NOS_TPU_TEST_REAL"):
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
