@@ -63,10 +63,12 @@ def run_scenario() -> float:
     for a in agents:
         a.tick()   # actuate initial geometry
 
-    # Mixed pressure: 4 full-host slices, 8 half-host, 16 quarter-host.
+    # Mixed pressure filling the cluster exactly: 4 full-host slices
+    # (32 chips) + 4 half-host (16) + 16 single-chip (16) = 64 chips —
+    # convergence therefore requires a perfect packing, not best-effort.
     pods = (
         [make_slice_pod("2x4", 1, name=f"train-{i}") for i in range(4)]
-        + [make_slice_pod("2x2", 1, name=f"mid-{i}") for i in range(8)]
+        + [make_slice_pod("2x2", 1, name=f"mid-{i}") for i in range(4)]
         + [make_slice_pod("1x1", 1, name=f"serve-{i}") for i in range(16)]
     )
     t0 = time.monotonic()

@@ -75,8 +75,18 @@ STATUS_ANNOT_RE = re.compile(
 
 # Plan-id handshake between decision plane and actuation plane
 # (reference annotations.go:21-58, partitioner_controller.go:212-232).
-ANNOT_SPEC_PLAN = f"{GROUP}/spec-partitioning-plan"
-ANNOT_STATUS_PLAN = f"{GROUP}/status-partitioning-plan"
+# Keys are per profile family ("slice" / "timeshare") so the two strategies
+# coexisting on a hybrid node cannot clobber each other's handshake.
+ANNOT_SPEC_PLAN_PREFIX = f"{GROUP}/spec-partitioning-plan"
+ANNOT_STATUS_PLAN_PREFIX = f"{GROUP}/status-partitioning-plan"
+
+
+def spec_plan_annotation(family: str = "slice") -> str:
+    return f"{ANNOT_SPEC_PLAN_PREFIX}.{family}"
+
+
+def status_plan_annotation(family: str = "slice") -> str:
+    return f"{ANNOT_STATUS_PLAN_PREFIX}.{family}"
 
 # Requested JAX mesh shape for a workload pod, e.g. "2x2x4" — lets the slice
 # shape chooser carve slices with usable ICI topology (SURVEY.md §2.8).
@@ -86,6 +96,10 @@ ANNOT_MESH = f"{GROUP}/mesh"
 # reference's blind time.Sleep(devicePluginDelaySeconds)
 # (mps/partitioner.go:99-100) with a generation-stamped readiness handshake.
 ANNOT_PLUGIN_GENERATION = f"{GROUP}/device-plugin-generation"
+
+# The ConfigMap key the device plugin last applied — the readiness signal
+# the chipagent turns into status-partitioning-plan.
+ANNOT_PLUGIN_APPLIED_CONFIG = f"{GROUP}/device-plugin-applied-config"
 
 # ---------------------------------------------------------------------------
 # Resource names

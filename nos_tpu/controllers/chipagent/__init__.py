@@ -1,0 +1,6 @@
+"""Timeshare node agent (reference cmd/gpuagent + internal/controllers/gpuagent)."""
+
+from .agent import ChipAgent
+from .reporter import ChipReporter
+
+__all__ = ["ChipAgent", "ChipReporter"]

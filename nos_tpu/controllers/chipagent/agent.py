@@ -1,0 +1,53 @@
+"""ChipAgent: the timeshare node daemon.
+
+Analog of reference cmd/gpuagent (gpuagent.go:54-152): bundles the device
+plugin (config application) and the reporter for one node.  Unlike the
+sliceagent there is no actuator — actuation is the device plugin consuming
+the ConfigMap.  Refuses to run on slice-partitioned nodes, mirroring the
+reference's MIG-GPU guard (gpuagent.go:106-114); hybrid nodes are fine.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.partitioning.timeshare.partitioner import (
+    DEVICE_PLUGIN_CM_NAME, DEVICE_PLUGIN_CM_NAMESPACE,
+)
+from nos_tpu.partitioning.timeshare.snapshot_taker import (
+    HYBRID_KIND, TIMESHARE_KIND,
+)
+from nos_tpu.device.timeshare_plugin import TimeshareDevicePlugin
+
+from .reporter import ChipReporter
+
+logger = logging.getLogger(__name__)
+
+
+class ChipAgent:
+    def __init__(self, api: APIServer, node_name: str,
+                 cm_name: str = DEVICE_PLUGIN_CM_NAME,
+                 cm_namespace: str = DEVICE_PLUGIN_CM_NAMESPACE) -> None:
+        self._api = api
+        self._node_name = node_name
+        self.plugin = TimeshareDevicePlugin(api, node_name, cm_name, cm_namespace)
+        self.reporter = ChipReporter(api, node_name, self.plugin)
+
+    def start(self) -> None:
+        node = self._api.get(KIND_NODE, self._node_name)
+        kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+        if kind not in (TIMESHARE_KIND, HYBRID_KIND):
+            raise RuntimeError(
+                f"chipagent must not run on node {self._node_name} with "
+                f"partitioning kind {kind!r} (reference cmd/gpuagent/"
+                f"gpuagent.go:106-114)"
+            )
+        self.tick()
+
+    def tick(self) -> None:
+        """One plugin-apply + report cycle (event-driven + periodic in the
+        reference, polled by the run loop here)."""
+        self.plugin.tick()
+        self.reporter.reconcile()

@@ -1,0 +1,82 @@
+"""ChipReporter: per-node status reporting for timeshare nodes.
+
+Analog of reference internal/controllers/gpuagent/reporter.go:50-110 — the
+timeshare path has no node-side actuator (the device plugin consumes the
+ConfigMap directly), so the agent is a reporter only.  It renders per-chip
+free/used counts as status annotations and stamps
+`status-partitioning-plan` once the device plugin has applied the config
+whose key carries the plan id — closing the handshake the timeshare
+partitioner opened (replacing the reference's blind propagation sleep).
+
+Used counts are attributed to chips greedily from the running pods'
+timeshare requests — the analog of the reference slicing client mapping
+shared device ids `<uuid>::<replica>` to GPU indexes
+(pkg/gpu/slicing/client.go:86-105).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE
+from nos_tpu.kube.objects import Node, RUNNING
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.topology.annotations import strip_status_annotations
+from nos_tpu.topology.profile import extract_timeshare_requests
+
+from nos_tpu.device.timeshare_plugin import TimeshareDevicePlugin
+from nos_tpu.partitioning.timeshare.partitioner import plan_id_from_key
+
+logger = logging.getLogger(__name__)
+
+
+class ChipReporter:
+    def __init__(self, api: APIServer, node_name: str,
+                 plugin: TimeshareDevicePlugin) -> None:
+        self._api = api
+        self._node_name = node_name
+        self._plugin = plugin
+
+    def reconcile(self) -> None:
+        node = self._api.get(KIND_NODE, self._node_name)
+        applied = node.metadata.annotations.get(
+            C.ANNOT_PLUGIN_APPLIED_CONFIG, "")
+        if not applied:
+            return
+        chips = self._plugin.chip_config(applied)
+        if chips is None:
+            return
+
+        # total requested per profile by live pods on this node
+        demand: dict[str, int] = {}
+        for pod in self._api.pods_on_node(self._node_name):
+            if pod.status.phase != RUNNING:
+                continue
+            for gb, qty in extract_timeshare_requests(pod_request(pod)).items():
+                demand[f"{gb}gb"] = demand.get(f"{gb}gb", 0) + qty
+
+        annotations: dict[str, str] = {}
+        for idx in sorted(chips):
+            for profile, total in chips[idx].items():
+                used = min(total, demand.get(profile, 0))
+                if used:
+                    demand[profile] -= used
+                free = total - used
+                if used:
+                    annotations[
+                        f"{C.ANNOT_STATUS_PREFIX}{idx}-{profile}-used"] = str(used)
+                if free:
+                    annotations[
+                        f"{C.ANNOT_STATUS_PREFIX}{idx}-{profile}-free"] = str(free)
+
+        plan_id = plan_id_from_key(self._node_name, applied)
+
+        def mutate(n: Node) -> None:
+            strip_status_annotations(n.metadata.annotations, family="timeshare")
+            n.metadata.annotations.update(annotations)
+            if plan_id:
+                n.metadata.annotations[C.status_plan_annotation("timeshare")] = plan_id
+
+        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        logger.debug("chipagent reporter: node %s reported", self._node_name)

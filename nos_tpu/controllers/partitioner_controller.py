@@ -88,7 +88,7 @@ class PartitionerController:
             if kind not in (self._kind, "hybrid"):
                 continue
             annots = node.metadata.annotations
-            spec_id = spec_plan_id(annots)
-            if spec_id and status_plan_id(annots) != spec_id:
+            spec_id = spec_plan_id(annots, family=self._kind)
+            if spec_id and status_plan_id(annots, family=self._kind) != spec_id:
                 return True
         return False

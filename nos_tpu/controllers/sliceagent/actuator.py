@@ -67,8 +67,8 @@ class SliceActuator:
             return False
         node = self._api.get(KIND_NODE, self._node_name)
         annots = node.metadata.annotations
-        self._shared.last_parsed_plan_id = spec_plan_id(annots)
-        if spec_matches_status(annots):
+        self._shared.last_parsed_plan_id = spec_plan_id(annots, family="slice")
+        if spec_matches_status(annots, family="slice"):
             logger.debug("sliceagent actuator: spec matches status, nothing to do")
             return False
 

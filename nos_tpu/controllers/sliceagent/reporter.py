@@ -49,10 +49,10 @@ class SliceReporter:
         plan_id = self._shared.last_parsed_plan_id
 
         def mutate(node: Node) -> None:
-            strip_status_annotations(node.metadata.annotations)
+            strip_status_annotations(node.metadata.annotations, family="slice")
             node.metadata.annotations.update(annotations)
             if plan_id:
-                node.metadata.annotations[C.ANNOT_STATUS_PLAN] = plan_id
+                node.metadata.annotations[C.status_plan_annotation("slice")] = plan_id
 
         self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
         self._shared.on_report_done()

@@ -1,0 +1,73 @@
+"""Timeshare device plugin: config-driven resource re-advertisement.
+
+Plays the role of the reference's forked NVIDIA device plugin consuming the
+MPS sharing ConfigMap (internal/partitioning/mps/partitioner.go:61-114): it
+watches the node's `nos.tpu/device-plugin.config` label, loads that key from
+the shared ConfigMap, advertises the configured `nos.tpu/tpu-<N>gb`
+resources on the node, and stamps the applied key + a generation counter —
+the readiness signal that replaces the reference's blind propagation sleep.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE
+from nos_tpu.kube.objects import Node
+from nos_tpu.topology.profile import is_timeshare_resource, timeshare_resource_name
+
+logger = logging.getLogger(__name__)
+
+
+class TimeshareDevicePlugin:
+    def __init__(self, api: APIServer, node_name: str,
+                 cm_name: str, cm_namespace: str) -> None:
+        self._api = api
+        self._node_name = node_name
+        self._cm_name = cm_name
+        self._cm_namespace = cm_namespace
+
+    def chip_config(self, key: str) -> dict[int, dict[str, int]] | None:
+        """chip index -> profile -> replicas for a ConfigMap key."""
+        cm = self._api.try_get(KIND_CONFIGMAP, self._cm_name, self._cm_namespace)
+        if cm is None or key not in cm.data:
+            return None
+        cfg = json.loads(cm.data[key])
+        chips = cfg.get("sharing", {}).get("timeshare", {}).get("chips", {})
+        return {int(i): dict(profiles) for i, profiles in chips.items()}
+
+    def tick(self) -> bool:
+        """Apply the labeled config if it isn't applied yet; returns True
+        if the node was updated."""
+        node = self._api.get(KIND_NODE, self._node_name)
+        key = node.metadata.labels.get(C.LABEL_DEVICE_PLUGIN_CONFIG, "")
+        if not key:
+            return False
+        if node.metadata.annotations.get(C.ANNOT_PLUGIN_APPLIED_CONFIG) == key:
+            return False
+        chips = self.chip_config(key)
+        if chips is None:
+            logger.warning("timeshare plugin: config key %s not found", key)
+            return False
+
+        totals: dict[str, float] = {}
+        for profiles in chips.values():
+            for profile, qty in profiles.items():
+                res = timeshare_resource_name(int(profile[:-2]))
+                totals[res] = totals.get(res, 0.0) + qty
+
+        def mutate(n: Node) -> None:
+            for table in (n.status.allocatable, n.status.capacity):
+                for res in [r for r in table if is_timeshare_resource(r)]:
+                    del table[res]
+            n.status.allocatable.update(totals)
+            n.status.capacity.update(totals)
+            gen = int(n.metadata.annotations.get(C.ANNOT_PLUGIN_GENERATION, "0"))
+            n.metadata.annotations[C.ANNOT_PLUGIN_GENERATION] = str(gen + 1)
+            n.metadata.annotations[C.ANNOT_PLUGIN_APPLIED_CONFIG] = key
+
+        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        logger.info("timeshare plugin: node %s applied %s", self._node_name, key)
+        return True

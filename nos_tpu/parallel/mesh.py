@@ -79,11 +79,14 @@ class MeshSpec:
                          want_tp: bool = True) -> "MeshSpec":
         """A sensible default factorization of n devices exercising every
         parallelism the count allows: sp=2 and tp=2 when divisible, the
-        remainder on fsdp."""
+        power-of-two part of the remainder on fsdp, and any odd factor on
+        dp — batch size is freely adjustable, model dims (which fsdp/tp/sp
+        must divide) are not."""
         sp = 2 if (want_sp and n % 2 == 0 and n >= 4) else 1
         tp = 2 if (want_tp and n % (2 * sp) == 0 and n // sp >= 2) else 1
-        fsdp = n // (sp * tp)
-        return MeshSpec(dp=1, fsdp=fsdp, tp=tp, sp=sp)
+        rem = n // (sp * tp)
+        fsdp = rem & -rem  # largest power of two dividing rem
+        return MeshSpec(dp=rem // fsdp, fsdp=fsdp, tp=tp, sp=sp)
 
 
 def make_mesh(spec: MeshSpec | None = None,

@@ -41,9 +41,9 @@ class SlicePartitioner(Partitioner):
             geometries[unit.index] = profiles
 
         def mutate(node: Node) -> None:
-            strip_spec_annotations(node.metadata.annotations)
+            strip_spec_annotations(node.metadata.annotations, family="slice")
             node.metadata.annotations.update(spec_from_geometries(geometries))
-            node.metadata.annotations[C.ANNOT_SPEC_PLAN] = plan_id
+            node.metadata.annotations[C.spec_plan_annotation("slice")] = plan_id
 
         self._api.patch(KIND_NODE, node_name, mutate=mutate)
         logger.info("slicepart: node %s spec updated (plan %s)", node_name, plan_id)
@@ -65,9 +65,9 @@ class SliceNodeInitializer(NodeInitializer):
         geometries = {0: {gen.host_block.canonical().name: 1}}
 
         def mutate(n: Node) -> None:
-            strip_spec_annotations(n.metadata.annotations)
+            strip_spec_annotations(n.metadata.annotations, family="slice")
             n.metadata.annotations.update(spec_from_geometries(geometries))
-            n.metadata.annotations[C.ANNOT_SPEC_PLAN] = new_plan_id()
+            n.metadata.annotations[C.spec_plan_annotation("slice")] = new_plan_id()
 
         self._api.patch(KIND_NODE, node_name, mutate=mutate)
         logger.info("slicepart: initialized virgin node %s", node_name)

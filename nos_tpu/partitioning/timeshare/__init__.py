@@ -1,0 +1,28 @@
+"""Timeshare (fractional-chip) partitioning strategy — the MPS analog.
+
+Reference internal/partitioning/mps/ + pkg/gpu/slicing/.
+"""
+
+from ..slicepart.snapshot_taker import HYBRID_KIND, TIMESHARE_KIND
+from .calculators import (
+    TimesharePartitionCalculator, TimeshareProfileCalculator,
+    TimeshareProfileFilter,
+)
+from .factory import new_timeshare_partitioner_controller
+from .node import TimeshareNode, units_from_node
+from .partitioner import (
+    DEVICE_PLUGIN_CM_NAME, DEVICE_PLUGIN_CM_NAMESPACE, TimesharePartitioner,
+    config_key, plan_id_from_key, to_plugin_config,
+)
+from .snapshot_taker import TimeshareSnapshotTaker
+
+__all__ = [
+    "TIMESHARE_KIND", "HYBRID_KIND",
+    "TimeshareNode", "units_from_node",
+    "TimeshareProfileCalculator", "TimeshareProfileFilter",
+    "TimesharePartitionCalculator",
+    "TimesharePartitioner", "TimeshareSnapshotTaker",
+    "new_timeshare_partitioner_controller",
+    "DEVICE_PLUGIN_CM_NAME", "DEVICE_PLUGIN_CM_NAMESPACE",
+    "config_key", "plan_id_from_key", "to_plugin_config",
+]

@@ -1,0 +1,47 @@
+"""Wiring for the timeshare partitioning controller.
+
+Analog of reference internal/partitioning/mps/factory.go.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.client import APIServer
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.utils.batcher import Batcher
+
+from ..core import GeometryActuator, GeometryPlanner
+from ..state import ClusterState
+from .calculators import TimesharePartitionCalculator, TimeshareProfileCalculator
+from .partitioner import (
+    DEVICE_PLUGIN_CM_NAME, DEVICE_PLUGIN_CM_NAMESPACE, TimesharePartitioner,
+)
+from .snapshot_taker import TIMESHARE_KIND, TimeshareSnapshotTaker
+
+
+def new_timeshare_partitioner_controller(
+    api: APIServer, cluster_state: ClusterState,
+    framework: Framework | None = None,
+    batch_timeout_s: float = 60.0, batch_idle_s: float = 10.0,
+    cm_name: str = DEVICE_PLUGIN_CM_NAME,
+    cm_namespace: str = DEVICE_PLUGIN_CM_NAMESPACE,
+    clock=None,
+):
+    from nos_tpu.controllers.partitioner_controller import PartitionerController
+
+    partition_calculator = TimesharePartitionCalculator()
+    planner = GeometryPlanner(
+        framework=framework or Framework(),
+        calculator=TimeshareProfileCalculator(),
+        partition_calculator=partition_calculator,
+    )
+    actuator = GeometryActuator(
+        TimesharePartitioner(api, cm_name, cm_namespace), partition_calculator)
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+    return PartitionerController(
+        api=api, cluster_state=cluster_state, kind=TIMESHARE_KIND,
+        planner=planner, actuator=actuator,
+        snapshot_taker=TimeshareSnapshotTaker(), batcher=batcher,
+    )

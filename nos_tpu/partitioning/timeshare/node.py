@@ -1,9 +1,9 @@
-"""slicepart.Node: PartitionableNode implementation for slice partitioning.
+"""timeshare.Node: PartitionableNode for fractional-chip sharing.
 
-Analog of reference pkg/gpu/mig/node.go:26-222: builds SliceUnits from the
-node's status annotations + topology labels, and keeps the embedded
-NodeInfo's allocatable scalars in sync with the (possibly hypothetical)
-geometry so the scheduler simulation sees it (node.go:171-195).
+Analog of reference pkg/gpu/slicing/node.go:26-215: one TimeshareUnit per
+chip (HBM budget from the generation), state rebuilt from the agent's status
+annotations, allocatable kept in sync with hypothetical geometry for the
+scheduler simulation.
 """
 
 from __future__ import annotations
@@ -14,10 +14,11 @@ from nos_tpu.api import constants as C
 from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.scheduler.framework import NodeInfo
-from nos_tpu.topology import Shape, SliceUnit, TopologyRegistry, DEFAULT_REGISTRY
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
 from nos_tpu.topology.annotations import parse_status_annotations
+from nos_tpu.topology.timeshare_unit import TimeshareUnit
 from nos_tpu.topology.profile import (
-    extract_slice_requests, slice_resource_name,
+    extract_timeshare_requests, is_timeshare_resource, timeshare_resource_name,
 )
 
 from ..core.interfaces import PartitionableNode, ProfileRequest
@@ -25,25 +26,25 @@ from ..core.usage import claim_bound_pod_usage
 
 
 def units_from_node(node: Node,
-                    registry: TopologyRegistry = DEFAULT_REGISTRY) -> list[SliceUnit]:
-    """Reconstruct per-unit used/free state from status annotations
-    (the agent-reported observed geometry)."""
-    accel = node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
-    gen = registry.get(accel)
-    units: dict[int, SliceUnit] = {}
+                    registry: TopologyRegistry = DEFAULT_REGISTRY
+                    ) -> list[TimeshareUnit]:
+    gen = registry.get(node.metadata.labels.get(C.LABEL_ACCELERATOR, ""))
+    units = {
+        i: TimeshareUnit(hbm_gb=gen.hbm_gb_per_chip, index=i)
+        for i in range(gen.chips_per_host)
+    }
     for a in parse_status_annotations(node.metadata.annotations):
-        if "x" not in a.profile:
-            continue  # timeshare annotation on a hybrid node
-        unit = units.setdefault(a.index, SliceUnit(generation=gen, index=a.index))
-        shape = Shape.parse(a.profile).canonical()
+        if not a.profile.endswith("gb") or "x" in a.profile:
+            continue  # slice annotation on a hybrid node
+        unit = units.setdefault(
+            a.index, TimeshareUnit(hbm_gb=gen.hbm_gb_per_chip, index=a.index))
+        gb = int(a.profile[:-2])
         table = unit.used if a.status == "used" else unit.free
-        table[shape] = table.get(shape, 0) + a.quantity
-    if not units:
-        units[0] = SliceUnit(generation=gen, index=0)
+        table[gb] = table.get(gb, 0) + a.quantity
     return [units[i] for i in sorted(units)]
 
 
-class SliceNode(PartitionableNode):
+class TimeshareNode(PartitionableNode):
     def __init__(self, node: Node, node_info: NodeInfo,
                  registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
         self._name = node.metadata.name
@@ -51,8 +52,7 @@ class SliceNode(PartitionableNode):
         self._registry = registry
         self.units = units_from_node(node, registry)
         self.generation = registry.get(
-            node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
-        )
+            node.metadata.labels.get(C.LABEL_ACCELERATOR, ""))
         self._claim_bound_pod_usage()
         self._sync_allocatable()
 
@@ -66,8 +66,8 @@ class SliceNode(PartitionableNode):
 
     def update_geometry_for(self, lacking: ProfileRequest) -> bool:
         remaining = {
-            Shape.parse(p).canonical(): q for p, q in lacking.items()
-            if "x" in p and q > 0
+            int(p[:-2]): q for p, q in lacking.items()
+            if p.endswith("gb") and "x" not in p and q > 0
         }
         changed = False
         for unit in self.units:
@@ -75,29 +75,28 @@ class SliceNode(PartitionableNode):
                 break
             if unit.update_geometry_for(remaining):
                 changed = True
-            for shape in list(remaining):
-                provided = unit.free.get(shape, 0)
+            for gb in list(remaining):
+                provided = unit.free.get(gb, 0)
                 if provided:
-                    remaining[shape] -= provided
-                    if remaining[shape] <= 0:
-                        del remaining[shape]
+                    remaining[gb] -= provided
+                    if remaining[gb] <= 0:
+                        del remaining[gb]
         if changed:
             self._sync_allocatable()
         return changed
 
     def add_pod(self, pod: Pod) -> bool:
-        requests = extract_slice_requests(pod_request(pod))
-        # all-or-nothing first-fit across units (reference node.go AddPod)
-        staged: list[tuple[SliceUnit, Shape]] = []
-        for shape, qty in requests.items():
+        requests = extract_timeshare_requests(pod_request(pod))
+        staged: list[tuple[TimeshareUnit, int]] = []
+        for gb, qty in requests.items():
             for _ in range(qty):
                 for unit in self.units:
-                    if unit.allocate(shape):
-                        staged.append((unit, shape))
+                    if unit.allocate(gb):
+                        staged.append((unit, gb))
                         break
                 else:
-                    for u, s in staged:
-                        u.release(s)
+                    for u, g in staged:
+                        u.release(g)
                     return False
         self._node_info.add_pod(pod)
         return True
@@ -105,8 +104,8 @@ class SliceNode(PartitionableNode):
     def geometries(self) -> dict[int, dict[str, int]]:
         return {u.index: u.geometry_names() for u in self.units}
 
-    def clone(self) -> "SliceNode":
-        c = object.__new__(SliceNode)
+    def clone(self) -> "TimeshareNode":
+        c = object.__new__(TimeshareNode)
         c._name = self._name
         c._node_info = self._node_info.clone()
         c._registry = self._registry
@@ -117,18 +116,16 @@ class SliceNode(PartitionableNode):
     # -- internals ----------------------------------------------------------
     def _claim_bound_pod_usage(self) -> None:
         claim_bound_pod_usage(self.units, self._node_info.pods,
-                              extract_slice_requests)
+                              extract_timeshare_requests)
 
     def _sync_allocatable(self) -> None:
-        """Recompute slice-resource allocatables from unit geometry so the
-        embedded NodeInfo reflects the hypothetical state
-        (reference node.go:171-195)."""
         alloc = self._node_info.node.status.allocatable
-        for res in [r for r in alloc if r.startswith(C.RESOURCE_SLICE_PREFIX)]:
+        # regex-matched (not prefix): nos.tpu/tpu-memory shares the prefix
+        for res in [r for r in alloc if is_timeshare_resource(r)]:
             del alloc[res]
         totals: dict[str, int] = {}
         for unit in self.units:
             for profile, qty in unit.geometry_names().items():
-                res = slice_resource_name(profile)
+                res = timeshare_resource_name(int(profile[:-2]))
                 totals[res] = totals.get(res, 0) + qty
         alloc.update(totals)

@@ -1,0 +1,95 @@
+"""Timeshare actuation: device-plugin ConfigMap + node label.
+
+Analog of reference internal/partitioning/mps/partitioner.go:61-157, with
+one deliberate improvement: where the reference blind-sleeps
+`devicePluginDelaySeconds` between the ConfigMap patch and the node label
+(mps/partitioner.go:99-100), we stamp `spec-partitioning-plan` on the node
+and let the chipagent report `status-partitioning-plan` once the device
+plugin has actually applied the config — the same generation-stamped
+handshake the slice path uses, so the batch controller defers new plans
+exactly until propagation, not for a fixed delay.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE, NotFound
+from nos_tpu.kube.objects import ConfigMap, Node, ObjectMeta
+from nos_tpu.topology.profile import gb_from_resource
+
+from ..core.interfaces import Partitioner
+from ..state import NodePartitioning
+
+logger = logging.getLogger(__name__)
+
+DEVICE_PLUGIN_CM_NAME = "nos-tpu-device-plugin-config"
+DEVICE_PLUGIN_CM_NAMESPACE = "nos-tpu-system"
+
+
+def config_key(node_name: str, plan_id: str) -> str:
+    # "." is the delimiter: plan ids never contain it, so rsplit-once
+    # recovers the exact node name even for FQDN node names — a plain
+    # dash-prefix match would let "tpu-host" claim "tpu-host-2"'s keys.
+    return f"{node_name}.{plan_id}"
+
+
+def key_belongs_to_node(node_name: str, key: str) -> bool:
+    return "." in key and key.rsplit(".", 1)[0] == node_name
+
+
+def plan_id_from_key(node_name: str, key: str) -> str:
+    return key.rsplit(".", 1)[1] if key_belongs_to_node(node_name, key) else ""
+
+
+def to_plugin_config(partitioning: NodePartitioning) -> dict:
+    """Render NodePartitioning as the device-plugin sharing config (the
+    nvidiav1.Config analog, reference mps/partitioner.go:123-157): per chip,
+    the replicated memory-sized resources to advertise."""
+    chips: dict[str, dict[str, int]] = {}
+    for unit in partitioning.units:
+        resources: dict[str, int] = {}
+        for res, qty in unit.resources.items():
+            gb = gb_from_resource(res)
+            if gb is not None and qty > 0:
+                resources[f"{gb}gb"] = resources.get(f"{gb}gb", 0) + qty
+        chips[str(unit.index)] = resources
+    return {"version": "v1", "sharing": {"timeshare": {
+        "chips": chips, "fail_requests_greater_than_one": True}}}
+
+
+class TimesharePartitioner(Partitioner):
+    def __init__(self, api: APIServer,
+                 cm_name: str = DEVICE_PLUGIN_CM_NAME,
+                 cm_namespace: str = DEVICE_PLUGIN_CM_NAMESPACE) -> None:
+        self._api = api
+        self._cm_name = cm_name
+        self._cm_namespace = cm_namespace
+
+    def apply_partitioning(self, node_name: str, plan_id: str,
+                           partitioning: NodePartitioning) -> None:
+        key = config_key(node_name, plan_id)
+        payload = json.dumps(to_plugin_config(partitioning))
+
+        def mutate_cm(cm: ConfigMap) -> None:
+            for k in [k for k in cm.data if key_belongs_to_node(node_name, k)]:
+                del cm.data[k]
+            cm.data[key] = payload
+
+        try:
+            self._api.patch(KIND_CONFIGMAP, self._cm_name,
+                            self._cm_namespace, mutate=mutate_cm)
+        except NotFound:
+            self._api.create(KIND_CONFIGMAP, ConfigMap(
+                metadata=ObjectMeta(name=self._cm_name,
+                                    namespace=self._cm_namespace),
+                data={key: payload}))
+
+        def mutate_node(node: Node) -> None:
+            node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] = key
+            node.metadata.annotations[C.spec_plan_annotation("timeshare")] = plan_id
+
+        self._api.patch(KIND_NODE, node_name, mutate=mutate_node)
+        logger.info("timeshare: node %s config %s published", node_name, key)

@@ -1,0 +1,36 @@
+"""Timeshare strategy snapshot taker.
+
+Analog of reference internal/partitioning/mps/snapshot_taker.go: wrap nodes
+labeled for timeshare (or hybrid) partitioning as TimeshareNodes.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+
+from ..core.interfaces import SnapshotTaker
+from ..core.snapshot import ClusterSnapshot
+from ..state import ClusterState
+from ..slicepart.snapshot_taker import HYBRID_KIND, TIMESHARE_KIND
+from .calculators import TimeshareProfileFilter
+from .node import TimeshareNode
+
+
+class TimeshareSnapshotTaker(SnapshotTaker):
+    def __init__(self, registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
+        self._registry = registry
+
+    def take_snapshot(self, cluster_state: ClusterState) -> ClusterSnapshot:
+        infos = cluster_state.node_infos()
+        nodes = {}
+        for name, node in cluster_state.nodes().items():
+            kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
+            if kind not in (TIMESHARE_KIND, HYBRID_KIND):
+                continue
+            if node.metadata.labels.get(C.LABEL_ACCELERATOR, "") not in \
+                    self._registry.generations:
+                continue
+            nodes[name] = TimeshareNode(
+                infos[name].node, infos[name], self._registry)
+        return ClusterSnapshot(nodes, TimeshareProfileFilter())

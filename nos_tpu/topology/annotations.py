@@ -92,33 +92,58 @@ def status_from_units(units: Iterable) -> dict[str, str]:
     return out
 
 
-def spec_matches_status(annotations: Mapping[str, str]) -> bool:
+def spec_matches_status(annotations: Mapping[str, str],
+                        family: str | None = None) -> bool:
     """Desired == observed, per index+profile (reference
-    pkg/gpu/mig/annotation.go:24 SpecMatchesStatus)."""
+    pkg/gpu/mig/annotation.go:24 SpecMatchesStatus).  `family` restricts the
+    comparison to one profile family so a hybrid node's other-family status
+    entries don't defeat the convergence short-circuit."""
+    def keep(profile: str) -> bool:
+        return family is None or _profile_family(profile) == family
+
     spec: dict[tuple[int, str], int] = {}
     for a in parse_spec_annotations(annotations):
-        spec[(a.index, a.profile)] = spec.get((a.index, a.profile), 0) + a.quantity
+        if keep(a.profile):
+            spec[(a.index, a.profile)] = \
+                spec.get((a.index, a.profile), 0) + a.quantity
     status: dict[tuple[int, str], int] = {}
     for a in parse_status_annotations(annotations):
-        key = (a.index, a.profile)
-        status[key] = status.get(key, 0) + a.quantity
+        if keep(a.profile):
+            key = (a.index, a.profile)
+            status[key] = status.get(key, 0) + a.quantity
     return ({k: v for k, v in spec.items() if v > 0}
             == {k: v for k, v in status.items() if v > 0})
 
 
-def strip_spec_annotations(annotations: dict[str, str]) -> None:
-    for k in [k for k in annotations if C.SPEC_ANNOT_RE.match(k)]:
-        del annotations[k]
+def _profile_family(profile: str) -> str:
+    return "slice" if "x" in profile else "timeshare"
 
 
-def strip_status_annotations(annotations: dict[str, str]) -> None:
-    for k in [k for k in annotations if C.STATUS_ANNOT_RE.match(k)]:
-        del annotations[k]
+def strip_spec_annotations(annotations: dict[str, str],
+                           family: str | None = None) -> None:
+    """Remove spec annotations; `family` ("slice"/"timeshare") restricts to
+    one profile family so the two strategies coexist on hybrid nodes."""
+    for k in list(annotations):
+        m = C.SPEC_ANNOT_RE.match(k)
+        if m and (family is None
+                  or _profile_family(m.group("profile")) == family):
+            del annotations[k]
 
 
-def spec_plan_id(annotations: Mapping[str, str]) -> str:
-    return annotations.get(C.ANNOT_SPEC_PLAN, "")
+def strip_status_annotations(annotations: dict[str, str],
+                             family: str | None = None) -> None:
+    for k in list(annotations):
+        m = C.STATUS_ANNOT_RE.match(k)
+        if m and (family is None
+                  or _profile_family(m.group("profile")) == family):
+            del annotations[k]
 
 
-def status_plan_id(annotations: Mapping[str, str]) -> str:
-    return annotations.get(C.ANNOT_STATUS_PLAN, "")
+def spec_plan_id(annotations: Mapping[str, str],
+                 family: str = "slice") -> str:
+    return annotations.get(C.spec_plan_annotation(family), "")
+
+
+def status_plan_id(annotations: Mapping[str, str],
+                   family: str = "slice") -> str:
+    return annotations.get(C.status_plan_annotation(family), "")

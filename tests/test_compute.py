@@ -126,8 +126,6 @@ class TestShardedTrainer:
         state = tr.init_state(0)
 
         # param shardings: vocab over tp, embed over fsdp
-        embed = jax.tree_util.tree_leaves(
-            state.params["embed"], is_leaf=lambda x: hasattr(x, "sharding"))
         import flax.linen as nn
         unboxed = nn.unbox(state.params)
         assert unboxed["embed"].sharding.spec == jax.sharding.PartitionSpec(

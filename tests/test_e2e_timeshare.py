@@ -1,0 +1,169 @@
+"""Integration test: timeshare (MPS-analog) fractional chip sharing.
+
+BASELINE config #2: a single v5e-8 host shares chips between small
+inference pods via HBM-sized timeshare profiles.  Exercises the full MPS
+actuation path — planner -> device-plugin ConfigMap + node label ->
+plugin re-advertisement -> chipagent report -> plan handshake -> schedule —
+with the generation-stamped readiness that replaces the reference's blind
+propagation sleep (mps/partitioner.go:99-100).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.controllers.chipagent import ChipAgent
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import RUNNING
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.timeshare import (
+    DEVICE_PLUGIN_CM_NAME, DEVICE_PLUGIN_CM_NAMESPACE, TimeshareNode,
+    new_timeshare_partitioner_controller, plan_id_from_key, to_plugin_config,
+)
+from nos_tpu.partitioning.state import NodePartitioning, UnitPartitioning
+from nos_tpu.scheduler.framework import Framework, NodeInfo
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_timeshare_pod, make_tpu_node
+from nos_tpu.topology.annotations import parse_status_annotations, status_plan_id
+
+
+class Harness:
+    def __init__(self):
+        self.api = APIServer()
+        self.state = ClusterState()
+        self.clock_now = [0.0]
+        NodeController(self.api, self.state).bind()
+        PodController(self.api, self.state).bind()
+        self.partitioner = new_timeshare_partitioner_controller(
+            self.api, self.state,
+            batch_timeout_s=60.0, batch_idle_s=10.0,
+            clock=lambda: self.clock_now[0],
+        )
+        self.partitioner.bind()
+        self.api.create(KIND_NODE, make_tpu_node(
+            "ts-0", partitioning="timeshare"))
+        self.agent = ChipAgent(self.api, "ts-0")
+        self.agent.start()
+        self.scheduler = Scheduler(self.api, Framework())
+
+    def advance(self, seconds: float):
+        self.clock_now[0] += seconds
+
+    def get_node(self):
+        return self.api.get(KIND_NODE, "ts-0")
+
+
+def test_fractional_sharing_end_to_end():
+    h = Harness()
+    # 4 small inference pods, each wanting 8 GB of a 16 GB chip
+    for i in range(4):
+        h.api.create(KIND_POD, make_timeshare_pod(8, 1, name=f"infer-{i}"))
+    assert h.scheduler.run_cycle() == 0          # nothing advertised yet
+    h.advance(11.0)
+    assert h.partitioner.process_if_ready()
+
+    # ConfigMap rendered under <node>-<planId>
+    cm = h.api.get(KIND_CONFIGMAP, DEVICE_PLUGIN_CM_NAME,
+                   DEVICE_PLUGIN_CM_NAMESPACE)
+    keys = [k for k in cm.data if k.startswith("ts-0.")]
+    assert len(keys) == 1
+    node = h.get_node()
+    assert node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] == keys[0]
+
+    # handshake: next batch deferred until the agent reports
+    h.advance(61.0)
+    h.api.create(KIND_POD, make_timeshare_pod(4, 1, name="late"))
+    h.scheduler.run_cycle()
+    assert not h.partitioner.process_if_ready()
+
+    # device plugin applies + reporter closes the handshake
+    h.agent.tick()
+    node = h.get_node()
+    assert node.status.allocatable.get(f"{C.RESOURCE_TIMESHARE_PREFIX}8gb") == 4.0
+    assert status_plan_id(node.metadata.annotations, family="timeshare") == \
+        plan_id_from_key("ts-0", keys[0])
+
+    assert h.scheduler.run_cycle() >= 4
+    for i in range(4):
+        pod = h.api.get(KIND_POD, f"infer-{i}", "default")
+        assert pod.spec.node_name == "ts-0"
+        assert pod.status.phase == RUNNING
+
+    # reporter attributes usage per chip
+    h.agent.tick()
+    status = parse_status_annotations(h.get_node().metadata.annotations)
+    used = sum(a.quantity for a in status if a.status == "used")
+    assert used == 4
+
+
+def test_repartition_sacrifices_free_profiles():
+    h = Harness()
+    h.api.create(KIND_POD, make_timeshare_pod(8, 1, name="first"))
+    h.scheduler.run_cycle()
+    h.advance(11.0)
+    h.partitioner.process_if_ready()
+    h.agent.tick()
+    assert h.scheduler.run_cycle() == 1
+
+    # now a 16gb pod: free 8gb profiles must be sacrificed on some chip
+    h.advance(61.0)
+    h.api.create(KIND_POD, make_timeshare_pod(16, 1, name="big"))
+    h.scheduler.run_cycle()
+    h.advance(11.0)
+    assert h.partitioner.process_if_ready()
+    h.agent.tick()
+    assert h.scheduler.run_cycle() == 1
+    assert h.api.get(KIND_POD, "big", "default").spec.node_name == "ts-0"
+    # the used 8gb stays advertised
+    node = h.get_node()
+    assert node.status.allocatable.get(f"{C.RESOURCE_TIMESHARE_PREFIX}8gb", 0) >= 1
+
+
+def test_plugin_config_render_roundtrip():
+    np = NodePartitioning(units=[
+        UnitPartitioning(index=0, resources={
+            f"{C.RESOURCE_TIMESHARE_PREFIX}8gb": 2}),
+        UnitPartitioning(index=3, resources={
+            f"{C.RESOURCE_TIMESHARE_PREFIX}4gb": 1}),
+    ])
+    cfg = to_plugin_config(np)
+    chips = cfg["sharing"]["timeshare"]["chips"]
+    assert chips == {"0": {"8gb": 2}, "3": {"4gb": 1}}
+
+
+def test_timeshare_node_respects_used_profiles():
+    node = make_tpu_node(
+        "ts-1", partitioning="timeshare",
+        status_geometry={"used": {"8gb": 1}, "free": {"8gb": 1}})
+    ni = NodeInfo(node=node)
+    tn = TimeshareNode(node, ni)
+    # used profile must survive any regeometry
+    tn.update_geometry_for({"16gb": 8})
+    total_used = sum(u.used.get(8, 0) for u in tn.units)
+    assert total_used == 1
+
+
+def test_hybrid_status_annotations_coexist():
+    """On a hybrid node the two reporters must not clobber each other's
+    status family (family-scoped stripping)."""
+    from nos_tpu.topology.annotations import strip_status_annotations
+    annots = {
+        f"{C.ANNOT_STATUS_PREFIX}0-2x2-free": "1",
+        f"{C.ANNOT_STATUS_PREFIX}1-8gb-used": "2",
+    }
+    strip_status_annotations(annots, family="timeshare")
+    assert f"{C.ANNOT_STATUS_PREFIX}0-2x2-free" in annots
+    assert f"{C.ANNOT_STATUS_PREFIX}1-8gb-used" not in annots
+    annots[f"{C.ANNOT_STATUS_PREFIX}1-8gb-used"] = "2"
+    strip_status_annotations(annots, family="slice")
+    assert f"{C.ANNOT_STATUS_PREFIX}1-8gb-used" in annots
+    assert f"{C.ANNOT_STATUS_PREFIX}0-2x2-free" not in annots
+
+
+def test_chipagent_refuses_slice_node():
+    api = APIServer()
+    api.create(KIND_NODE, make_tpu_node("s-0", partitioning="slice"))
+    import pytest
+    with pytest.raises(RuntimeError):
+        ChipAgent(api, "s-0").start()

@@ -235,7 +235,7 @@ class TestActuatorAndPartitioner:
         node = self.api.get(KIND_NODE, "n1")
         parsed = parse_spec_annotations(node.metadata.annotations)
         assert [(a.index, a.profile, a.quantity) for a in parsed] == [(0, "2x2", 2)]
-        assert node.metadata.annotations[C.ANNOT_SPEC_PLAN]
+        assert node.metadata.annotations[C.spec_plan_annotation("slice")]
 
     def test_apply_skips_when_equal(self):
         snap, _ = snapshot_for([self.node])
@@ -246,7 +246,7 @@ class TestActuatorAndPartitioner:
         })
         assert not self.actuator.apply(snap, desired)
         node = self.api.get(KIND_NODE, "n1")
-        assert C.ANNOT_SPEC_PLAN not in node.metadata.annotations
+        assert C.spec_plan_annotation("slice") not in node.metadata.annotations
 
     def test_apply_skips_empty(self):
         snap, _ = snapshot_for([self.node])

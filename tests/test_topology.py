@@ -312,6 +312,6 @@ class TestAnnotations:
         assert ann.spec_matches_status({})
 
     def test_ignores_foreign_annotations(self):
-        annots = {"foo/bar": "1", C.ANNOT_SPEC_PLAN: "abc"}
+        annots = {"foo/bar": "1", C.spec_plan_annotation("slice"): "abc"}
         assert ann.parse_spec_annotations(annots) == []
         assert ann.spec_plan_id(annots) == "abc"
