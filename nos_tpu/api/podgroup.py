@@ -1,0 +1,37 @@
+"""PodGroup: the gang-scheduling unit.
+
+No reference analog (SURVEY.md §2.8 — gang scheduling is new for the TPU
+build); modeled on the kubernetes-sigs scheduler-plugins coscheduling
+PodGroup.  Pods join a group via the `nos.tpu/pod-group` label; the group
+is admitted all-or-nothing once `min_member` pods exist.  `mesh` optionally
+names the JAX mesh the job will build (e.g. "4x8"), letting the scheduler
+hold all members to one physical TPU pod's ICI domain and the partitioner
+carve slices with usable topology.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from nos_tpu.kube.objects import ObjectMeta
+
+
+@dataclass
+class PodGroupSpec:
+    # Gang size: schedule no member until this many exist, then all at once.
+    min_member: int = 1
+    # Requested JAX mesh shape ("2x2x4"); empty = no topology constraint.
+    mesh: str = ""
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = "Pending"          # Pending | Scheduled
+    scheduled: int = 0
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)

@@ -182,8 +182,14 @@ class CapacityScheduling:
     # ------------------------------------------------------------------
     def pre_filter(self, state: CycleState, pod: Pod,
                    nodes: SharedLister) -> Status:
-        snapshot = self.elastic_quota_infos.clone()
-        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = snapshot
+        # Reuse an existing cycle-state snapshot rather than re-cloning:
+        # gang scheduling runs PreFilter once per member against ONE state,
+        # booking each placed member via the AddPod extension, so later
+        # members' max/aggregate checks see their gang-mates' usage.
+        snapshot = state.get(ELASTIC_QUOTA_SNAPSHOT_KEY)
+        if snapshot is None:
+            snapshot = self.elastic_quota_infos.clone()
+            state[ELASTIC_QUOTA_SNAPSHOT_KEY] = snapshot
         pod_req = self.calculator.compute_pod_request(pod)
 
         eq = snapshot.get(pod.metadata.namespace)
@@ -313,11 +319,8 @@ class CapacityScheduling:
     def _evict(self, victim: Pod) -> None:
         if self._api is None:
             return
-        try:
-            self._api.delete(KIND_POD, victim.metadata.name,
-                             victim.metadata.namespace)
-        except NotFound:
-            pass
+        from nos_tpu.scheduler.gang import evict_gang
+        evict_gang(self._api, victim)
 
     def _select_victims_on_node(
             self, state: CycleState, pod: Pod, node_info: NodeInfo,

@@ -250,6 +250,21 @@ class Framework:
                         return nominated, st
             return "", Status.unschedulable("no postfilter plugin succeeded")
 
+    def run_pre_filter_extension_add_pod(
+            self, state: CycleState, pod_to_schedule: Pod, pod_to_add: Pod,
+            node_info: NodeInfo) -> Status:
+        """Book a hypothetically-placed pod into every plugin's cycle-state
+        snapshot (reference capacity_scheduling.go:286-302) — used by
+        preemption what-ifs and gang placement."""
+        with self._lock:
+            for p in self._plugins:
+                if isinstance(p, PreFilterExtensions) and hasattr(p, "add_pod"):
+                    st = p.add_pod(state, pod_to_schedule, pod_to_add,
+                                   node_info)
+                    if not st.is_success:
+                        return st
+            return Status.ok()
+
     def run_reserve_plugins(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:
         with self._lock:

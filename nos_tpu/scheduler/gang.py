@@ -1,0 +1,137 @@
+"""Gang scheduling: all-or-nothing PodGroup admission with ICI topology.
+
+New for the TPU build (SURVEY.md §7 step 6 — no reference analog): a
+multi-host JAX job is useless partially placed, so its pods must bind
+together onto hosts sharing one physical TPU pod's ICI domain.
+
+Mechanics (the analog of the coscheduling plugin's Permit-stage holding,
+recast for this framework's synchronous scheduler): the scheduler groups
+pending pods by the `nos.tpu/pod-group` label and simulates placing the
+WHOLE gang on a cloned cluster snapshot — each member consumes capacity the
+next member sees.  Only if every member fits does anything bind; otherwise
+every member is marked unschedulable, which feeds the partitioner's batcher
+with the gang's full demand at once (so the planner carves for the whole
+job, not one pod).
+
+Topology: the scheduler tries one candidate physical pod (`nos.tpu/pod-id`
+ICI domain) at a time, best-fit first — the pod with the LEAST free
+capacity that still holds the whole gang — so large pods stay whole for
+large gangs; the TopologyFilter rejects hosts outside the pinned domain,
+keeping the gang's collectives on ICI rather than DCN.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_POD_GROUP, NotFound
+from nos_tpu.kube.objects import Pod
+from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.topology.shape import Shape
+
+logger = logging.getLogger(__name__)
+
+GANG_POD_ID_KEY = "gang-pinned-pod-id"
+
+
+def gang_name(pod: Pod) -> str:
+    return pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+
+
+def get_pod_group(api: APIServer, name: str, namespace: str):
+    try:
+        return api.get(KIND_POD_GROUP, name, namespace)
+    except NotFound:
+        return None
+
+
+def requested_mesh_chips(pg) -> int | None:
+    """Chip count implied by the PodGroup's mesh shape, if any."""
+    if pg is None or not pg.spec.mesh:
+        return None
+    try:
+        return Shape.parse(pg.spec.mesh).chips
+    except ValueError:
+        logger.warning("pod group %s has unparseable mesh %r",
+                       pg.metadata.name, pg.spec.mesh)
+        return None
+
+
+_MESH_CHIPS_KEY = "topo-mesh-chips"
+_POD_CHIPS_KEY = "topo-pod-chip-counts"
+
+
+class TopologyFilter:
+    """Filter plugin: gang members must share one physical TPU pod, and the
+    pod must be large enough for the requested mesh.  Cycle-invariant
+    lookups (the PodGroup's mesh requirement, per-pod chip totals) are
+    computed once in PreFilter and stashed in cycle state — Filter runs
+    per member x node and must stay O(1)."""
+
+    name = "TopologyFilter"
+
+    def __init__(self, api: APIServer) -> None:
+        self._api = api
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Status:
+        gang = gang_name(pod)
+        if not gang:
+            return Status.ok()
+        if _MESH_CHIPS_KEY not in state:
+            pg = get_pod_group(self._api, gang, pod.metadata.namespace)
+            state[_MESH_CHIPS_KEY] = requested_mesh_chips(pg)
+        if _POD_CHIPS_KEY not in state:
+            counts: dict[str, int] = {}
+            for ni in nodes.list():
+                labels = ni.node.metadata.labels
+                pid = labels.get(C.LABEL_POD_ID, "")
+                if pid:
+                    counts[pid] = counts.get(pid, 0) + int(
+                        labels.get(C.LABEL_CHIP_COUNT, "0"))
+            state[_POD_CHIPS_KEY] = counts
+        return Status.ok()
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        gang = gang_name(pod)
+        if not gang:
+            return Status.ok()
+        node_pod_id = node_info.node.metadata.labels.get(C.LABEL_POD_ID, "")
+        pinned = state.get(GANG_POD_ID_KEY)
+        # "" pins to unlabeled hosts only — a gang must never straddle a
+        # labeled ICI domain and anything else.
+        if pinned is not None and node_pod_id != pinned:
+            return Status.unschedulable(
+                f"gang {gang} pinned to TPU pod {pinned or '(unlabeled)'}, "
+                f"node is in {node_pod_id or '(unlabeled)'}"
+            )
+        chips = state.get(_MESH_CHIPS_KEY)
+        if chips is not None and node_pod_id:
+            total = state.get(_POD_CHIPS_KEY, {}).get(node_pod_id, 0)
+            if total < chips:
+                return Status.unschedulable(
+                    f"TPU pod {node_pod_id} has {total} chips < mesh "
+                    f"requirement {chips}"
+                )
+        return Status.ok()
+
+
+def evict_gang(api: APIServer, victim: Pod) -> list[str]:
+    """A gang is all-or-nothing in death too: evicting one member evicts
+    the whole group (partial gangs would deadlock the job while holding
+    chips — SURVEY.md §7 hard part 2)."""
+    gang = gang_name(victim)
+    doomed = [victim]
+    if gang:
+        doomed = api.list(
+            "Pod", namespace=victim.metadata.namespace,
+            label_selector={C.LABEL_POD_GROUP: gang})
+    deleted = []
+    for p in doomed:
+        try:
+            api.delete("Pod", p.metadata.name, p.metadata.namespace)
+            deleted.append(p.key)
+        except NotFound:
+            pass
+    return deleted

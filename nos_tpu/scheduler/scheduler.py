@@ -13,6 +13,11 @@ from __future__ import annotations
 
 import logging
 
+from nos_tpu.api.constants import (
+    LABEL_POD_GROUP as C_LABEL_POD_GROUP,
+    LABEL_POD_ID as C_LABEL_POD_ID,
+    RESOURCE_TPU,
+)
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import pod_request
@@ -85,7 +90,10 @@ class Scheduler:
 
     def run_cycle(self) -> int:
         """Schedule all pending, not-yet-bound pods for this scheduler;
-        returns number of pods bound."""
+        returns number of pods bound.  Pods sharing a `nos.tpu/pod-group`
+        label are admitted all-or-nothing (gang scheduling)."""
+        from nos_tpu.scheduler.gang import gang_name
+
         bound = 0
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
@@ -93,10 +101,113 @@ class Scheduler:
         ]
         pods.sort(key=lambda p: (-p.spec.priority,
                                  p.metadata.creation_timestamp, p.key))
+        gangs: dict[tuple[str, str], list[Pod]] = {}
         for pod in pods:
-            if self.schedule_one(pod) is not None:
-                bound += 1
+            g = gang_name(pod)
+            if g:
+                gangs.setdefault((pod.metadata.namespace, g), []).append(pod)
+        seen_gangs: set[tuple[str, str]] = set()
+        for pod in pods:
+            g = gang_name(pod)
+            if not g:
+                if self.schedule_one(pod) is not None:
+                    bound += 1
+                continue
+            key = (pod.metadata.namespace, g)
+            if key not in seen_gangs:
+                seen_gangs.add(key)
+                bound += self.schedule_gang(gangs[key])
         return bound
+
+    def schedule_gang(self, members: list[Pod]) -> int:
+        """All-or-nothing placement of a pod group: simulate every member
+        on a shared snapshot (each consumes capacity the next one sees,
+        and the first placement pins the gang's physical TPU pod); bind
+        only if all fit, else mark all unschedulable so the partitioner
+        sees the gang's full demand."""
+        from nos_tpu.scheduler.gang import (
+            GANG_POD_ID_KEY, gang_name, get_pod_group,
+        )
+
+        first = members[0]
+        gang = gang_name(first)
+        pg = get_pod_group(self._api, gang, first.metadata.namespace)
+        min_member = pg.spec.min_member if pg else len(members)
+        # Count every live member — already-running mates count toward the
+        # gang, so a recreated worker of a partially-running gang schedules
+        # instead of deadlocking on "waiting for members".
+        alive = len(self._api.list(
+            KIND_POD, namespace=first.metadata.namespace,
+            label_selector={C_LABEL_POD_GROUP: gang},
+            filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
+        if alive < min_member:
+            for pod in members:
+                self._mark_unschedulable(pod, Status.unschedulable(
+                    f"pod group waiting for members "
+                    f"({alive}/{min_member})"))
+            return 0
+
+        # Candidate ICI domains, best-fit first (least free capacity that
+        # still might hold the gang — keeps large pods free for large
+        # gangs); "" = hosts with no pod-id label (no pinning).
+        lister = self.snapshot()
+        free_by_pod: dict[str, float] = {}
+        for ni in lister.list():
+            pid = ni.node.metadata.labels.get(C_LABEL_POD_ID, "")
+            free_by_pod[pid] = free_by_pod.get(pid, 0.0) + max(
+                0.0, ni.free().get(RESOURCE_TPU, 0.0))
+        candidates = sorted(free_by_pod, key=lambda p: (free_by_pod[p], p))
+
+        placements: list[tuple[Pod, NodeInfo]] = []
+        state = CycleState()
+        for candidate in candidates:
+            lister = self.snapshot()
+            state = CycleState()
+            # Pin even the "" candidate: a gang trying unlabeled hosts must
+            # use ONLY unlabeled hosts, never span labeled ICI domains.
+            state[GANG_POD_ID_KEY] = candidate
+            placements = []
+            for pod in members:
+                status = self._framework.run_pre_filter_plugins(
+                    state, pod, lister)
+                if not status.is_success:
+                    placements = []
+                    break
+                feasible = [
+                    ni for ni in lister.list()
+                    if self._framework.run_filter_plugins(
+                        state, pod, ni).is_success
+                ]
+                if not feasible:
+                    placements = []
+                    break
+                chosen = min(feasible, key=self._score_key(pod))
+                chosen.add_pod(pod)  # next member sees reduced capacity
+                self._framework.run_pre_filter_extension_add_pod(
+                    state, pod, pod, chosen)  # book quota usage for mates
+                placements.append((pod, chosen))
+            if len(placements) == len(members):
+                break
+
+        if len(placements) != len(members):
+            for pod in members:
+                self._mark_unschedulable(pod, Status.unschedulable(
+                    "gang does not fit as a whole"))
+            return 0
+        for pod, ni in placements:
+            st = self._framework.run_reserve_plugins(state, pod, ni.name)
+            if not st.is_success:
+                # roll back the whole gang
+                for p2, n2 in placements:
+                    self._framework.run_unreserve_plugins(state, p2, n2.name)
+                for p2 in members:
+                    self._mark_unschedulable(p2, st)
+                return 0
+        for pod, ni in placements:
+            self._bind(pod, ni.name)
+        logger.info("gang %s: bound %d pods",
+                    gang_name(first), len(placements))
+        return len(placements)
 
     # -- internals ----------------------------------------------------------
     def _score_key(self, pod: Pod):

@@ -1,0 +1,259 @@
+"""Gang scheduling tests: all-or-nothing PodGroups + ICI topology pinning
+(BASELINE config #4 shape: multi-host JAX job across one TPU pod)."""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.kube.client import (
+    APIServer, KIND_ELASTIC_QUOTA, KIND_NODE, KIND_POD, KIND_POD_GROUP,
+)
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.quota import TPUResourceCalculator
+from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_node, make_pod, make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+
+
+def make_cluster(*, hosts_per_pod: dict[str, int], chips: int = 8):
+    api = APIServer()
+    fw = Framework([NodeResourcesFit(), TopologyFilter(api)])
+    i = 0
+    for pod_id, n in hosts_per_pod.items():
+        for h in range(n):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}",
+                labels={C.LABEL_POD_ID: pod_id, C.LABEL_CHIP_COUNT: str(chips)},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: float(chips)},
+            ))
+            i += 1
+    return api, Scheduler(api, fw)
+
+
+def gang_pod(name: str, gang: str, chips: int = 8, **kw):
+    return make_pod(name=name, labels={C.LABEL_POD_GROUP: gang},
+                    resources={C.RESOURCE_TPU: chips, "cpu": 1.0}, **kw)
+
+
+def create_pod_group(api, name: str, min_member: int, mesh: str = "",
+                     namespace: str = "default"):
+    api.create(KIND_POD_GROUP, PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=PodGroupSpec(min_member=min_member, mesh=mesh)))
+
+
+class TestGangAdmission:
+    def test_gang_binds_atomically(self):
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 4})
+        create_pod_group(api, "train", min_member=4)
+        for i in range(4):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 4
+        nodes = {api.get(KIND_POD, f"w-{i}", "default").spec.node_name
+                 for i in range(4)}
+        assert len(nodes) == 4  # one worker per host
+
+    def test_waits_for_min_member(self):
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 4})
+        create_pod_group(api, "train", min_member=4)
+        for i in range(3):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 0
+        pod = api.get(KIND_POD, "w-0", "default")
+        assert pod.is_unschedulable()
+        # the straggler arrives -> whole gang binds
+        api.create(KIND_POD, gang_pod("w-3", "train"))
+        assert sched.run_cycle() == 4
+
+    def test_no_partial_binding_when_gang_cannot_fit(self):
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 2})
+        create_pod_group(api, "train", min_member=3)
+        for i in range(3):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 0
+        for i in range(3):
+            assert api.get(KIND_POD, f"w-{i}", "default").spec.node_name == ""
+
+    def test_mixed_gang_and_singles(self):
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 3})
+        create_pod_group(api, "train", min_member=2)
+        api.create(KIND_POD, gang_pod("w-0", "train"))
+        api.create(KIND_POD, gang_pod("w-1", "train"))
+        api.create(KIND_POD, make_pod(
+            name="single", resources={C.RESOURCE_TPU: 8}))
+        assert sched.run_cycle() == 3
+
+
+class TestTopologyPinning:
+    def test_gang_lands_on_single_tpu_pod(self):
+        # pod-a has spare hosts but only pod-b can hold the whole gang
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 2, "pod-b": 4})
+        create_pod_group(api, "train", min_member=3)
+        for i in range(3):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 3
+        pods_of = set()
+        for i in range(3):
+            node = api.get(KIND_NODE, api.get(
+                KIND_POD, f"w-{i}", "default").spec.node_name)
+            pods_of.add(node.metadata.labels[C.LABEL_POD_ID])
+        assert pods_of == {"pod-b"}
+
+    def test_mesh_chip_requirement_rejects_small_pod(self):
+        # mesh 4x8 = 32 chips; pod-a has 2 hosts x 8 = 16 chips
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 2})
+        create_pod_group(api, "train", min_member=2, mesh="4x8")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 0
+
+    def test_mesh_fits_pod(self):
+        # mesh 4x8 = 32 chips; pod-b has 4 hosts x 8 = 32 chips
+        api, sched = make_cluster(hosts_per_pod={"pod-b": 4})
+        create_pod_group(api, "train", min_member=4, mesh="4x8")
+        for i in range(4):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 4
+
+
+class TestGangWithPartitioner:
+    def test_gang_triggers_repartition_then_binds(self):
+        """Unschedulable gang feeds the partitioner its full demand; after
+        the re-carve the gang binds atomically (BASELINE config #4 on one
+        host group)."""
+        api = APIServer()
+        state = ClusterState()
+        now = [0.0]
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        pc = new_slice_partitioner_controller(
+            api, state, batch_idle_s=10.0, clock=lambda: now[0])
+        pc.bind()
+        agents = []
+        for i in range(2):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{i}", pod_id="pod-a", host_index=i))
+            a = SliceAgent(api, f"host-{i}", FakeTpuRuntime(V5E),
+                           FakePodResources())
+            a.start()
+            a.tick()
+            agents.append(a)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api)])
+        sched = Scheduler(api, fw)
+        create_pod_group(api, "fsdp", min_member=4)
+        for i in range(4):
+            api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"w-{i}",
+                labels={C.LABEL_POD_GROUP: "fsdp"}))
+        assert sched.run_cycle() == 0            # nothing advertised yet
+        now[0] += 11.0
+        assert pc.process_if_ready()
+        for a in agents:
+            a.tick()
+        assert sched.run_cycle() == 4
+        for i in range(4):
+            assert api.get(KIND_POD, f"w-{i}", "default").status.phase == RUNNING
+
+
+class TestGangRegressions:
+    def test_gang_cannot_collectively_exceed_quota_max(self):
+        """Each member alone fits under max, but the gang together exceeds
+        it — nothing may bind (members must see gang-mates' usage)."""
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        api = APIServer()
+        calc = TPUResourceCalculator(16)
+        plugin = CapacityScheduling(calc)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+        plugin.set_framework(fw)
+        plugin.attach(api)
+        for i in range(2):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}", labels={C.LABEL_POD_ID: "pod-a"},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0,
+                             C.RESOURCE_TPU_MEMORY: 128.0}))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-a", namespace="ns-a"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 256},
+                                  max={C.RESOURCE_TPU_MEMORY: 128})))
+        sched = Scheduler(api, fw)
+        create_pod_group(api, "big", min_member=2, namespace="ns-a")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "big", namespace="ns-a"))
+        assert sched.run_cycle() == 0
+        for i in range(2):
+            assert api.get(KIND_POD, f"w-{i}", "ns-a").spec.node_name == ""
+
+    def test_gang_never_spans_labeled_and_unlabeled_hosts(self):
+        """The unlabeled-host candidate must use ONLY unlabeled hosts."""
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 2, "pod-b": 2})
+        api.create(KIND_NODE, make_node(
+            "bare-0", allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0}))
+        create_pod_group(api, "train", min_member=3)
+        for i in range(3):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        # no single domain holds 3 full hosts -> nothing binds
+        assert sched.run_cycle() == 0
+
+    def test_recreated_member_of_running_gang_schedules(self):
+        """Running gang-mates count toward min_member, so a replacement
+        worker schedules instead of deadlocking on 'waiting for members'."""
+        api, sched = make_cluster(hosts_per_pod={"pod-a": 4})
+        create_pod_group(api, "train", min_member=4)
+        for i in range(4):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        assert sched.run_cycle() == 4
+        api.delete(KIND_POD, "w-3", "default")
+        api.create(KIND_POD, gang_pod("w-3b", "train"))
+        assert sched.run_cycle() == 1
+        assert api.get(KIND_POD, "w-3b", "default").spec.node_name != ""
+
+
+class TestGangPreemption:
+    def test_whole_gang_evicted(self):
+        api = APIServer()
+        calc = TPUResourceCalculator(16)
+        plugin = CapacityScheduling(calc)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+        plugin.set_framework(fw)
+        plugin.attach(api)
+        for i in range(2):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}", labels={C.LABEL_POD_ID: "pod-a"},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0,
+                             C.RESOURCE_TPU_MEMORY: 128.0}))
+        sched = Scheduler(api, fw)
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-a", namespace="ns-a"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 128})))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-b", namespace="ns-b"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 128})))
+        # ns-b gang fills both hosts (borrowing half from ns-a)
+        create_pod_group(api, "borrower", min_member=2, namespace="ns-b")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(
+                f"b-{i}", "borrower", namespace="ns-b",
+                creation_timestamp=float(i)))
+        assert sched.run_cycle() == 2
+        from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
+        ElasticQuotaReconciler(api, calc).reconcile_all()
+        # ns-a claims its min back with one 8-chip pod: one member of the
+        # gang is the victim, but the WHOLE gang must go
+        api.create(KIND_POD, make_pod(
+            name="a-0", namespace="ns-a",
+            resources={C.RESOURCE_TPU: 8, "cpu": 1.0}))
+        sched.run_cycle()
+        assert api.list(KIND_POD, namespace="ns-b") == []
+        sched.run_cycle()
+        assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name != ""
