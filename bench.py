@@ -1,13 +1,14 @@
 """Benchmark: end-to-end dynamic repartitioning latency.
 
-Scenario (BASELINE.md target: repartition < 30 s end-to-end; the reference's
-defaults alone spend up to 70 s batching): a simulated v5e-64 — 8 hosts x 8
-chips — boots carved as one 2x4 slice per host; a mixed burst of pending
-pods (2x4 / 2x2 / 1x1 profiles) then forces the planner to re-carve every
-host, the slice agents to actuate, and the scheduler to bind.  Everything
-runs through the real control-plane code paths (batcher, planner with
-scheduler simulation, packer, annotation protocol, fake TPU runtime);
-measured time is wall-clock from pod submission to the last pod bound.
+Scenario = BASELINE config #3 (target: repartition < 30 s end-to-end; the
+reference's defaults alone spend up to 70 s batching): a simulated v5e-64 —
+8 hosts x 8 chips in one physical pod — is reshaped under pending-pod
+pressure into {4 x v5e-8, 2 x v5e-16}: four single-host jobs plus two
+2-pod gangs each consuming a multi-host 4x4 slice.  Everything runs
+through the real control-plane code paths (batcher, planner with scheduler
+simulation + multi-host group pass, packer, annotation protocol, gang
+scheduler, fake TPU runtime); measured time is wall-clock from pod
+submission to the last pod bound.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"} where
 vs_baseline = value / 30 s (lower is better, < 1.0 beats the target).
@@ -18,16 +19,21 @@ from __future__ import annotations
 import json
 import time
 
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
 from nos_tpu.controllers.sliceagent.agent import SliceAgent
 from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
-from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
-from nos_tpu.kube.objects import RUNNING
+from nos_tpu.kube.client import (
+    APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP,
+)
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
 from nos_tpu.partitioning.slicepart import SliceNodeInitializer
 from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
-from nos_tpu.scheduler.framework import Framework
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
 from nos_tpu.scheduler.scheduler import Scheduler
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
@@ -50,11 +56,13 @@ def build_cluster():
     agents = []
     for i in range(HOSTS):
         name = f"host-{i}"
-        api.create(KIND_NODE, make_tpu_node(name, host_index=i))
+        api.create(KIND_NODE, make_tpu_node(
+            name, pod_id="pod-0", host_index=i))
         agent = SliceAgent(api, name, FakeTpuRuntime(V5E), FakePodResources())
         agent.start()
         agents.append(agent)
-    scheduler = Scheduler(api, Framework())
+    scheduler = Scheduler(
+        api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
     return api, partitioner, agents, scheduler
 
 
@@ -63,14 +71,19 @@ def run_scenario() -> float:
     for a in agents:
         a.tick()   # actuate initial geometry
 
-    # Mixed pressure filling the cluster exactly: 4 full-host slices
-    # (32 chips) + 4 half-host (16) + 16 single-chip (16) = 64 chips —
-    # convergence therefore requires a perfect packing, not best-effort.
-    pods = (
-        [make_slice_pod("2x4", 1, name=f"train-{i}") for i in range(4)]
-        + [make_slice_pod("2x2", 1, name=f"mid-{i}") for i in range(4)]
-        + [make_slice_pod("1x1", 1, name=f"serve-{i}") for i in range(16)]
-    )
+    # BASELINE #3 exactly: 4 x v5e-8 single-host jobs + 2 x v5e-16 jobs
+    # (2-pod gangs on multi-host 4x4 slices) = all 64 chips — convergence
+    # requires a perfect packing including the multi-host group pass.
+    pods = [make_slice_pod("2x4", 1, name=f"v5e8-{i}") for i in range(4)]
+    for g in range(2):
+        api.create(KIND_POD_GROUP, PodGroup(
+            metadata=ObjectMeta(name=f"v5e16-{g}", namespace="default"),
+            spec=PodGroupSpec(min_member=2)))
+        pods += [
+            make_slice_pod("4x4", 1, name=f"v5e16-{g}-{i}",
+                           labels={C.LABEL_POD_GROUP: f"v5e16-{g}"})
+            for i in range(2)
+        ]
     t0 = time.monotonic()
     for p in pods:
         api.create(KIND_POD, p)
@@ -96,7 +109,7 @@ def run_scenario() -> float:
 def main() -> None:
     latency = run_scenario()
     print(json.dumps({
-        "metric": "repartition_latency_v5e64_mixed_burst",
+        "metric": "repartition_latency_v5e64_reshape",
         "value": round(latency, 3),
         "unit": "s",
         "vs_baseline": round(latency / BASELINE_S, 4),

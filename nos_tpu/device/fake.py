@@ -57,6 +57,27 @@ class FakeTpuRuntime(TpuRuntimeClient):
                 raise SliceCreationError("injected create failure")
             fixed = [pl for _, (u, _, pl) in self._devices.items()
                      if u == unit_index]
+            multi = [s for s in shapes
+                     if s.chips > self._gen.chips_per_host]
+            if multi:
+                # A multi-host shard consumes this host's ENTIRE block as
+                # its per-host share (the real runtime joins the host into
+                # the slice via the Cloud TPU multi-host config).
+                if len(shapes) != 1 or fixed:
+                    raise SliceCreationError(
+                        f"multi-host shard {multi[0].name} needs the whole "
+                        f"block of unit {unit_index} "
+                        f"({len(fixed)} devices present)"
+                    )
+                shape = multi[0].canonical()
+                pl = Placement(
+                    shape=shape,
+                    offset=(0,) * len(self._gen.host_block.dims),
+                    dims=self._gen.host_block.dims,
+                )
+                did = f"tpu-{unit_index}-{shape.name}-{next(self._ids)}"
+                self._devices[did] = (unit_index, shape, pl)
+                return [did]
             counts: dict[Shape, int] = {}
             for s in shapes:
                 counts[s.canonical()] = counts.get(s.canonical(), 0) + 1

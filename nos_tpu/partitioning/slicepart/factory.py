@@ -9,9 +9,10 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import GeometryActuator, GeometryPlanner
+from ..core import GeometryActuator
 from ..state import ClusterState
 from .calculators import SlicePartitionCalculator, SliceProfileCalculator
+from .group import MultiHostGeometryPlanner
 from .partitioner import SliceNodeInitializer, SlicePartitioner
 from .snapshot_taker import SLICE_KIND, SliceSnapshotTaker
 
@@ -25,7 +26,7 @@ def new_slice_partitioner_controller(
     from nos_tpu.controllers.partitioner_controller import PartitionerController
 
     partition_calculator = SlicePartitionCalculator()
-    planner = GeometryPlanner(
+    planner = MultiHostGeometryPlanner(
         framework=framework or Framework(),
         calculator=SliceProfileCalculator(),
         partition_calculator=partition_calculator,

@@ -1,0 +1,167 @@
+"""Multi-host slice planning: the group pass.
+
+SURVEY.md §7 hard part 4: TPU slices larger than one host (v5e 4x4 = 2
+hosts, 4x8 = 4, ...) break the reference's one-node-one-partition
+assumption.  The per-node annotation protocol is preserved by carving a
+multi-host slice as whole-host *shards*: every member host's spec/status
+carries the slice profile at quantity 1 and advertises the
+`nos.tpu/slice-<shape>` resource, and a consuming job is a gang of
+one-pod-per-host members (nos_tpu/scheduler/gang.py picks the matching
+host window).
+
+Shard adjacency convention shared with the gang scheduler: member hosts of
+one slice instance are a host-index-aligned consecutive window within one
+physical pod — window [i, i + hosts) with i % hosts == 0.  With row-major
+Cloud TPU host numbering these windows are ICI-contiguous sub-meshes.
+
+The pass runs before the per-node planning loop:
+
+1. reclaim: if sub-host profiles are lacking, break up fully-free
+   multi-host instances back to virgin host blocks (never touching used
+   shards) so the per-node loop can re-carve them;
+2. provide: for each lacking multi-host shape, find an aligned window of
+   freeable hosts (no used slices) in some physical pod and dedicate each
+   as a shard.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.scheduler.framework import CycleState, SharedLister
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+from nos_tpu.topology.shape import Shape
+
+from nos_tpu.topology.windows import aligned_index_windows
+
+from ..core.planner import GeometryPlanner
+from ..core.snapshot import ClusterSnapshot
+from ..core.tracker import SliceTracker
+from ..state import PartitioningState
+from .node import SliceNode
+
+logger = logging.getLogger(__name__)
+
+
+def aligned_windows(members: list[SliceNode], hosts: int) -> list[list[SliceNode]]:
+    """Host-index-aligned consecutive windows of the given size."""
+    by_index = {n.host_index: n for n in members}
+    return [[by_index[i] for i in w]
+            for w in aligned_index_windows(by_index, hosts)]
+
+
+class MultiHostGeometryPlanner(GeometryPlanner):
+    """GeometryPlanner plus the multi-host group pass."""
+
+    def __init__(self, *args, registry: TopologyRegistry = DEFAULT_REGISTRY,
+                 **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._registry = registry
+
+    def plan(self, snapshot: ClusterSnapshot,
+             pending_pods: list[Pod]) -> PartitioningState:
+        tracker = SliceTracker(snapshot, self._calculator, pending_pods)
+        if not tracker.empty:
+            self._group_pass(snapshot, tracker.lacking, pending_pods)
+        return super().plan(snapshot, pending_pods)
+
+    # -- the pass -----------------------------------------------------------
+    def _group_pass(self, snapshot: ClusterSnapshot,
+                    lacking: dict[str, int], pending_pods: list[Pod]) -> None:
+        nodes = [n for n in snapshot.nodes().values()
+                 if isinstance(n, SliceNode)]
+        if not nodes:
+            return
+        multi: dict[Shape, int] = {}
+        sub_lacking_chips = 0
+        for profile, qty in lacking.items():
+            if "x" not in profile or qty <= 0:
+                continue
+            shape = Shape.parse(profile).canonical()
+            gen = nodes[0].generation
+            if shape.chips > gen.chips_per_host:
+                multi[shape] = multi.get(shape, 0) + qty
+            else:
+                sub_lacking_chips += shape.chips * qty
+
+        if sub_lacking_chips:
+            self._reclaim_free_instances(nodes, sub_lacking_chips)
+        if not multi:
+            return
+
+        by_pod: dict[str, list[SliceNode]] = defaultdict(list)
+        for n in nodes:
+            if n.pod_id:
+                by_pod[n.pod_id].append(n)
+
+        for shape in sorted(multi, key=lambda s: -s.chips):
+            want = multi[shape]
+            for pod_id in sorted(by_pod):
+                members = by_pod[pod_id]
+                gen = members[0].generation
+                if shape not in gen.multihost_shapes():
+                    continue
+                hosts = gen.hosts_for(shape)
+                for window in aligned_windows(members, hosts):
+                    if want <= 0:
+                        break
+                    if any(w.has_used_slices() or w.is_multihost_member()
+                           for w in window):
+                        continue
+                    for w in window:
+                        w.make_member_of(shape)
+                    want -= 1
+                    logger.info(
+                        "group pass: carved %s across %s",
+                        shape.name, [w.name for w in window])
+                if want <= 0:
+                    break
+
+    def _reclaim_free_instances(self, nodes: list[SliceNode],
+                                lacking_chips: int) -> None:
+        """Break up multi-host instances whose every shard is free — the
+        per-node loop then re-carves the blocks for sub-host demand.  An
+        instance with ANY used shard is untouchable, and instances are
+        reclaimed only while the lacking sub-host demand exceeds what
+        non-member hosts' re-carvable free capacity can supply (a free
+        slice reserved for an assembling gang must not churn under small-pod
+        arrivals the rest of the cluster can absorb)."""
+        deficit = lacking_chips
+        for n in nodes:
+            if n.is_multihost_member():
+                continue
+            for u in n.units:
+                if u.is_multihost_shard():
+                    continue
+                deficit -= sum(s.chips * c for s, c in u.free.items())
+        if deficit <= 0:
+            return
+
+        by_pod: dict[str, list[SliceNode]] = defaultdict(list)
+        for n in nodes:
+            if n.pod_id and n.is_multihost_member():
+                by_pod[n.pod_id].append(n)
+        for pod_id, members in by_pod.items():
+            gen = members[0].generation
+            # group shards into instances by shape + aligned window
+            by_shape: dict[Shape, list[SliceNode]] = defaultdict(list)
+            for n in members:
+                for u in n.units:
+                    for s in u.current_geometry():
+                        if s.chips > gen.chips_per_host:
+                            by_shape[s].append(n)
+            for shape, shards in by_shape.items():
+                hosts = gen.hosts_for(shape)
+                for window in aligned_windows(shards, hosts):
+                    if deficit <= 0:
+                        return
+                    if any(w.has_used_slices() for w in window):
+                        continue
+                    for w in window:
+                        w.reset_virgin()
+                    deficit -= shape.chips
+                    logger.info(
+                        "group pass: reclaimed free %s at %s",
+                        shape.name, [w.name for w in window])

@@ -61,8 +61,43 @@ class SliceNode(PartitionableNode):
     def name(self) -> str:
         return self._name
 
+    @property
+    def pod_id(self) -> str:
+        return self._node_info.node.metadata.labels.get(C.LABEL_POD_ID, "")
+
+    @property
+    def host_index(self) -> int:
+        try:
+            return int(self._node_info.node.metadata.labels.get(
+                C.LABEL_HOST_INDEX, "0"))
+        except ValueError:
+            return 0
+
     def node_info(self) -> NodeInfo:
         return self._node_info
+
+    def has_used_slices(self) -> bool:
+        return any(c > 0 for u in self.units for c in u.used.values())
+
+    def is_multihost_member(self) -> bool:
+        return any(u.is_multihost_shard() for u in self.units)
+
+    def make_member_of(self, shape: Shape) -> None:
+        """Dedicate this host as one shard of a multi-host slice: unit 0
+        carries the membership profile, remaining units go empty (the whole
+        host belongs to the slice)."""
+        for u in self.units[1:]:
+            if any(c > 0 for c in u.used.values()):
+                raise ValueError(
+                    f"host {self._name} has used slices on unit {u.index}")
+            u.free = {}
+        self.units[0].make_member_of(shape)
+        self._sync_allocatable()
+
+    def reset_virgin(self) -> None:
+        for u in self.units:
+            u.reset_virgin()
+        self._sync_allocatable()
 
     def update_geometry_for(self, lacking: ProfileRequest) -> bool:
         remaining = {
@@ -73,6 +108,11 @@ class SliceNode(PartitionableNode):
         for unit in self.units:
             if not remaining:
                 break
+            # multi-host shards are carved/broken only by the group pass —
+            # a per-host re-carve here would orphan the partner hosts'
+            # shards (nos_tpu/partitioning/slicepart/group.py)
+            if unit.is_multihost_shard():
+                continue
             if unit.update_geometry_for(remaining):
                 changed = True
             for shape in list(remaining):

@@ -46,6 +46,19 @@ def get_pod_group(api: APIServer, name: str, namespace: str):
         return None
 
 
+def set_pod_group_status(api: APIServer, pg, phase: str,
+                         scheduled: int) -> None:
+    def mutate(o) -> None:
+        o.status.phase = phase
+        o.status.scheduled = scheduled
+
+    try:
+        api.patch(KIND_POD_GROUP, pg.metadata.name,
+                  pg.metadata.namespace, mutate=mutate)
+    except NotFound:
+        pass
+
+
 def requested_mesh_chips(pg) -> int | None:
     """Chip count implied by the PodGroup's mesh shape, if any."""
     if pg is None or not pg.spec.mesh:
@@ -60,6 +73,54 @@ def requested_mesh_chips(pg) -> int | None:
 
 _MESH_CHIPS_KEY = "topo-mesh-chips"
 _POD_CHIPS_KEY = "topo-pod-chip-counts"
+GANG_HOST_SET_KEY = "gang-allowed-hosts"
+
+
+def gang_slice_windows(api: APIServer, members: list[Pod]
+                       ) -> list[tuple[str, frozenset[str]]]:
+    """Placement candidates for a gang consuming one multi-host slice: the
+    host-index-aligned windows matching the partitioner's shard adjacency
+    convention (nos_tpu/partitioning/slicepart/group.py).  Returns
+    (pod_id, member host names) per candidate window, [] when the gang does
+    not request a multi-host slice resource."""
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.topology import DEFAULT_REGISTRY
+    from nos_tpu.topology.profile import extract_slice_requests
+
+    shapes = set()
+    for pod in members:
+        shapes.update(extract_slice_requests(pod_request(pod)))
+    if len(shapes) != 1:
+        return []
+    shape = next(iter(shapes))
+
+    by_pod: dict[str, dict[int, object]] = {}
+    hosts_needed: int | None = None
+    for node in api.list("Node"):
+        labels = node.metadata.labels
+        pid = labels.get(C.LABEL_POD_ID, "")
+        accel = labels.get(C.LABEL_ACCELERATOR, "")
+        if not pid or accel not in DEFAULT_REGISTRY.generations:
+            continue
+        gen = DEFAULT_REGISTRY.get(accel)
+        if shape.chips <= gen.chips_per_host:
+            return []  # single-host profile: no window constraint
+        hosts_needed = gen.hosts_for(shape)
+        try:
+            idx = int(labels.get(C.LABEL_HOST_INDEX, "0"))
+        except ValueError:
+            continue
+        by_pod.setdefault(pid, {})[idx] = node.metadata.name
+    if not hosts_needed:
+        return []
+    from nos_tpu.topology.windows import aligned_index_windows
+
+    out: list[tuple[str, frozenset[str]]] = []
+    for pid in sorted(by_pod):
+        hosts = by_pod[pid]
+        for window in aligned_index_windows(hosts, hosts_needed):
+            out.append((pid, frozenset(hosts[i] for i in window)))
+    return out
 
 
 class TopologyFilter:
@@ -106,6 +167,12 @@ class TopologyFilter:
                 f"gang {gang} pinned to TPU pod {pinned or '(unlabeled)'}, "
                 f"node is in {node_pod_id or '(unlabeled)'}"
             )
+        allowed_hosts = state.get(GANG_HOST_SET_KEY)
+        if allowed_hosts is not None and node_info.name not in allowed_hosts:
+            return Status.unschedulable(
+                f"gang {gang} pinned to slice hosts "
+                f"{sorted(allowed_hosts)}, node {node_info.name} is outside"
+            )
         chips = state.get(_MESH_CHIPS_KEY)
         if chips is not None and node_pod_id:
             total = state.get(_POD_CHIPS_KEY, {}).get(node_pod_id, 0)
@@ -134,4 +201,8 @@ def evict_gang(api: APIServer, victim: Pod) -> list[str]:
             deleted.append(p.key)
         except NotFound:
             pass
+    if gang:
+        pg = get_pod_group(api, gang, victim.metadata.namespace)
+        if pg is not None:
+            set_pod_group_status(api, pg, "Pending", 0)
     return deleted

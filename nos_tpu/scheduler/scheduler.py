@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 
 from nos_tpu.api.constants import (
+    LABEL_HOST_INDEX as C_LABEL_HOST_INDEX,
     LABEL_POD_GROUP as C_LABEL_POD_GROUP,
     LABEL_POD_ID as C_LABEL_POD_ID,
     RESOURCE_TPU,
@@ -23,6 +24,10 @@ from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status, UNSCHEDULABLE,
+)
+from nos_tpu.scheduler.gang import (
+    GANG_HOST_SET_KEY, GANG_POD_ID_KEY, gang_name, gang_slice_windows,
+    get_pod_group, set_pod_group_status,
 )
 
 logger = logging.getLogger(__name__)
@@ -92,8 +97,6 @@ class Scheduler:
         """Schedule all pending, not-yet-bound pods for this scheduler;
         returns number of pods bound.  Pods sharing a `nos.tpu/pod-group`
         label are admitted all-or-nothing (gang scheduling)."""
-        from nos_tpu.scheduler.gang import gang_name
-
         bound = 0
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
@@ -125,10 +128,6 @@ class Scheduler:
         and the first placement pins the gang's physical TPU pod); bind
         only if all fit, else mark all unschedulable so the partitioner
         sees the gang's full demand."""
-        from nos_tpu.scheduler.gang import (
-            GANG_POD_ID_KEY, gang_name, get_pod_group,
-        )
-
         first = members[0]
         gang = gang_name(first)
         pg = get_pod_group(self._api, gang, first.metadata.namespace)
@@ -147,25 +146,48 @@ class Scheduler:
                     f"({alive}/{min_member})"))
             return 0
 
-        # Candidate ICI domains, best-fit first (least free capacity that
-        # still might hold the gang — keeps large pods free for large
-        # gangs); "" = hosts with no pod-id label (no pinning).
-        lister = self.snapshot()
-        free_by_pod: dict[str, float] = {}
-        for ni in lister.list():
-            pid = ni.node.metadata.labels.get(C_LABEL_POD_ID, "")
-            free_by_pod[pid] = free_by_pod.get(pid, 0.0) + max(
-                0.0, ni.free().get(RESOURCE_TPU, 0.0))
-        candidates = sorted(free_by_pod, key=lambda p: (free_by_pod[p], p))
+        # Candidates: for a gang consuming one multi-host slice, the
+        # aligned host windows matching the partitioner's shard layout;
+        # otherwise whole ICI domains, best-fit first (least free capacity
+        # that still might hold the gang — keeps large pods free for large
+        # gangs).  "" = hosts with no pod-id label.
+        windows = gang_slice_windows(self._api, members)
+        base = self.snapshot()
+        if windows:
+            candidate_pins = [
+                {GANG_POD_ID_KEY: pid, GANG_HOST_SET_KEY: hosts}
+                for pid, hosts in windows
+            ]
+        else:
+            free_by_pod: dict[str, float] = {}
+            for ni in base.list():
+                pid = ni.node.metadata.labels.get(C_LABEL_POD_ID, "")
+                free_by_pod[pid] = free_by_pod.get(pid, 0.0) + max(
+                    0.0, ni.free().get(RESOURCE_TPU, 0.0))
+            # Pin even the "" candidate: a gang trying unlabeled hosts must
+            # use ONLY unlabeled hosts, never span labeled ICI domains.
+            candidate_pins = [
+                {GANG_POD_ID_KEY: pid}
+                for pid in sorted(free_by_pod,
+                                  key=lambda p: (free_by_pod[p], p))
+            ]
+
+        def in_domain(ni: NodeInfo, pins: dict) -> bool:
+            pid = pins.get(GANG_POD_ID_KEY)
+            if pid is not None and \
+                    ni.node.metadata.labels.get(C_LABEL_POD_ID, "") != pid:
+                return False
+            hosts = pins.get(GANG_HOST_SET_KEY)
+            return hosts is None or ni.name in hosts
 
         placements: list[tuple[Pod, NodeInfo]] = []
         state = CycleState()
-        for candidate in candidates:
-            lister = self.snapshot()
-            state = CycleState()
-            # Pin even the "" candidate: a gang trying unlabeled hosts must
-            # use ONLY unlabeled hosts, never span labeled ICI domains.
-            state[GANG_POD_ID_KEY] = candidate
+        for pins in candidate_pins:
+            # one API snapshot for the whole gang attempt; each candidate
+            # works on clones of ONLY its pinned domain's NodeInfos
+            domain = [ni.clone() for ni in base.list() if in_domain(ni, pins)]
+            lister = SharedLister(domain)
+            state = CycleState(pins)
             placements = []
             for pod in members:
                 status = self._framework.run_pre_filter_plugins(
@@ -174,7 +196,7 @@ class Scheduler:
                     placements = []
                     break
                 feasible = [
-                    ni for ni in lister.list()
+                    ni for ni in domain
                     if self._framework.run_filter_plugins(
                         state, pod, ni).is_success
                 ]
@@ -205,6 +227,8 @@ class Scheduler:
                 return 0
         for pod, ni in placements:
             self._bind(pod, ni.name)
+        if pg is not None:
+            set_pod_group_status(self._api, pg, "Scheduled", len(placements))
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
@@ -212,13 +236,21 @@ class Scheduler:
     # -- internals ----------------------------------------------------------
     def _score_key(self, pod: Pod):
         """Least-requested on the pod's own resources: packs TPU profiles
-        tightly (utilization) while spreading nothing else."""
+        tightly (utilization).  Ties break on numeric host index, not name
+        — filling hosts in physical order keeps high-index aligned windows
+        contiguous for multi-host slices (lexicographic order would put
+        host-10 before host-2 and fragment every window)."""
         req = pod_request(pod)
 
         def key(ni: NodeInfo):
             free = ni.free()
             headroom = sum(free.get(r, 0.0) for r in req)
-            return (headroom, ni.name)
+            try:
+                idx = int(ni.node.metadata.labels.get(
+                    C_LABEL_HOST_INDEX, "0"))
+            except ValueError:
+                idx = 0
+            return (headroom, idx, ni.name)
 
         return key
 

@@ -116,6 +116,31 @@ class SliceUnit:
         self.apply_geometry(best_geo)
         return True
 
+    # -- multi-host membership ---------------------------------------------
+    def is_multihost_shard(self) -> bool:
+        """True if this block is (part of) a slice larger than one host."""
+        limit = self.generation.chips_per_host
+        return any(s.chips > limit for s in self.current_geometry())
+
+    def make_member_of(self, shape: Shape) -> None:
+        """Dedicate the whole block as one shard of a multi-host slice: the
+        unit advertises the slice's profile, quantity 1 (per-host share).
+        Only valid on a block with no used slices."""
+        if any(c > 0 for c in self.used.values()):
+            raise InvalidGeometryError(
+                f"unit {self.index} has used slices; cannot join "
+                f"multi-host slice {shape.name}"
+            )
+        self.free = {shape.canonical(): 1}
+
+    def reset_virgin(self) -> None:
+        """Back to the fewest-slices geometry (breaking up a free shard)."""
+        if any(c > 0 for c in self.used.values()):
+            raise InvalidGeometryError(
+                f"unit {self.index} has used slices; cannot reset")
+        self.used = {}
+        self.free = {self.generation.host_block.canonical(): 1}
+
     # -- allocation --------------------------------------------------------
     def allocate(self, shape: Shape) -> bool:
         """Move one free slice to used (reference mig/gpu.go AddPod)."""

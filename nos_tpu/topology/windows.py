@@ -1,0 +1,29 @@
+"""The shard-adjacency convention, in one place.
+
+Member hosts of one multi-host slice instance are a host-index-aligned
+consecutive window within one physical pod: window [i, i + size) with
+i % size == 0.  With row-major Cloud TPU host numbering these windows are
+ICI-contiguous sub-meshes.  BOTH the partitioner's group pass
+(nos_tpu/partitioning/slicepart/group.py) and the gang scheduler's window
+candidates (nos_tpu/scheduler/gang.py) derive windows from this helper —
+if the convention ever changes, it changes for carving and placement
+together.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def aligned_index_windows(indices: Iterable[int],
+                          size: int) -> list[list[int]]:
+    """Aligned, fully-present windows over the given host indices."""
+    present = set(indices)
+    out: list[list[int]] = []
+    for start in sorted(present):
+        if start % size:
+            continue
+        window = list(range(start, start + size))
+        if all(i in present for i in window):
+            out.append(window)
+    return out

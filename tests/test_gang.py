@@ -62,6 +62,8 @@ class TestGangAdmission:
         nodes = {api.get(KIND_POD, f"w-{i}", "default").spec.node_name
                  for i in range(4)}
         assert len(nodes) == 4  # one worker per host
+        pg = api.get(KIND_POD_GROUP, "train", "default")
+        assert pg.status.phase == "Scheduled" and pg.status.scheduled == 4
 
     def test_waits_for_min_member(self):
         api, sched = make_cluster(hosts_per_pod={"pod-a": 4})

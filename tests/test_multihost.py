@@ -1,0 +1,179 @@
+"""Multi-host slice tests (SURVEY.md §7 hard part 4, BASELINE config #3):
+slices spanning hosts are carved as whole-host shards by the planner's
+group pass, consumed by gangs pinned to the matching host window.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.slicepart.group import aligned_windows
+from nos_tpu.partitioning.slicepart.node import SliceNode
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework, NodeInfo, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import Shape, V5E
+from nos_tpu.topology.annotations import parse_spec_annotations
+
+
+class Harness:
+    """8 v5e hosts in one physical pod (a v5e-64)."""
+
+    def __init__(self, hosts: int = 8):
+        self.api = APIServer()
+        self.state = ClusterState()
+        self.now = [0.0]
+        NodeController(self.api, self.state, SliceNodeInitializer(self.api)).bind()
+        PodController(self.api, self.state).bind()
+        self.partitioner = new_slice_partitioner_controller(
+            self.api, self.state, batch_idle_s=10.0,
+            clock=lambda: self.now[0])
+        self.partitioner.bind()
+        self.agents = []
+        for i in range(hosts):
+            self.api.create(KIND_NODE, make_tpu_node(
+                f"host-{i}", pod_id="pod-a", host_index=i))
+            a = SliceAgent(self.api, f"host-{i}", FakeTpuRuntime(V5E),
+                           FakePodResources())
+            a.start()
+            a.tick()
+            self.agents.append(a)
+        self.scheduler = Scheduler(
+            self.api, Framework([NodeResourcesFit(), TopologyFilter(self.api)]))
+
+    def converge(self, cycles: int = 4) -> int:
+        bound = 0
+        for _ in range(cycles):
+            bound += self.scheduler.run_cycle()
+            self.now[0] += 11.0
+            self.partitioner.process_if_ready()
+            for a in self.agents:
+                a.tick()
+        return bound
+
+    def gang(self, name: str, members: int, shape: str):
+        self.api.create(KIND_POD_GROUP, PodGroup(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=PodGroupSpec(min_member=members)))
+        for i in range(members):
+            self.api.create(KIND_POD, make_slice_pod(
+                shape, 1, name=f"{name}-{i}",
+                labels={C.LABEL_POD_GROUP: name}))
+
+
+def test_aligned_windows_helper():
+    nodes = []
+    for i in (0, 1, 2, 3, 5):
+        n = make_tpu_node(f"h{i}", pod_id="p", host_index=i)
+        nodes.append(SliceNode(n, NodeInfo(node=n)))
+    wins = aligned_windows(nodes, 2)
+    names = [[n.name for n in w] for w in wins]
+    assert names == [["h0", "h1"], ["h2", "h3"]]  # 5 has no partner at 4
+
+
+def test_baseline_reshape_v5e64():
+    """BASELINE config #3: v5e-64 -> {4 x v5e-8, 2 x v5e-16} under
+    pending-pod pressure."""
+    h = Harness(8)
+    # 4 single-host jobs (v5e-8 = one 2x4 block each)
+    for i in range(4):
+        h.api.create(KIND_POD, make_slice_pod("2x4", 1, name=f"single-{i}"))
+    # 2 multi-host jobs (v5e-16 = 4x4 over 2 hosts), each a 2-pod gang
+    h.gang("job-a", 2, "4x4")
+    h.gang("job-b", 2, "4x4")
+
+    assert h.converge() == 8
+    # every pod is running
+    for p in h.api.list(KIND_POD):
+        assert p.status.phase == RUNNING, p.metadata.name
+
+    # each gang occupies one aligned 2-host window
+    for job in ("job-a", "job-b"):
+        idxs = sorted(
+            int(h.api.get(KIND_NODE, h.api.get(
+                KIND_POD, f"{job}-{i}", "default").spec.node_name
+            ).metadata.labels[C.LABEL_HOST_INDEX])
+            for i in range(2)
+        )
+        assert idxs[1] == idxs[0] + 1 and idxs[0] % 2 == 0, (job, idxs)
+
+    # shard spec annotations on member hosts
+    member = h.api.get(KIND_POD, "job-a-0", "default").spec.node_name
+    node = h.api.get(KIND_NODE, member)
+    spec = {(a.index, a.profile): a.quantity
+            for a in parse_spec_annotations(node.metadata.annotations)}
+    assert spec.get((0, "4x4")) == 1
+
+
+def test_reclaim_free_multihost_for_small_pods():
+    """Free multi-host instances are broken up when sub-host profiles are
+    lacking (the v5e-16 -> small-slices direction of the reshape)."""
+    h = Harness(2)
+    h.gang("big", 2, "4x4")
+    assert h.converge() == 2
+    # the job finishes: pods deleted, shards become free
+    for i in range(2):
+        h.api.delete(KIND_POD, f"big-{i}", "default")
+    for a in h.agents:
+        a.tick()
+    # now 4 quarter-host pods arrive
+    for i in range(4):
+        h.api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"small-{i}"))
+    assert h.converge() == 4
+
+
+def test_used_shards_never_destroyed():
+    """A running multi-host job's shards survive any repartition pressure."""
+    h = Harness(2)
+    h.gang("big", 2, "4x4")
+    assert h.converge() == 2
+    # register device usage with the fake kubelet so reports mark them used
+    for i, a in enumerate(h.agents):
+        node = h.api.get(KIND_NODE, f"host-{i}")
+        devs = a.runtime.list_devices()
+        assert len(devs) == 1
+        a.pod_resources.allocate(f"default/big-{i}", {devs[0].device_id})
+        a.tick()
+    # heavy small-slice pressure cannot break up the used instance
+    for i in range(4):
+        h.api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"small-{i}"))
+    h.converge()
+    for i, a in enumerate(h.agents):
+        ids = [d.device_id for d in a.runtime.list_devices()]
+        assert any("4x4" in d for d in ids), f"host-{i} lost its shard"
+    for i in range(4):
+        assert h.api.get(KIND_POD, f"small-{i}", "default").spec.node_name == ""
+
+
+def test_gang_rejects_misaligned_window():
+    """With host 0 occupied, a 2-host slice gang must not land on the
+    unaligned pair (1,2); it fits the aligned window (2,3)."""
+    h = Harness(4)
+    h.api.create(KIND_POD, make_slice_pod("2x4", 1, name="holder"))
+    assert h.converge(1) >= 1
+    holder_node = h.api.get(KIND_POD, "holder", "default").spec.node_name
+    h.gang("big", 2, "4x4")
+    assert h.converge() == 2
+    idxs = sorted(
+        int(h.api.get(KIND_NODE, h.api.get(
+            KIND_POD, f"big-{i}", "default").spec.node_name
+        ).metadata.labels[C.LABEL_HOST_INDEX])
+        for i in range(2)
+    )
+    assert idxs[0] % 2 == 0 and idxs[1] == idxs[0] + 1
+    assert holder_node not in {
+        h.api.get(KIND_POD, f"big-{i}", "default").spec.node_name
+        for i in range(2)
+    }
