@@ -1,0 +1,28 @@
+"""Device layer: the native boundary and its fakes.
+
+Reference analogs: pkg/gpu/nvml (CGo boundary), pkg/gpu/mig/client.go,
+pkg/resource (kubelet pod-resources).  `default_tpu_runtime` applies the
+reference's build-tag discipline at runtime: the C++ shim when it can be
+built/loaded, the stateful fake otherwise.
+"""
+
+from .tpuclient import PodResourcesClient, SliceDeviceClient, TpuRuntimeClient
+
+
+def default_tpu_runtime(generation=None) -> TpuRuntimeClient:
+    from nos_tpu.topology import V5E
+
+    generation = generation or V5E
+    from . import native
+
+    if native.available():
+        return native.NativeTpuRuntime(generation)
+    from .fake import FakeTpuRuntime
+
+    return FakeTpuRuntime(generation)
+
+
+__all__ = [
+    "TpuRuntimeClient", "PodResourcesClient", "SliceDeviceClient",
+    "default_tpu_runtime",
+]

@@ -1,0 +1,180 @@
+"""Native TPU runtime client: ctypes bindings for the C++ shim.
+
+The production implementation of TpuRuntimeClient (the reference's
+CGo/NVML analog, pkg/gpu/nvml/client.go, compiled only under the `nvml`
+build tag).  The same gating discipline applies here: `available()` reports
+whether the shim can be built/loaded, callers fall back to the fake
+(nos_tpu/device/fake.py) exactly as the reference's default build runs with
+mocks.  Build is `make` in nos_tpu/native (g++, no pybind11 — plain C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pathlib
+import subprocess
+import threading
+
+from nos_tpu.topology import Device, DeviceList, FREE, Generation, Placement, Shape, V5E
+from nos_tpu.topology.errors import DeviceNotFoundError
+from nos_tpu.topology.profile import slice_resource_name
+
+from .tpuclient import TpuRuntimeClient
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libnos_tpu_shim.so"
+_BUILD_LOCK = threading.Lock()
+_OUT_CAP = 1 << 20
+
+
+def build_shim(force: bool = False) -> pathlib.Path | None:
+    """Compile the shim if needed; returns the .so path or None."""
+    with _BUILD_LOCK:
+        if _SO_PATH.exists() and not force:
+            return _SO_PATH
+        try:
+            subprocess.run(
+                ["make", "-s", "libnos_tpu_shim.so"],
+                cwd=_NATIVE_DIR, check=True, capture_output=True, text=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("native shim build failed: %s", detail)
+            return None
+        return _SO_PATH if _SO_PATH.exists() else None
+
+
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    so = build_shim()
+    if so is None:
+        _lib_failed = True
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.nos_runtime_new.restype = ctypes.c_void_p
+    lib.nos_runtime_new.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.nos_runtime_free.argtypes = [ctypes.c_void_p]
+    lib.nos_runtime_chips_per_host.argtypes = [ctypes.c_void_p]
+    lib.nos_runtime_chips_per_host.restype = ctypes.c_int
+    lib.nos_runtime_create_slices.restype = ctypes.c_int
+    lib.nos_runtime_create_slices.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.nos_runtime_delete_slice.restype = ctypes.c_int
+    lib.nos_runtime_delete_slice.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nos_runtime_list.restype = ctypes.c_int
+    lib.nos_runtime_list.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nos_runtime_delete_all_except.restype = ctypes.c_int
+    lib.nos_runtime_delete_all_except.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeSliceError(Exception):
+    pass
+
+
+class NativeTpuRuntime(TpuRuntimeClient):
+    """TpuRuntimeClient backed by the C++ shim."""
+
+    def __init__(self, generation: Generation = V5E) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native shim unavailable (g++ build failed?) — use "
+                "FakeTpuRuntime or check nos_tpu/native")
+        self._lib = lib
+        self._gen = generation
+        dims = list(generation.host_block.dims) + [1] * (
+            3 - len(generation.host_block.dims))
+        arr = (ctypes.c_int * 3)(*dims)
+        self._h = lib.nos_runtime_new(
+            generation.name.encode(), arr, len(generation.host_block.dims))
+        if not self._h:
+            raise RuntimeError("nos_runtime_new failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.nos_runtime_free(h)
+            self._h = None
+
+    # -- TpuRuntimeClient ---------------------------------------------------
+    def topology(self) -> tuple[str, Shape]:
+        return self._gen.name, self._gen.host_block
+
+    def _parse_list(self) -> list[tuple[str, int, Shape, bool, Placement]]:
+        buf = ctypes.create_string_buffer(_OUT_CAP)
+        rc = self._lib.nos_runtime_list(self._h, buf, _OUT_CAP)
+        if rc < 0:
+            raise NativeSliceError(f"nos_runtime_list rc={rc}")
+        out = []
+        text = buf.value.decode()
+        if not text:
+            return out
+        for line in text.split("\n"):
+            did, unit, shape_s, multi, off_s, dims_s = line.split(",")
+            shape = Shape.parse(shape_s).canonical()
+            pl = Placement(
+                shape=shape,
+                offset=tuple(int(v) for v in off_s.split(";")),
+                dims=tuple(int(v) for v in dims_s.split(";")),
+            )
+            out.append((did, int(unit), shape, multi == "1", pl))
+        return out
+
+    def list_devices(self) -> DeviceList:
+        out = DeviceList()
+        for did, unit, shape, _multi, _pl in self._parse_list():
+            out.append(Device(slice_resource_name(shape), did, FREE, unit))
+        return out
+
+    def placements(self) -> dict[str, Placement]:
+        return {did: pl for did, _, _, _, pl in self._parse_list()}
+
+    def create_slices(self, unit_index: int, shapes: list[Shape]) -> list[str]:
+        flat = []
+        for s in shapes:
+            c = s.canonical()
+            dims = list(c.dims) + [1] * (3 - len(c.dims))
+            flat.extend(dims)
+        arr = (ctypes.c_int * len(flat))(*flat)
+        buf = ctypes.create_string_buffer(_OUT_CAP)
+        rc = self._lib.nos_runtime_create_slices(
+            self._h, unit_index, arr, len(shapes), buf, _OUT_CAP)
+        if rc == -1:
+            raise NativeSliceError(
+                f"cannot place {[s.name for s in shapes]} on unit "
+                f"{unit_index}")
+        if rc < 0:
+            raise NativeSliceError(f"create_slices rc={rc}")
+        return buf.value.decode().split("\n") if buf.value else []
+
+    def delete_slice(self, device_id: str) -> None:
+        rc = self._lib.nos_runtime_delete_slice(self._h, device_id.encode())
+        if rc != 0:
+            raise DeviceNotFoundError(device_id)
+
+    def delete_all_except(self, keep: set[str]) -> list[str]:
+        buf = ctypes.create_string_buffer(_OUT_CAP)
+        rc = self._lib.nos_runtime_delete_all_except(
+            self._h, "\n".join(sorted(keep)).encode(), buf, _OUT_CAP)
+        if rc < 0:
+            raise NativeSliceError(f"delete_all_except rc={rc}")
+        return buf.value.decode().split("\n") if buf.value else []

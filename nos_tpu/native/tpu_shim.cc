@@ -1,0 +1,295 @@
+// nos_tpu native device shim.
+//
+// The single native component of the framework (SURVEY.md §2: the analog of
+// the reference's CGo/NVML boundary, pkg/gpu/nvml/client.go — there the one
+// piece that must talk to a C driver; here the piece that talks to the TPU
+// runtime).  In production this wraps libtpu topology introspection and the
+// Cloud TPU API's slice lifecycle; the device bookkeeping, placement search
+// and geometry validation below are the real algorithms either way, and the
+// in-memory device table stands in for the runtime calls (exactly as the
+// reference isolates NVML behind an interface so everything above is
+// testable without hardware).
+//
+// Exposed as a plain C ABI consumed via ctypes (nos_tpu/device/native.py);
+// no pybind11 dependency.
+//
+// Placement search: a slice shape is placed into the host chip block
+// (≤ 3-D, tiny cell count) by exact bitmask cover with orientation
+// permutations and backtracking — the analog of the reference's NVML
+// creation-order permutation search (pkg/gpu/nvml/client.go:286-340), but
+// exhaustive instead of capped at 20 attempts: blocks are ≤ 8 cells, so
+// exhaustive search is both exact and fast.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxDims = 3;
+
+struct Shape {
+  int dims[kMaxDims];  // padded with 1s
+  int ndims;
+
+  int chips() const {
+    int c = 1;
+    for (int i = 0; i < kMaxDims; ++i) c *= dims[i];
+    return c;
+  }
+  std::string name() const {
+    std::ostringstream os;
+    for (int i = 0; i < ndims; ++i) {
+      if (i) os << 'x';
+      os << dims[i];
+    }
+    return os.str();
+  }
+};
+
+struct Device {
+  std::string id;
+  int unit;
+  Shape shape;      // canonical (as requested)
+  uint64_t mask;    // occupied cells of the unit's block; 0 = whole host
+                    // dedicated (multi-host shard)
+  bool multihost;
+  int offset[kMaxDims];
+  int placed_dims[kMaxDims];
+};
+
+struct Runtime {
+  std::mutex mu;
+  Shape host_block;
+  std::string accel;
+  int next_id = 1;
+  std::map<std::string, Device> devices;
+};
+
+uint64_t cell_bit(const int* coord, const int* block) {
+  int idx = 0;
+  for (int i = 0; i < kMaxDims; ++i) idx = idx * block[i] + coord[i];
+  return 1ull << idx;
+}
+
+// All aligned placements of oriented `dims` within `block` as bitmasks.
+void placements_for(const int* dims, const int* block,
+                    std::vector<std::pair<uint64_t, int[kMaxDims]>>*) = delete;
+
+struct Candidate {
+  uint64_t mask;
+  int offset[kMaxDims];
+  int dims[kMaxDims];
+};
+
+void enumerate_orientation(const int* dims, const int* block,
+                           std::vector<Candidate>* out) {
+  int limit[kMaxDims];
+  for (int i = 0; i < kMaxDims; ++i) {
+    if (dims[i] > block[i]) return;
+    limit[i] = block[i] - dims[i];
+  }
+  for (int x = 0; x <= limit[0]; ++x)
+    for (int y = 0; y <= limit[1]; ++y)
+      for (int z = 0; z <= limit[2]; ++z) {
+        Candidate c{};
+        c.offset[0] = x; c.offset[1] = y; c.offset[2] = z;
+        std::memcpy(c.dims, dims, sizeof(c.dims));
+        uint64_t m = 0;
+        for (int dx = 0; dx < dims[0]; ++dx)
+          for (int dy = 0; dy < dims[1]; ++dy)
+            for (int dz = 0; dz < dims[2]; ++dz) {
+              int coord[kMaxDims] = {x + dx, y + dy, z + dz};
+              m |= cell_bit(coord, block);
+            }
+        c.mask = m;
+        out->push_back(c);
+      }
+}
+
+std::vector<Candidate> candidates_for(const Shape& s, const Shape& block) {
+  std::vector<Candidate> out;
+  int d[kMaxDims];
+  std::memcpy(d, s.dims, sizeof(d));
+  std::sort(d, d + kMaxDims);
+  std::set<uint64_t> seen;  // dedupe identical masks across orientations
+  do {
+    std::vector<Candidate> tmp;
+    enumerate_orientation(d, block.dims, &tmp);
+    for (auto& c : tmp)
+      if (seen.insert(c.mask).second) out.push_back(c);
+  } while (std::next_permutation(d, d + kMaxDims));
+  return out;
+}
+
+// Exact backtracking placement of `shapes` around `occupied`.
+bool place_all(const std::vector<Shape>& shapes, size_t i, uint64_t occupied,
+               const Shape& block, std::vector<Candidate>* chosen) {
+  if (i == shapes.size()) return true;
+  for (const auto& c : candidates_for(shapes[i], block)) {
+    if (c.mask & occupied) continue;
+    chosen->push_back(c);
+    if (place_all(shapes, i + 1, occupied | c.mask, block, chosen))
+      return true;
+    chosen->pop_back();
+  }
+  return false;
+}
+
+int write_out(const std::string& s, char* out, int cap) {
+  if ((int)s.size() + 1 > cap) return -2;  // buffer too small
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nos_runtime_new(const char* accel, const int* host_block, int ndims) {
+  if (ndims < 1 || ndims > kMaxDims) return nullptr;
+  auto* rt = new Runtime();
+  rt->accel = accel ? accel : "";
+  rt->host_block.ndims = ndims;
+  for (int i = 0; i < kMaxDims; ++i)
+    rt->host_block.dims[i] = i < ndims ? host_block[i] : 1;
+  return rt;
+}
+
+void nos_runtime_free(void* h) { delete static_cast<Runtime*>(h); }
+
+int nos_runtime_chips_per_host(void* h) {
+  return static_cast<Runtime*>(h)->host_block.chips();
+}
+
+// shapes: flat array of n*3 ints (padded with 1s).  On success writes
+// newline-separated device ids and returns the count; -1 = cannot place,
+// -2 = output buffer too small, -3 = bad arguments.  All-or-nothing.
+int nos_runtime_create_slices(void* h, int unit, const int* shapes_flat,
+                              int n, char* out, int out_cap) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lock(rt->mu);
+  if (n <= 0) return -3;
+
+  std::vector<Shape> shapes(n);
+  bool any_multi = false;
+  for (int i = 0; i < n; ++i) {
+    shapes[i].ndims = rt->host_block.ndims;
+    int chips = 1;
+    for (int d = 0; d < kMaxDims; ++d) {
+      shapes[i].dims[d] = shapes_flat[i * kMaxDims + d];
+      if (shapes[i].dims[d] < 1) return -3;
+      chips *= shapes[i].dims[d];
+    }
+    // restore caller dim count for naming: trailing 1s beyond ndims kept
+    if (chips > rt->host_block.chips()) any_multi = true;
+  }
+
+  uint64_t occupied = 0;
+  int unit_devices = 0;
+  for (auto& [id, d] : rt->devices)
+    if (d.unit == unit) {
+      occupied |= d.mask;
+      ++unit_devices;
+      if (d.multihost) occupied = ~0ull;
+    }
+
+  std::ostringstream ids;
+  if (any_multi) {
+    // a multi-host shard takes this host's entire block as its share
+    if (n != 1 || unit_devices > 0) return -1;
+    Device dev{};
+    dev.unit = unit;
+    dev.shape = shapes[0];
+    dev.multihost = true;
+    dev.mask = ~0ull;
+    dev.id = "tpu-" + std::to_string(unit) + "-" + shapes[0].name() + "-" +
+             std::to_string(rt->next_id++);
+    rt->devices[dev.id] = dev;
+    ids << dev.id;
+    int rc = write_out(ids.str(), out, out_cap);
+    return rc == 0 ? 1 : rc;
+  }
+
+  // largest-first improves backtracking speed
+  std::vector<Shape> ordered = shapes;
+  std::stable_sort(ordered.begin(), ordered.end(),
+                   [](const Shape& a, const Shape& b) {
+                     return a.chips() > b.chips();
+                   });
+  std::vector<Candidate> chosen;
+  if (!place_all(ordered, 0, occupied, rt->host_block, &chosen)) return -1;
+
+  for (size_t i = 0; i < ordered.size(); ++i) {
+    Device dev{};
+    dev.unit = unit;
+    dev.shape = ordered[i];
+    dev.multihost = false;
+    dev.mask = chosen[i].mask;
+    std::memcpy(dev.offset, chosen[i].offset, sizeof(dev.offset));
+    std::memcpy(dev.placed_dims, chosen[i].dims, sizeof(dev.placed_dims));
+    dev.id = "tpu-" + std::to_string(unit) + "-" + ordered[i].name() + "-" +
+             std::to_string(rt->next_id++);
+    rt->devices[dev.id] = dev;
+    if (i) ids << '\n';
+    ids << dev.id;
+  }
+  int rc = write_out(ids.str(), out, out_cap);
+  return rc == 0 ? (int)ordered.size() : rc;
+}
+
+int nos_runtime_delete_slice(void* h, const char* id) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lock(rt->mu);
+  return rt->devices.erase(id) ? 0 : -1;
+}
+
+// Lines: id,unit,shape,multihost,offset(x;y;z),dims(x;y;z)
+int nos_runtime_list(void* h, char* out, int out_cap) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lock(rt->mu);
+  std::ostringstream os;
+  bool first = true;
+  for (auto& [id, d] : rt->devices) {
+    if (!first) os << '\n';
+    first = false;
+    os << id << ',' << d.unit << ',' << d.shape.name() << ','
+       << (d.multihost ? 1 : 0) << ','
+       << d.offset[0] << ';' << d.offset[1] << ';' << d.offset[2] << ','
+       << d.placed_dims[0] << ';' << d.placed_dims[1] << ';'
+       << d.placed_dims[2];
+  }
+  int rc = write_out(os.str(), out, out_cap);
+  return rc == 0 ? (int)rt->devices.size() : rc;
+}
+
+// keep: newline-separated ids.  Deletes everything else; writes deleted ids.
+int nos_runtime_delete_all_except(void* h, const char* keep, char* out,
+                                  int out_cap) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lock(rt->mu);
+  std::set<std::string> keep_set;
+  std::istringstream is(keep ? keep : "");
+  for (std::string line; std::getline(is, line);)
+    if (!line.empty()) keep_set.insert(line);
+  std::vector<std::string> doomed;
+  for (auto& [id, d] : rt->devices)
+    if (!keep_set.count(id)) doomed.push_back(id);
+  std::ostringstream os;
+  for (size_t i = 0; i < doomed.size(); ++i) {
+    rt->devices.erase(doomed[i]);
+    if (i) os << '\n';
+    os << doomed[i];
+  }
+  int rc = write_out(os.str(), out, out_cap);
+  return rc == 0 ? (int)doomed.size() : rc;
+}
+
+}  // extern "C"

@@ -74,50 +74,53 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                  if isinstance(n, SliceNode)]
         if not nodes:
             return
-        multi: dict[Shape, int] = {}
+        # Classification is per generation: a profile can be sub-host on
+        # v5e (8 chips/host) and multi-host on v4 (4 chips/host) at once.
+        gens = {n.generation for n in nodes}
+        shapes_lacking: dict[Shape, int] = {}
         sub_lacking_chips = 0
         for profile, qty in lacking.items():
             if "x" not in profile or qty <= 0:
                 continue
             shape = Shape.parse(profile).canonical()
-            gen = nodes[0].generation
-            if shape.chips > gen.chips_per_host:
-                multi[shape] = multi.get(shape, 0) + qty
-            else:
+            shapes_lacking[shape] = shapes_lacking.get(shape, 0) + qty
+            if any(shape.chips <= g.chips_per_host for g in gens):
                 sub_lacking_chips += shape.chips * qty
 
         if sub_lacking_chips:
             self._reclaim_free_instances(nodes, sub_lacking_chips)
-        if not multi:
-            return
 
         by_pod: dict[str, list[SliceNode]] = defaultdict(list)
         for n in nodes:
             if n.pod_id:
                 by_pod[n.pod_id].append(n)
 
-        for shape in sorted(multi, key=lambda s: -s.chips):
-            want = multi[shape]
+        # `remaining` counts lacking per-host SHARDS: one window of N
+        # member hosts advertises N shard resources, satisfying N pending
+        # gang pods.
+        remaining = dict(shapes_lacking)
+        for shape in sorted(remaining, key=lambda s: -s.chips):
             for pod_id in sorted(by_pod):
+                if remaining[shape] <= 0:
+                    break
                 members = by_pod[pod_id]
                 gen = members[0].generation
-                if shape not in gen.multihost_shapes():
+                if shape.chips <= gen.chips_per_host or \
+                        shape not in gen.multihost_shapes():
                     continue
                 hosts = gen.hosts_for(shape)
                 for window in aligned_windows(members, hosts):
-                    if want <= 0:
+                    if remaining[shape] <= 0:
                         break
                     if any(w.has_used_slices() or w.is_multihost_member()
                            for w in window):
                         continue
                     for w in window:
                         w.make_member_of(shape)
-                    want -= 1
+                    remaining[shape] -= hosts
                     logger.info(
                         "group pass: carved %s across %s",
                         shape.name, [w.name for w in window])
-                if want <= 0:
-                    break
 
     def _reclaim_free_instances(self, nodes: list[SliceNode],
                                 lacking_chips: int) -> None:

@@ -228,7 +228,9 @@ class Scheduler:
         for pod, ni in placements:
             self._bind(pod, ni.name)
         if pg is not None:
-            set_pod_group_status(self._api, pg, "Scheduled", len(placements))
+            # `alive` counts running mates plus the members just bound —
+            # the true scheduled size, not just this cycle's batch
+            set_pod_group_status(self._api, pg, "Scheduled", alive)
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)

@@ -1,0 +1,153 @@
+"""Native shim tests: the C++ TpuRuntimeClient must be a drop-in for the
+fake — same placement semantics, same agent e2e behavior (the analog of the
+reference's nvml-tagged client conforming to the mocked interface)."""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.device import native
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime, SliceCreationError
+from nos_tpu.topology import Shape, V4, V5E
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native shim not buildable (no g++?)")
+
+
+def shapes(*names):
+    return [Shape.parse(n) for n in names]
+
+
+class TestNativeRuntime:
+    def test_create_list_delete(self):
+        rt = native.NativeTpuRuntime(V5E)
+        ids = rt.create_slices(0, shapes("2x2", "1x1"))
+        assert len(ids) == 2
+        assert len(rt.list_devices()) == 2
+        rt.delete_slice(ids[0])
+        assert len(rt.list_devices()) == 1
+        with pytest.raises(Exception):
+            rt.delete_slice("nope")
+
+    def test_exact_fill_and_overfull(self):
+        rt = native.NativeTpuRuntime(V5E)   # 2x4 block = 8 chips
+        rt.create_slices(0, shapes("2x2", "2x2"))
+        with pytest.raises(native.NativeSliceError):
+            rt.create_slices(0, shapes("1x1"))
+
+    def test_all_or_nothing_on_failure(self):
+        rt = native.NativeTpuRuntime(V5E)
+        rt.create_slices(0, shapes("2x2"))
+        before = len(rt.list_devices())
+        with pytest.raises(native.NativeSliceError):
+            rt.create_slices(0, shapes("1x1", "2x2"))  # 2nd 2x2 can't fit
+        assert len(rt.list_devices()) == before
+
+    def test_joint_placement_beats_greedy(self):
+        """2x2 + 4x1x1 on a 2x4 block only fits if placed jointly."""
+        rt = native.NativeTpuRuntime(V5E)
+        ids = rt.create_slices(0, shapes("1x1", "1x1", "1x1", "1x1", "2x2"))
+        assert len(ids) == 5
+
+    def test_3d_generation(self):
+        rt = native.NativeTpuRuntime(V4)    # 1x2x2 block = 4 chips
+        ids = rt.create_slices(0, shapes("1x1x2", "1x1x2"))
+        assert len(ids) == 2
+        with pytest.raises(native.NativeSliceError):
+            rt.create_slices(0, shapes("1x1x1"))
+
+    def test_multihost_shard(self):
+        rt = native.NativeTpuRuntime(V5E)
+        ids = rt.create_slices(0, shapes("4x4"))
+        assert len(ids) == 1
+        assert rt.list_devices()[0].resource_name == "nos.tpu/slice-4x4"
+        with pytest.raises(native.NativeSliceError):
+            rt.create_slices(0, shapes("1x1"))
+
+    def test_startup_cleanup(self):
+        rt = native.NativeTpuRuntime(V5E)
+        ids = rt.create_slices(0, shapes("2x2", "2x2"))
+        doomed = rt.delete_all_except({ids[0]})
+        assert doomed == [ids[1]]
+        assert [d.device_id for d in rt.list_devices()] == [ids[0]]
+
+
+class TestConformanceWithFake:
+    """Same operation sequence -> same resulting device multiset."""
+
+    SEQUENCES = [
+        [("create", 0, ("2x2", "1x1", "1x1")), ("create", 0, ("1x2",))],
+        [("create", 0, ("2x4",)), ("delete_first", 0), ("create", 0, ("2x2", "2x2"))],
+        [("create", 0, ("1x1",) * 8)],
+        [("create", 0, ("4x4",))],
+        [("create", 1, ("2x2",)), ("create", 0, ("2x4",))],
+    ]
+
+    @pytest.mark.parametrize("seq", SEQUENCES)
+    def test_sequence(self, seq):
+        fake, nat = FakeTpuRuntime(V5E), native.NativeTpuRuntime(V5E)
+        for rt in (fake, nat):
+            for op in seq:
+                if op[0] == "create":
+                    rt.create_slices(op[1], shapes(*op[2]))
+                elif op[0] == "delete_first":
+                    first = sorted(d.device_id for d in rt.list_devices()
+                                   if d.unit_index == op[1])[0]
+                    rt.delete_slice(first)
+        summarize = lambda rt: sorted(  # noqa: E731
+            (d.unit_index, d.resource_name) for d in rt.list_devices())
+        assert summarize(fake) == summarize(nat)
+
+    @pytest.mark.parametrize("reqs", [
+        ("2x2", "2x2", "1x1"),        # 9 chips > 8: both must refuse
+        ("2x4", "1x1"),
+    ])
+    def test_both_reject_overfull(self, reqs):
+        fake, nat = FakeTpuRuntime(V5E), native.NativeTpuRuntime(V5E)
+        with pytest.raises(SliceCreationError):
+            fake.create_slices(0, shapes(*reqs))
+        with pytest.raises(native.NativeSliceError):
+            nat.create_slices(0, shapes(*reqs))
+
+
+class TestNativeEndToEnd:
+    def test_agent_e2e_on_native_runtime(self):
+        """The full decision-plane loop with the C++ runtime actuating."""
+        from nos_tpu.controllers.node_controller import NodeController
+        from nos_tpu.controllers.pod_controller import PodController
+        from nos_tpu.controllers.sliceagent.agent import SliceAgent
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.kube.objects import RUNNING
+        from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+        from nos_tpu.partitioning.slicepart.factory import (
+            new_slice_partitioner_controller,
+        )
+        from nos_tpu.partitioning.state import ClusterState
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.scheduler.scheduler import Scheduler
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        api = APIServer()
+        state = ClusterState()
+        now = [0.0]
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        pc = new_slice_partitioner_controller(
+            api, state, batch_idle_s=10.0, clock=lambda: now[0])
+        pc.bind()
+        api.create(KIND_NODE, make_tpu_node("host-0"))
+        agent = SliceAgent(api, "host-0", native.NativeTpuRuntime(V5E),
+                           FakePodResources())
+        agent.start()
+        agent.tick()
+        sched = Scheduler(api, Framework())
+
+        for i in range(2):
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"p-{i}"))
+        sched.run_cycle()
+        now[0] += 11.0
+        assert pc.process_if_ready()
+        agent.tick()
+        assert sched.run_cycle() == 2
+        for i in range(2):
+            assert api.get(KIND_POD, f"p-{i}", "default").status.phase == RUNNING
