@@ -17,6 +17,9 @@ vs_baseline = value / 30 s (lower is better, < 1.0 beats the target).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 from nos_tpu.api import constants as C
@@ -106,13 +109,30 @@ def run_scenario() -> float:
         f"{sum(1 for p in api.list(KIND_POD) if p.spec.node_name)}/{total}")
 
 
+def run_compute_bench() -> dict:
+    """bench_compute.py in a subprocess (it needs a jax process whose
+    platform selection is untouched by this one); {} off-TPU/on failure."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_compute.py")],
+            capture_output=True, text=True, timeout=900)
+        line = proc.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — bench must still print its line
+        return {"error": f"compute bench failed: {e}"}
+
+
 def main() -> None:
     latency = run_scenario()
+    compute = run_compute_bench()
     print(json.dumps({
         "metric": "repartition_latency_v5e64_reshape",
         "value": round(latency, 3),
         "unit": "s",
         "vs_baseline": round(latency / BASELINE_S, 4),
+        "compute": compute,
     }))
 
 

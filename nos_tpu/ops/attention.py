@@ -1,16 +1,23 @@
-"""Flash attention: fused pallas TPU kernel + pure-XLA fallback.
+"""Flash attention: fused pallas TPU kernels (forward + backward) + XLA fallback.
 
-The kernel streams K/V blocks through VMEM with online-softmax accumulation
-so the [S, S] score matrix never hits HBM (HBM bandwidth, not FLOPs, bounds
-naive attention).  Grid is (batch, heads, q-blocks); the causal variant
-skips K/V blocks entirely above the diagonal.  Written per
-/opt/skills/guides/pallas_guide.md: fp32 accumulation on the MXU
-(preferred_element_type), (block, 128)-aligned tiles, broadcasted_iota for
-position masks.
+The forward kernel streams K/V blocks through VMEM with online-softmax
+accumulation so the [S, S] score matrix never hits HBM (HBM bandwidth, not
+FLOPs, bounds naive attention).  Grid is (batch*heads, q-blocks); the causal
+variant skips K/V blocks entirely above the diagonal.  The forward also
+emits the per-row logsumexp so the backward can reconstruct the softmax
+without a second online pass.
 
-Training: the op carries a custom VJP whose backward recomputes attention
-with the XLA fallback (pallas kernels are not auto-differentiable);
-dedicated backward kernels are a later optimization.
+The backward is two kernels (the standard TPU split, since TPU has no
+atomics and pallas grids write disjoint output blocks):
+
+- dq kernel: grid over q-blocks, scans K/V, accumulates dq.
+- dkv kernel: grid over k-blocks, scans Q/dO, accumulates dk and dv.
+
+Both recompute p = exp(s - lse) from the saved logsumexp (flash-attention-2
+style), use ds = p * (dp - delta) with delta = rowsum(dO * O) computed once
+in XLA, and keep fp32 accumulation on the MXU (preferred_element_type).
+Written per /opt/skills/guides/pallas_guide.md: (block, 128)-aligned tiles,
+broadcasted_iota position masks, fori_loop streaming.
 
 Layout convention everywhere in nos_tpu: [batch, seq, heads, head_dim].
 """
@@ -33,9 +40,30 @@ def _xla_attention(q, k, v, causal):
     return dense_attention(q, k, v, causal=causal)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-                  block_q, block_k):
-    # refs are [1, block, D] slices of the [B*H, S, D] folded layout.
+def _causal_mask(qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos >= k_pos
+
+
+def _fold(x):
+    """[B, S, H, D] -> [B*H, S, D] (TPU block shapes constrain only the
+    last two dims, which become (seq-block, head_dim))."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, batch, heads):
+    bh, s, d = x.shape
+    return x.reshape(batch, heads, s, d).transpose(0, 2, 1, 3)
+
+
+# -- forward ----------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k):
     qi = pl.program_id(1)
     seq_k = k_ref.shape[1]
     num_k_blocks = seq_k // block_k
@@ -46,36 +74,41 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
 
-    if causal:
-        # blocks fully above the diagonal contribute nothing
-        hi = jnp.minimum(num_k_blocks,
-                         pl.cdiv((qi + 1) * block_q, block_k))
-    else:
-        hi = num_k_blocks
-
-    def body(j, carry):
+    def body(j, carry, masked):
         m, l, acc = carry
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = q_pos >= k_pos
+        if masked:
+            mask = _causal_mask(qi, j, block_q, block_k)
             s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jnp.dot(p, vb, preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    carry = (m0, l0, acc0)
+    if causal:
+        # [0, full): wholly below the diagonal, mask-free; [full, hi):
+        # straddles the diagonal; blocks above it are skipped entirely.
+        full = (qi * block_q + 1) // block_k
+        hi = jnp.minimum(num_k_blocks,
+                         pl.cdiv((qi + 1) * block_q, block_k))
+        carry = jax.lax.fori_loop(
+            0, full, functools.partial(body, masked=False), carry)
+        carry = jax.lax.fori_loop(
+            full, hi, functools.partial(body, masked=True), carry)
+    else:
+        carry = jax.lax.fori_loop(
+            0, num_k_blocks, functools.partial(body, masked=False), carry)
+    m, l, acc = carry
+    l = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)                            # [bq, 1]
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
@@ -83,21 +116,20 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     seq_k = k.shape[1]
     scale = head_dim ** -0.5
 
-    # Fold batch*heads into the leading dim: TPU block shapes constrain
-    # only the last two dims, which become (seq-block, head_dim).
-    def fold(x):
-        b, s, h, d = x.shape
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
     grid = (batch * heads, seq_q // block_q)
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            # [BH, Sq, 1]: a trailing unit dim keeps the block's last two
+            # dims TPU-legal ((block_q, 1) with 1 == array dim).
+            jax.ShapeDtypeStruct((batch * heads, seq_q, 1), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
@@ -107,8 +139,12 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
         cost_estimate=pl.CostEstimate(
             flops=4 * batch * heads * seq_q * seq_k * head_dim,
             bytes_accessed=2 * (q.size + k.size + v.size),
@@ -116,8 +152,153 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         ),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    return out, lse
 
+
+# -- backward ---------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    seq_k = k_ref.shape[1]
+    num_k_blocks = seq_k // block_k
+    q = q_ref[0].astype(jnp.float32)                       # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                     # [bq, D]
+    lse = lse_ref[0]                                       # [bq, 1]
+    delta = delta_ref[0]                                   # [bq, 1]
+
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    def body(j, acc, masked):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        if masked:
+            mask = _causal_mask(qi, j, block_q, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        full = (qi * block_q + 1) // block_k
+        hi = jnp.minimum(num_k_blocks,
+                         pl.cdiv((qi + 1) * block_q, block_k))
+        acc = jax.lax.fori_loop(
+            0, full, functools.partial(body, masked=False), acc0)
+        acc = jax.lax.fori_loop(
+            full, hi, functools.partial(body, masked=True), acc)
+    else:
+        acc = jax.lax.fori_loop(
+            0, num_k_blocks, functools.partial(body, masked=False), acc0)
+    dq_ref[0] = (scale * acc).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+    kj = pl.program_id(1)
+    seq_q = q_ref.shape[1]
+    num_q_blocks = seq_q // block_q
+    k = k_ref[0].astype(jnp.float32)                       # [bk, D]
+    v = v_ref[0].astype(jnp.float32)                       # [bk, D]
+
+    acc0 = (jnp.zeros((block_k, k.shape[-1]), jnp.float32),
+            jnp.zeros((block_k, v.shape[-1]), jnp.float32))
+
+    def body(i, carry, masked):
+        dk_acc, dv_acc = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = scale * jnp.dot(qb, k.T, preferred_element_type=jnp.float32)
+        if masked:
+            mask = _causal_mask(i, kj, block_q, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dv_acc = dv_acc + jnp.dot(p.T, dob,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jnp.dot(ds.T, qb,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    if causal:
+        # [lo, full): straddles the diagonal, masked; [full, end): wholly
+        # below it, mask-free.  Blocks above the diagonal are skipped.
+        lo = (kj * block_k) // block_q
+        full = pl.cdiv((kj + 1) * block_k - 1, block_q)
+        carry = jax.lax.fori_loop(
+            lo, full, functools.partial(body, masked=True), acc0)
+        dk_acc, dv_acc = jax.lax.fori_loop(
+            full, num_q_blocks, functools.partial(body, masked=False), carry)
+    else:
+        dk_acc, dv_acc = jax.lax.fori_loop(
+            0, num_q_blocks, functools.partial(body, masked=False), acc0)
+    dk_ref[0] = (scale * dk_acc).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    scale = head_dim ** -0.5
+
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof = _fold(g)
+    # delta_i = sum_d dO_id * O_id — one fused elementwise+reduce, XLA-side.
+    delta = jnp.sum(dof.astype(jnp.float32) * _fold(o).astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [BH, Sq, 1]
+
+    qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    qfull = pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    kfull = pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    rowfull = pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0),
+                           memory_space=pltpu.VMEM)
+
+    bwd_flops = 10 * batch * heads * seq_q * seq_k * head_dim
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(batch * heads, seq_q // block_q),
+        in_specs=[qspec, kfull, kfull, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        cost_estimate=pl.CostEstimate(
+            flops=bwd_flops // 2, bytes_accessed=3 * q.size,
+            transcendentals=batch * heads * seq_q * seq_k),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=[jax.ShapeDtypeStruct(kf.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vf.shape, v.dtype)],
+        grid=(batch * heads, seq_k // block_k),
+        in_specs=[qfull, kspec, kspec, qfull, rowfull, rowfull],
+        out_specs=[kspec, kspec],
+        cost_estimate=pl.CostEstimate(
+            flops=bwd_flops // 2, bytes_accessed=3 * q.size,
+            transcendentals=batch * heads * seq_q * seq_k),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (_unfold(dq, batch, heads), _unfold(dk, batch, heads),
+            _unfold(dv, batch, heads))
+
+
+# -- public op with custom VJP ----------------------------------------------
 
 def _supported(q, k, block_q, block_k) -> bool:
     _, seq_q, _, head_dim = q.shape
@@ -135,16 +316,26 @@ def flash_attention(q, k, v, causal: bool = True,
     XLA implementation off-TPU or for unaligned shapes."""
     on_tpu = jax.default_backend() == "tpu"
     if (on_tpu or interpret) and _supported(q, k, block_q, block_k):
-        return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+        out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+        return _unfold(out, q.shape[0], q.shape[2])
     return _xla_attention(q, k, v, causal)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    on_tpu = jax.default_backend() == "tpu"
+    if (on_tpu or interpret) and _supported(q, k, block_q, block_k):
+        out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                                  interpret)
+        out = _unfold(out, q.shape[0], q.shape[2])
+        return out, (q, k, v, out, lse)
+    return _xla_attention(q, k, v, causal), (q, k, v, None, None)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if lse is not None:
+        return _flash_backward(q, k, v, o, lse, g, causal,
+                               block_q, block_k, interpret)
     _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
     return vjp(g)
 

@@ -79,15 +79,40 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, True)
         assert jnp.max(jnp.abs(out - dense_attention(q, k, v, True))) < 1e-5
 
-    def test_grad_via_custom_vjp(self):
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_backward_kernels_match_dense(self, causal):
+        """The dedicated dq/dkv pallas kernels vs autodiff of the dense
+        path, for all three inputs and a non-trivial cotangent."""
         key = jax.random.PRNGKey(1)
-        q, k, v = (jax.random.normal(kk, (1, 256, 2, 128), jnp.float32)
+        q, k, v = (jax.random.normal(kk, (2, 256, 2, 128), jnp.float32)
                    for kk in jax.random.split(key, 3))
-        g = jax.grad(
-            lambda q: flash_attention(q, k, v, True, 128, 128, True).sum()
-        )(q)
-        g_ref = jax.grad(lambda q: dense_attention(q, k, v, True).sum())(q)
-        assert jnp.max(jnp.abs(g - g_ref)) < 1e-4
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        flash = loss(lambda q, k, v: flash_attention(
+            q, k, v, causal, 128, 128, True))
+        dense = loss(lambda q, k, v: dense_attention(q, k, v, causal))
+        g = jax.grad(flash, (0, 1, 2))(q, k, v)
+        g_ref = jax.grad(dense, (0, 1, 2))(q, k, v)
+        for got, want in zip(g, g_ref):
+            scale = float(jnp.max(jnp.abs(want))) + 1e-9
+            assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
+
+    def test_backward_rectangular_blocks(self):
+        """block_q != block_k exercises the diagonal bounds in both
+        backward kernels."""
+        key = jax.random.PRNGKey(2)
+        q, k, v = (jax.random.normal(kk, (1, 512, 1, 128), jnp.float32)
+                   for kk in jax.random.split(key, 3))
+        flash = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, True, 128, 256, True).sum()
+        dense = lambda q, k, v: dense_attention(q, k, v, True).sum()
+        g = jax.grad(flash, (0, 1, 2))(q, k, v)
+        g_ref = jax.grad(dense, (0, 1, 2))(q, k, v)
+        for got, want in zip(g, g_ref):
+            scale = float(jnp.max(jnp.abs(want))) + 1e-9
+            assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
 
     def test_repeat_kv(self):
         x = jnp.arange(2 * 4 * 2 * 3, dtype=jnp.float32).reshape(2, 4, 2, 3)
