@@ -34,6 +34,9 @@ class _ReentrancyGuard:
     Nested triggers are deferred and drained iteratively — bounded stack
     regardless of how many pods flip labels."""
 
+    MAX_STALL_PASSES = 100    # identical consecutive batches (ping-pong)
+    MAX_TOTAL_PASSES = 10000  # absolute livelock backstop, any batch shape
+
     def __init__(self) -> None:
         self._active = False
         self._pending: list[tuple[str, str]] = []
@@ -44,13 +47,30 @@ class _ReentrancyGuard:
             return
         self._active = True
         try:
-            seen_idle = 0
-            while self._pending and seen_idle < 1000:
+            stalled = passes = 0
+            prev_batch: dict | None = None
+            while self._pending:
                 batch = dict.fromkeys(self._pending)
                 self._pending.clear()
+                # Identical consecutive batches are the label ping-pong
+                # signature (e.g. EQ and CEQ reconcilers transiently
+                # disagreeing on a pod's capacity label) and trip the small
+                # cap; the absolute cap catches alternating-batch loops
+                # that never repeat exactly.
+                stalled = stalled + 1 if batch == prev_batch else 0
+                passes += 1
+                if stalled >= self.MAX_STALL_PASSES \
+                        or passes >= self.MAX_TOTAL_PASSES:
+                    logger.warning(
+                        "elasticquota reconcile livelock: dropping %d "
+                        "pending reconcile(s) after %d passes (%d "
+                        "identical; last batch %s) — quota labels/status "
+                        "may be stale",
+                        len(batch), passes, stalled, sorted(batch))
+                    break
+                prev_batch = batch
                 for n, ns in batch:
                     fn(n, ns)
-                seen_idle += 1
         finally:
             self._active = False
 

@@ -41,10 +41,15 @@ class TimeshareDevicePlugin:
     def tick(self) -> bool:
         """Apply the labeled config if it isn't applied yet; returns True
         if the node was updated."""
+        from nos_tpu.partitioning.timeshare.partitioner import config_key
+
         node = self._api.get(KIND_NODE, self._node_name)
-        key = node.metadata.labels.get(C.LABEL_DEVICE_PLUGIN_CONFIG, "")
-        if not key:
+        plan_id = node.metadata.labels.get(C.LABEL_DEVICE_PLUGIN_CONFIG, "")
+        if not plan_id:
             return False
+        # The label carries the plan id only (63-char label-value limit);
+        # the full ConfigMap key is node-local knowledge.
+        key = config_key(self._node_name, plan_id)
         if node.metadata.annotations.get(C.ANNOT_PLUGIN_APPLIED_CONFIG) == key:
             return False
         chips = self.chip_config(key)

@@ -88,7 +88,11 @@ class TimesharePartitioner(Partitioner):
                 data={key: payload}))
 
         def mutate_node(node: Node) -> None:
-            node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] = key
+            # Label value is the plan id ALONE: a k8s label value caps at
+            # 63 chars, which `<fqdn-node>.<plan>` would blow past on real
+            # clusters.  The plugin derives the ConfigMap key as
+            # config_key(its own node name, label value).
+            node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] = plan_id
             node.metadata.annotations[C.spec_plan_annotation("timeshare")] = plan_id
 
         self._api.patch(KIND_NODE, node_name, mutate=mutate_node)

@@ -77,12 +77,17 @@ GANG_HOST_SET_KEY = "gang-allowed-hosts"
 
 
 def gang_slice_windows(api: APIServer, members: list[Pod]
-                       ) -> list[tuple[str, frozenset[str]]]:
+                       ) -> list[tuple[str, frozenset[str] | None]]:
     """Placement candidates for a gang consuming one multi-host slice: the
     host-index-aligned windows matching the partitioner's shard adjacency
     convention (nos_tpu/partitioning/slicepart/group.py).  Returns
-    (pod_id, member host names) per candidate window, [] when the gang does
-    not request a multi-host slice resource."""
+    (pod_id, member host names) per candidate window.  hosts_needed is
+    derived per physical pod from THAT pod's generation (a mixed-generation
+    cluster has different window sizes per pod — mirroring _group_pass's
+    per-generation classification); a pod whose generation fits the shape
+    on a single host yields a (pod_id, None) whole-domain candidate.
+    Returns [] when the gang does not request a slice resource or no
+    generation needs window pinning (the best-fit domain fallback wins)."""
     from nos_tpu.kube.resources import pod_request
     from nos_tpu.topology import DEFAULT_REGISTRY
     from nos_tpu.topology.profile import extract_slice_requests
@@ -95,30 +100,41 @@ def gang_slice_windows(api: APIServer, members: list[Pod]
     shape = next(iter(shapes))
 
     by_pod: dict[str, dict[int, object]] = {}
-    hosts_needed: int | None = None
+    needed_by_pod: dict[str, int | None] = {}  # None = sub-host shape
+    mixed_pids: set[str] = set()  # permanently poisoned, not just popped
     for node in api.list("Node"):
         labels = node.metadata.labels
         pid = labels.get(C.LABEL_POD_ID, "")
         accel = labels.get(C.LABEL_ACCELERATOR, "")
-        if not pid or accel not in DEFAULT_REGISTRY.generations:
+        if not pid or pid in mixed_pids \
+                or accel not in DEFAULT_REGISTRY.generations:
             continue
         gen = DEFAULT_REGISTRY.get(accel)
-        if shape.chips <= gen.chips_per_host:
-            return []  # single-host profile: no window constraint
-        hosts_needed = gen.hosts_for(shape)
+        needed = (None if shape.chips <= gen.chips_per_host
+                  else gen.hosts_for(shape))
+        if pid in needed_by_pod and needed_by_pod[pid] != needed:
+            logger.warning("TPU pod %s spans generations; skipping", pid)
+            mixed_pids.add(pid)
+            by_pod.pop(pid, None)
+            continue
+        needed_by_pod[pid] = needed
         try:
             idx = int(labels.get(C.LABEL_HOST_INDEX, "0"))
         except ValueError:
             continue
         by_pod.setdefault(pid, {})[idx] = node.metadata.name
-    if not hosts_needed:
-        return []
+    if not any(needed_by_pod[pid] for pid in by_pod):
+        return []  # every usable generation is sub-host: no constraint
     from nos_tpu.topology.windows import aligned_index_windows
 
-    out: list[tuple[str, frozenset[str]]] = []
+    out: list[tuple[str, frozenset[str] | None]] = []
     for pid in sorted(by_pod):
         hosts = by_pod[pid]
-        for window in aligned_index_windows(hosts, hosts_needed):
+        needed = needed_by_pod.get(pid)
+        if needed is None:
+            out.append((pid, None))  # sub-host generation: whole domain
+            continue
+        for window in aligned_index_windows(hosts, needed):
             out.append((pid, frozenset(hosts[i] for i in window)))
     return out
 

@@ -154,8 +154,11 @@ class Scheduler:
         windows = gang_slice_windows(self._api, members)
         base = self.snapshot()
         if windows:
+            # hosts=None: a sub-host-generation domain — pin the pod id
+            # only (gang_slice_windows' per-generation classification).
             candidate_pins = [
                 {GANG_POD_ID_KEY: pid, GANG_HOST_SET_KEY: hosts}
+                if hosts is not None else {GANG_POD_ID_KEY: pid}
                 for pid, hosts in windows
             ]
         else:
@@ -212,9 +215,21 @@ class Scheduler:
                 break
 
         if len(placements) != len(members):
+            # A gang claiming its guaranteed quota min must not starve
+            # behind over-quota borrowers: give it the same preemption
+            # attempt single pods get (schedule_one's PostFilter path).
+            # Victims are evicted whole-gang (evict_gang), so one member's
+            # eviction frees real capacity; the gang binds on a later
+            # cycle once the space exists.
+            preempted = False
+            if self._gang_feasible_after_evictions(
+                    members, candidate_pins, base, in_domain):
+                preempted = self._preempt_for_gang(members)
+            msg = "gang does not fit as a whole"
+            if preempted:
+                msg += " (evicted over-quota victims, retrying)"
             for pod in members:
-                self._mark_unschedulable(pod, Status.unschedulable(
-                    "gang does not fit as a whole"))
+                self._mark_unschedulable(pod, Status.unschedulable(msg))
             return 0
         for pod, ni in placements:
             st = self._framework.run_reserve_plugins(state, pod, ni.name)
@@ -234,6 +249,93 @@ class Scheduler:
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
+
+    def _gang_feasible_after_evictions(
+            self, members: list[Pod], candidate_pins: list[dict],
+            base: SharedLister, in_domain) -> bool:
+        """Would the gang fit some candidate domain if every *evictable*
+        pod were gone?  Guards gang preemption: a gang that is
+        topology-infeasible (e.g. needs a 4-host window no domain has, or
+        windows fragmented by non-evictable in-quota pods) must not evict
+        a fresh over-quota victim gang every cycle to no effect.
+
+        Evictability mirrors _select_victims_on_node's eligibility
+        (capacityscheduling.py): cross-namespace over-quota-labelled pods,
+        or same-namespace lower-priority pods.  Quota prefilters are
+        skipped — eviction is exactly what relaxes them; only
+        filter-capable plugins (resources, topology) gate here."""
+        from nos_tpu.utils.pod_util import is_over_quota
+
+        first = members[0]
+
+        def directly_evictable(p: Pod) -> bool:
+            if p.metadata.namespace == first.metadata.namespace:
+                return p.spec.priority < first.spec.priority
+            return is_over_quota(p)
+
+        # Gang amplification: evicting any member evicts the whole gang
+        # (evict_gang), so every gang-mate of an evictable pod is gone too.
+        doomed_gangs = {
+            (p.metadata.namespace, gang_name(p))
+            for ni in base.list() for p in ni.pods
+            if gang_name(p) and directly_evictable(p)
+        }
+
+        def evictable(p: Pod) -> bool:
+            if directly_evictable(p):
+                return True
+            g = gang_name(p)
+            return bool(g) and (p.metadata.namespace, g) in doomed_gangs
+
+        fw = Framework([p for p in self._framework.plugins
+                        if hasattr(p, "filter")])
+        for pins in candidate_pins:
+            domain = []
+            for ni in base.list():
+                if not in_domain(ni, pins):
+                    continue
+                optimistic = NodeInfo(node=ni.node)
+                for p in ni.pods:
+                    if not evictable(p):
+                        optimistic.add_pod(p)
+                domain.append(optimistic)
+            lister = SharedLister(domain)
+            state = CycleState(pins)
+            placed = 0
+            for pod in members:
+                fw.run_pre_filter_plugins(state, pod, lister)
+                feasible = [
+                    ni for ni in domain
+                    if fw.run_filter_plugins(state, pod, ni).is_success
+                ]
+                if not feasible:
+                    break
+                chosen = min(feasible, key=self._score_key(pod))
+                chosen.add_pod(pod)
+                placed += 1
+            if placed == len(members):
+                return True
+        return False
+
+    def _preempt_for_gang(self, members: list[Pod]) -> bool:
+        """PostFilter preemption on behalf of a gang that found no fit,
+        driven through a representative member (quota checks and victim
+        maths are namespace-scoped, so any member represents the gang's
+        quota claim).  Returns True if victims were evicted."""
+        first = members[0]
+        lister = self.snapshot()
+        state = CycleState()
+        # Seed cycle state (quota snapshot + PreFilterState); an
+        # unschedulable verdict here is exactly the starvation case
+        # preemption exists to fix, so the status is deliberately ignored.
+        self._framework.run_pre_filter_plugins(state, first, lister)
+        nominated, post = self._framework.run_post_filter_plugins(
+            state, first, lister)
+        # Deliberately NOT nominating: a nominated pod stops matching
+        # extra_resources_could_help_scheduling, which would hide this
+        # member from the partitioner and split the gang's demand.  The
+        # evictions PostFilter performed are the useful effect.
+        return post.is_success and bool(nominated)
 
     # -- internals ----------------------------------------------------------
     def _score_key(self, pod: Pod):

@@ -69,7 +69,11 @@ def test_fractional_sharing_end_to_end():
     keys = [k for k in cm.data if k.startswith("ts-0.")]
     assert len(keys) == 1
     node = h.get_node()
-    assert node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] == keys[0]
+    # label holds the plan id alone (63-char label-value limit); the full
+    # CM key is derived node-side
+    label = node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG]
+    assert keys[0] == f"ts-0.{label}"
+    assert len(label) <= 63
 
     # handshake: next batch deferred until the agent reports
     h.advance(61.0)

@@ -259,3 +259,91 @@ class TestGangPreemption:
         assert api.list(KIND_POD, namespace="ns-b") == []
         sched.run_cycle()
         assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name != ""
+
+    def test_infeasible_gang_does_not_evict(self):
+        """A gang that cannot fit even with every evictable pod gone
+        (here: 3 members x 8 chips on a 2-host cluster) must not evict
+        over-quota victims cycle after cycle to no effect."""
+        api = APIServer()
+        calc = TPUResourceCalculator(16)
+        plugin = CapacityScheduling(calc)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+        plugin.set_framework(fw)
+        plugin.attach(api)
+        for i in range(2):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}", labels={C.LABEL_POD_ID: "pod-a"},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0,
+                             C.RESOURCE_TPU_MEMORY: 128.0}))
+        sched = Scheduler(api, fw)
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-a", namespace="ns-a"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 384})))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-b", namespace="ns-b"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 128})))
+        create_pod_group(api, "borrower", min_member=2, namespace="ns-b")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(
+                f"b-{i}", "borrower", namespace="ns-b",
+                creation_timestamp=float(i)))
+        assert sched.run_cycle() == 2
+        from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
+        ElasticQuotaReconciler(api, calc).reconcile_all()
+        create_pod_group(api, "claimant", min_member=3, namespace="ns-a")
+        for i in range(3):
+            api.create(KIND_POD, gang_pod(
+                f"a-{i}", "claimant", namespace="ns-a",
+                creation_timestamp=float(10 + i)))
+        for _ in range(3):  # several cycles: still no pointless eviction
+            sched.run_cycle()
+            assert len(api.list(KIND_POD, namespace="ns-b")) == 2
+
+    def test_gang_preemptor_evicts_over_quota_gang(self):
+        """Mirror of test_whole_gang_evicted with the GANG as preemptor:
+        a gang claiming its guaranteed min must not starve behind an
+        over-quota borrower gang (ADVICE r1: schedule_gang previously
+        never ran PostFilter, so 'min is guaranteed' was not honored for
+        multi-host jobs)."""
+        api = APIServer()
+        calc = TPUResourceCalculator(16)
+        plugin = CapacityScheduling(calc)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+        plugin.set_framework(fw)
+        plugin.attach(api)
+        for i in range(2):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}", labels={C.LABEL_POD_ID: "pod-a"},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0,
+                             C.RESOURCE_TPU_MEMORY: 128.0}))
+        sched = Scheduler(api, fw)
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-a", namespace="ns-a"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 256})))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-b", namespace="ns-b"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 128})))
+        # ns-b gang fills the cluster, borrowing beyond its min
+        create_pod_group(api, "borrower", min_member=2, namespace="ns-b")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(
+                f"b-{i}", "borrower", namespace="ns-b",
+                creation_timestamp=float(i)))
+        assert sched.run_cycle() == 2
+        from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
+        ElasticQuotaReconciler(api, calc).reconcile_all()
+        # ns-a's gang claims its min (2 x 8 chips = its entire guarantee)
+        create_pod_group(api, "claimant", min_member=2, namespace="ns-a")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(
+                f"a-{i}", "claimant", namespace="ns-a",
+                creation_timestamp=float(10 + i)))
+        sched.run_cycle()  # no fit -> gang preemption evicts borrower gang
+        assert api.list(KIND_POD, namespace="ns-b") == []
+        assert sched.run_cycle() == 2  # freed capacity: claimant binds
+        for i in range(2):
+            pod = api.get(KIND_POD, f"a-{i}", "ns-a")
+            assert pod.spec.node_name
+            assert pod.status.phase == RUNNING
