@@ -1,0 +1,255 @@
+"""Utilization benchmark: v5e-256 mixed trace (the BASELINE north star).
+
+Simulates 32 hosts x 8 chips = 256 chips (two slice ICI domains of 16 and
+12 hosts plus 4 timeshare hosts) under a churning mixed workload — small
+slice jobs (1x1 / 2x2 / full-host 2x4), multi-host gangs (4x4 over 2
+hosts, 4x8 over 4 hosts), and fractional timeshare jobs (4/8 GB HBM
+profiles) — driven through the REAL control plane: scheduler cycles with
+gang admission + topology pinning, both partitioner controllers
+(batcher -> planner -> packer -> annotation protocol), and per-host agents
+actuating geometry against fake runtimes.
+
+Time is virtual (the batcher clock is injected), so a multi-minute trace
+runs in seconds of wall clock while preserving every control-loop
+interaction: batch windows, plan handshakes, repartition latency all play
+out in simulated seconds exactly as they would in real ones.
+
+Metrics: time-weighted mean chip utilization after warmup (target >= 0.85,
+BASELINE.md), p50/p90 pod schedule latency (creation -> bind, virtual
+seconds), and p50/p99 wall-clock scheduler cycle time (the gang-search
+cost at v5e-256 scale).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.controllers.chipagent import ChipAgent
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device import default_tpu_runtime
+from nos_tpu.device.fake import FakePodResources
+from nos_tpu.kube.client import (
+    APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP, NotFound,
+)
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_slice_pod, make_timeshare_pod, make_tpu_node
+from nos_tpu.topology import V5E
+from nos_tpu.topology.profile import extract_slice_requests, extract_timeshare_requests
+
+SLICE_DOMAINS = {"pod-0": 16, "pod-1": 12}
+TS_HOSTS = 4
+CHIPS_PER_HOST = V5E.chips_per_host          # 8
+HBM_GB = 16                                  # v5e chip HBM
+TOTAL_CHIPS = (sum(SLICE_DOMAINS.values()) + TS_HOSTS) * CHIPS_PER_HOST
+
+TICK_S = 0.25
+WARMUP_S = 60.0
+TRACE_S = 360.0
+BATCH_IDLE_S = 0.5
+BATCH_TIMEOUT_S = 2.0
+TARGET_BACKLOG_CHIPS = 64.0                  # keep demand ~25% over capacity
+UTILIZATION_TARGET = 0.85
+
+# (kind, arg, members, weight): chip-equivalents are derived from requests.
+JOB_MIX = [
+    ("slice", "1x1", 1, 3.0),
+    ("slice", "2x2", 1, 4.0),
+    ("slice", "2x4", 1, 4.0),
+    ("gang", "4x4", 2, 2.0),
+    ("gang", "4x8", 4, 1.0),
+    ("ts", 8, 1, 2.0),
+    ("ts", 4, 1, 2.0),
+]
+
+
+def chip_equiv(pod) -> float:
+    req = pod_request(pod)
+    chips = sum(s.chips * q for s, q in extract_slice_requests(req).items())
+    gb = sum(g * q for g, q in extract_timeshare_requests(req).items())
+    return chips + gb / HBM_GB
+
+
+class Job:
+    def __init__(self, name: str, pods: list, duration: float,
+                 created: float) -> None:
+        self.name = name
+        self.pods = pods
+        self.duration = duration
+        self.created = created
+        self.bound_at: float | None = None
+
+
+class Sim:
+    def __init__(self, seed: int = 0) -> None:
+        self.rng = random.Random(seed)
+        self.now = [0.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        api = self.api = APIServer()
+        state = ClusterState()
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        self.slice_ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock)
+        self.slice_ctl.bind()
+        self.ts_ctl = new_timeshare_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock)
+        self.ts_ctl.bind()
+
+        self.agents = []
+        idx = 0
+        for pod_id, n in SLICE_DOMAINS.items():
+            for h in range(n):
+                name = f"host-{idx}"
+                api.create(KIND_NODE, make_tpu_node(
+                    name, pod_id=pod_id, host_index=h))
+                agent = SliceAgent(api, name, default_tpu_runtime(V5E),
+                                   FakePodResources())
+                agent.start()
+                self.agents.append(agent)
+                idx += 1
+        for t in range(TS_HOSTS):
+            name = f"ts-{t}"
+            api.create(KIND_NODE, make_tpu_node(
+                name, partitioning="timeshare", pod_id="", host_index=t))
+            agent = ChipAgent(api, name)
+            agent.start()
+            self.agents.append(agent)
+
+        self.scheduler = Scheduler(
+            api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+
+        self.jobs: dict[str, Job] = {}
+        self._job_seq = 0
+        self.latencies: list[float] = []
+        self.cycle_wall_ms: list[float] = []
+        self._util_area = 0.0
+        self._util_time = 0.0
+        self.completed = 0
+
+    # -- trace -------------------------------------------------------------
+    def _spawn(self) -> None:
+        kinds, weights = zip(*[(m[:3], m[3]) for m in JOB_MIX])
+        backlog = sum(
+            chip_equiv(p) for p in self.api.list(KIND_POD)
+            if not p.spec.node_name)
+        while backlog < TARGET_BACKLOG_CHIPS:
+            kind, arg, members = self.rng.choices(kinds, weights)[0]
+            self._job_seq += 1
+            name = f"job-{self._job_seq}"
+            duration = self.rng.uniform(25.0, 50.0)
+            pods = []
+            if kind == "gang":
+                self.api.create(KIND_POD_GROUP, PodGroup(
+                    metadata=ObjectMeta(name=name, namespace="default"),
+                    spec=PodGroupSpec(min_member=members)))
+            for i in range(members):
+                if kind == "ts":
+                    pod = make_timeshare_pod(
+                        arg, 1, name=f"{name}-{i}",
+                        creation_timestamp=self.now[0])
+                else:
+                    labels = ({C.LABEL_POD_GROUP: name}
+                              if kind == "gang" else None)
+                    pod = make_slice_pod(
+                        arg, 1, name=f"{name}-{i}", labels=labels,
+                        creation_timestamp=self.now[0])
+                self.api.create(KIND_POD, pod)
+                pods.append(pod.metadata.name)
+                backlog += chip_equiv(pod)
+            self.jobs[name] = Job(name, pods, duration, self.now[0])
+
+    def _complete_finished(self) -> None:
+        for job in list(self.jobs.values()):
+            if job.bound_at is None \
+                    or self.now[0] < job.bound_at + job.duration:
+                continue
+            for pname in job.pods:
+                try:
+                    self.api.delete(KIND_POD, pname, "default")
+                except NotFound:
+                    pass
+            try:
+                self.api.delete(KIND_POD_GROUP, job.name, "default")
+            except NotFound:
+                pass
+            del self.jobs[job.name]
+            self.completed += 1
+
+    def _record_binds(self) -> None:
+        bound: dict[str, float] = {}
+        for p in self.api.list(KIND_POD):
+            if p.spec.node_name and p.status.phase == RUNNING:
+                bound[p.metadata.name] = p.metadata.creation_timestamp
+        for job in self.jobs.values():
+            if job.bound_at is None and all(n in bound for n in job.pods):
+                job.bound_at = self.now[0]
+                self.latencies.append(self.now[0] - job.created)
+
+    def _sample_utilization(self) -> None:
+        if self.now[0] < WARMUP_S:
+            return
+        used = sum(
+            chip_equiv(p) for p in self.api.list(KIND_POD)
+            if p.spec.node_name and p.status.phase == RUNNING)
+        self._util_area += min(1.0, used / TOTAL_CHIPS) * TICK_S
+        self._util_time += TICK_S
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> dict:
+        while self.now[0] < TRACE_S:
+            self.now[0] += TICK_S
+            self._complete_finished()
+            self._spawn()
+            t0 = time.perf_counter()
+            self.scheduler.run_cycle()
+            self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
+            self.slice_ctl.process_if_ready()
+            self.ts_ctl.process_if_ready()
+            for a in self.agents:
+                a.tick()
+            self._record_binds()
+            self._sample_utilization()
+
+        lat = sorted(self.latencies)
+        cyc = sorted(self.cycle_wall_ms)
+
+        def pct(xs, q):
+            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+        return {
+            "utilization_pct": round(self._util_area / self._util_time, 4),
+            "total_chips": TOTAL_CHIPS,
+            "trace_seconds": TRACE_S,
+            "jobs_completed": self.completed,
+            "jobs_bound": len(self.latencies),
+            "p50_schedule_latency_s": round(pct(lat, 0.50), 3),
+            "p90_schedule_latency_s": round(pct(lat, 0.90), 3),
+            "scheduler_cycle_wall_ms_p50": round(pct(cyc, 0.50), 2),
+            "scheduler_cycle_wall_ms_p99": round(pct(cyc, 0.99), 2),
+        }
+
+
+def main() -> None:
+    out = Sim().run()
+    out["vs_target"] = round(out["utilization_pct"] / UTILIZATION_TARGET, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,3 +22,22 @@ A ground-up rebuild of the capabilities of Nebuly `nos` (reference:
 """
 
 __version__ = "0.1.0"
+
+
+def _install_native() -> None:
+    # Back the geometry packer's hot loops with the C++ exact search when
+    # the shim is already built (dlopen only — importing the package never
+    # spawns a compiler; the build happens when a caller explicitly asks
+    # for the native runtime, e.g. default_tpu_runtime()).  Best-effort:
+    # every caller of topology.packing falls back to the pure Python
+    # search when this fails, mirroring the reference's `nvml` build-tag
+    # discipline (default builds run without the native library).
+    try:
+        from nos_tpu.device.native import install_native_packer
+
+        install_native_packer(build=False)
+    except Exception:  # noqa: BLE001 — import must never fail on this
+        pass
+
+
+_install_native()

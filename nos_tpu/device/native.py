@@ -11,6 +11,7 @@ mocks.  Build is `make` in nos_tpu/native (g++, no pybind11 — plain C ABI).
 from __future__ import annotations
 
 import ctypes
+import functools
 import logging
 import pathlib
 import subprocess
@@ -31,15 +32,15 @@ _OUT_CAP = 1 << 20
 
 
 def build_shim(force: bool = False) -> pathlib.Path | None:
-    """Compile the shim if needed; returns the .so path or None."""
+    """Compile the shim if needed; returns the .so path or None.  Always
+    runs make (a no-op when fresh) so a prebuilt .so from an older
+    tpu_shim.cc is rebuilt, not loaded stale."""
     with _BUILD_LOCK:
-        if _SO_PATH.exists() and not force:
-            return _SO_PATH
         try:
-            subprocess.run(
-                ["make", "-s", "libnos_tpu_shim.so"],
-                cwd=_NATIVE_DIR, check=True, capture_output=True, text=True,
-            )
+            cmd = ["make", "-s"] + (["-B"] if force else []) \
+                + ["libnos_tpu_shim.so"]
+            subprocess.run(cmd, cwd=_NATIVE_DIR, check=True,
+                           capture_output=True, text=True)
         except (subprocess.CalledProcessError, FileNotFoundError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             logger.warning("native shim build failed: %s", detail)
@@ -51,14 +52,57 @@ _lib = None
 _lib_failed = False
 
 
-def _load():
+def _load(allow_build: bool = True):
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
-    so = build_shim()
+    if allow_build:
+        so = build_shim()
+    else:
+        # import-time path: dlopen an existing artifact only, never spawn
+        # a compiler; leave _lib_failed unlatched so a later explicit
+        # caller may still build.
+        so = _SO_PATH if _SO_PATH.exists() else None
     if so is None:
-        _lib_failed = True
+        if allow_build:
+            _lib_failed = True
         return None
+    try:
+        lib = _bind(so)
+    except (OSError, AttributeError) as e:
+        # e.g. a stale prebuilt .so missing a newer symbol: force-rebuild
+        # once (when building is allowed), then give up cleanly so callers
+        # fall back to the Python paths.
+        logger.warning("native shim load failed: %s", e)
+        lib = None
+        if allow_build:
+            so = build_shim(force=True)
+            try:
+                # dlopen caches by path string, so the rebuilt library must
+                # be bound from a fresh name to displace the stale mapping.
+                if so is not None:
+                    import shutil
+                    import tempfile
+
+                    fd, tmp = tempfile.mkstemp(
+                        suffix=".so", prefix="nos_tpu_shim_")
+                    import os
+
+                    os.close(fd)
+                    shutil.copy2(so, tmp)
+                    lib = _bind(pathlib.Path(tmp))
+            except (OSError, AttributeError) as e2:
+                logger.warning("native shim unusable after rebuild: %s", e2)
+        if lib is None:
+            if allow_build:
+                _lib_failed = True
+            return None
+    _lib = lib
+    _install_packer_seam()
+    return _lib
+
+
+def _bind(so: pathlib.Path):
     lib = ctypes.CDLL(str(so))
     lib.nos_runtime_new.restype = ctypes.c_void_p
     lib.nos_runtime_new.argtypes = [
@@ -78,12 +122,86 @@ def _load():
     lib.nos_runtime_delete_all_except.restype = ctypes.c_int
     lib.nos_runtime_delete_all_except.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
-    _lib = lib
-    return _lib
+    lib.nos_pack.restype = ctypes.c_int
+    lib.nos_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int]
+    return lib
 
 
-def available() -> bool:
-    return _load() is not None
+def _install_packer_seam() -> None:
+    """Whenever the shim is successfully loaded — lazily by any caller —
+    also back topology.packing's hot loops with the C++ search."""
+    from nos_tpu.topology import packing
+
+    packing.set_native_packer(native_packer)
+
+
+def available(build: bool = True) -> bool:
+    return _load(allow_build=build) is not None
+
+
+@functools.lru_cache(maxsize=65536)
+def _native_pack_cached(block: Shape, key: tuple, occupied: int,
+                        require_full: bool):
+    lib = _load()
+    ndims = len(block.dims)
+    bdims = list(block.dims) + [1] * (3 - ndims)
+    n = len(key)
+    shapes_flat: list[int] = []
+    counts: list[int] = []
+    for shape, cnt in key:
+        dims = list(shape.canonical().dims) + [1] * (
+            3 - len(shape.canonical().dims))
+        shapes_flat.extend(dims)
+        counts.append(cnt)
+    buf = ctypes.create_string_buffer(_OUT_CAP)
+    rc = lib.nos_pack(
+        (ctypes.c_int * 3)(*bdims), ndims,
+        (ctypes.c_int * max(1, len(shapes_flat)))(*shapes_flat),
+        (ctypes.c_int * max(1, n))(*counts), n,
+        ctypes.c_uint64(occupied), int(require_full), buf, _OUT_CAP)
+    if rc == -1:
+        return None
+    if rc < 0:
+        raise NativeSliceError(f"nos_pack rc={rc}")
+    out = []
+    text = buf.value.decode()
+    lines = text.split("\n") if text else []
+    for line in lines:
+        dims_s, off_s = line.split(",")
+        dims = tuple(int(v) for v in dims_s.split(";"))[:ndims]
+        offset = tuple(int(v) for v in off_s.split(";"))[:ndims]
+        # canonical shape == sorted oriented dims, by definition
+        out.append(Placement(Shape(tuple(sorted(dims))), offset, dims))
+    return tuple(out)
+
+
+def native_packer(block: Shape, key: tuple, occupied: int,
+                  require_full: bool):
+    """set_native_packer-compatible bridge to the C++ exact search
+    (nos_pack in tpu_shim.cc).  Memoised with the same key discipline as
+    the Python packer's cache; returns NotImplemented if the shim cannot
+    be loaded so the caller falls back to the Python search."""
+    if _load() is None:
+        return NotImplemented
+    try:
+        return _native_pack_cached(block, key, occupied, require_full)
+    except NativeSliceError as e:
+        logger.warning("native packer failed (%s); falling back", e)
+        return NotImplemented
+
+
+def install_native_packer(build: bool = False) -> bool:
+    """Back topology.packing's hot loops with the C++ search.  With
+    build=False (the nos_tpu-import default) this only dlopens an
+    already-built .so — importing the package must never spawn a compiler.
+    Any later caller that explicitly asks for the native runtime (e.g.
+    default_tpu_runtime) triggers the build, and _load installs the packer
+    seam as a side effect at that point."""
+    return available(build=build)
 
 
 class NativeSliceError(Exception):

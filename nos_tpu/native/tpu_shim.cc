@@ -89,6 +89,10 @@ struct Candidate {
   int dims[kMaxDims];
 };
 
+// Aligned enumeration: an oriented shape with dims d sits only at offsets
+// o with o[i] % d[i] == 0 — the same shape-aligned discipline as the
+// Python packer (topology/packing.py), so native and Python searches agree
+// exactly on feasibility and produce interchangeable placements.
 void enumerate_orientation(const int* dims, const int* block,
                            std::vector<Candidate>* out) {
   int limit[kMaxDims];
@@ -96,9 +100,9 @@ void enumerate_orientation(const int* dims, const int* block,
     if (dims[i] > block[i]) return;
     limit[i] = block[i] - dims[i];
   }
-  for (int x = 0; x <= limit[0]; ++x)
-    for (int y = 0; y <= limit[1]; ++y)
-      for (int z = 0; z <= limit[2]; ++z) {
+  for (int x = 0; x <= limit[0]; x += dims[0])
+    for (int y = 0; y <= limit[1]; y += dims[1])
+      for (int z = 0; z <= limit[2]; z += dims[2]) {
         Candidate c{};
         c.offset[0] = x; c.offset[1] = y; c.offset[2] = z;
         std::memcpy(c.dims, dims, sizeof(c.dims));
@@ -147,6 +151,52 @@ int write_out(const std::string& s, char* out, int cap) {
   if ((int)s.size() + 1 > cap) return -2;  // buffer too small
   std::memcpy(out, s.c_str(), s.size() + 1);
   return 0;
+}
+
+int first_empty_cell(uint64_t occ, int total) {
+  for (int i = 0; i < total; ++i)
+    if (!(occ & (1ull << i))) return i;
+  return -1;
+}
+
+// Exact multiset packer with the Python packer's exact semantics
+// (topology/packing.py:_pack_masks): first-empty-cell driven backtracking
+// over aligned candidate placements, largest shapes first, with the
+// skip-cell branch when a full tiling is not required.
+struct PackEntry {
+  Shape shape;        // canonical
+  int count;
+  std::vector<Candidate> cands;
+};
+
+bool pack_rec(std::vector<PackEntry>& entries, uint64_t occ, int total,
+              bool require_full, const uint64_t full_mask,
+              std::vector<std::pair<int, Candidate>>* acc) {
+  bool all_done = true;
+  for (auto& e : entries)
+    if (e.count > 0) { all_done = false; break; }
+  if (all_done) return !require_full || occ == full_mask;
+  int cell = first_empty_cell(occ, total);
+  if (cell == -1) return false;
+  uint64_t cell_bit = 1ull << cell;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    auto& e = entries[i];
+    if (e.count == 0) continue;
+    for (const auto& c : e.cands) {
+      if (!(c.mask & cell_bit) || (c.mask & occ)) continue;
+      --e.count;
+      acc->push_back({(int)i, c});
+      if (pack_rec(entries, occ | c.mask, total, require_full, full_mask,
+                   acc))
+        return true;
+      acc->pop_back();
+      ++e.count;
+    }
+  }
+  if (!require_full)
+    return pack_rec(entries, occ | cell_bit, total, require_full, full_mask,
+                    acc);
+  return false;
 }
 
 }  // namespace
@@ -243,6 +293,60 @@ int nos_runtime_create_slices(void* h, int unit, const int* shapes_flat,
   }
   int rc = write_out(ids.str(), out, out_cap);
   return rc == 0 ? (int)ordered.size() : rc;
+}
+
+// Standalone exact packer backing the Python search (the hot loop of
+// geometry planning).  block: 3 dims; shapes_flat: n*3 canonical dims;
+// counts: n; occupied: bitmask of taken cells; require_full: exact tiling.
+// Writes one line per placement: "dx;dy;dz,ox;oy;oz" (oriented dims,
+// offset).  Returns placement count, -1 = infeasible, -2 = buffer too
+// small, -3 = bad args.
+int nos_pack(const int* block_dims, int ndims, const int* shapes_flat,
+             const int* counts, int n, uint64_t occupied, int require_full,
+             char* out, int out_cap) {
+  if (ndims < 1 || ndims > kMaxDims || n < 0) return -3;
+  Shape block;
+  block.ndims = ndims;
+  for (int i = 0; i < kMaxDims; ++i)
+    block.dims[i] = i < ndims ? block_dims[i] : 1;
+  int total = block.chips();
+  if (total > 64) return -3;
+  const uint64_t full_mask =
+      total == 64 ? ~0ull : ((1ull << total) - 1);
+
+  std::vector<PackEntry> entries;
+  for (int i = 0; i < n; ++i) {
+    PackEntry e;
+    e.shape.ndims = ndims;
+    for (int d = 0; d < kMaxDims; ++d) {
+      e.shape.dims[d] = shapes_flat[i * kMaxDims + d];
+      if (e.shape.dims[d] < 1) return -3;
+    }
+    e.count = counts[i];
+    if (e.count < 0) return -3;
+    e.cands = candidates_for(e.shape, block);
+    entries.push_back(std::move(e));
+  }
+  // Largest-first at every level, matching the Python packer's ordering.
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const PackEntry& a, const PackEntry& b) {
+                     return a.shape.chips() > b.shape.chips();
+                   });
+
+  std::vector<std::pair<int, Candidate>> acc;
+  if (!pack_rec(entries, occupied, total, require_full != 0, full_mask,
+                &acc))
+    return -1;
+
+  std::ostringstream os;
+  for (size_t i = 0; i < acc.size(); ++i) {
+    const auto& c = acc[i].second;
+    if (i) os << '\n';
+    os << c.dims[0] << ';' << c.dims[1] << ';' << c.dims[2] << ','
+       << c.offset[0] << ';' << c.offset[1] << ';' << c.offset[2];
+  }
+  int rc = write_out(os.str(), out, out_cap);
+  return rc == 0 ? (int)acc.size() : rc;
 }
 
 int nos_runtime_delete_slice(void* h, const char* id) {

@@ -185,6 +185,10 @@ class Scheduler:
 
         placements: list[tuple[Pod, NodeInfo]] = []
         state = CycleState()
+        # Furthest-progress failed attempt: its cycle state carries the
+        # placed mates' quota bookings and its domain their capacity usage
+        # — the context gang preemption needs (see below).
+        best_stuck: tuple[int, CycleState, list[NodeInfo], Pod] | None = None
         for pins in candidate_pins:
             # one API snapshot for the whole gang attempt; each candidate
             # works on clones of ONLY its pinned domain's NodeInfos
@@ -195,15 +199,16 @@ class Scheduler:
             for pod in members:
                 status = self._framework.run_pre_filter_plugins(
                     state, pod, lister)
-                if not status.is_success:
-                    placements = []
-                    break
-                feasible = [
-                    ni for ni in domain
-                    if self._framework.run_filter_plugins(
-                        state, pod, ni).is_success
-                ]
+                feasible = []
+                if status.is_success:
+                    feasible = [
+                        ni for ni in domain
+                        if self._framework.run_filter_plugins(
+                            state, pod, ni).is_success
+                    ]
                 if not feasible:
+                    if best_stuck is None or len(placements) > best_stuck[0]:
+                        best_stuck = (len(placements), state, domain, pod)
                     placements = []
                     break
                 chosen = min(feasible, key=self._score_key(pod))
@@ -217,14 +222,23 @@ class Scheduler:
         if len(placements) != len(members):
             # A gang claiming its guaranteed quota min must not starve
             # behind over-quota borrowers: give it the same preemption
-            # attempt single pods get (schedule_one's PostFilter path).
-            # Victims are evicted whole-gang (evict_gang), so one member's
-            # eviction frees real capacity; the gang binds on a later
-            # cycle once the space exists.
+            # attempt single pods get (schedule_one's PostFilter path),
+            # run for the STUCK member with its gang-mates' bookings in
+            # cycle state — so victim selection sees the whole gang's
+            # claim, not one member that might fit beside its victims.
+            # Victims are evicted whole-gang (evict_gang); the gang binds
+            # on a later cycle once the space exists.
             preempted = False
-            if self._gang_feasible_after_evictions(
+            if best_stuck is not None and self._gang_feasible_after_evictions(
                     members, candidate_pins, base, in_domain):
-                preempted = self._preempt_for_gang(members)
+                _, st, domain, stuck = best_stuck
+                nominated, post = self._framework.run_post_filter_plugins(
+                    st, stuck, SharedLister(domain))
+                # Deliberately NOT nominating: a nominated pod stops
+                # matching extra_resources_could_help_scheduling, which
+                # would hide this member from the partitioner and split
+                # the gang's demand.  The evictions are the useful effect.
+                preempted = post.is_success and bool(nominated)
             msg = "gang does not fit as a whole"
             if preempted:
                 msg += " (evicted over-quota victims, retrying)"
@@ -259,18 +273,40 @@ class Scheduler:
         windows fragmented by non-evictable in-quota pods) must not evict
         a fresh over-quota victim gang every cycle to no effect.
 
-        Evictability mirrors _select_victims_on_node's eligibility
-        (capacityscheduling.py): cross-namespace over-quota-labelled pods,
-        or same-namespace lower-priority pods.  Quota prefilters are
-        skipped — eviction is exactly what relaxes them; only
-        filter-capable plugins (resources, topology) gate here."""
+        Evictability mirrors _select_victims_on_node's branch structure
+        (capacityscheduling.py): a quota-less preemptor takes lower-
+        priority quota-less victims; a preemptor over its min takes
+        same-namespace lower-priority or cross-namespace over-quota
+        victims; a preemptor within min takes cross-namespace over-quota
+        victims only.  Quota prefilters are skipped — eviction is exactly
+        what relaxes them; only filter-capable plugins (resources,
+        topology) gate here."""
         from nos_tpu.utils.pod_util import is_over_quota
 
+        if not any(hasattr(p, "post_filter")
+                   for p in self._framework.plugins):
+            return False  # nothing could perform an eviction anyway
         first = members[0]
+        cap = next((p for p in self._framework.plugins
+                    if hasattr(p, "elastic_quota_infos")), None)
+        infos = cap.elastic_quota_infos if cap is not None else None
+        preemptor_info = (infos.get(first.metadata.namespace)
+                          if infos is not None else None)
+        more_than_min = False
+        if preemptor_info is not None:
+            req = cap.calculator.compute_pod_request(first)
+            more_than_min = preemptor_info.used_over_min_with(req)
 
         def directly_evictable(p: Pod) -> bool:
-            if p.metadata.namespace == first.metadata.namespace:
+            if preemptor_info is None:
+                # classic priority preemption among quota-less pods
+                if infos is not None \
+                        and infos.get(p.metadata.namespace) is not None:
+                    return False
                 return p.spec.priority < first.spec.priority
+            if p.metadata.namespace == first.metadata.namespace:
+                return more_than_min \
+                    and p.spec.priority < first.spec.priority
             return is_over_quota(p)
 
         # Gang amplification: evicting any member evicts the whole gang
@@ -316,26 +352,6 @@ class Scheduler:
             if placed == len(members):
                 return True
         return False
-
-    def _preempt_for_gang(self, members: list[Pod]) -> bool:
-        """PostFilter preemption on behalf of a gang that found no fit,
-        driven through a representative member (quota checks and victim
-        maths are namespace-scoped, so any member represents the gang's
-        quota claim).  Returns True if victims were evicted."""
-        first = members[0]
-        lister = self.snapshot()
-        state = CycleState()
-        # Seed cycle state (quota snapshot + PreFilterState); an
-        # unschedulable verdict here is exactly the starvation case
-        # preemption exists to fix, so the status is deliberately ignored.
-        self._framework.run_pre_filter_plugins(state, first, lister)
-        nominated, post = self._framework.run_post_filter_plugins(
-            state, first, lister)
-        # Deliberately NOT nominating: a nominated pod stops matching
-        # extra_resources_could_help_scheduling, which would hide this
-        # member from the partitioner and split the gang's demand.  The
-        # evictions PostFilter performed are the useful effect.
-        return post.is_success and bool(nominated)
 
     # -- internals ----------------------------------------------------------
     def _score_key(self, pod: Pod):

@@ -260,6 +260,53 @@ class TestGangPreemption:
         sched.run_cycle()
         assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name != ""
 
+    def test_gang_aggregate_demand_preempts_when_members_fit_alone(self):
+        """The stuck member's preemption runs WITH its gang-mates booked:
+        if each member individually fits beside the victims (2 free chips
+        per host, members need 2), a naive single-pod preemption would
+        reprieve every victim and evict nothing — the gang's aggregate
+        claim must drive the eviction."""
+        api = APIServer()
+        calc = TPUResourceCalculator(16)
+        plugin = CapacityScheduling(calc)
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+        plugin.set_framework(fw)
+        plugin.attach(api)
+        for i in range(2):
+            api.create(KIND_NODE, make_node(
+                f"host-{i}", labels={C.LABEL_POD_ID: "pod-a"},
+                allocatable={"cpu": 64.0, C.RESOURCE_TPU: 8.0,
+                             C.RESOURCE_TPU_MEMORY: 128.0}))
+        sched = Scheduler(api, fw)
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-a", namespace="ns-a"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 256})))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="eq-b", namespace="ns-b"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 96})))
+        # borrower gang: 6 chips on each host (2 chips stay free per host)
+        create_pod_group(api, "borrower", min_member=2, namespace="ns-b")
+        for i in range(2):
+            api.create(KIND_POD, gang_pod(
+                f"b-{i}", "borrower", chips=6, namespace="ns-b",
+                creation_timestamp=float(i)))
+        assert sched.run_cycle() == 2
+        from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
+        ElasticQuotaReconciler(api, calc).reconcile_all()
+        # claimant gang: 8 members x 2 chips = its full 256 GB min; any
+        # single member fits in the 4 free chips, the gang does not
+        create_pod_group(api, "claimant", min_member=8, namespace="ns-a")
+        for i in range(8):
+            api.create(KIND_POD, gang_pod(
+                f"a-{i}", "claimant", chips=2, namespace="ns-a",
+                creation_timestamp=float(10 + i)))
+        sched.run_cycle()
+        assert api.list(KIND_POD, namespace="ns-b") == []
+        assert sched.run_cycle() == 8
+        for i in range(8):
+            assert api.get(KIND_POD, f"a-{i}", "ns-a").spec.node_name
+
     def test_infeasible_gang_does_not_evict(self):
         """A gang that cannot fit even with every evictable pod gone
         (here: 3 members x 8 chips on a 2-host cluster) must not evict

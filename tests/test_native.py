@@ -151,3 +151,68 @@ class TestNativeEndToEnd:
         assert sched.run_cycle() == 2
         for i in range(2):
             assert api.get(KIND_POD, f"p-{i}", "default").status.phase == RUNNING
+
+
+class TestNativePacker:
+    """The C++ exact packer (nos_pack) must agree with the Python search
+    on feasibility and produce valid aligned placements — it backs
+    topology.packing's hot loops via set_native_packer."""
+
+    def test_installed_at_import(self):
+        from nos_tpu.topology import packing
+
+        # nos_tpu/__init__ auto-installs when the shim builds (it does
+        # here, per the skipif guard on this module)
+        assert packing._native_packer is native.native_packer
+
+    @pytest.mark.parametrize("block_name,pool", [
+        ("2x4", ["1x1", "1x2", "2x2", "1x4", "2x4"]),
+        ("1x2x2", ["1x1x1", "1x1x2", "1x2x2"]),
+    ])
+    def test_matches_python_search(self, block_name, pool):
+        import itertools
+        import random
+
+        from nos_tpu.topology import packing
+
+        rng = random.Random(1234)
+        block = Shape.parse(block_name)
+        pool = [Shape.parse(s) for s in pool]
+        for _ in range(200):
+            counts = {s: rng.randint(1, 3)
+                      for s in rng.sample(pool, rng.randint(1, len(pool)))}
+            occ = rng.getrandbits(block.chips) if rng.random() < 0.5 else 0
+            require_full = occ == 0 and rng.random() < 0.3
+            key = packing._counts_key(counts)
+            got = native.native_packer(block, key, occ, require_full)
+            want = packing._pack_masks(block, key, occupied=occ,
+                                       require_full=require_full)
+            assert (got is None) == (want is None), (counts, occ,
+                                                     require_full)
+            if got is None:
+                continue
+            used = occ
+            for pl in got:
+                assert all(o % d == 0 for o, d in zip(pl.offset, pl.dims))
+                for cell in itertools.product(
+                        *[range(o, o + d)
+                          for o, d in zip(pl.offset, pl.dims)]):
+                    bit = 1 << packing._cell_id(cell, block.dims)
+                    assert not used & bit, "overlapping placement"
+                    used |= bit
+            if require_full:
+                assert used == (1 << block.chips) - 1
+
+    def test_pack_uses_native_and_agrees(self):
+        """pack() through the installed seam equals the pure-Python result
+        for the exact-tiling geometry derivation path."""
+        from nos_tpu.topology import packing
+
+        block = V5E.host_block
+        counts = {Shape.parse("2x2"): 1, Shape.parse("1x2"): 2}
+        via_seam = packing.pack(block, counts)
+        direct = packing._pack_masks(
+            block, packing._counts_key(counts), occupied=0,
+            require_full=False)
+        assert (via_seam is None) == (direct is None)
+        assert via_seam is not None
