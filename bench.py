@@ -23,9 +23,9 @@ import sys
 import time
 
 from nos_tpu.api import constants as C
+from nos_tpu.api.config import PartitionerConfig
 from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
-from nos_tpu.controllers.node_controller import NodeController
-from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.cmd.assembly import build_partitioner_main, build_scheduler
 from nos_tpu.controllers.sliceagent.agent import SliceAgent
 from nos_tpu.device import default_tpu_runtime
 from nos_tpu.device.fake import FakePodResources
@@ -33,31 +33,27 @@ from nos_tpu.kube.client import (
     APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP,
 )
 from nos_tpu.kube.objects import ObjectMeta, RUNNING
-from nos_tpu.partitioning.slicepart import SliceNodeInitializer
-from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
-from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
-from nos_tpu.scheduler.gang import TopologyFilter
-from nos_tpu.scheduler.scheduler import Scheduler
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
 
 HOSTS = 8
 BATCH_IDLE_S = 0.5     # tightened vs the reference's 10 s idle window
 BATCH_TIMEOUT_S = 2.0  # vs the reference's 60 s
+POLL_S = 0.02
 BASELINE_S = 30.0
 
 
 def build_cluster():
+    """The full control plane as the cmd/ process model runs it: the
+    partitioner/scheduler/agents are threaded run loops on a Main
+    (nos_tpu/cmd), not a hand-cranked tick loop."""
     api = APIServer()
     state = ClusterState()
-    NodeController(api, state, SliceNodeInitializer(api)).bind()
-    PodController(api, state).bind()
-    partitioner = new_slice_partitioner_controller(
-        api, state, batch_timeout_s=BATCH_TIMEOUT_S,
-        batch_idle_s=BATCH_IDLE_S)
-    partitioner.bind()
-    agents = []
+    cfg = PartitionerConfig(batch_timeout_s=BATCH_TIMEOUT_S,
+                            batch_idle_s=BATCH_IDLE_S,
+                            poll_interval_s=POLL_S)
+    main, _ = build_partitioner_main(api, state, cfg)
     for i in range(HOSTS):
         name = f"host-{i}"
         api.create(KIND_NODE, make_tpu_node(
@@ -68,16 +64,14 @@ def build_cluster():
         agent = SliceAgent(api, name, default_tpu_runtime(V5E),
                            FakePodResources())
         agent.start()
-        agents.append(agent)
-    scheduler = Scheduler(
-        api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
-    return api, partitioner, agents, scheduler
+        main.add_loop(f"sliceagent-{name}", agent.tick, POLL_S)
+    scheduler = build_scheduler(api)
+    main.add_loop("scheduler", scheduler.run_cycle, POLL_S)
+    return api, main
 
 
 def run_scenario() -> float:
-    api, partitioner, agents, scheduler = build_cluster()
-    for a in agents:
-        a.tick()   # actuate initial geometry
+    api, main = build_cluster()
 
     # BASELINE #3 exactly: 4 x v5e-8 single-host jobs + 2 x v5e-16 jobs
     # (2-pod gangs on multi-host 4x4 slices) = all 64 chips — convergence
@@ -92,26 +86,26 @@ def run_scenario() -> float:
                            labels={C.LABEL_POD_GROUP: f"v5e16-{g}"})
             for i in range(2)
         ]
-    t0 = time.monotonic()
-    for p in pods:
-        api.create(KIND_POD, p)
-
-    deadline = t0 + 120.0
-    total = len(pods)
-    while time.monotonic() < deadline:
-        scheduler.run_cycle()
-        partitioner.process_if_ready()
-        for a in agents:
-            a.tick()
-        bound = sum(
-            1 for p in api.list(KIND_POD)
-            if p.spec.node_name and p.status.phase == RUNNING)
-        if bound == total:
-            return time.monotonic() - t0
-        time.sleep(0.02)
-    raise RuntimeError(
-        f"bench did not converge: "
-        f"{sum(1 for p in api.list(KIND_POD) if p.spec.node_name)}/{total}")
+    main.start()
+    try:
+        t0 = time.monotonic()
+        for p in pods:
+            api.create(KIND_POD, p)
+        deadline = t0 + 120.0
+        total = len(pods)
+        while time.monotonic() < deadline:
+            bound = sum(
+                1 for p in api.list(KIND_POD)
+                if p.spec.node_name and p.status.phase == RUNNING)
+            if bound == total:
+                return time.monotonic() - t0
+            time.sleep(POLL_S)
+        raise RuntimeError(
+            f"bench did not converge: "
+            f"{sum(1 for p in api.list(KIND_POD) if p.spec.node_name)}"
+            f"/{total}")
+    finally:
+        main.shutdown()
 
 
 def run_compute_bench() -> dict:

@@ -229,19 +229,24 @@ class Sim:
         lat = sorted(self.latencies)
         cyc = sorted(self.cycle_wall_ms)
 
-        def pct(xs, q):
-            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+        def pct(xs, q, digits):
+            # None (no samples — e.g. zero binds all trace) must survive
+            # into the JSON rather than blow up in round()
+            if not xs:
+                return None
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
 
         return {
-            "utilization_pct": round(self._util_area / self._util_time, 4),
+            "utilization_pct": round(self._util_area / self._util_time, 4)
+            if self._util_time else 0.0,
             "total_chips": TOTAL_CHIPS,
             "trace_seconds": TRACE_S,
             "jobs_completed": self.completed,
             "jobs_bound": len(self.latencies),
-            "p50_schedule_latency_s": round(pct(lat, 0.50), 3),
-            "p90_schedule_latency_s": round(pct(lat, 0.90), 3),
-            "scheduler_cycle_wall_ms_p50": round(pct(cyc, 0.50), 2),
-            "scheduler_cycle_wall_ms_p99": round(pct(cyc, 0.99), 2),
+            "p50_schedule_latency_s": pct(lat, 0.50, 3),
+            "p90_schedule_latency_s": pct(lat, 0.90, 3),
+            "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
+            "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
         }
 
 

@@ -1,0 +1,21 @@
+"""Entry points — the analog of the reference's six cmd/ binaries.
+
+Each main loads a typed validated config (--config, nos_tpu/api/config.py),
+assembles its component against the kube client seam, and runs named
+threaded reconcile loops with graceful shutdown plus /healthz /readyz
+/metrics endpoints (nos_tpu/cmd/_runtime.py):
+
+- python -m nos_tpu.cmd.partitioner   (gpupartitioner analog)
+- python -m nos_tpu.cmd.scheduler     (scheduler analog)
+- python -m nos_tpu.cmd.operator      (operator analog)
+- python -m nos_tpu.cmd.sliceagent    (migagent analog)
+- python -m nos_tpu.cmd.chipagent     (gpuagent analog)
+- python -m nos_tpu.cmd.metricsexporter (metricsexporter analog)
+
+The in-memory APIServer stands in for the Kubernetes API server exactly
+as throughout the framework; a production deployment swaps that seam for
+a real API-server client and runs one process per component, unchanged.
+`--sim N` on the partitioner main bootstraps an N-host demo cluster with
+in-process agents + scheduler so the binary exercises the full loop
+standalone.
+"""

@@ -1,0 +1,146 @@
+"""Shared process runtime for the cmd/ mains.
+
+The analog of the controller-runtime manager every reference main starts
+(cmd/gpupartitioner/gpupartitioner.go:72-268): named run loops on
+threads, graceful SIGINT/SIGTERM shutdown, and an HTTP endpoint serving
+/healthz + /readyz (operator.go:112-119) and /metrics (the Prometheus
+registry, nos_tpu/exporter/metrics.py).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import signal
+import threading
+import time
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+
+class RunLoop(threading.Thread):
+    """Periodic loop: fn() every interval until stop.  One crashing tick
+    is logged and counted, not fatal (level-triggered reconcile)."""
+
+    def __init__(self, name: str, fn: Callable[[], object],
+                 interval_s: float, stop: threading.Event) -> None:
+        super().__init__(name=name, daemon=True)
+        self._fn = fn
+        self._interval = interval_s
+        # NB: not `_stop` — threading.Thread uses that name internally.
+        self._halt = stop
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            t0 = time.perf_counter()
+            try:
+                self._fn()
+            except Exception:  # noqa: BLE001 — reconcile loops must survive
+                logger.exception("run loop %s: tick failed", self.name)
+                REGISTRY.inc("nos_tpu_runloop_errors_total",
+                             labels={"loop": self.name})
+            REGISTRY.observe("nos_tpu_runloop_tick_seconds",
+                             time.perf_counter() - t0,
+                             labels={"loop": self.name})
+            self._halt.wait(self._interval)
+
+
+class _HealthHandler(http.server.BaseHTTPRequestHandler):
+    main: "Main" = None  # type: ignore[assignment]
+
+    def do_GET(self):  # noqa: N802 — stdlib API
+        if self.path == "/healthz":
+            self._respond(200, "ok")
+        elif self.path == "/readyz":
+            ready = self.main is not None and self.main.ready.is_set()
+            self._respond(200 if ready else 503,
+                          "ok" if ready else "not ready")
+        elif self.path == "/metrics":
+            self._respond(200, REGISTRY.render(),
+                          content_type="text/plain; version=0.0.4")
+        else:
+            self._respond(404, "not found")
+
+    def _respond(self, code: int, body: str,
+                 content_type: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+
+class Main:
+    """Owns the stop event, run-loop threads, and the health server."""
+
+    def __init__(self, name: str, health_addr: str = "") -> None:
+        self.name = name
+        self.stop = threading.Event()
+        self.ready = threading.Event()
+        self._loops: list[RunLoop] = []
+        self._server: http.server.ThreadingHTTPServer | None = None
+        self._health_addr = health_addr
+
+    def add_loop(self, name: str, fn: Callable[[], object],
+                 interval_s: float) -> None:
+        self._loops.append(RunLoop(name, fn, interval_s, self.stop))
+
+    def start(self) -> None:
+        if self._health_addr:
+            host, port = self._health_addr.rsplit(":", 1)
+            handler = type("Handler", (_HealthHandler,), {"main": self})
+            self._server = http.server.ThreadingHTTPServer(
+                (host or "127.0.0.1", int(port)), handler)
+            threading.Thread(target=self._server.serve_forever,
+                             name=f"{self.name}-health",
+                             daemon=True).start()
+            logger.info("%s: health/metrics on %s", self.name,
+                        self._health_addr)
+        for loop in self._loops:
+            loop.start()
+        self.ready.set()
+        logger.info("%s: %d run loop(s) started", self.name,
+                    len(self._loops))
+
+    @property
+    def health_address(self) -> str:
+        """Actual bound host:port (useful with a :0 config port)."""
+        if self._server is None:
+            return ""
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def shutdown(self) -> None:
+        self.stop.set()
+        self.ready.clear()
+        for loop in self._loops:
+            loop.join(timeout=5.0)
+        if self._server is not None:
+            self._server.shutdown()
+        logger.info("%s: shut down", self.name)
+
+    def install_signal_handlers(self) -> None:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: self.stop.set())
+
+    def run_until_stopped(self) -> None:
+        """start() + block until a signal (or stop) arrives, then shut
+        down gracefully — the `mgr.Start(ctx)` analog."""
+        self.install_signal_handlers()
+        self.start()
+        try:
+            while not self.stop.is_set():
+                self.stop.wait(0.2)
+        finally:
+            self.shutdown()
+
+
+def health_port(addr: str) -> int:
+    return int(addr.rsplit(":", 1)[1]) if addr else 0

@@ -1,0 +1,71 @@
+"""Component assembly shared by the cmd/ mains, bench.py, and tests.
+
+The construction logic the reference spreads over its mains
+(cmd/gpupartitioner/gpupartitioner.go:72-380 et al.), factored so a main,
+the benchmark, and a simulation wire the identical control plane.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api.config import (
+    HYBRID_KIND, PartitionerConfig, SLICE_KIND, TIMESHARE_KIND,
+)
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.kube.client import APIServer
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
+from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+
+
+def build_partitioner_main(api: APIServer, state: ClusterState,
+                           cfg: PartitionerConfig,
+                           main: Main | None = None) -> tuple[Main, list]:
+    """Node/pod state controllers + the partitioner controller(s) for the
+    configured kind(s), as run loops on `main`."""
+    if cfg.known_geometries_file:
+        from nos_tpu.topology import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.load_overrides(cfg.known_geometries_file)
+    main = main or Main("nos-tpu-partitioner", cfg.health_probe_addr)
+    NodeController(api, state, SliceNodeInitializer(api)).bind()
+    PodController(api, state).bind()
+    controllers = []
+    if cfg.kind in (SLICE_KIND, HYBRID_KIND):
+        ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=cfg.batch_timeout_s,
+            batch_idle_s=cfg.batch_idle_s)
+        ctl.bind()
+        controllers.append(ctl)
+        main.add_loop("partitioner-slice", ctl.process_if_ready,
+                      cfg.poll_interval_s)
+    if cfg.kind in (TIMESHARE_KIND, HYBRID_KIND):
+        ctl = new_timeshare_partitioner_controller(
+            api, state, batch_timeout_s=cfg.batch_timeout_s,
+            batch_idle_s=cfg.batch_idle_s,
+            cm_name=cfg.device_plugin_cm_name,
+            cm_namespace=cfg.device_plugin_cm_namespace)
+        ctl.bind()
+        controllers.append(ctl)
+        main.add_loop("partitioner-timeshare", ctl.process_if_ready,
+                      cfg.poll_interval_s)
+    return main, controllers
+
+
+def build_scheduler(api: APIServer,
+                    tpu_memory_gb_per_chip: int = 16) -> Scheduler:
+    """The recompiled-kube-scheduler analog: framework with resources +
+    topology + capacity plugins, quota ledger attached to the API."""
+    from nos_tpu.quota import TPUResourceCalculator
+
+    plugin = CapacityScheduling(TPUResourceCalculator(tpu_memory_gb_per_chip))
+    fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+    plugin.set_framework(fw)
+    plugin.attach(api)
+    return Scheduler(api, fw)

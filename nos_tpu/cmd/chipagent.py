@@ -1,0 +1,68 @@
+"""chipagent main analog (reference cmd/gpuagent/gpuagent.go:54-152): the
+per-node agent for timeshare nodes — device-plugin config application +
+reporter only (no actuator), refusing to run on slice nodes exactly as
+gpuagent refuses MIG nodes (gpuagent.go:106-114).
+
+    python -m nos_tpu.cmd.chipagent --config chipagent.yaml
+    python -m nos_tpu.cmd.chipagent --node ts-0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from nos_tpu.api.config import ConfigError, AgentConfig, load_config
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.kube.client import APIServer, KIND_NODE, NotFound
+
+
+def build_chipagent_main(api: APIServer, cfg: AgentConfig,
+                         main: Main | None = None) -> Main:
+    from nos_tpu.controllers.chipagent import ChipAgent
+    from nos_tpu.topology import DEFAULT_REGISTRY
+
+    try:
+        api.get(KIND_NODE, cfg.node_name)
+    except NotFound:
+        from nos_tpu.testing.factory import make_tpu_node
+
+        api.create(KIND_NODE, make_tpu_node(
+            cfg.node_name, generation=DEFAULT_REGISTRY.get(cfg.generation),
+            partitioning="timeshare"))
+    main = main or Main(f"nos-tpu-chipagent-{cfg.node_name}",
+                        cfg.health_probe_addr)
+    agent = ChipAgent(api, cfg.node_name)
+    agent.start()  # raises on slice nodes (the gpuagent guard)
+    main.add_loop("chipagent", agent.tick, cfg.report_interval_s)
+    return main
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--config", default=None,
+                    help="YAML/JSON AgentConfig file")
+    ap.add_argument("--node", default=None, help="node name override")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.config or not args.node:
+            cfg = load_config(args.config, AgentConfig)
+        else:
+            cfg = AgentConfig(node_name=args.node)
+        if args.node:
+            cfg.node_name = args.node
+        cfg.validate()
+    except ConfigError as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        return 2
+    build_chipagent_main(APIServer(), cfg).run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

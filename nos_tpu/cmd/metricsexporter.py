@@ -1,0 +1,61 @@
+"""metricsexporter main analog (reference cmd/metricsexporter/
+metricsexporter.go:33-91): one-shot telemetry — collect the cluster/
+components/metrics payload and POST it to an endpoint and/or write it to
+a file, then exit.
+
+    python -m nos_tpu.cmd.metricsexporter --out /tmp/metrics.json
+    python -m nos_tpu.cmd.metricsexporter --endpoint http://host/ingest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import urllib.request
+
+from nos_tpu.exporter import collect
+from nos_tpu.kube.client import APIServer
+
+logger = logging.getLogger("nos_tpu.cmd.metricsexporter")
+
+
+def export(payload: dict, endpoint: str = "", out: str = "") -> int:
+    if out:
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=2)
+        logger.info("wrote %s", out)
+    if endpoint:
+        req = urllib.request.Request(
+            endpoint, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                logger.info("POST %s -> %d", endpoint, resp.status)
+        except OSError as e:
+            logger.error("POST %s failed: %s", endpoint, e)
+            return 1
+    if not out and not endpoint:
+        json.dump(payload, sys.stdout, indent=2)
+        print()
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--endpoint", default="", help="POST target URL")
+    ap.add_argument("--out", default="", help="write payload to this file")
+    args = ap.parse_args(argv)
+
+    payload = collect(APIServer(), components={
+        "partitioner": True, "scheduler": True, "operator": True,
+    })
+    return export(payload, endpoint=args.endpoint, out=args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""operator main analog (reference cmd/operator/operator.go:50-126): the
+ElasticQuota + CompositeElasticQuota reconcilers with their validating
+webhooks registered, watch-driven plus a periodic resync.
+
+    python -m nos_tpu.cmd.operator --config operator.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from nos_tpu.api.config import ConfigError, OperatorConfig, load_config
+from nos_tpu.api.elasticquota import install_quota_webhooks
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.controllers.elasticquota import (
+    CompositeElasticQuotaReconciler, ElasticQuotaReconciler,
+)
+from nos_tpu.kube.client import APIServer
+from nos_tpu.quota import TPUResourceCalculator
+
+
+def build_operator_main(api: APIServer, cfg: OperatorConfig,
+                        main: Main | None = None) -> Main:
+    main = main or Main("nos-tpu-operator", cfg.health_probe_addr)
+    install_quota_webhooks(api)
+    calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
+    eq = ElasticQuotaReconciler(api, calc)
+    ceq = CompositeElasticQuotaReconciler(api, calc)
+    eq.bind()
+    ceq.bind()
+
+    def resync() -> None:
+        eq.reconcile_all()
+        ceq.reconcile_all()
+
+    main.add_loop("quota-resync", resync, cfg.resync_interval_s)
+    return main
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--config", default=None,
+                    help="YAML/JSON OperatorConfig file")
+    args = ap.parse_args(argv)
+
+    try:
+        cfg = load_config(args.config, OperatorConfig)
+    except ConfigError as e:
+        print(f'invalid config: {e}', file=sys.stderr)
+        return 2
+    build_operator_main(APIServer(), cfg).run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

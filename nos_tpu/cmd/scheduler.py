@@ -1,0 +1,44 @@
+"""scheduler main analog (reference cmd/scheduler/scheduler.go:43-59: the
+stock kube-scheduler recompiled with CapacityScheduling registered) —
+here the scheduling cycle loop over the framework with resources +
+topology + capacity plugins.
+
+    python -m nos_tpu.cmd.scheduler --config scheduler.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from nos_tpu.api.config import ConfigError, SchedulerConfig, load_config
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.kube.client import APIServer
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--config", default=None,
+                    help="YAML/JSON SchedulerConfig file")
+    args = ap.parse_args(argv)
+
+    try:
+        cfg = load_config(args.config, SchedulerConfig)
+    except ConfigError as e:
+        print(f'invalid config: {e}', file=sys.stderr)
+        return 2
+    api = APIServer()
+    scheduler = build_scheduler(api, cfg.tpu_memory_gb_per_chip)
+    m = Main("nos-tpu-scheduler", cfg.health_probe_addr)
+    m.add_loop("scheduler", scheduler.run_cycle, cfg.cycle_interval_s)
+    m.run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""sliceagent main analog (reference cmd/migagent/migagent.go:56-199):
+the per-node DaemonSet agent — startup cleanup of orphaned slices, then
+the reporter+actuator pair on a report-interval run loop, actuating the
+node's TPU runtime (the native C++ shim when it builds, the fake
+otherwise — the `nvml` build-tag discipline).
+
+    python -m nos_tpu.cmd.sliceagent --config sliceagent.yaml
+    python -m nos_tpu.cmd.sliceagent --node host-0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from nos_tpu.api.config import ConfigError, AgentConfig, load_config
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.kube.client import APIServer, KIND_NODE, NotFound
+
+
+def build_agent_main(api: APIServer, cfg: AgentConfig,
+                     main: Main | None = None) -> Main:
+    from nos_tpu.controllers.sliceagent.agent import SliceAgent
+    from nos_tpu.device import default_tpu_runtime
+    from nos_tpu.device.fake import FakePodResources
+    from nos_tpu.topology import DEFAULT_REGISTRY
+
+    generation = DEFAULT_REGISTRY.get(cfg.generation)
+    try:
+        api.get(KIND_NODE, cfg.node_name)
+    except NotFound:
+        # standalone demo process: self-register the node object (a real
+        # deployment reads it from the cluster API server)
+        from nos_tpu.testing.factory import make_tpu_node
+
+        api.create(KIND_NODE, make_tpu_node(cfg.node_name,
+                                            generation=generation))
+    main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
+                        cfg.health_probe_addr)
+    agent = SliceAgent(api, cfg.node_name, default_tpu_runtime(generation),
+                       FakePodResources())
+    agent.start()  # startup cleanup + first report (migagent.go:190-199)
+    main.add_loop("sliceagent", agent.tick, cfg.report_interval_s)
+    return main
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--config", default=None,
+                    help="YAML/JSON AgentConfig file")
+    ap.add_argument("--node", default=None, help="node name override")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.config or not args.node:
+            cfg = load_config(args.config, AgentConfig)
+        else:
+            cfg = AgentConfig(node_name=args.node)
+        if args.node:
+            cfg.node_name = args.node
+        cfg.validate()
+    except ConfigError as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        return 2
+    build_agent_main(APIServer(), cfg).run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,8 @@ class PartitionerController:
         return True
 
     def process_pending_pods(self) -> None:
+        from nos_tpu.exporter.metrics import REGISTRY
+
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if extra_resources_could_help_scheduling(p)
@@ -77,8 +79,13 @@ class PartitionerController:
         snapshot = self._snapshot_taker.take_snapshot(self._state)
         if not snapshot.nodes():
             return
-        desired = self._planner.plan(snapshot.clone(), pods)
-        self._actuator.apply(snapshot, desired)
+        with REGISTRY.time("nos_tpu_plan_seconds",
+                           labels={"kind": self._kind}):
+            desired = self._planner.plan(snapshot.clone(), pods)
+            self._actuator.apply(snapshot, desired)
+        REGISTRY.inc("nos_tpu_plans_total", labels={"kind": self._kind})
+        REGISTRY.set("nos_tpu_plan_pending_pods",
+                     float(len(pods)), labels={"kind": self._kind})
 
     def _waiting_for_nodes_to_report_plan(self) -> bool:
         """spec-partitioning-plan vs status-partitioning-plan per node

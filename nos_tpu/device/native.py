@@ -78,19 +78,28 @@ def _load(allow_build: bool = True):
         if allow_build:
             so = build_shim(force=True)
             try:
-                # dlopen caches by path string, so the rebuilt library must
-                # be bound from a fresh name to displace the stale mapping.
+                # dlopen caches by path STRING (verified empirically: a
+                # rebuilt .so at the same path returns the stale handle
+                # even with a new inode), so the rebuilt library must be
+                # bound from a fresh name to displace the stale mapping.
+                # The temp copy is unlinked immediately — the mapping
+                # stays valid on Linux after unlink.
                 if so is not None:
+                    import os
                     import shutil
                     import tempfile
 
                     fd, tmp = tempfile.mkstemp(
                         suffix=".so", prefix="nos_tpu_shim_")
-                    import os
-
                     os.close(fd)
-                    shutil.copy2(so, tmp)
-                    lib = _bind(pathlib.Path(tmp))
+                    try:
+                        shutil.copy2(so, tmp)
+                        lib = _bind(pathlib.Path(tmp))
+                    finally:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
             except (OSError, AttributeError) as e2:
                 logger.warning("native shim unusable after rebuild: %s", e2)
         if lib is None:

@@ -1,0 +1,44 @@
+"""Telemetry exporter: cluster snapshot + component toggles + metrics.
+
+The analog of the reference's metricsexporter binary
+(cmd/metricsexporter/metricsexporter.go:33-91, payload schema
+cmd/metricsexporter/metrics/metrics.go:24-42): collect a one-shot
+description of the cluster — node/chip inventory per partitioning kind,
+component toggles — plus this process's metric series, and POST it to an
+endpoint or write it to a file (python -m nos_tpu.cmd.metricsexporter).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY, Registry
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+
+__all__ = ["REGISTRY", "Registry", "collect"]
+
+
+def collect(api: APIServer, components: dict[str, bool] | None = None,
+            registry: Registry | None = None) -> dict:
+    """The metricsexporter payload (metrics.go:24-42 analog): anonymous
+    cluster shape + enabled components + in-process metric series."""
+    nodes = api.list(KIND_NODE)
+    by_kind: dict[str, dict[str, float]] = {}
+    for node in nodes:
+        kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "none")
+        agg = by_kind.setdefault(kind, {"nodes": 0, "chips": 0.0})
+        agg["nodes"] += 1
+        agg["chips"] += float(
+            node.metadata.labels.get(C.LABEL_CHIP_COUNT, "0") or 0)
+    pods = api.list(KIND_POD)
+    return {
+        "timestamp": _time.time(),
+        "cluster": {
+            "nodes_total": len(nodes),
+            "pods_total": len(pods),
+            "partitioning": by_kind,
+        },
+        "components": components or {},
+        "metrics": (registry or REGISTRY).snapshot(),
+    }

@@ -1,0 +1,127 @@
+"""In-process metrics: counters/gauges/timers with a Prometheus text dump.
+
+The analog of controller-runtime's default Prometheus registry that every
+reference main exposes through kube-rbac-proxy
+(config/gpupartitioner/prometheus/monitor.yaml:1-20).  Components call
+`inc`/`set`/`observe` on the process-global REGISTRY; the cmd/_runtime
+health server serves it at /metrics in the Prometheus exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        # histogram-lite: count + sum + max per series
+        self._timers: dict[tuple[str, tuple], list[float]] = {}
+        self._help: dict[str, str] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def describe(self, name: str, help_text: str) -> None:
+        with self._lock:
+            self._help[name] = help_text
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict | None = None) -> None:
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def set(self, name: str, value: float,
+            labels: dict | None = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, seconds: float,
+                labels: dict | None = None) -> None:
+        with self._lock:
+            agg = self._timers.setdefault(self._key(name, labels),
+                                          [0.0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] += seconds
+            agg[2] = max(agg[2], seconds)
+
+    def time(self, name: str, labels: dict | None = None):
+        """with REGISTRY.time("nos_tpu_plan_seconds"): ..."""
+        return _Timer(self, name, labels)
+
+    def snapshot(self) -> dict:
+        """All series as a plain dict (the metricsexporter payload)."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for (name, labels), v in self._counters.items():
+                out.setdefault(name, {})[_series(labels)] = v
+            for (name, labels), v in self._gauges.items():
+                out.setdefault(name, {})[_series(labels)] = v
+            for (name, labels), (cnt, total, mx) in self._timers.items():
+                series = _series(labels)
+                out.setdefault(name + "_count", {})[series] = cnt
+                out.setdefault(name + "_sum", {})[series] = total
+                out.setdefault(name + "_max", {})[series] = mx
+            return out
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines: list[str] = []
+        with self._lock:
+            items = []
+            for (name, labels), v in sorted(self._counters.items()):
+                items.append((name, "counter", labels, v))
+            for (name, labels), v in sorted(self._gauges.items()):
+                items.append((name, "gauge", labels, v))
+            for (name, labels), (cnt, total, mx) in sorted(
+                    self._timers.items()):
+                items.append((name + "_count", "counter", labels, cnt))
+                items.append((name + "_sum", "counter", labels, total))
+                items.append((name + "_max", "gauge", labels, mx))
+            seen_types: set[str] = set()
+            for name, typ, labels, v in items:
+                if name not in seen_types:
+                    seen_types.add(name)
+                    base = name.removesuffix("_count").removesuffix(
+                        "_sum").removesuffix("_max")
+                    if base in self._help:
+                        lines.append(f"# HELP {name} {self._help[base]}")
+                    lines.append(f"# TYPE {name} {typ}")
+                label_s = ""
+                if labels:
+                    inner = ",".join(f'{k}="{val}"' for k, val in labels)
+                    label_s = "{" + inner + "}"
+                lines.append(f"{name}{label_s} {v}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+def _series(labels: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels) or ""
+
+
+class _Timer:
+    def __init__(self, reg: Registry, name: str, labels: dict | None):
+        self._reg, self._name, self._labels = reg, name, labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._reg.observe(self._name, time.perf_counter() - self._t0,
+                          self._labels)
+        return False
+
+
+REGISTRY = Registry()

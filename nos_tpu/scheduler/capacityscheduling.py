@@ -302,6 +302,10 @@ class CapacityScheduling:
         node_name, victims, _ = best
         for v in victims:
             self._evict(v)
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        REGISTRY.inc("nos_tpu_preemptions_total")
+        REGISTRY.inc("nos_tpu_preemption_victims_total", len(victims))
         logger.info("preempting %d pod(s) on %s for %s",
                     len(victims), node_name, pod.key)
         return node_name, Status.ok()

@@ -175,70 +175,40 @@ class Scheduler:
                                   key=lambda p: (free_by_pod[p], p))
             ]
 
-        def in_domain(ni: NodeInfo, pins: dict) -> bool:
-            pid = pins.get(GANG_POD_ID_KEY)
-            if pid is not None and \
-                    ni.node.metadata.labels.get(C_LABEL_POD_ID, "") != pid:
-                return False
-            hosts = pins.get(GANG_HOST_SET_KEY)
-            return hosts is None or ni.name in hosts
-
         placements: list[tuple[Pod, NodeInfo]] = []
         state = CycleState()
-        # Furthest-progress failed attempt: its cycle state carries the
-        # placed mates' quota bookings and its domain their capacity usage
-        # — the context gang preemption needs (see below).
-        best_stuck: tuple[int, CycleState, list[NodeInfo], Pod] | None = None
         for pins in candidate_pins:
-            # one API snapshot for the whole gang attempt; each candidate
-            # works on clones of ONLY its pinned domain's NodeInfos
-            domain = [ni.clone() for ni in base.list() if in_domain(ni, pins)]
-            lister = SharedLister(domain)
-            state = CycleState(pins)
-            placements = []
-            for pod in members:
-                status = self._framework.run_pre_filter_plugins(
-                    state, pod, lister)
-                feasible = []
-                if status.is_success:
-                    feasible = [
-                        ni for ni in domain
-                        if self._framework.run_filter_plugins(
-                            state, pod, ni).is_success
-                    ]
-                if not feasible:
-                    if best_stuck is None or len(placements) > best_stuck[0]:
-                        best_stuck = (len(placements), state, domain, pod)
-                    placements = []
-                    break
-                chosen = min(feasible, key=self._score_key(pod))
-                chosen.add_pod(pod)  # next member sees reduced capacity
-                self._framework.run_pre_filter_extension_add_pod(
-                    state, pod, pod, chosen)  # book quota usage for mates
-                placements.append((pod, chosen))
+            placements, state, _, _ = self._attempt_gang(pins, base, members)
             if len(placements) == len(members):
                 break
 
         if len(placements) != len(members):
             # A gang claiming its guaranteed quota min must not starve
             # behind over-quota borrowers: give it the same preemption
-            # attempt single pods get (schedule_one's PostFilter path),
-            # run for the STUCK member with its gang-mates' bookings in
-            # cycle state — so victim selection sees the whole gang's
-            # claim, not one member that might fit beside its victims.
-            # Victims are evicted whole-gang (evict_gang); the gang binds
-            # on a later cycle once the space exists.
+            # attempt single pods get (schedule_one's PostFilter path).
+            # The feasibility gate picks the candidate domain where the
+            # gang COULD fit once evictable pods are gone; the attempt is
+            # re-run there so PostFilter serves the stuck member with its
+            # gang-mates' bookings in cycle state — victim selection sees
+            # the whole gang's claim on the domain where eviction actually
+            # helps.  Victims are evicted whole-gang (evict_gang); the
+            # gang binds on a later cycle once the space exists.
             preempted = False
-            if best_stuck is not None and self._gang_feasible_after_evictions(
-                    members, candidate_pins, base, in_domain):
-                _, st, domain, stuck = best_stuck
-                nominated, post = self._framework.run_post_filter_plugins(
-                    st, stuck, SharedLister(domain))
-                # Deliberately NOT nominating: a nominated pod stops
-                # matching extra_resources_could_help_scheduling, which
-                # would hide this member from the partitioner and split
-                # the gang's demand.  The evictions are the useful effect.
-                preempted = post.is_success and bool(nominated)
+            feasible_pins = self._gang_feasible_after_evictions(
+                members, candidate_pins, base)
+            if feasible_pins is not None:
+                _, st, domain, stuck = self._attempt_gang(
+                    feasible_pins, base, members)
+                if stuck is not None:
+                    nominated, post = \
+                        self._framework.run_post_filter_plugins(
+                            st, stuck, SharedLister(domain))
+                    # Deliberately NOT nominating: a nominated pod stops
+                    # matching extra_resources_could_help_scheduling,
+                    # which would hide this member from the partitioner
+                    # and split the gang's demand.  The evictions are the
+                    # useful effect.
+                    preempted = post.is_success and bool(nominated)
             msg = "gang does not fit as a whole"
             if preempted:
                 msg += " (evicted over-quota victims, retrying)"
@@ -264,11 +234,54 @@ class Scheduler:
                     gang_name(first), len(placements))
         return len(placements)
 
+    def _attempt_gang(self, pins: dict, base: SharedLister,
+                      members: list[Pod]):
+        """Simulate placing the whole gang in one pinned domain over
+        clones of the base snapshot.  Returns (placements, state, domain,
+        stuck): placements is complete on success; `stuck` is the first
+        member that found no fit (None on success), with the placed
+        mates' capacity on the domain clones and their quota bookings in
+        `state` — exactly the context PostFilter preemption needs."""
+        domain = [ni.clone() for ni in base.list()
+                  if self._pins_match(ni, pins)]
+        lister = SharedLister(domain)
+        state = CycleState(pins)
+        placements: list[tuple[Pod, NodeInfo]] = []
+        for pod in members:
+            status = self._framework.run_pre_filter_plugins(
+                state, pod, lister)
+            feasible = []
+            if status.is_success:
+                feasible = [
+                    ni for ni in domain
+                    if self._framework.run_filter_plugins(
+                        state, pod, ni).is_success
+                ]
+            if not feasible:
+                return [], state, domain, pod
+            chosen = min(feasible, key=self._score_key(pod))
+            chosen.add_pod(pod)  # next member sees reduced capacity
+            self._framework.run_pre_filter_extension_add_pod(
+                state, pod, pod, chosen)  # book quota usage for mates
+            placements.append((pod, chosen))
+        return placements, state, domain, None
+
+    @staticmethod
+    def _pins_match(ni: NodeInfo, pins: dict) -> bool:
+        pid = pins.get(GANG_POD_ID_KEY)
+        if pid is not None and \
+                ni.node.metadata.labels.get(C_LABEL_POD_ID, "") != pid:
+            return False
+        hosts = pins.get(GANG_HOST_SET_KEY)
+        return hosts is None or ni.name in hosts
+
     def _gang_feasible_after_evictions(
             self, members: list[Pod], candidate_pins: list[dict],
-            base: SharedLister, in_domain) -> bool:
+            base: SharedLister) -> dict | None:
         """Would the gang fit some candidate domain if every *evictable*
-        pod were gone?  Guards gang preemption: a gang that is
+        pod were gone?  Returns the first such domain's pins (where the
+        subsequent preemption attempt should run — eviction only helps
+        there), or None.  Guards gang preemption: a gang that is
         topology-infeasible (e.g. needs a 4-host window no domain has, or
         windows fragmented by non-evictable in-quota pods) must not evict
         a fresh over-quota victim gang every cycle to no effect.
@@ -285,7 +298,7 @@ class Scheduler:
 
         if not any(hasattr(p, "post_filter")
                    for p in self._framework.plugins):
-            return False  # nothing could perform an eviction anyway
+            return None  # nothing could perform an eviction anyway
         first = members[0]
         cap = next((p for p in self._framework.plugins
                     if hasattr(p, "elastic_quota_infos")), None)
@@ -294,8 +307,17 @@ class Scheduler:
                           if infos is not None else None)
         more_than_min = False
         if preemptor_info is not None:
-            req = cap.calculator.compute_pod_request(first)
-            more_than_min = preemptor_info.used_over_min_with(req)
+            # Aggregate gang demand: victim selection runs with the placed
+            # mates booked into the quota snapshot, so its over-min test
+            # effectively sees the whole gang's claim — a single member's
+            # request would misclassify same-namespace victims.
+            from nos_tpu.kube.resources import sum_resources
+
+            total_req: dict = {}
+            for m in members:
+                total_req = sum_resources(
+                    total_req, cap.calculator.compute_pod_request(m))
+            more_than_min = preemptor_info.used_over_min_with(total_req)
 
         def directly_evictable(p: Pod) -> bool:
             if preemptor_info is None:
@@ -328,7 +350,7 @@ class Scheduler:
         for pins in candidate_pins:
             domain = []
             for ni in base.list():
-                if not in_domain(ni, pins):
+                if not self._pins_match(ni, pins):
                     continue
                 optimistic = NodeInfo(node=ni.node)
                 for p in ni.pods:
@@ -350,8 +372,8 @@ class Scheduler:
                 chosen.add_pod(pod)
                 placed += 1
             if placed == len(members):
-                return True
-        return False
+                return pins
+        return None
 
     # -- internals ----------------------------------------------------------
     def _score_key(self, pod: Pod):

@@ -145,11 +145,20 @@ def pack(block: Shape, counts: Mapping[Shape, int],
     """Place the multiset `counts` into `block` without overlap (aligned).
     Returns placements or None if infeasible.  `require_full` demands an
     exact tiling (used when deriving geometry tables)."""
+    from time import perf_counter
+
+    from nos_tpu.exporter.metrics import REGISTRY
+
     key = _counts_key(counts)
+    t0 = perf_counter()
     native = _try_native(block, key, 0, require_full)
     if native is not NotImplemented:
+        REGISTRY.observe("nos_tpu_pack_seconds", perf_counter() - t0,
+                         labels={"impl": "native"})
         return list(native) if native is not None else None
     res = _pack_cached(block, key, require_full)
+    REGISTRY.observe("nos_tpu_pack_seconds", perf_counter() - t0,
+                     labels={"impl": "python"})
     return list(res) if res is not None else None
 
 

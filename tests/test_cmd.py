@@ -1,0 +1,209 @@
+"""Process-model tests: typed configs, run loops, health/metrics
+endpoints, graceful shutdown, and the end-to-end sim demo — the analog of
+the reference's main-wiring coverage (cmd/gpupartitioner etc.)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nos_tpu.api.config import (
+    AgentConfig, ConfigError, OperatorConfig, PartitionerConfig,
+    SchedulerConfig, load_config,
+)
+from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd.assembly import build_partitioner_main, build_scheduler
+from nos_tpu.exporter.metrics import Registry
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import RUNNING
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        for cls in (PartitionerConfig, SchedulerConfig, OperatorConfig):
+            load_config(None, cls)
+
+    def test_yaml_round_trip(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("kind: hybrid\nbatch_timeout_s: 5\nbatch_idle_s: 1\n")
+        cfg = load_config(p, PartitionerConfig)
+        assert cfg.kind == "hybrid"
+        assert cfg.batch_timeout_s == 5.0  # int coerced to float
+
+    def test_json_also_accepted(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"tpu_memory_gb_per_chip": 32}))
+        assert load_config(p, SchedulerConfig).tpu_memory_gb_per_chip == 32
+
+    @pytest.mark.parametrize("body,err", [
+        ("kind: banana", "slice|timeshare|hybrid"),
+        ("batch_idle_s: 10\nbatch_timeout_s: 2", "must not exceed"),
+        ("batch_timeout_s: -1", "positive"),
+        ("frobnicate: 1", "unknown config key"),
+        ("health_probe_addr: nocolon", "host:port"),
+        ("known_geometries_file: /nope/missing.json", "does not exist"),
+    ])
+    def test_partitioner_validation(self, tmp_path, body, err):
+        p = tmp_path / "bad.yaml"
+        p.write_text(body)
+        with pytest.raises(ConfigError, match=err):
+            load_config(p, PartitionerConfig)
+
+    def test_agent_requires_node_name(self):
+        with pytest.raises(ConfigError, match="node_name"):
+            AgentConfig().validate()
+
+    def test_geometry_override_file_accepted(self, tmp_path):
+        f = tmp_path / "geo.json"
+        f.write_text("{}")
+        cfg = load_config(None, PartitionerConfig)
+        cfg.known_geometries_file = str(f)
+        cfg.validate()
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_timer_and_render(self):
+        reg = Registry()
+        reg.describe("nos_test_total", "a test counter")
+        reg.inc("nos_test_total", labels={"kind": "slice"})
+        reg.inc("nos_test_total", 2.0, labels={"kind": "slice"})
+        reg.set("nos_test_gauge", 7.0)
+        with reg.time("nos_test_op_seconds"):
+            pass
+        text = reg.render()
+        assert 'nos_test_total{kind="slice"} 3.0' in text
+        assert "# HELP nos_test_total a test counter" in text
+        assert "nos_test_gauge 7.0" in text
+        assert "nos_test_op_seconds_count 1" in text
+        snap = reg.snapshot()
+        assert snap["nos_test_total"]["kind=slice"] == 3.0
+
+
+class TestRunLoops:
+    def test_loop_survives_exceptions_and_stops(self):
+        main = Main("t")
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("tick failed")
+
+        main.add_loop("boom", boom, 0.01)
+        main.start()
+        time.sleep(0.1)
+        main.shutdown()
+        assert len(calls) >= 2  # kept running after the exception
+        n = len(calls)
+        time.sleep(0.05)
+        assert len(calls) == n  # actually stopped
+
+    def test_health_endpoints(self):
+        main = Main("t", health_addr="127.0.0.1:0")
+        main.add_loop("noop", lambda: None, 0.05)
+        main.start()
+        try:
+            base = f"http://{main.health_address}"
+            for path, want in (("/healthz", 200), ("/readyz", 200),
+                               ("/metrics", 200)):
+                with urllib.request.urlopen(base + path) as resp:
+                    assert resp.status == want
+            with urllib.request.urlopen(base + "/metrics") as resp:
+                assert b"nos_tpu_runloop" in resp.read()
+        finally:
+            main.shutdown()
+        # after shutdown readiness is cleared
+        assert not main.ready.is_set()
+
+
+class TestMetricsExporter:
+    def test_collect_and_export(self, tmp_path):
+        from nos_tpu.cmd.metricsexporter import export
+        from nos_tpu.exporter import collect
+        from nos_tpu.exporter.metrics import Registry
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("h0", pod_id="p0"))
+        api.create(KIND_NODE, make_tpu_node(
+            "t0", partitioning="timeshare", pod_id=""))
+        reg = Registry()
+        reg.inc("nos_tpu_plans_total", labels={"kind": "slice"})
+        payload = collect(api, components={"partitioner": True},
+                          registry=reg)
+        assert payload["cluster"]["nodes_total"] == 2
+        assert payload["cluster"]["partitioning"]["slice"]["chips"] == 8.0
+        assert payload["cluster"]["partitioning"]["timeshare"]["nodes"] == 1
+        assert payload["metrics"]["nos_tpu_plans_total"]["kind=slice"] == 1.0
+        out = tmp_path / "m.json"
+        assert export(payload, out=str(out)) == 0
+        assert json.loads(out.read_text())["components"]["partitioner"]
+
+    def test_export_pos_failure_is_nonfatal_rc(self):
+        from nos_tpu.cmd.metricsexporter import export
+
+        # unreachable endpoint: rc 1, no exception
+        assert export({"x": 1},
+                      endpoint="http://127.0.0.1:1/ingest") == 1
+
+
+class TestProcessModelEndToEnd:
+    def test_threaded_control_plane_converges(self):
+        """The bench path: partitioner + scheduler + agents as run loops
+        bind a slice pod with no hand-cranking."""
+        from nos_tpu.controllers.sliceagent.agent import SliceAgent
+        from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+        from nos_tpu.topology import V5E
+
+        api = APIServer()
+        state = ClusterState()
+        cfg = PartitionerConfig(batch_timeout_s=0.3, batch_idle_s=0.05,
+                                poll_interval_s=0.01)
+        main, _ = build_partitioner_main(api, state, cfg)
+        api.create(KIND_NODE, make_tpu_node("host-0", pod_id="pod-0"))
+        agent = SliceAgent(api, "host-0", FakeTpuRuntime(V5E),
+                           FakePodResources())
+        agent.start()
+        main.add_loop("agent", agent.tick, 0.01)
+        main.add_loop("sched", build_scheduler(api).run_cycle, 0.01)
+        main.start()
+        try:
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name="w"))
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                pod = api.get(KIND_POD, "w", "default")
+                if pod.spec.node_name and pod.status.phase == RUNNING:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("pod did not bind via threaded control plane")
+        finally:
+            main.shutdown()
+
+    def test_partitioner_sim_demo(self):
+        """`--sim` assembly converges (the standalone demo the main runs)."""
+        from nos_tpu.cmd.partitioner import add_sim
+
+        api = APIServer()
+        state = ClusterState()
+        cfg = PartitionerConfig(batch_timeout_s=0.3, batch_idle_s=0.05,
+                                poll_interval_s=0.01)
+        main, _ = build_partitioner_main(api, state, cfg)
+        add_sim(main, api, hosts=2)
+        main.start()
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                bound = sum(1 for p in api.list(KIND_POD)
+                            if p.spec.node_name
+                            and p.status.phase == RUNNING)
+                if bound == 2:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("sim demo did not converge")
+        finally:
+            main.shutdown()
