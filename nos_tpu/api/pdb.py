@@ -1,0 +1,60 @@
+"""PodDisruptionBudget: voluntary-eviction protection for preemption.
+
+The minimal analog of policy/v1 PodDisruptionBudget as the reference's
+preemption reprieve consumes it (capacity_scheduling.go:628-675 via
+filterPodsWithPDBViolation): a namespaced budget selecting pods by label,
+allowing `disruptions_allowed = healthy - min_available` voluntary
+evictions.  `disruptions_allowed` is derived on demand from the live pod
+set (`refresh_pdb_status`) — the stand-in for the upstream disruption
+controller that maintains it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    min_available: int = 0
+    selector: dict[str, str] = field(default_factory=dict)  # label match
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(
+        default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus)
+
+    def matches(self, pod) -> bool:
+        if pod.metadata.namespace != self.metadata.namespace:
+            return False
+        labels = pod.metadata.labels
+        return all(labels.get(k) == v for k, v in self.spec.selector.items())
+
+
+KIND_POD_DISRUPTION_BUDGET = "PodDisruptionBudget"
+
+
+def refresh_pdb_status(api, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+    """Recompute status from the live pod set (the disruption-controller
+    analog): healthy = running matching pods."""
+    healthy = sum(
+        1 for p in api.list("Pod", namespace=pdb.metadata.namespace)
+        if p.status.phase == RUNNING and pdb.matches(p))
+    pdb.status.current_healthy = healthy
+    pdb.status.desired_healthy = pdb.spec.min_available
+    pdb.status.disruptions_allowed = max(
+        0, healthy - pdb.spec.min_available)
+    return pdb

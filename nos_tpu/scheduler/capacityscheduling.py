@@ -420,14 +420,17 @@ class CapacityScheduling:
             if snapshot.aggregated_used_over_min_with(pod_req):
                 return [], 0, Status.unschedulable("total min quota exceeded")
 
-        # Reprieve as many victims as possible, highest priority first
-        # (:626-673).  No PDB objects exist in this object model yet, so all
-        # victims are non-violating.
+        # Reprieve as many victims as possible (:626-673): split potential
+        # victims by PodDisruptionBudget violation and try to reprieve the
+        # violating ones FIRST (capacity is freest at the start of the
+        # walk, minimising PDB violations); victims that stay despite
+        # violating a budget are counted for the node-choice tiebreak.
+        violating, non_violating = self._split_pdb_violation(
+            potential, pdbs)
         victims: list[Pod] = []
         num_violating = 0
-        for pv in sorted(potential,
-                         key=lambda p: (-p.spec.priority,
-                                        p.metadata.creation_timestamp)):
+
+        def reprieve(pv: Pod) -> bool:
             add(pv)
             fits = fw.run_filter_plugins(wstate, pod, ni).is_success
             over_quota = preemptor_info is not None and (
@@ -437,4 +440,45 @@ class CapacityScheduling:
             if not fits or over_quota:
                 remove(pv)
                 victims.append(pv)
+                return False
+            return True
+
+        by_prio = lambda p: (-p.spec.priority,  # noqa: E731
+                             p.metadata.creation_timestamp)
+        for pv in sorted(violating, key=by_prio):
+            if not reprieve(pv):
+                num_violating += 1
+        for pv in sorted(non_violating, key=by_prio):
+            reprieve(pv)
         return victims, num_violating, Status.ok()
+
+    def _split_pdb_violation(
+            self, pods: list[Pod], pdbs: list | None
+    ) -> tuple[list[Pod], list[Pod]]:
+        """filterPodsWithPDBViolation analog: a pod violates when any
+        matching budget has no disruptions left (prior same-walk victims
+        consume budget); otherwise it consumes one from each match."""
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, refresh_pdb_status,
+        )
+
+        if pdbs is None:
+            pdbs = []
+            if self._api is not None:
+                pdbs = [refresh_pdb_status(self._api, pdb)
+                        for pdb in self._api.list(
+                            KIND_POD_DISRUPTION_BUDGET)]
+        if not pdbs:
+            return [], list(pods)
+        allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
+        violating: list[Pod] = []
+        non_violating: list[Pod] = []
+        for pod in pods:
+            matched = [i for i, pdb in enumerate(pdbs) if pdb.matches(pod)]
+            if any(allowed[i] <= 0 for i in matched):
+                violating.append(pod)
+                continue
+            for i in matched:
+                allowed[i] -= 1
+            non_violating.append(pod)
+        return violating, non_violating
