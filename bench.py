@@ -118,8 +118,12 @@ def run_compute_bench() -> dict:
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_compute.py")],
             capture_output=True, text=True, timeout=900)
-        line = proc.stdout.strip().splitlines()[-1]
-        return json.loads(line)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"compute bench produced no output "
+                             f"(rc={proc.returncode}): "
+                             f"{proc.stderr.strip()[-500:]}"}
+        return json.loads(lines[-1])
     except Exception as e:  # noqa: BLE001 — bench must still print its line
         return {"error": f"compute bench failed: {e}"}
 

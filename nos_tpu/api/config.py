@@ -127,6 +127,14 @@ class AgentConfig(ManagerConfig):
 T = TypeVar("T")
 
 
+_FIELD_TYPES = {
+    "float": float, float: float,
+    "int": int, int: int,
+    "str": str, str: str,
+    "bool": bool, bool: bool,
+}
+
+
 def _coerce(cls: type, raw: dict[str, Any]):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(raw) - set(fields)
@@ -135,18 +143,41 @@ def _coerce(cls: type, raw: dict[str, Any]):
             f"unknown config key(s) for {cls.__name__}: {sorted(unknown)}")
     kwargs = {}
     for name, value in raw.items():
-        want = fields[name].type
+        if value is None:
+            # YAML bare key ("metrics_addr:") = unset → dataclass default.
+            continue
+        want = _FIELD_TYPES.get(fields[name].type)
         # YAML gives ints where floats are declared; that's fine.
-        if want in ("float", float) and isinstance(value, int) \
+        if want is float and isinstance(value, int) \
                 and not isinstance(value, bool):
             value = float(value)
+        if want is not None and not isinstance(value, want) or \
+                want in (int, float) and isinstance(value, bool):
+            raise ConfigError(
+                f"{cls.__name__}.{name} must be {want.__name__}, "
+                f"got {type(value).__name__} ({value!r})")
         kwargs[name] = value
     return cls(**kwargs)
 
 
-def load_config(path: str | pathlib.Path | None, cls: type[T]) -> T:
+def load_agent_config(path: str | pathlib.Path | None,
+                      node: str | None) -> "AgentConfig":
+    """AgentConfig load with the --node override applied BEFORE validation,
+    so a shared config file without node_name plus a per-node flag works
+    (the reference gets node identity from the downward API)."""
+    cfg = load_config(path, AgentConfig, validate=False)
+    if node:
+        cfg.node_name = node
+    cfg.validate()
+    return cfg
+
+
+def load_config(path: str | pathlib.Path | None, cls: type[T], *,
+                validate: bool = True) -> T:
     """Decode + validate a config file into `cls`; defaults when path is
-    None.  YAML when pyyaml is available, JSON otherwise."""
+    None.  YAML when pyyaml is available, JSON otherwise.  Pass
+    validate=False when the caller applies CLI overrides (e.g. --node)
+    before validating itself."""
     if path is None:
         cfg = cls()
     else:
@@ -163,5 +194,6 @@ def load_config(path: str | pathlib.Path | None, cls: type[T]) -> T:
             raise ConfigError(f"config root must be a mapping, "
                               f"got {type(raw).__name__}")
         cfg = _coerce(cls, raw)
-    cfg.validate()
+    if validate:
+        cfg.validate()
     return cfg

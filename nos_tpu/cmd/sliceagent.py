@@ -14,7 +14,7 @@ import argparse
 import logging
 import sys
 
-from nos_tpu.api.config import ConfigError, AgentConfig, load_config
+from nos_tpu.api.config import ConfigError, AgentConfig, load_agent_config
 from nos_tpu.cmd._runtime import Main
 from nos_tpu.kube.client import APIServer, KIND_NODE, NotFound
 
@@ -56,13 +56,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        if args.config or not args.node:
-            cfg = load_config(args.config, AgentConfig)
-        else:
-            cfg = AgentConfig(node_name=args.node)
-        if args.node:
-            cfg.node_name = args.node
-        cfg.validate()
+        cfg = load_agent_config(args.config, args.node)
     except ConfigError as e:
         print(f"invalid config: {e}", file=sys.stderr)
         return 2

@@ -188,18 +188,33 @@ def _native_pack_cached(block: Shape, key: tuple, occupied: int,
     return tuple(out)
 
 
+_pack_failed_keys: set[tuple] = set()
+
+
 def native_packer(block: Shape, key: tuple, occupied: int,
                   require_full: bool):
     """set_native_packer-compatible bridge to the C++ exact search
     (nos_pack in tpu_shim.cc).  Memoised with the same key discipline as
     the Python packer's cache; returns NotImplemented if the shim cannot
-    be loaded so the caller falls back to the Python search."""
+    be loaded so the caller falls back to the Python search.  Inputs the
+    shim cannot represent (blocks over 64 chips — its occupancy bitmask
+    limit, tpu_shim.cc nos_pack) are rejected up front, and failures are
+    latched per key so a hot-path caller neither re-enters the native
+    search nor re-logs the fallback warning."""
+    if block.chips > 64 or len(block.dims) > 3:
+        return NotImplemented
     if _load() is None:
+        return NotImplemented
+    full_key = (block, key, occupied, require_full)
+    if full_key in _pack_failed_keys:
         return NotImplemented
     try:
         return _native_pack_cached(block, key, occupied, require_full)
     except NativeSliceError as e:
         logger.warning("native packer failed (%s); falling back", e)
+        if len(_pack_failed_keys) >= 65536:  # same bound as the lru above
+            _pack_failed_keys.clear()
+        _pack_failed_keys.add(full_key)
         return NotImplemented
 
 

@@ -94,7 +94,8 @@ class Registry:
                     lines.append(f"# TYPE {name} {typ}")
                 label_s = ""
                 if labels:
-                    inner = ",".join(f'{k}="{val}"' for k, val in labels)
+                    inner = ",".join(
+                        f'{k}="{_escape_label(val)}"' for k, val in labels)
                     label_s = "{" + inner + "}"
                 lines.append(f"{name}{label_s} {v}")
         return "\n".join(lines) + "\n"
@@ -104,6 +105,13 @@ class Registry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+
+
+def _escape_label(val) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition is invalid."""
+    return str(val).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
 
 
 def _series(labels: tuple) -> str:

@@ -65,6 +65,44 @@ class TestConfig:
         cfg.known_geometries_file = str(f)
         cfg.validate()
 
+    def test_string_for_numeric_field_is_config_error(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("batch_timeout_s: 'two'\n")
+        with pytest.raises(ConfigError, match="must be float"):
+            load_config(p, PartitionerConfig)
+        p.write_text("tpu_memory_gb_per_chip: '32'\n")
+        with pytest.raises(ConfigError, match="must be int"):
+            load_config(p, SchedulerConfig)
+        p.write_text("leader_election: 'yes'\n")
+        with pytest.raises(ConfigError, match="must be bool"):
+            load_config(p, OperatorConfig)
+
+    def test_bool_for_numeric_field_is_config_error(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("tpu_memory_gb_per_chip: true\n")
+        with pytest.raises(ConfigError, match="must be int"):
+            load_config(p, SchedulerConfig)
+
+    def test_node_override_applies_before_validation(self, tmp_path):
+        # ADVICE r2: shared config file without node_name + per-node
+        # --node flag must not fail validation at load time.
+        from nos_tpu.api.config import load_agent_config
+
+        p = tmp_path / "agent.yaml"
+        p.write_text("report_interval_s: 5\n")
+        cfg = load_agent_config(p, "host-7")
+        assert cfg.node_name == "host-7"
+        assert cfg.report_interval_s == 5.0
+        with pytest.raises(ConfigError, match="node_name"):
+            load_agent_config(p, None)
+
+    def test_yaml_bare_key_means_default(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("metrics_addr:\nbatch_timeout_s: 3\n")
+        cfg = load_config(p, PartitionerConfig)
+        assert cfg.metrics_addr == ""
+        assert cfg.batch_timeout_s == 3.0
+
 
 class TestMetricsRegistry:
     def test_counter_gauge_timer_and_render(self):
@@ -82,6 +120,12 @@ class TestMetricsRegistry:
         assert "nos_test_op_seconds_count 1" in text
         snap = reg.snapshot()
         assert snap["nos_test_total"]["kind=slice"] == 3.0
+
+    def test_label_values_escaped(self):
+        reg = Registry()
+        reg.inc("nos_esc_total", labels={"v": 'a"b\\c\nd'})
+        text = reg.render()
+        assert 'v="a\\"b\\\\c\\nd"' in text
 
 
 class TestRunLoops:
