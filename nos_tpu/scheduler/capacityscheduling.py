@@ -289,12 +289,29 @@ class CapacityScheduling:
         if PRE_FILTER_STATE_KEY not in state:
             return "", Status.unschedulable("PreFilter was not run")
 
+        # PDB statuses are O(namespace pods) to refresh — compute once per
+        # PostFilter, not once per candidate node.
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, refresh_pdb_status,
+        )
+
+        pdbs: list = []
+        if self._api is not None:
+            pdbs = [refresh_pdb_status(self._api, pdb)
+                    for pdb in self._api.list(KIND_POD_DISRUPTION_BUDGET)]
+        # Gang membership is O(namespace pods) to list — resolve each gang
+        # once per PostFilter and share the cache across candidate nodes.
+        gang_cache: dict[tuple[str, str], list[Pod]] = {}
+
         candidates: list[tuple[str, list[Pod], int]] = []
         for ni in nodes.list():
             victims, num_violating, st = self._select_victims_on_node(
-                state, pod, ni)
+                state, pod, ni, pdbs, gang_cache)
             if st.is_success and victims:
-                candidates.append((ni.name, victims, num_violating))
+                # Score and account the TRUE eviction set: gang eviction
+                # amplifies cluster-wide, not just on this node.
+                full = self._expand_eviction(victims, gang_cache)
+                candidates.append((ni.name, full, num_violating))
         if not candidates:
             return "", Status.unschedulable("preemption found no candidates")
 
@@ -309,6 +326,19 @@ class CapacityScheduling:
         logger.info("preempting %d pod(s) on %s for %s",
                     len(victims), node_name, pod.key)
         return node_name, Status.ok()
+
+    def _expand_eviction(self, victims: list[Pod],
+                         gang_cache: dict | None = None) -> list[Pod]:
+        """Deduplicated cluster-wide eviction set for a victim list: every
+        gang-mate of a victim dies with it (evict_gang)."""
+        out: list[Pod] = []
+        seen: set[str] = set()
+        for v in victims:
+            for m in self._eviction_set(v, gang_cache):
+                if m.metadata.uid not in seen:
+                    seen.add(m.metadata.uid)
+                    out.append(m)
+        return out
 
     @staticmethod
     def _candidate_key(cand: tuple[str, list[Pod], int]):
@@ -328,7 +358,8 @@ class CapacityScheduling:
 
     def _select_victims_on_node(
             self, state: CycleState, pod: Pod, node_info: NodeInfo,
-            pdbs: list | None = None) -> tuple[list[Pod], int, Status]:
+            pdbs: list | None = None,
+            gang_cache: dict | None = None) -> tuple[list[Pod], int, Status]:
         """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675),
         run against clones so failed candidates leave no trace."""
         base_snapshot: ElasticQuotaInfos = state[ELASTIC_QUOTA_SNAPSHOT_KEY]
@@ -426,7 +457,7 @@ class CapacityScheduling:
         # walk, minimising PDB violations); victims that stay despite
         # violating a budget are counted for the node-choice tiebreak.
         violating, non_violating = self._split_pdb_violation(
-            potential, pdbs)
+            potential, pdbs, gang_cache)
         victims: list[Pod] = []
         num_violating = 0
 
@@ -450,14 +481,65 @@ class CapacityScheduling:
                 num_violating += 1
         for pv in sorted(non_violating, key=by_prio):
             reprieve(pv)
+
+        # Gang coherence: a reprieved candidate whose gang-mate stayed a
+        # victim dies anyway at eviction time (evict_gang is all-or-nothing)
+        # — fold it back into the victim set so the PDB-violation count and
+        # the node-choice key reflect the true eviction set.
+        from nos_tpu.scheduler.gang import gang_name
+
+        doomed_gangs = {(v.metadata.namespace, gang_name(v))
+                        for v in victims if gang_name(v)}
+        if doomed_gangs:
+            victim_uids = {v.metadata.uid for v in victims}
+            violating_uids = {p.metadata.uid for p in violating}
+            for pv in potential:
+                if pv.metadata.uid in victim_uids:
+                    continue
+                g = gang_name(pv)
+                if g and (pv.metadata.namespace, g) in doomed_gangs:
+                    remove(pv)
+                    victims.append(pv)
+                    victim_uids.add(pv.metadata.uid)
+                    if pv.metadata.uid in violating_uids:
+                        num_violating += 1
         return victims, num_violating, Status.ok()
 
+    def _eviction_set(self, victim: Pod,
+                      cache: dict | None = None) -> list[Pod]:
+        """The amplification set of evicting `victim`: gang eviction is
+        all-or-nothing (gang.evict_gang deletes every member), so the whole
+        group is disrupted, wherever its members run.  `cache` memoises the
+        O(namespace pods) membership list per (namespace, gang)."""
+        from nos_tpu.scheduler.gang import gang_name
+
+        g = gang_name(victim)
+        if not g or self._api is None:
+            return [victim]
+        key = (victim.metadata.namespace, g)
+        members = cache.get(key) if cache is not None else None
+        if members is None:
+            members = self._api.list(
+                KIND_POD, namespace=victim.metadata.namespace,
+                label_selector={C.LABEL_POD_GROUP: g})
+            if cache is not None:
+                cache[key] = members
+        if not any(m.metadata.uid == victim.metadata.uid for m in members):
+            members = [victim] + members
+        return members
+
     def _split_pdb_violation(
-            self, pods: list[Pod], pdbs: list | None
+            self, pods: list[Pod], pdbs: list | None,
+            gang_cache: dict | None = None
     ) -> tuple[list[Pod], list[Pod]]:
-        """filterPodsWithPDBViolation analog: a pod violates when any
-        matching budget has no disruptions left (prior same-walk victims
-        consume budget); otherwise it consumes one from each match."""
+        """filterPodsWithPDBViolation analog, gang-aware: evicting a gang
+        member evicts its whole group, so budget accounting charges every
+        RUNNING member of the candidate's eviction set — a candidate
+        violates when any matching budget lacks allowance for the full
+        amplification set, not just the candidate itself (prior same-walk
+        victims consume budget; a member already charged in this walk is
+        not re-charged).  Non-running members never consume budget, matching
+        the healthy-pod accounting of refresh_pdb_status."""
         from nos_tpu.api.pdb import (
             KIND_POD_DISRUPTION_BUDGET, refresh_pdb_status,
         )
@@ -471,14 +553,22 @@ class CapacityScheduling:
         if not pdbs:
             return [], list(pods)
         allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
+        charged: set[tuple[int, str]] = set()
         violating: list[Pod] = []
         non_violating: list[Pod] = []
         for pod in pods:
-            matched = [i for i, pdb in enumerate(pdbs) if pdb.matches(pod)]
-            if any(allowed[i] <= 0 for i in matched):
+            needed: dict[int, list[str]] = {}
+            for m in self._eviction_set(pod, gang_cache):
+                if m.status.phase != RUNNING:
+                    continue  # only healthy pods consume disruption budget
+                for i, pdb in enumerate(pdbs):
+                    if pdb.matches(m) and (i, m.metadata.uid) not in charged:
+                        needed.setdefault(i, []).append(m.metadata.uid)
+            if any(allowed[i] < len(uids) for i, uids in needed.items()):
                 violating.append(pod)
                 continue
-            for i in matched:
-                allowed[i] -= 1
+            for i, uids in needed.items():
+                allowed[i] -= len(uids)
+                charged.update((i, u) for u in uids)
             non_violating.append(pod)
         return violating, non_violating

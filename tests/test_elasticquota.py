@@ -307,6 +307,166 @@ class TestEndToEndSchedulingWithQuota:
         assert api.get(KIND_POD, "a-0", "ns-a").spec.node_name == ""
 
 
+class TestPDBGangPreemption:
+    """Gang eviction is all-or-nothing (evict_gang), so its amplification
+    set must be charged against PodDisruptionBudgets at victim-selection
+    time — not discovered at deletion time."""
+
+    @staticmethod
+    def _pdb(api, ns, selector, min_available):
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+
+        api.create(KIND_POD_DISRUPTION_BUDGET, PodDisruptionBudget(
+            metadata=ObjectMeta(name=f"pdb-{ns}", namespace=ns),
+            spec=PodDisruptionBudgetSpec(min_available=min_available,
+                                         selector=dict(selector))))
+
+    def test_split_counts_gang_amplification(self):
+        api, plugin, fw, sched = quota_cluster()
+        # 2-member gang; the PDB allows ONE disruption.  Evicting one
+        # member amplifies to both, so the candidate must be violating
+        # even though it alone is within budget.
+        for i, node in enumerate(["node-0", "node-1"]):
+            api.create(KIND_POD, make_pod(
+                name=f"g-{i}", namespace="work",
+                labels={C.LABEL_POD_GROUP: "job-g"},
+                resources={C.RESOURCE_TPU: 4}, node_name=node,
+                phase=RUNNING))
+        self._pdb(api, "work", {C.LABEL_POD_GROUP: "job-g"}, 1)
+        member = api.get(KIND_POD, "g-0", "work")
+        violating, non_violating = plugin._split_pdb_violation(
+            [member], None)
+        assert [p.metadata.name for p in violating] == ["g-0"]
+        assert non_violating == []
+
+    def test_split_charges_each_member_once(self):
+        api, plugin, fw, sched = quota_cluster()
+        for i, node in enumerate(["node-0", "node-1"]):
+            api.create(KIND_POD, make_pod(
+                name=f"g-{i}", namespace="work",
+                labels={C.LABEL_POD_GROUP: "job-g"},
+                resources={C.RESOURCE_TPU: 4}, node_name=node,
+                phase=RUNNING))
+        self._pdb(api, "work", {C.LABEL_POD_GROUP: "job-g"}, 0)  # allow 2
+        members = [api.get(KIND_POD, f"g-{i}", "work") for i in range(2)]
+        # Both members as candidates: the first charges the whole gang (2),
+        # the second is already fully charged — still non-violating.
+        violating, non_violating = plugin._split_pdb_violation(members, None)
+        assert violating == []
+        assert [p.metadata.name for p in non_violating] == ["g-0", "g-1"]
+
+    def test_pdb_protected_gang_survives_preemption(self):
+        """VERDICT r2 #5: a candidate whose gang-mates are PDB-protected is
+        marked violating, so the scheduler prefers a violation-free node —
+        the gang survives a preemption that previously killed it."""
+        api, plugin, fw, sched = quota_cluster(nodes=3, chips_per_node=8)
+        # node-0: a plain victim, HIGHER priority than the gang members —
+        # without PDB amplification the (cheaper) gang member would win.
+        api.create(KIND_POD, make_pod(
+            name="plain", namespace="work", priority=10,
+            resources={C.RESOURCE_TPU: 8}, node_name="node-0",
+            phase=RUNNING))
+        # node-1/node-2: a 2-member gang, priority 0.
+        for i in (1, 2):
+            api.create(KIND_POD, make_pod(
+                name=f"g-{i}", namespace="work", priority=0,
+                labels={C.LABEL_POD_GROUP: "job-g"},
+                resources={C.RESOURCE_TPU: 8}, node_name=f"node-{i}",
+                phase=RUNNING))
+        # Budget tolerates one gang disruption — but eviction would take 2.
+        self._pdb(api, "work", {C.LABEL_POD_GROUP: "job-g"}, 1)
+
+        api.create(KIND_POD, make_pod(
+            name="pre", namespace="work", priority=100,
+            resources={C.RESOURCE_TPU: 8}))
+        sched.run_cycle()
+        # The plain pod was evicted; the PDB-protected gang survived.
+        assert api.try_get(KIND_POD, "plain", "work") is None
+        assert api.try_get(KIND_POD, "g-1", "work") is not None
+        assert api.try_get(KIND_POD, "g-2", "work") is not None
+        assert api.get(KIND_POD, "pre", "work") \
+            .status.nominated_node_name == "node-0"
+
+    def test_pending_gang_member_consumes_no_budget(self):
+        """Only RUNNING (healthy) members consume disruption budget —
+        matching refresh_pdb_status's healthy accounting."""
+        api, plugin, fw, sched = quota_cluster()
+        api.create(KIND_POD, make_pod(
+            name="g-0", namespace="work",
+            labels={C.LABEL_POD_GROUP: "job-g"},
+            resources={C.RESOURCE_TPU: 4}, node_name="node-0",
+            phase=RUNNING))
+        api.create(KIND_POD, make_pod(
+            name="g-1", namespace="work",
+            labels={C.LABEL_POD_GROUP: "job-g"},
+            resources={C.RESOURCE_TPU: 4}))  # pending, unbound
+        self._pdb(api, "work", {C.LABEL_POD_GROUP: "job-g"}, 0)
+        # healthy=1, allowed=1: the running member alone is within budget;
+        # the pending mate must not inflate the charge to 2.
+        member = api.get(KIND_POD, "g-0", "work")
+        violating, non_violating = plugin._split_pdb_violation(
+            [member], None)
+        assert violating == []
+        assert [p.metadata.name for p in non_violating] == ["g-0"]
+
+    def test_cross_node_gang_amplification_in_scoring(self):
+        """The fewest-victims tiebreak must see the cluster-wide eviction
+        set: one on-node gang member whose mates span other nodes is a
+        3-pod eviction, not a 1-pod one."""
+        api, plugin, fw, sched = quota_cluster(nodes=4, chips_per_node=8)
+        for i in range(2):
+            api.create(KIND_POD, make_pod(
+                name=f"plain-{i}", namespace="work", priority=0,
+                resources={C.RESOURCE_TPU: 4}, node_name="node-0",
+                phase=RUNNING))
+        for i in (1, 2, 3):
+            api.create(KIND_POD, make_pod(
+                name=f"g-{i}", namespace="work", priority=0,
+                labels={C.LABEL_POD_GROUP: "job-g"},
+                resources={C.RESOURCE_TPU: 8}, node_name=f"node-{i}",
+                phase=RUNNING))
+        api.create(KIND_POD, make_pod(
+            name="pre", namespace="work", priority=100,
+            resources={C.RESOURCE_TPU: 8}))
+        sched.run_cycle()
+        # Evicting two plain pods beats evicting a 3-member gang.
+        assert api.try_get(KIND_POD, "plain-0", "work") is None
+        assert api.try_get(KIND_POD, "plain-1", "work") is None
+        for i in (1, 2, 3):
+            assert api.try_get(KIND_POD, f"g-{i}", "work") is not None
+
+    def test_gang_coherent_victim_accounting(self):
+        """A reprieved candidate whose gang-mate stays a victim dies anyway
+        at eviction — it must be folded back into the victim set so the
+        accounting matches what evict_gang actually deletes."""
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        api, plugin, fw, sched = quota_cluster(nodes=1, chips_per_node=8)
+        # Two same-gang members on one node; preemptor needs only 4 chips,
+        # so the reprieve pass would keep one member — but gang eviction
+        # takes both.
+        for i, prio in enumerate([0, 5]):
+            api.create(KIND_POD, make_pod(
+                name=f"g-{i}", namespace="work", priority=prio,
+                labels={C.LABEL_POD_GROUP: "job-g"},
+                resources={C.RESOURCE_TPU: 4}, node_name="node-0",
+                phase=RUNNING))
+        before = REGISTRY.snapshot().get(
+            "nos_tpu_preemption_victims_total", {}).get("", 0)
+        api.create(KIND_POD, make_pod(
+            name="pre", namespace="work", priority=100,
+            resources={C.RESOURCE_TPU: 4}))
+        sched.run_cycle()
+        assert api.try_get(KIND_POD, "g-0", "work") is None
+        assert api.try_get(KIND_POD, "g-1", "work") is None
+        after = REGISTRY.snapshot().get(
+            "nos_tpu_preemption_victims_total", {}).get("", 0)
+        assert after - before == 2  # both members accounted, not one
+
+
 # ---------------------------------------------------------------------------
 # Reconcilers
 # ---------------------------------------------------------------------------
