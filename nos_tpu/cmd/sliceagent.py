@@ -26,7 +26,15 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
     from nos_tpu.device.fake import FakePodResources
     from nos_tpu.topology import DEFAULT_REGISTRY
 
-    generation = DEFAULT_REGISTRY.get(cfg.generation)
+    if cfg.generation == "auto":
+        # discover the topology from the hardware (PJRT / Cloud TPU env)
+        # instead of asserting it — nos_tpu/device/discovery.py
+        runtime = default_tpu_runtime(None)
+        generation_name, _ = runtime.topology()
+        generation = DEFAULT_REGISTRY.get(generation_name)
+    else:
+        generation = DEFAULT_REGISTRY.get(cfg.generation)
+        runtime = default_tpu_runtime(generation)
     try:
         api.get(KIND_NODE, cfg.node_name)
     except NotFound:
@@ -38,8 +46,7 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
                                             generation=generation))
     main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
                         cfg.health_probe_addr)
-    agent = SliceAgent(api, cfg.node_name, default_tpu_runtime(generation),
-                       FakePodResources())
+    agent = SliceAgent(api, cfg.node_name, runtime, FakePodResources())
     agent.start()  # startup cleanup + first report (migagent.go:190-199)
     main.add_loop("sliceagent", agent.tick, cfg.report_interval_s)
     return main

@@ -10,15 +10,26 @@ from .tpuclient import PodResourcesClient, SliceDeviceClient, TpuRuntimeClient
 
 
 def default_tpu_runtime(generation=None) -> TpuRuntimeClient:
-    from nos_tpu.topology import V5E
-
-    generation = generation or V5E
+    """generation=None means *discover* the topology (PJRT device
+    attributes / Cloud TPU env metadata, falling back to configured v5e
+    off-TPU) — see nos_tpu/device/discovery.py."""
     from . import native
 
     if native.available():
         return native.NativeTpuRuntime(generation)
     from .fake import FakeTpuRuntime
 
+    if generation is None:
+        import dataclasses
+
+        from . import discovery
+
+        disc = discovery.discover()
+        # Preserve the *observed* host block, not the generation's default
+        # — otherwise a 4-chip VM would advertise the full 8-chip block
+        # and the partitioner could carve nonexistent hardware.
+        generation = dataclasses.replace(
+            disc.generation, host_block=disc.host_block)
     return FakeTpuRuntime(generation)
 
 

@@ -233,21 +233,37 @@ class NativeSliceError(Exception):
 
 
 class NativeTpuRuntime(TpuRuntimeClient):
-    """TpuRuntimeClient backed by the C++ shim."""
+    """TpuRuntimeClient backed by the C++ shim.
 
-    def __init__(self, generation: Generation = V5E) -> None:
+    With generation=None the runtime *discovers* its topology (PJRT device
+    attributes / Cloud TPU env metadata — nos_tpu/device/discovery.py, the
+    NVML-enumeration analog of reference pkg/gpu/nvml/client.go:31-518)
+    instead of asserting it, and the device table is sized to the observed
+    host block, so carved slices name real chips.  Passing a Generation
+    keeps the asserted behavior (off-TPU control-plane and tests)."""
+
+    def __init__(self, generation: Generation | None = V5E) -> None:
         lib = _load()
         if lib is None:
             raise RuntimeError(
                 "native shim unavailable (g++ build failed?) — use "
                 "FakeTpuRuntime or check nos_tpu/native")
         self._lib = lib
-        self._gen = generation
-        dims = list(generation.host_block.dims) + [1] * (
-            3 - len(generation.host_block.dims))
+        if generation is None:
+            from . import discovery
+
+            self._disc = discovery.discover()
+            self._gen = self._disc.generation
+            self._host_block = self._disc.host_block
+        else:
+            self._disc = None
+            self._gen = generation
+            self._host_block = generation.host_block
+        dims = list(self._host_block.dims) + [1] * (
+            3 - len(self._host_block.dims))
         arr = (ctypes.c_int * 3)(*dims)
         self._h = lib.nos_runtime_new(
-            generation.name.encode(), arr, len(generation.host_block.dims))
+            self._gen.name.encode(), arr, len(self._host_block.dims))
         if not self._h:
             raise RuntimeError("nos_runtime_new failed")
 
@@ -259,7 +275,19 @@ class NativeTpuRuntime(TpuRuntimeClient):
 
     # -- TpuRuntimeClient ---------------------------------------------------
     def topology(self) -> tuple[str, Shape]:
-        return self._gen.name, self._gen.host_block
+        return self._gen.name, self._host_block
+
+    @property
+    def topology_source(self) -> str:
+        """How the topology was learned: "device" (PJRT), "env" (Cloud TPU
+        VM metadata), or "configured" (asserted by the constructor)."""
+        from . import discovery
+
+        return self._disc.source if self._disc else discovery.SOURCE_CONFIGURED
+
+    @property
+    def discovered(self):
+        return self._disc
 
     def _parse_list(self) -> list[tuple[str, int, Shape, bool, Placement]]:
         buf = ctypes.create_string_buffer(_OUT_CAP)

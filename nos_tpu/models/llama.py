@@ -25,6 +25,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import ad_checkpoint
 from jax.sharding import Mesh
 
 from nos_tpu.ops.attention import flash_attention, repeat_kv
@@ -47,6 +48,10 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     attn_impl: str = "dense"      # "dense" | "flash" | "ring"
     remat: bool = True
+    # What the backward may keep instead of recomputing ("nothing" = full
+    # remat; "attn" saves the attention op's output so the flash kernel is
+    # never re-run in backward; "dots" saves all non-batch matmul outputs).
+    remat_policy: str = "nothing"
     scan_layers: bool = True
 
 
@@ -71,6 +76,15 @@ BENCH_350M = LlamaConfig(
     num_layers=24, num_heads=8, num_kv_heads=4, head_dim=128,
     max_seq_len=2048,
 )
+
+
+# Lazy thunks: checkpoint_policies lookups stay cheap at import time and
+# save_only_these_names constructs a fresh policy per model build.
+_REMAT_POLICIES = {
+    "nothing": lambda: jax.checkpoint_policies.nothing_saveable,
+    "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "attn": lambda: jax.checkpoint_policies.save_only_these_names("attn_out"),
+}
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -133,6 +147,10 @@ class Attention(nn.Module):
             out = dense_attention(q, k, v, causal=True)
         out = nn.with_logical_constraint(
             out, ("batch", "seq", "heads", "head_dim"))
+        # Named so remat_policy="attn" can save exactly this tensor:
+        # recomputing the O(S^2) attention op in backward is the one remat
+        # expense the analytic MFU never credits.
+        out = ad_checkpoint.checkpoint_name(out, "attn_out")
         proj = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="o_proj",
@@ -197,7 +215,7 @@ class Llama(nn.Module):
         if cfg.remat:
             block = nn.remat(
                 Block, prevent_cse=not cfg.scan_layers,
-                policy=jax.checkpoint_policies.nothing_saveable)
+                policy=_REMAT_POLICIES[cfg.remat_policy]())
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions), None),
@@ -211,10 +229,13 @@ class Llama(nn.Module):
                 x = block(cfg, self.mesh, name=f"layer_{i}")(x, positions)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        # Tied embeddings.  The matmul runs in the activation dtype (bf16
+        # on the MXU) with fp32 accumulation — upcasting the inputs would
+        # force fp32 multiplies at a fraction of peak for ~9% of the
+        # model's FLOPs; the loss softmax downstream is fp32 regardless.
         logits = jnp.einsum(
-            "bse,ve->bsv", x.astype(jnp.float32),
-            embed.astype(jnp.float32),
-            preferred_element_type=jnp.float32)  # tied embeddings
+            "bse,ve->bsv", x, embed.astype(cfg.dtype),
+            preferred_element_type=jnp.float32)
         return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
 
     def param_count(self) -> int:

@@ -317,8 +317,7 @@ class CapacityScheduling:
 
         best = min(candidates, key=self._candidate_key)
         node_name, victims, _ = best
-        for v in victims:
-            self._evict(v)
+        self._evict_all(victims)
         from nos_tpu.exporter.metrics import REGISTRY
 
         REGISTRY.inc("nos_tpu_preemptions_total")
@@ -350,11 +349,23 @@ class CapacityScheduling:
         return (num_violating, max(priorities), sum(priorities),
                 len(victims), name)
 
-    def _evict(self, victim: Pod) -> None:
+    def _evict_all(self, victims: list[Pod]) -> None:
+        """Evict each gang once: the victim list is already gang-expanded
+        (_expand_eviction), and evict_gang deletes every member of a
+        victim's group, so per-member calls would re-list and re-delete
+        each gang N times."""
         if self._api is None:
             return
-        from nos_tpu.scheduler.gang import evict_gang
-        evict_gang(self._api, victim)
+        from nos_tpu.scheduler.gang import evict_gang, gang_name
+        evicted_gangs: set[tuple[str, str]] = set()
+        for v in victims:
+            gang = gang_name(v)
+            if gang:
+                key = (v.metadata.namespace, gang)
+                if key in evicted_gangs:
+                    continue
+                evicted_gangs.add(key)
+            evict_gang(self._api, v)
 
     def _select_victims_on_node(
             self, state: CycleState, pod: Pod, node_info: NodeInfo,

@@ -1,0 +1,95 @@
+"""Sweep flash-attention block sizes on real hardware.
+
+Times the pallas forward and forward+backward at the training shapes for a
+grid of (block_q, block_k), using bench_compute's chained-iteration slope
+methodology (the tunneled platform hides completion behind an RTT — the
+slope of wall time vs chained iterations cancels it).
+
+    python scripts/sweep_attention.py
+
+Output: one line per config with fwd/bwd ms and TFLOP/s; the winner feeds
+the defaults in nos_tpu/ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from bench_compute import _slope  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops.attention import flash_attention
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+
+    B, S, H, D = 8, 2048, 8, 128  # the BENCH_350M training shapes
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    fwd_flops = 4 * B * H * S * S * D * 0.5          # causal
+    bwd_flops = 2.5 * fwd_flops                      # dq + dkv kernels
+
+    results = []
+    for bq, bk in itertools.product([128, 256, 512, 1024],
+                                    [128, 256, 512, 1024]):
+        if S % bq or S % bk:
+            continue
+
+        def make_fwd(iters, bq=bq, bk=bk):
+            @jax.jit
+            def run(q, k, v):
+                def body(i, acc):
+                    return flash_attention(acc, k, v, True, bq, bk)
+                return jax.lax.fori_loop(0, iters, body, q)[0, 0, 0, 0]
+            return lambda: float(run(q, k, v))
+
+        def make_bwd(iters, bq=bq, bk=bk):
+            def loss(q):
+                return jnp.sum(
+                    flash_attention(q, k, v, True, bq, bk)
+                    .astype(jnp.float32) ** 2)
+
+            @jax.jit
+            def run(q, k, v):
+                def body(i, acc):
+                    return jax.grad(loss)(acc)
+                return jax.lax.fori_loop(0, iters, body, q)[0, 0, 0, 0]
+            return lambda: float(run(q, k, v))
+
+        try:
+            t_fwd = _slope(make_fwd)
+            t_tot = _slope(make_bwd, target_total_s=1.2)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            results.append({"block_q": bq, "block_k": bk, "error": str(e)[:120]})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        t_bwd = max(t_tot - t_fwd, 1e-9)
+        results.append({
+            "block_q": bq, "block_k": bk,
+            "fwd_ms": round(t_fwd * 1e3, 3),
+            "fwd_tflops": round(fwd_flops / t_fwd / 1e12, 1),
+            "fwdbwd_ms": round(t_tot * 1e3, 3),
+            "bwd_ms": round(t_bwd * 1e3, 3),
+            "bwd_tflops": round(bwd_flops / t_bwd / 1e12, 1),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    ok = [r for r in results if "error" not in r]
+    if ok:
+        best_f = min(ok, key=lambda r: r["fwd_ms"])
+        best_t = min(ok, key=lambda r: r["fwdbwd_ms"])
+        print(json.dumps({"best_fwd": best_f, "best_fwdbwd": best_t}))
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,22 @@ class TestFlashAttention:
             scale = float(jnp.max(jnp.abs(want))) + 1e-9
             assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
 
+    def test_causal_rectangle_takes_fallback(self):
+        """Decode-style causal shapes (seq_q < seq_k over cached keys)
+        need bottom-right mask alignment; the kernel's mask is top-left
+        aligned, so _plan must route them to the XLA fallback."""
+        from nos_tpu.ops.attention import _plan
+
+        key = jax.random.PRNGKey(3)
+        q = jax.random.normal(key, (1, 1, 2, 128), jnp.float32)
+        k, v = (jax.random.normal(kk, (1, 256, 2, 128), jnp.float32)
+                for kk in jax.random.split(key, 2))
+        assert _plan(q, k, True, 128, 128) is None
+        assert _plan(q, k, False, 128, 128) is not None
+        out = flash_attention(q, k, v, True, 128, 128, True)
+        ref = dense_attention(q, k, v, True)
+        assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
     def test_repeat_kv(self):
         x = jnp.arange(2 * 4 * 2 * 3, dtype=jnp.float32).reshape(2, 4, 2, 3)
         y = repeat_kv(x, 2)

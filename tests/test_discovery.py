@@ -1,0 +1,133 @@
+"""Topology discovery tests (nos_tpu/device/discovery.py).
+
+The NVML-enumeration analog (reference pkg/gpu/nvml/client.go:31-518) must
+attribute generations from PJRT device kinds and Cloud TPU env metadata,
+and fall back to the configured generation off-TPU.  The real-hardware
+closure of the loop lives in tests/test_e2e_device.py.
+"""
+
+from nos_tpu.device import discovery
+from nos_tpu.topology import Shape, V4, V5E, V5P
+
+
+class TestKindAttribution:
+    def test_v5e_lite(self):
+        assert discovery._match("TPU v5 lite", discovery._KIND_PATTERNS) is V5E
+
+    def test_v5p(self):
+        assert discovery._match("TPU v5p", discovery._KIND_PATTERNS) is V5P
+
+    def test_plain_v5_is_v5p(self):
+        assert discovery._match("TPU v5", discovery._KIND_PATTERNS) is V5P
+
+    def test_v4(self):
+        assert discovery._match("TPU v4", discovery._KIND_PATTERNS) is V4
+
+    def test_unknown(self):
+        assert discovery._match("TPU v99", discovery._KIND_PATTERNS) is None
+
+
+class TestBoundingBlock:
+    def test_single_chip_3d_coords_clipped_to_2d(self):
+        block, origin = discovery._bounding_block([(0, 0, 0)], 2)
+        assert block == Shape((1, 1))
+        assert origin == (0, 0)
+
+    def test_full_v5e_host(self):
+        coords = [(x, y, 0) for x in range(2) for y in range(4)]
+        block, origin = discovery._bounding_block(coords, 2)
+        assert block == Shape((2, 4))
+        assert origin == (0, 0)
+
+    def test_offset_origin(self):
+        coords = [(4, 4, 0), (4, 5, 0), (5, 4, 0), (5, 5, 0)]
+        block, origin = discovery._bounding_block(coords, 2)
+        assert block == Shape((2, 2))
+        assert origin == (4, 4)
+
+    def test_v4_keeps_three_dims(self):
+        coords = [(0, 0, 0), (0, 1, 0), (0, 0, 1), (0, 1, 1)]
+        block, origin = discovery._bounding_block(coords, 3)
+        assert block == Shape((1, 2, 2))
+        assert origin == (0, 0, 0)
+
+
+class TestEnvDiscovery:
+    def test_single_worker_uses_advertised_topology(self):
+        env = {"TPU_ACCELERATOR_TYPE": "v5litepod-8",
+               "TPU_TOPOLOGY": "2x4",
+               "TPU_WORKER_HOSTNAMES": "localhost"}
+        d = discovery._discover_from_env(env)
+        assert d.generation is V5E
+        assert d.host_block == Shape((2, 4))
+        assert d.num_hosts == 1
+        assert d.source == discovery.SOURCE_ENV
+        assert d.accelerator_type == "v5litepod-8"
+
+    def test_multi_worker_falls_back_to_generation_host_block(self):
+        env = {"TPU_ACCELERATOR_TYPE": "v5litepod-16",
+               "TPU_TOPOLOGY": "4x4",
+               "TPU_WORKER_HOSTNAMES": "h0,h1"}
+        d = discovery._discover_from_env(env)
+        assert d.num_hosts == 2
+        assert d.host_block == V5E.host_block  # 4x4 spans hosts, not local
+
+    def test_v4(self):
+        d = discovery._discover_from_env({"TPU_ACCELERATOR_TYPE": "v4-8"})
+        assert d.generation is V4
+
+    def test_v5p(self):
+        d = discovery._discover_from_env({"TPU_ACCELERATOR_TYPE": "v5p-16"})
+        assert d.generation is V5P
+
+    def test_unknown_type(self):
+        assert discovery._discover_from_env(
+            {"TPU_ACCELERATOR_TYPE": "v99-8"}) is None
+
+    def test_absent(self):
+        assert discovery._discover_from_env({}) is None
+
+    def test_bad_topology_string_tolerated(self):
+        d = discovery._discover_from_env(
+            {"TPU_ACCELERATOR_TYPE": "v5litepod-8", "TPU_TOPOLOGY": "zzz"})
+        assert d.host_block == V5E.host_block
+
+
+class TestDiscoverFallback:
+    def test_configured_fallback_with_empty_env(self):
+        d = discovery.discover(configured=V4, allow_jax=False, environ={})
+        assert d.generation is V4
+        assert d.host_block == V4.host_block
+        assert d.source == discovery.SOURCE_CONFIGURED
+
+    def test_default_configured_is_v5e(self):
+        d = discovery.discover(allow_jax=False, environ={})
+        assert d.generation is V5E
+
+    def test_env_beats_configured(self):
+        d = discovery.discover(
+            configured=V4, allow_jax=False,
+            environ={"TPU_ACCELERATOR_TYPE": "v5litepod-4"})
+        assert d.generation is V5E
+        assert d.source == discovery.SOURCE_ENV
+
+
+class TestFakeFallbackTopology:
+    def test_fake_runtime_keeps_observed_host_block(self, monkeypatch):
+        """default_tpu_runtime(None) with the native shim unavailable must
+        advertise the discovered block, not the generation default."""
+        from nos_tpu import device as device_pkg
+        from nos_tpu.device import fake, native
+
+        monkeypatch.setattr(native, "available", lambda build=True: False)
+        observed = discovery.DiscoveredTopology(
+            generation=V5E, host_block=Shape((2, 2)), num_local_chips=4,
+            num_hosts=1, source=discovery.SOURCE_ENV,
+            accelerator_type="v5litepod-4", origin=(0, 0))
+        monkeypatch.setattr(discovery, "discover",
+                            lambda *a, **k: observed)
+        rt = device_pkg.default_tpu_runtime(None)
+        assert isinstance(rt, fake.FakeTpuRuntime)
+        name, block = rt.topology()
+        assert name == "tpu-v5e"
+        assert block == Shape((2, 2))

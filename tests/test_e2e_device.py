@@ -1,0 +1,77 @@
+"""End-to-end native boundary on real hardware (round-2 VERDICT item #1).
+
+Closes the loop the reference closes with NVML (pkg/gpu/nvml/client.go:
+create a MIG device, kubelet hands it to a pod, CUDA runs on it): discover
+the topology from the device (PJRT attributes), carve a slice from the
+*observed* block through the native C++ shim, map the carved placement back
+to a live PJRT device at those physical coordinates, and run a JAX program
+on exactly that chip.
+
+Skipped off-TPU: run with NOS_TPU_TEST_REAL=1 on a TPU host.  The observed
+block may be smaller than a full v5e host (a tunnel can expose one chip);
+the test carves the largest shape that fits whatever was observed.
+"""
+
+import pytest
+
+from nos_tpu.device import discovery
+
+
+def _on_real_tpu() -> bool:
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.local_devices())
+    except Exception:
+        return False
+
+
+requires_tpu = pytest.mark.skipif(
+    not _on_real_tpu(),
+    reason="no real TPU visible (set NOS_TPU_TEST_REAL=1 on a TPU host)")
+
+
+@requires_tpu
+def test_discovery_observes_device():
+    d = discovery.discover()
+    assert d.source == discovery.SOURCE_DEVICE
+    assert d.num_local_chips >= 1
+    assert d.accelerator_type  # a real device_kind string
+    assert len(d.chip_coords) == d.num_local_chips
+
+
+@requires_tpu
+def test_carve_slice_and_run_jax_on_it():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.device import native
+
+    if not native.available():
+        pytest.skip("native shim did not build")
+    rt = native.NativeTpuRuntime(None)  # discover, don't assert
+    assert rt.topology_source == discovery.SOURCE_DEVICE
+    _, block = rt.topology()
+    disc = rt.discovered
+    assert block.chips == disc.num_local_chips
+
+    fitting = [s for s in disc.generation.subhost_shapes()
+               if s.fits_in(block)]
+    if not fitting:  # observed block smaller than any profile: carve it all
+        fitting = [block.canonical()]
+    target = max(fitting, key=lambda s: s.chips)
+
+    ids = rt.create_slices(0, [target])
+    assert len(ids) == 1
+    try:
+        placement = rt.placements()[ids[0]]
+        dev = disc.jax_device_for(placement.offset)
+        assert dev.platform == "tpu"
+
+        x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16), dev)
+        y = jax.jit(lambda a: jnp.sum(a @ a))(x)
+        assert list(y.devices()) == [dev]
+        assert float(y) == pytest.approx(256.0 * 256 * 256, rel=1e-2)
+    finally:
+        rt.delete_slice(ids[0])
+    assert ids[0] not in rt.placements()
