@@ -117,7 +117,7 @@ def run_compute_bench() -> dict:
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_compute.py")],
-            capture_output=True, text=True, timeout=900)
+            capture_output=True, text=True, timeout=1800)
         lines = proc.stdout.strip().splitlines()
         if not lines:
             return {"error": f"compute bench produced no output "
@@ -178,9 +178,9 @@ def run_packer_microbench(rounds: int = 30) -> dict:
 
 def run_utilization_bench() -> dict:
     try:
-        from bench_utilization import Sim
+        from bench_utilization import run_seeds
 
-        return Sim().run()
+        return run_seeds()
     except Exception as e:  # noqa: BLE001 — headline line must still print
         return {"error": f"utilization bench failed: {e}"}
 

@@ -2,17 +2,24 @@
 
 Measures, on the real chip (skipped off-TPU):
 
-- Llama BENCH_350M (flash attention) forward+backward+optimizer step:
-  step time, tokens/s, and MFU vs the v5e bf16 peak (~197 TFLOP/s/chip).
-- flash vs dense attention forward time at the model's shapes.
+- Llama BENCH_350M (flash attention, "mats" selective remat, unrolled
+  layers) forward+backward+optimizer step: step time, tokens/s, MFU vs
+  the v5e bf16 peak (~197 TFLOP/s/chip), plus a step breakdown
+  (forward / backward / optimizer) so a missing percent has an address.
+- flash attention forward AND backward kernel times vs the dense XLA
+  path at the model's shapes (backward grads flow to q, k and v so
+  neither backward kernel can be dead-code-eliminated).
+- the chip's in-session matmul roofline (big bf16 matmul chain) — the
+  achievable ceiling the kernel percentages are judged against.
+- how this host's topology was learned (`topology_source`:
+  device/env/configured — nos_tpu/device/discovery.py).
 
 Timing methodology: the 'axon' tunneled platform does not block in
 `block_until_ready` (device work completes asynchronously behind the
 tunnel), so each measurement chains N iterations data-dependently inside a
 single jit (lax.fori_loop) and fetches a scalar to force completion; the
-per-iteration time is the least-squares slope over several N, which
-cancels the ~100 ms tunnel round-trip (intercept) exactly.  R^2 is checked
-so a noisy fit fails loudly rather than producing a fantasy number.
+per-iteration time is the slope between a small and a large N over
+min-of-reps, which cancels the ~100 ms tunnel round-trip exactly.
 
 Prints one JSON object with all metrics; bench.py merges it into the
 driver's single benchmark line.
@@ -24,57 +31,32 @@ import dataclasses
 import json
 import time
 
-import numpy as np
-
 # v5e: 197 bf16 TFLOP/s per chip (public Cloud TPU spec).
-PEAK_TFLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5": 197e12}
+PEAK_TFLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
+               "v5": 197e12}
 DEFAULT_PEAK = 197e12
 
 BATCH = 8
 SEQ = 2048
 
 
-def _fit(pts):
-    xs = np.array([p[0] for p in pts], dtype=np.float64)
-    ys = np.array([p[1] for p in pts], dtype=np.float64)
-    a = np.vstack([xs, np.ones_like(xs)]).T
-    coef, *_ = np.linalg.lstsq(a, ys, rcond=None)
-    pred = a @ coef
-    ss_res = float(((ys - pred) ** 2).sum())
-    ss_tot = float(((ys - ys.mean()) ** 2).sum()) or 1e-12
-    return float(coef[0]), 1.0 - ss_res / ss_tot
-
-
-def _slope(fn_maker, reps=2, min_r2=0.98, target_total_s=0.8):
-    """Per-iteration device time = least-squares slope of wall time vs
-    chained iteration count (the tunnel RTT is the intercept).  Iteration
-    counts adapt to the workload so the largest run stays ~target_total_s
-    (very long fetches trip tunnel hiccups and wreck the fit)."""
-    r1, r9 = fn_maker(1), fn_maker(9)
-    r1(), r9()  # compile + warm
-    t1 = min(_t(r1) for _ in range(2))
-    t9 = min(_t(r9) for _ in range(2))
-    est = max((t9 - t1) / 8, 1e-5)
-    n_max = int(min(max(target_total_s / est, 16), 400))
-    ns = sorted({1, n_max // 4, n_max // 2, n_max})
-    runs = {n: fn_maker(n) for n in ns}
-    for n in ns:
-        runs[n]()
-    for _ in range(2):  # one retry on a noisy fit
-        pts = []
-        for _ in range(reps):
-            for n in ns:
-                pts.append((n, _t(runs[n])))
-        slope, r2 = _fit(pts)
-        if r2 >= min_r2:
-            return slope
-    raise RuntimeError(f"noisy timing fit (R^2={r2:.4f})")
-
-
 def _t(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+def _slope(fn_maker, n1=20, n2=80, reps=5):
+    """Per-iteration device time = (t[n2] - t[n1]) / (n2 - n1) over
+    min-of-reps wall times (the tunnel RTT cancels in the difference;
+    min filters tunnel jitter)."""
+    fa, fb = fn_maker(n1), fn_maker(n2)
+    fa(), fb()  # compile + warm
+    tsa, tsb = [], []
+    for _ in range(reps):
+        tsa.append(_t(fa))
+        tsb.append(_t(fb))
+    return (min(tsb) - min(tsa)) / (n2 - n1)
 
 
 def model_flops_per_step(cfg, batch, seq) -> float:
@@ -96,14 +78,35 @@ def model_flops_per_step(cfg, batch, seq) -> float:
     return float(matmul + attn)
 
 
-def bench_attention(jax, jnp, flash_attention, dense_attention):
-    B, S, H, D = 4, SEQ, 8, 128
+def bench_matmul_roofline(jax, jnp) -> dict:
+    """Big bf16 matmul chain: the in-session achievable MXU ceiling."""
+    n = 8192
+    x = jax.random.normal(jax.random.PRNGKey(9), (n, n), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(8), (n, n), jnp.bfloat16)
+
+    def make(iters):
+        @jax.jit
+        def run(x):
+            def body(i, acc):
+                y = jnp.dot(acc, w, preferred_element_type=jnp.float32)
+                return (y * (1.0 / n)).astype(jnp.bfloat16)
+            return jax.lax.fori_loop(0, iters, body, x)[0, 0]
+        return lambda: float(run(x))
+
+    t = _slope(make, n1=10, n2=40, reps=3)
+    return {"matmul_roofline_tflops": round(2 * n ** 3 / t / 1e12, 1)}
+
+
+def bench_attention(jax, jnp, flash_attention, dense_attention, peak):
+    B, S, H, D = BATCH, SEQ, 8, 128
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
                for kk in jax.random.split(key, 3))
-    flops = 4 * B * H * S * S * D * 0.5
+    fwd_flops = 4 * B * H * S * S * D * 0.5      # causal
+    # dq kernel: 3 dots, dkv kernel: 4 dots, vs the forward's 2.
+    bwd_flops = 3.5 * fwd_flops
 
-    def maker(attn):
+    def fwd_maker(attn):
         def make(iters):
             @jax.jit
             def run(q, k, v):
@@ -112,22 +115,53 @@ def bench_attention(jax, jnp, flash_attention, dense_attention):
             return lambda: float(run(q, k, v))
         return make
 
-    t_flash = _slope(maker(lambda q, k, v: flash_attention(q, k, v, True)))
-    t_dense = _slope(maker(lambda q, k, v: dense_attention(q, k, v, True)))
+    def grad_maker(attn):
+        def loss(qq, kk2, vv):
+            return jnp.sum(attn(qq, kk2, vv).astype(jnp.float32) ** 2)
+
+        def gstep(qx):
+            gq, gk, gv = jax.grad(loss, (0, 1, 2))(qx, k, v)
+            return gq + gk + gv  # all three kernels stay live
+
+        def make(iters):
+            @jax.jit
+            def run(q, k, v):
+                return jax.lax.fori_loop(
+                    0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
+            return lambda: float(run(q, k, v))
+        return make
+
+    flash = lambda q, k, v: flash_attention(q, k, v, True)   # noqa: E731
+    dense = lambda q, k, v: dense_attention(q, k, v, True)   # noqa: E731
+
+    t_flash = _slope(fwd_maker(flash), n1=40, n2=160)
+    t_dense = _slope(fwd_maker(dense), n1=20, n2=80)
+    t_grad = _slope(grad_maker(flash))
+    t_bwd = max(t_grad - t_flash, 1e-9)
     return {
         "flash_fwd_ms": round(t_flash * 1e3, 4),
         "dense_fwd_ms": round(t_dense * 1e3, 4),
         "flash_speedup": round(t_dense / t_flash, 2),
-        "flash_tflops": round(flops / t_flash / 1e12, 1),
+        "flash_tflops": round(fwd_flops / t_flash / 1e12, 1),
+        "flash_pct_peak": round(fwd_flops / t_flash / peak * 100, 1),
+        "flash_bwd_ms": round(t_bwd * 1e3, 4),
+        "flash_bwd_tflops": round(bwd_flops / t_bwd / 1e12, 1),
+        "flash_bwd_pct_peak": round(bwd_flops / t_bwd / peak * 100, 1),
     }
 
 
-def bench_train_step(jax, jnp):
+def bench_train_step(jax, jnp, peak):
+    import flax.linen as nn
+
     from nos_tpu.models.llama import BENCH_350M
     from nos_tpu.models.train import ShardedTrainer
-    from nos_tpu.parallel.mesh import MeshSpec, make_mesh
+    from nos_tpu.parallel.mesh import DEFAULT_RULES, MeshSpec, make_mesh
 
-    cfg = dataclasses.replace(BENCH_350M, attn_impl="flash")
+    # The measured best single-chip config (hardware exploration r3):
+    # flash kernels, "mats" selective remat (attention output + MLP
+    # gate/up saved; full no-remat needs ~30 GB), unrolled layers.
+    cfg = dataclasses.replace(BENCH_350M, attn_impl="flash",
+                              remat_policy="mats", scan_layers=False)
     mesh = make_mesh(MeshSpec.for_device_count(1),
                      devices=jax.devices()[:1])
     trainer = ShardedTrainer(cfg, mesh, batch_size=BATCH, seq_len=SEQ)
@@ -138,7 +172,7 @@ def bench_train_step(jax, jnp):
 
     step = trainer._step  # chain inside one jit (see module docstring)
 
-    def make(iters):
+    def make_step(iters):
         @jax.jit
         def run(state, tokens):
             def body(i, carry):
@@ -148,16 +182,51 @@ def bench_train_step(jax, jnp):
             return loss
         return lambda: float(run(state, tokens))
 
-    t_step = _slope(make, target_total_s=2.0)
+    # breakdown pieces: forward-only loss, forward+backward (grads kept
+    # live by consuming one element of every leaf)
+    def fwd_loss(params, toks):
+        with trainer.mesh, nn.logical_axis_rules(DEFAULT_RULES):
+            return trainer.model.apply({"params": params}, toks,
+                                       targets=toks)
+
+    def chain(fn):
+        def make(iters):
+            @jax.jit
+            def run(params, toks):
+                def body(i, acc):
+                    t2 = toks + (acc > 1e30).astype(jnp.int32)
+                    return fn(params, t2)
+                return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+            return lambda: float(run(state.params, tokens))
+        return make
+
+    def fwd_bwd(params, toks):
+        loss, g = jax.value_and_grad(fwd_loss)(params, toks)
+        gsum = jax.tree_util.tree_reduce(
+            lambda a, leaf: a + jnp.ravel(leaf)[0].astype(jnp.float32),
+            g, jnp.float32(0))
+        return loss + gsum * 1e-30
+
+    t_step = _slope(make_step, n1=4, n2=16, reps=4)
+    t_fwd = _slope(chain(fwd_loss), n1=4, n2=16, reps=4)
+    t_grad = _slope(chain(fwd_bwd), n1=4, n2=16, reps=4)
+
     flops = model_flops_per_step(cfg, BATCH, SEQ)
     device_kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in PEAK_TFLOPS.items() if k in device_kind),
-                DEFAULT_PEAK)
     return {
         "step_time_ms": round(t_step * 1e3, 2),
         "tokens_per_s": round(BATCH * SEQ / t_step),
         "model_tflops_per_step": round(flops / 1e12, 2),
         "mfu": round(flops / t_step / peak, 4),
+        "step_breakdown_ms": {
+            "forward": round(t_fwd * 1e3, 1),
+            "backward": round((t_grad - t_fwd) * 1e3, 1),
+            "optimizer": round(max(t_step - t_grad, 0.0) * 1e3, 1),
+        },
+        "train_config": {"remat_policy": cfg.remat_policy,
+                         "scan_layers": cfg.scan_layers,
+                         "attn_impl": cfg.attn_impl,
+                         "loss_chunk": cfg.loss_chunk},
         "device_kind": device_kind,
     }
 
@@ -170,12 +239,26 @@ def main() -> None:
         print(json.dumps({"skipped": "not on tpu",
                           "platform": jax.default_backend()}))
         return
+    from nos_tpu.device import discovery
     from nos_tpu.ops.attention import flash_attention
     from nos_tpu.parallel.ring import dense_attention
 
-    out = {"platform": "tpu"}
-    out.update(bench_attention(jax, jnp, flash_attention, dense_attention))
-    out.update(bench_train_step(jax, jnp))
+    disc = discovery.discover()
+    device_kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in PEAK_TFLOPS.items() if k in device_kind),
+                DEFAULT_PEAK)
+
+    out = {
+        "platform": "tpu",
+        "topology_source": disc.source,
+        "accelerator": disc.accelerator_type,
+        "observed_host_block": disc.host_block.name,
+        "peak_tflops": peak / 1e12,
+    }
+    out.update(bench_matmul_roofline(jax, jnp))
+    out.update(bench_attention(jax, jnp, flash_attention, dense_attention,
+                               peak))
+    out.update(bench_train_step(jax, jnp, peak))
     print(json.dumps(out))
 
 

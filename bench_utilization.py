@@ -85,11 +85,12 @@ def chip_equiv(pod) -> float:
 
 class Job:
     def __init__(self, name: str, pods: list, duration: float,
-                 created: float) -> None:
+                 created: float, cls: str = "") -> None:
         self.name = name
         self.pods = pods
         self.duration = duration
         self.created = created
+        self.cls = cls                      # e.g. "gang-4x8", "slice-1x1"
         self.bound_at: float | None = None
 
 
@@ -137,6 +138,7 @@ class Sim:
         self.jobs: dict[str, Job] = {}
         self._job_seq = 0
         self.latencies: list[float] = []
+        self.latency_by_class: dict[str, list[float]] = {}
         self.cycle_wall_ms: list[float] = []
         self._util_area = 0.0
         self._util_time = 0.0
@@ -172,7 +174,8 @@ class Sim:
                 self.api.create(KIND_POD, pod)
                 pods.append(pod.metadata.name)
                 backlog += chip_equiv(pod)
-            self.jobs[name] = Job(name, pods, duration, self.now[0])
+            self.jobs[name] = Job(name, pods, duration, self.now[0],
+                                  cls=f"{kind}-{arg}")
 
     def _complete_finished(self) -> None:
         for job in list(self.jobs.values()):
@@ -199,7 +202,9 @@ class Sim:
         for job in self.jobs.values():
             if job.bound_at is None and all(n in bound for n in job.pods):
                 job.bound_at = self.now[0]
-                self.latencies.append(self.now[0] - job.created)
+                lat = self.now[0] - job.created
+                self.latencies.append(lat)
+                self.latency_by_class.setdefault(job.cls, []).append(lat)
 
     def _sample_utilization(self) -> None:
         if self.now[0] < WARMUP_S:
@@ -236,6 +241,11 @@ class Sim:
                 return None
             return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
 
+        by_class = {
+            cls: {"n": len(ls), "p50": pct(sorted(ls), 0.50, 2),
+                  "p90": pct(sorted(ls), 0.90, 2)}
+            for cls, ls in sorted(self.latency_by_class.items())
+        }
         return {
             "utilization_pct": round(self._util_area / self._util_time, 4)
             if self._util_time else 0.0,
@@ -245,13 +255,66 @@ class Sim:
             "jobs_bound": len(self.latencies),
             "p50_schedule_latency_s": pct(lat, 0.50, 3),
             "p90_schedule_latency_s": pct(lat, 0.90, 3),
+            # p90 attribution: which job class pays the tail (gangs wait
+            # through batch windows + repartition; singles bind off free
+            # geometry immediately)
+            "schedule_latency_by_class": by_class,
             "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
             "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
         }
 
 
+def run_seeds(seeds=range(5)) -> dict:
+    """Multi-seed run: per-seed utilization + pooled tail attribution.
+    The headline is the MEAN utilization (a single lucky seed is not a
+    result); min is reported so regressions at the unlucky end are
+    visible."""
+    runs = {}
+    sims = []
+    for seed in seeds:
+        sim = Sim(seed=seed)
+        runs[seed] = sim.run()
+        sims.append(sim)
+    utils = [r["utilization_pct"] for r in runs.values()]
+    first = runs[next(iter(runs))]
+
+    def pct(xs, q, digits):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+    # pooled across ALL seeds — a tail that only shows on one seed must
+    # still move the published numbers
+    lat = [x for sim in sims for x in sim.latencies]
+    cyc = [x for sim in sims for x in sim.cycle_wall_ms]
+    by_class: dict[str, list[float]] = {}
+    for sim in sims:
+        for cls, ls in sim.latency_by_class.items():
+            by_class.setdefault(cls, []).extend(ls)
+    return {
+        "utilization_pct": round(sum(utils) / len(utils), 4),
+        "utilization_min": round(min(utils), 4),
+        "utilization_per_seed": {str(s): r["utilization_pct"]
+                                 for s, r in runs.items()},
+        "total_chips": first["total_chips"],
+        "trace_seconds": first["trace_seconds"],
+        "jobs_completed": sum(r["jobs_completed"] for r in runs.values()),
+        "jobs_bound": sum(r["jobs_bound"] for r in runs.values()),
+        "p50_schedule_latency_s": pct(lat, 0.50, 3),
+        "p90_schedule_latency_s": pct(lat, 0.90, 3),
+        "schedule_latency_by_class": {
+            cls: {"n": len(ls), "p50": pct(ls, 0.50, 2),
+                  "p90": pct(ls, 0.90, 2)}
+            for cls, ls in sorted(by_class.items())
+        },
+        "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
+        "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
+    }
+
+
 def main() -> None:
-    out = Sim().run()
+    out = run_seeds()
     out["vs_target"] = round(out["utilization_pct"] / UTILIZATION_TARGET, 4)
     print(json.dumps(out))
 

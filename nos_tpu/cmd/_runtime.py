@@ -61,6 +61,21 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._respond(200, REGISTRY.render(),
                           content_type="text/plain; version=0.0.4")
+        elif self.path == "/snapshot":
+            # Live cluster-state dump + metric series: what the one-shot
+            # metricsexporter scrapes (the reference exporter reads the
+            # real cluster, cmd/metricsexporter/metricsexporter.go:33-91).
+            if self.main is None or self.main.api is None:
+                self._respond(404, "no api server attached")
+                return
+            import json
+
+            from nos_tpu.kube.serialize import dump_state
+
+            payload = {"state": dump_state(self.main.api),
+                       "metrics": REGISTRY.snapshot()}
+            self._respond(200, json.dumps(payload),
+                          content_type="application/json")
         else:
             self._respond(404, "not found")
 
@@ -80,10 +95,12 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
 class Main:
     """Owns the stop event, run-loop threads, and the health server."""
 
-    def __init__(self, name: str, health_addr: str = "") -> None:
+    def __init__(self, name: str, health_addr: str = "",
+                 api=None) -> None:
         self.name = name
         self.stop = threading.Event()
         self.ready = threading.Event()
+        self.api = api            # APIServer served at /snapshot (optional)
         self._loops: list[RunLoop] = []
         self._server: http.server.ThreadingHTTPServer | None = None
         self._health_addr = health_addr

@@ -33,7 +33,8 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
         from nos_tpu.topology import DEFAULT_REGISTRY
 
         DEFAULT_REGISTRY.load_overrides(cfg.known_geometries_file)
-    main = main or Main("nos-tpu-partitioner", cfg.health_probe_addr)
+    main = main or Main("nos-tpu-partitioner", cfg.health_probe_addr,
+                        api=api)
     NodeController(api, state, SliceNodeInitializer(api)).bind()
     PodController(api, state).bind()
     controllers = []

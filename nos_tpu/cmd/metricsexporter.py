@@ -1,11 +1,17 @@
 """metricsexporter main analog (reference cmd/metricsexporter/
-metricsexporter.go:33-91): one-shot telemetry — collect the cluster/
-components/metrics payload and POST it to an endpoint and/or write it to
-a file, then exit.
+metricsexporter.go:33-91): one-shot telemetry — observe a live
+component's cluster state via its /snapshot endpoint (or a dumped state
+file), collect the cluster/components/metrics payload, and POST it to an
+endpoint and/or write it to a file, then exit.
 
-    python -m nos_tpu.cmd.metricsexporter --out /tmp/metrics.json
+    python -m nos_tpu.cmd.metricsexporter --source http://127.0.0.1:8080 \\
+        --out /tmp/metrics.json
+    python -m nos_tpu.cmd.metricsexporter --source state.json
     python -m nos_tpu.cmd.metricsexporter --endpoint http://host/ingest
-"""
+
+Without --source the payload describes an empty cluster (only this
+process's metric series are real) — the reference one-shot always reads
+live state, so prefer --source."""
 
 from __future__ import annotations
 
@@ -19,6 +25,25 @@ from nos_tpu.exporter import collect
 from nos_tpu.kube.client import APIServer
 
 logger = logging.getLogger("nos_tpu.cmd.metricsexporter")
+
+
+def load_source(source: str) -> tuple[APIServer, dict | None]:
+    """(APIServer, metric series) from a live main's /snapshot URL or a
+    dumped state file."""
+    from nos_tpu.kube.serialize import load_state
+
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith("/snapshot"):
+            url += "/snapshot"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            data = json.load(resp)
+        return load_state(data.get("state", {})), data.get("metrics")
+    with open(source) as f:
+        data = json.load(f)
+    # bare dump_state files and full /snapshot payloads both accepted
+    state = data.get("state", data)
+    return load_state(state), data.get("metrics")
 
 
 def export(payload: dict, endpoint: str = "", out: str = "") -> int:
@@ -47,13 +72,30 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--source", default="",
+                    help="live main /snapshot URL (http://host:port) or "
+                         "dumped state file to observe")
     ap.add_argument("--endpoint", default="", help="POST target URL")
     ap.add_argument("--out", default="", help="write payload to this file")
     args = ap.parse_args(argv)
 
-    payload = collect(APIServer(), components={
+    metrics_override = None
+    if args.source:
+        try:
+            api, metrics_override = load_source(args.source)
+        except (OSError, ValueError) as e:
+            logger.error("cannot read --source %s: %s", args.source, e)
+            return 1
+    else:
+        api = APIServer()
+        logger.warning("no --source: exporting an empty cluster snapshot")
+
+    payload = collect(api, components={
         "partitioner": True, "scheduler": True, "operator": True,
     })
+    if metrics_override is not None:
+        # the observed process's series, not this one-shot's empty registry
+        payload["metrics"] = metrics_override
     return export(payload, endpoint=args.endpoint, out=args.out)
 
 

@@ -23,7 +23,8 @@ from nos_tpu.quota import TPUResourceCalculator
 
 def build_operator_main(api: APIServer, cfg: OperatorConfig,
                         main: Main | None = None) -> Main:
-    main = main or Main("nos-tpu-operator", cfg.health_probe_addr)
+    main = main or Main("nos-tpu-operator", cfg.health_probe_addr,
+                        api=api)
     install_quota_webhooks(api)
     calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
     eq = ElasticQuotaReconciler(api, calc)

@@ -34,7 +34,7 @@ def main(argv=None) -> int:
         return 2
     api = APIServer()
     scheduler = build_scheduler(api, cfg.tpu_memory_gb_per_chip)
-    m = Main("nos-tpu-scheduler", cfg.health_probe_addr)
+    m = Main("nos-tpu-scheduler", cfg.health_probe_addr, api=api)
     m.add_loop("scheduler", scheduler.run_cycle, cfg.cycle_interval_s)
     m.run_until_stopped()
     return 0

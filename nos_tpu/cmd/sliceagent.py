@@ -28,10 +28,16 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
 
     if cfg.generation == "auto":
         # discover the topology from the hardware (PJRT / Cloud TPU env)
-        # instead of asserting it — nos_tpu/device/discovery.py
+        # instead of asserting it — nos_tpu/device/discovery.py.  The
+        # node object must advertise the *observed* block too: labelling
+        # the generation's full chip count on a partially-populated host
+        # would let the partitioner carve nonexistent hardware.
+        import dataclasses
+
         runtime = default_tpu_runtime(None)
-        generation_name, _ = runtime.topology()
-        generation = DEFAULT_REGISTRY.get(generation_name)
+        generation_name, host_block = runtime.topology()
+        generation = dataclasses.replace(
+            DEFAULT_REGISTRY.get(generation_name), host_block=host_block)
     else:
         generation = DEFAULT_REGISTRY.get(cfg.generation)
         runtime = default_tpu_runtime(generation)
@@ -45,7 +51,7 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
         api.create(KIND_NODE, make_tpu_node(cfg.node_name,
                                             generation=generation))
     main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
-                        cfg.health_probe_addr)
+                        cfg.health_probe_addr, api=api)
     agent = SliceAgent(api, cfg.node_name, runtime, FakePodResources())
     agent.start()  # startup cleanup + first report (migagent.go:190-199)
     main.add_loop("sliceagent", agent.tick, cfg.report_interval_s)

@@ -71,6 +71,11 @@ class APIServer:
         for fn in list(self._watchers.get(kind, [])):
             fn(event, copy.deepcopy(obj))
 
+    def kinds(self) -> list[str]:
+        """Kinds with at least one stored object (snapshot enumeration)."""
+        with self._lock:
+            return [k for k, s in self._stores.items() if s]
+
     # -- CRUD -------------------------------------------------------------
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:

@@ -1,0 +1,101 @@
+"""JSON (de)serialization of the cluster object model.
+
+Two consumers:
+
+- the `/snapshot` endpoint every cmd/ main serves (`cmd/_runtime.py`),
+  which lets the one-shot metricsexporter observe a *live* process's
+  cluster instead of an empty one (the reference metricsexporter reads
+  the actual cluster, cmd/metricsexporter/metricsexporter.go:33-91);
+- state dump/restore: all durable control-plane state lives in the API
+  server (SURVEY.md §5 checkpoint/resume), so `dump_state`/`load_state`
+  of its stores IS the control plane's checkpoint format.
+
+Objects are plain nested dataclasses (kube/objects.py, api/*), so the
+codec is generic: `dataclasses.asdict` out, recursive field-typed
+construction back in.  Unknown keys in input are ignored (forward
+compatibility); unknown kinds round-trip as raw dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any
+
+from nos_tpu.api.elasticquota import CompositeElasticQuota, ElasticQuota
+from nos_tpu.api.pdb import KIND_POD_DISRUPTION_BUDGET, PodDisruptionBudget
+from nos_tpu.api.podgroup import PodGroup
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_CONFIGMAP,
+    KIND_ELASTIC_QUOTA, KIND_NODE, KIND_POD, KIND_POD_GROUP,
+)
+from nos_tpu.kube.objects import ConfigMap, Node, Pod
+
+KIND_TYPES: dict[str, type] = {
+    KIND_POD: Pod,
+    KIND_NODE: Node,
+    KIND_CONFIGMAP: ConfigMap,
+    KIND_ELASTIC_QUOTA: ElasticQuota,
+    KIND_COMPOSITE_ELASTIC_QUOTA: CompositeElasticQuota,
+    KIND_POD_GROUP: PodGroup,
+    KIND_POD_DISRUPTION_BUDGET: PodDisruptionBudget,
+}
+
+
+def _build(cls: type, data: Any) -> Any:
+    """Recursively construct `cls` from plain JSON data using dataclass
+    field types; tolerates missing (defaulted) and unknown keys."""
+    if data is None:
+        return None
+    origin = typing.get_origin(cls)
+    if origin in (list, tuple):
+        (item_t,) = typing.get_args(cls)[:1] or (Any,)
+        seq = [_build(item_t, v) for v in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        return dict(data)
+    if origin is typing.Union:  # Optional[...]
+        args = [a for a in typing.get_args(cls) if a is not type(None)]
+        return _build(args[0], data) if args else data
+    if dataclasses.is_dataclass(cls):
+        if not isinstance(data, dict):
+            # a str here would "work" (substring `in`) and silently yield
+            # a default object — corrupt input must fail loudly instead
+            raise ValueError(
+                f"expected object for {cls.__name__}, got {type(data).__name__}")
+        hints = typing.get_type_hints(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name in data:
+                kwargs[f.name] = _build(hints.get(f.name, Any), data[f.name])
+        return cls(**kwargs)
+    return data
+
+
+def to_dict(obj: Any) -> Any:
+    return dataclasses.asdict(obj) if dataclasses.is_dataclass(obj) else obj
+
+
+def from_dict(kind: str, data: dict) -> Any:
+    cls = KIND_TYPES.get(kind)
+    return _build(cls, data) if cls is not None else data
+
+
+def dump_state(api: APIServer) -> dict:
+    """{kind: [object dicts]} for every populated store."""
+    out: dict[str, list] = {}
+    for kind in api.kinds():
+        objs = api.list(kind)
+        if objs:
+            out[kind] = [to_dict(o) for o in objs]
+    return out
+
+
+def load_state(data: dict, api: APIServer | None = None) -> APIServer:
+    """Rebuild an APIServer from dump_state output (admission/webhooks are
+    not re-run: the snapshot is already-admitted state)."""
+    api = api or APIServer()
+    for kind, objs in data.items():
+        for obj in objs:
+            api.create(kind, from_dict(kind, obj))
+    return api

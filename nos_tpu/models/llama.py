@@ -47,6 +47,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16     # activation dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "dense"      # "dense" | "flash" | "ring"
+    # Sequence chunk for the fused head+loss path (__call__ with targets):
+    # the [B, S, vocab] fp32 logits never materialize — each chunk's
+    # logits/softmax live only inside its remat region.  0 disables.
+    loss_chunk: int = 512
     remat: bool = True
     # What the backward may keep instead of recomputing ("nothing" = full
     # remat; "attn" saves the attention op's output so the flash kernel is
@@ -80,19 +84,35 @@ BENCH_350M = LlamaConfig(
 
 # Lazy thunks: checkpoint_policies lookups stay cheap at import time and
 # save_only_these_names constructs a fresh policy per model build.
+# Full no-remat needs ~2x the HBM (measured 30.4 GB vs the v5e's 15.75 at
+# 350M/batch 8); "mats" saves the expensive-to-recompute matmul outputs
+# while still rematting the cheap elementwise/norm chain.
 _REMAT_POLICIES = {
     "nothing": lambda: jax.checkpoint_policies.nothing_saveable,
     "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     "attn": lambda: jax.checkpoint_policies.save_only_these_names("attn_out"),
+    "mlp": lambda: jax.checkpoint_policies.save_only_these_names(
+        "mlp_gate", "mlp_up"),
+    "mats": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "mlp_gate", "mlp_up"),
+    # everything matmul-shaped saved; backward recomputes only the cheap
+    # elementwise/norm chain
+    "all_mats": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_q", "attn_k", "attn_v", "attn_out", "mlp_gate", "mlp_up"),
 }
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_tables(positions: jax.Array, dim: int, theta: float):
+    """cos/sin tables [B, S, 1, dim/2], computed once per forward and
+    shared by every layer (they depend only on positions)."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope(x: jax.Array, rope: tuple[jax.Array, jax.Array]) -> jax.Array:
     """Rotary position embedding over the last dim of [B, S, H, D]."""
-    d = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,S,1,d/2
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    cos, sin = rope
     x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(x.shape).astype(x.dtype)
@@ -117,7 +137,7 @@ class Attention(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, rope):
         cfg = self.cfg
         dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
@@ -130,10 +150,13 @@ class Attention(nn.Module):
                   ("embed", "kv_heads", "head_dim"), "k_proj")(x)
         v = dense((cfg.num_kv_heads, cfg.head_dim),
                   ("embed", "kv_heads", "head_dim"), "v_proj")(x)
+        q = ad_checkpoint.checkpoint_name(q, "attn_q")
+        k = ad_checkpoint.checkpoint_name(k, "attn_k")
+        v = ad_checkpoint.checkpoint_name(v, "attn_v")
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, rope)
+        k = _rope(k, rope)
         n_rep = cfg.num_heads // cfg.num_kv_heads
         k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
 
@@ -173,6 +196,10 @@ class MLP(nn.Module):
                 nn.initializers.lecun_normal(), logical))
         gate = dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(x)
         up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
+        # Named so selective remat can save them: recomputing gate/up is
+        # ~half the per-layer matmul FLOPs, the dominant remat expense.
+        gate = ad_checkpoint.checkpoint_name(gate, "mlp_gate")
+        up = ad_checkpoint.checkpoint_name(up, "mlp_up")
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
         return dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(h)
@@ -183,24 +210,60 @@ class Block(nn.Module):
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, rope):
         cfg = self.cfg
         x = x + Attention(cfg, self.mesh, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions)
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), rope)
         x = x + MLP(cfg, name="mlp")(
             RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
+def _chunked_xent(x, embed, tokens, chunk, dtype):
+    """Next-token cross entropy with the head matmul fused into the loss,
+    scanned over sequence chunks so the [B, S, vocab] fp32 logits never
+    exist at once (at vocab 32k/batch 8 they plus their cotangent are
+    ~4 GB — a large share of a v5e's HBM).  Each chunk is a remat region:
+    its logits are recomputed from the saved [B, chunk, E] activations in
+    backward, costing one extra head matmul per step.
+
+    Position i predicts tokens[i+1]; the last position is masked out."""
+    bsz, seq, emb = x.shape
+    nch = seq // chunk if chunk else 1
+    if nch <= 1 or seq % chunk:
+        nch, chunk = 1, seq
+    targets = jnp.roll(tokens, -1, axis=1)
+    # [nch, B, chunk, ...] scan layout
+    xc = x.reshape(bsz, nch, chunk, emb).transpose(1, 0, 2, 3)
+    tc = targets.reshape(bsz, nch, chunk).transpose(1, 0, 2)
+    pos = jnp.arange(seq).reshape(nch, chunk)
+
+    @jax.checkpoint
+    def chunk_loss(xx, tt, pp):
+        logits = jnp.einsum("bce,ve->bcv", xx, embed.astype(dtype),
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, tt[..., None], axis=-1)[..., 0]
+        w = (pp < seq - 1).astype(jnp.float32)[None, :]
+        return jnp.sum((lse - ll) * w)
+
+    def body(carry, args):
+        return carry + chunk_loss(*args), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0), (xc, tc, pos))
+    return total / (bsz * (seq - 1))
+
+
 class Llama(nn.Module):
     """Decoder-only LM.  __call__(tokens [B, S] int32) -> logits
-    [B, S, vocab]."""
+    [B, S, vocab]; with targets, -> scalar next-token loss via the
+    chunk-fused head (cfg.loss_chunk)."""
 
     cfg: LlamaConfig
     mesh: Mesh | None = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, targets=None):
         cfg = self.cfg
         embed = self.param(
             "embed", nn.with_logical_partitioning(
@@ -210,6 +273,7 @@ class Llama(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None], tokens.shape)
+        rope = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
         block = Block
         if cfg.remat:
@@ -218,7 +282,7 @@ class Llama(nn.Module):
                 policy=_REMAT_POLICIES[cfg.remat_policy]())
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, positions), None),
+                lambda mdl, carry, _: (mdl(carry, rope), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
@@ -226,9 +290,12 @@ class Llama(nn.Module):
             )(block(cfg, self.mesh, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = block(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+                x = block(cfg, self.mesh, name=f"layer_{i}")(x, rope)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if targets is not None:
+            return _chunked_xent(x, embed, targets, cfg.loss_chunk,
+                                 cfg.dtype)
         # Tied embeddings.  The matmul runs in the activation dtype (bf16
         # on the MXU) with fp32 accumulation — upcasting the inputs would
         # force fp32 multiplies at a fraction of peak for ~9% of the

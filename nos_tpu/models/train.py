@@ -88,8 +88,10 @@ class ShardedTrainer:
     def _step(self, state: TrainState, tokens: jax.Array):
         with self.mesh, nn.logical_axis_rules(self.rules):
             def loss_fn(params):
-                logits = state.apply_fn({"params": params}, tokens)
-                return cross_entropy_loss(logits, tokens)
+                # Fused chunked head+loss: the full [B, S, vocab] fp32
+                # logits never materialize (llama._chunked_xent).
+                return state.apply_fn({"params": params}, tokens,
+                                      targets=tokens)
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
             new_state = state.apply_gradients(grads=grads)

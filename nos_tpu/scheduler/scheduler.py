@@ -11,9 +11,11 @@ mark the pod unschedulable so the partitioner notices it
 
 from __future__ import annotations
 
+import functools
 import logging
 
 from nos_tpu.api.constants import (
+    LABEL_ACCELERATOR as C_LABEL_ACCELERATOR,
     LABEL_HOST_INDEX as C_LABEL_HOST_INDEX,
     LABEL_POD_GROUP as C_LABEL_POD_GROUP,
     LABEL_POD_ID as C_LABEL_POD_ID,
@@ -29,8 +31,18 @@ from nos_tpu.scheduler.gang import (
     GANG_HOST_SET_KEY, GANG_POD_ID_KEY, gang_name, gang_slice_windows,
     get_pod_group, set_pod_group_status,
 )
+from nos_tpu.topology import DEFAULT_REGISTRY
 
 logger = logging.getLogger(__name__)
+
+
+@functools.lru_cache(maxsize=16)
+def _gen_window_sizes(accel: str) -> tuple[int, ...]:
+    try:
+        gen = DEFAULT_REGISTRY.get(accel)
+    except KeyError:
+        return ()
+    return tuple(sorted({gen.hosts_for(s) for s in gen.multihost_shapes()}))
 
 
 class Scheduler:
@@ -84,7 +96,7 @@ class Scheduler:
             else:
                 self._mark_unschedulable(pod, Status.unschedulable("no fit"))
             return None
-        chosen = min(feasible, key=self._score_key(pod))
+        chosen = min(feasible, key=self._score_key(pod, lister))
         status = self._framework.run_reserve_plugins(state, pod, chosen.name)
         if not status.is_success:
             self._framework.run_unreserve_plugins(state, pod, chosen.name)
@@ -376,13 +388,61 @@ class Scheduler:
         return None
 
     # -- internals ----------------------------------------------------------
-    def _score_key(self, pod: Pod):
+    def _window_busy_map(self, lister: SharedLister) -> dict:
+        """(pod_id, host_index) -> has-pods, for fragmentation-aware
+        scoring.  Built once per scoring decision from the cycle's
+        lister view."""
+        busy: dict[tuple[str, int], bool] = {}
+        for ni in lister.list():
+            labels = ni.node.metadata.labels
+            pid = labels.get(C_LABEL_POD_ID, "")
+            if not pid:
+                continue
+            try:
+                idx = int(labels.get(C_LABEL_HOST_INDEX, "0"))
+            except ValueError:
+                continue
+            busy[(pid, idx)] = busy.get((pid, idx), False) or bool(ni.pods)
+        return busy
+
+    @staticmethod
+    def _window_sizes(ni: NodeInfo) -> tuple[int, ...]:
+        """Multi-host window sizes (in hosts) for this node's generation."""
+        return _gen_window_sizes(
+            ni.node.metadata.labels.get(C_LABEL_ACCELERATOR, ""))
+
+    def _score_key(self, pod: Pod, lister: SharedLister | None = None):
         """Least-requested on the pod's own resources: packs TPU profiles
-        tightly (utilization).  Ties break on numeric host index, not name
-        — filling hosts in physical order keeps high-index aligned windows
-        contiguous for multi-host slices (lexicographic order would put
-        host-10 before host-2 and fragment every window)."""
+        tightly (utilization).  Equal-headroom ties prefer hosts whose
+        aligned multi-host windows are already broken — placing a
+        single-host job in a wholly-free window would strand it for gangs
+        (fragmentation; the window convention is topology/windows.py).
+        Final ties break on numeric host index, not name — filling hosts
+        in physical order keeps high-index aligned windows contiguous
+        (lexicographic order would put host-10 before host-2 and fragment
+        every window)."""
         req = pod_request(pod)
+        busy = self._window_busy_map(lister) if lister is not None else {}
+
+        def window_penalty(ni: NodeInfo) -> int:
+            if not busy:
+                return 0
+            labels = ni.node.metadata.labels
+            pid = labels.get(C_LABEL_POD_ID, "")
+            if not pid:
+                return 0
+            try:
+                idx = int(labels.get(C_LABEL_HOST_INDEX, "0"))
+            except ValueError:
+                return 0
+            pen = 0
+            for size in self._window_sizes(ni):
+                start = (idx // size) * size
+                window = [(pid, i) for i in range(start, start + size)]
+                whole = all(w in busy and not busy[w] for w in window)
+                if whole:
+                    pen += size  # breaking a whole free window of `size`
+            return pen
 
         def key(ni: NodeInfo):
             free = ni.free()
@@ -392,7 +452,7 @@ class Scheduler:
                     C_LABEL_HOST_INDEX, "0"))
             except ValueError:
                 idx = 0
-            return (headroom, idx, ni.name)
+            return (headroom, window_penalty(ni), idx, ni.name)
 
         return key
 

@@ -251,3 +251,92 @@ class TestProcessModelEndToEnd:
                 pytest.fail("sim demo did not converge")
         finally:
             main.shutdown()
+
+class TestSnapshotAndExporterSource:
+    """The one-shot exporter must observe real state (round-2 VERDICT #6):
+    a live main's /snapshot endpoint or a dumped state file, never an
+    empty APIServer by accident."""
+
+    def test_serialize_round_trip(self):
+        import dataclasses
+
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.kube.serialize import dump_state, load_state
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("host-0"))
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p0"))
+        data = dump_state(api)
+        api2 = load_state(json.loads(json.dumps(data)))
+        n = api2.get(KIND_NODE, "host-0")
+        assert n.metadata.labels == api.get(KIND_NODE, "host-0").metadata.labels
+        p = api2.list(KIND_POD)[0]
+        assert p.metadata.name == "p0"
+        assert dataclasses.asdict(p) == dataclasses.asdict(
+            api.list(KIND_POD)[0])
+
+    def test_snapshot_endpoint_serves_live_state(self):
+        import urllib.request
+
+        from nos_tpu.cmd._runtime import Main
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+        from nos_tpu.testing.factory import make_tpu_node
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("host-0"))
+        main = Main("t", health_addr="127.0.0.1:0", api=api)
+        main.start()
+        try:
+            url = f"http://{main.health_address}/snapshot"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                data = json.load(resp)
+            assert "Node" in data["state"]
+            assert data["state"]["Node"][0]["metadata"]["name"] == "host-0"
+            assert "metrics" in data
+        finally:
+            main.shutdown()
+
+    def test_exporter_source_url_yields_nonzero_nodes(self, tmp_path):
+        from nos_tpu.cmd import metricsexporter
+        from nos_tpu.cmd._runtime import Main
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+        from nos_tpu.testing.factory import make_tpu_node
+
+        api = APIServer()
+        for i in range(4):
+            api.create(KIND_NODE, make_tpu_node(f"host-{i}"))
+        main = Main("t", health_addr="127.0.0.1:0", api=api)
+        main.start()
+        try:
+            out = tmp_path / "payload.json"
+            rc = metricsexporter.main([
+                "--source", f"http://{main.health_address}",
+                "--out", str(out)])
+            assert rc == 0
+            payload = json.loads(out.read_text())
+            assert payload["cluster"]["nodes_total"] == 4
+        finally:
+            main.shutdown()
+
+    def test_exporter_source_state_file(self, tmp_path):
+        from nos_tpu.cmd import metricsexporter
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+        from nos_tpu.kube.serialize import dump_state
+        from nos_tpu.testing.factory import make_tpu_node
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("host-0"))
+        src = tmp_path / "state.json"
+        src.write_text(json.dumps(dump_state(api)))
+        out = tmp_path / "payload.json"
+        rc = metricsexporter.main(["--source", str(src), "--out", str(out)])
+        assert rc == 0
+        payload = json.loads(out.read_text())
+        assert payload["cluster"]["nodes_total"] == 1
+
+    def test_exporter_bad_source_fails_cleanly(self):
+        from nos_tpu.cmd import metricsexporter
+
+        rc = metricsexporter.main(["--source", "/nonexistent/state.json"])
+        assert rc == 1

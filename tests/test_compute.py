@@ -199,6 +199,64 @@ class TestShardedTrainer:
         assert cross_entropy_loss(logits, tokens) < 1e-3
 
 
+class TestChunkedLoss:
+    def test_fused_loss_matches_logits_path(self):
+        """__call__(tokens, targets=tokens) must equal
+        cross_entropy_loss(__call__(tokens), tokens) — same math, chunked
+        and head-fused."""
+        import dataclasses
+
+        from nos_tpu.models.llama import Llama, TINY
+        from nos_tpu.models.train import cross_entropy_loss
+
+        cfg = dataclasses.replace(TINY, loss_chunk=32, max_seq_len=128)
+        model = Llama(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 128), 0, cfg.vocab_size, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        logits = model.apply(params, tokens)
+        ref = cross_entropy_loss(logits, tokens)
+        fused = model.apply(params, tokens, targets=tokens)
+        assert abs(float(ref) - float(fused)) < 1e-4
+
+    def test_fused_loss_grads_match(self):
+        import dataclasses
+
+        from nos_tpu.models.llama import Llama, TINY
+        from nos_tpu.models.train import cross_entropy_loss
+
+        cfg = dataclasses.replace(TINY, loss_chunk=64, max_seq_len=128)
+        model = Llama(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 128), 0, cfg.vocab_size, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+
+        g_ref = jax.grad(lambda p: cross_entropy_loss(
+            model.apply(p, tokens), tokens))(params)
+        g_fused = jax.grad(lambda p: model.apply(
+            p, tokens, targets=tokens))(params)
+        flat_r = jax.tree_util.tree_leaves(g_ref)
+        flat_f = jax.tree_util.tree_leaves(g_fused)
+        for a, b in zip(flat_r, flat_f):
+            scale = float(jnp.max(jnp.abs(a))) + 1e-9
+            assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-3
+
+    def test_uneven_chunk_falls_back_whole(self):
+        import dataclasses
+
+        from nos_tpu.models.llama import Llama, TINY
+        from nos_tpu.models.train import cross_entropy_loss
+
+        cfg = dataclasses.replace(TINY, loss_chunk=48, max_seq_len=128)
+        model = Llama(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (1, 128), 0, cfg.vocab_size, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        ref = cross_entropy_loss(model.apply(params, tokens), tokens)
+        fused = model.apply(params, tokens, targets=tokens)
+        assert abs(float(ref) - float(fused)) < 1e-4
+
+
 class TestGraftEntry:
     def test_dryrun_multichip(self):
         import sys
