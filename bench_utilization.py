@@ -76,6 +76,23 @@ JOB_MIX = [
 ]
 
 
+def percentile(xs, q: float, digits: int):
+    """Value at quantile q, or None with no samples (must survive into
+    the JSON rather than blow up in round())."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+
+def latency_summary(by_class: dict[str, list[float]]) -> dict:
+    return {
+        cls: {"n": len(ls), "p50": percentile(ls, 0.50, 2),
+              "p90": percentile(ls, 0.90, 2)}
+        for cls, ls in sorted(by_class.items())
+    }
+
+
 def chip_equiv(pod) -> float:
     req = pod_request(pod)
     chips = sum(s.chips * q for s, q in extract_slice_requests(req).items())
@@ -231,21 +248,10 @@ class Sim:
             self._record_binds()
             self._sample_utilization()
 
-        lat = sorted(self.latencies)
-        cyc = sorted(self.cycle_wall_ms)
-
-        def pct(xs, q, digits):
-            # None (no samples — e.g. zero binds all trace) must survive
-            # into the JSON rather than blow up in round()
-            if not xs:
-                return None
-            return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
-
-        by_class = {
-            cls: {"n": len(ls), "p50": pct(sorted(ls), 0.50, 2),
-                  "p90": pct(sorted(ls), 0.90, 2)}
-            for cls, ls in sorted(self.latency_by_class.items())
-        }
+        lat = self.latencies
+        cyc = self.cycle_wall_ms
+        pct = percentile
+        by_class = latency_summary(self.latency_by_class)
         return {
             "utilization_pct": round(self._util_area / self._util_time, 4)
             if self._util_time else 0.0,
@@ -278,14 +284,9 @@ def run_seeds(seeds=range(5)) -> dict:
     utils = [r["utilization_pct"] for r in runs.values()]
     first = runs[next(iter(runs))]
 
-    def pct(xs, q, digits):
-        if not xs:
-            return None
-        xs = sorted(xs)
-        return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
-
     # pooled across ALL seeds — a tail that only shows on one seed must
     # still move the published numbers
+    pct = percentile
     lat = [x for sim in sims for x in sim.latencies]
     cyc = [x for sim in sims for x in sim.cycle_wall_ms]
     by_class: dict[str, list[float]] = {}
@@ -303,11 +304,7 @@ def run_seeds(seeds=range(5)) -> dict:
         "jobs_bound": sum(r["jobs_bound"] for r in runs.values()),
         "p50_schedule_latency_s": pct(lat, 0.50, 3),
         "p90_schedule_latency_s": pct(lat, 0.90, 3),
-        "schedule_latency_by_class": {
-            cls: {"n": len(ls), "p50": pct(ls, 0.50, 2),
-                  "p90": pct(ls, 0.90, 2)}
-            for cls, ls in sorted(by_class.items())
-        },
+        "schedule_latency_by_class": latency_summary(by_class),
         "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
         "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
     }

@@ -1,0 +1,16 @@
+{{/*
+Common helpers.  The template language is deliberately restricted to the
+subset the repo's renderer test understands (tests/test_deploy.py):
+.Values/.Release/.Chart lookups, `default`, if/end blocks, and these
+named helpers — keep new templates inside that subset so `pytest` keeps
+proving the chart renders.
+*/}}
+
+{{- define "nos-tpu.tag" -}}
+{{ .Values.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "nos-tpu.labels" -}}
+app.kubernetes.io/part-of: nos-tpu
+app.kubernetes.io/managed-by: Helm
+{{- end -}}

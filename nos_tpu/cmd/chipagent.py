@@ -23,13 +23,25 @@ def build_chipagent_main(api: APIServer, cfg: AgentConfig,
     from nos_tpu.controllers.chipagent import ChipAgent
     from nos_tpu.topology import DEFAULT_REGISTRY
 
+    if cfg.generation == "auto":
+        # observe, don't assert (nos_tpu/device/discovery.py) — and keep
+        # the observed host block so the node advertises real capacity
+        import dataclasses
+
+        from nos_tpu.device import discovery
+
+        disc = discovery.discover()
+        generation = dataclasses.replace(
+            disc.generation, host_block=disc.host_block)
+    else:
+        generation = DEFAULT_REGISTRY.get(cfg.generation)
     try:
         api.get(KIND_NODE, cfg.node_name)
     except NotFound:
         from nos_tpu.testing.factory import make_tpu_node
 
         api.create(KIND_NODE, make_tpu_node(
-            cfg.node_name, generation=DEFAULT_REGISTRY.get(cfg.generation),
+            cfg.node_name, generation=generation,
             partitioning="timeshare"))
     main = main or Main(f"nos-tpu-chipagent-{cfg.node_name}",
                         cfg.health_probe_addr, api=api)

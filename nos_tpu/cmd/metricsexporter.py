@@ -38,9 +38,15 @@ def load_source(source: str) -> tuple[APIServer, dict | None]:
             url += "/snapshot"
         with urllib.request.urlopen(url, timeout=10) as resp:
             data = json.load(resp)
+        if not isinstance(data, dict):
+            raise ValueError(f"snapshot payload is {type(data).__name__}, "
+                             f"expected object")
         return load_state(data.get("state", {})), data.get("metrics")
     with open(source) as f:
         data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"state file holds {type(data).__name__}, "
+                         f"expected object")
     # bare dump_state files and full /snapshot payloads both accepted
     state = data.get("state", data)
     return load_state(state), data.get("metrics")

@@ -12,13 +12,15 @@ Two consumers:
 
 Objects are plain nested dataclasses (kube/objects.py, api/*), so the
 codec is generic: `dataclasses.asdict` out, recursive field-typed
-construction back in.  Unknown keys in input are ignored (forward
-compatibility); unknown kinds round-trip as raw dicts.
+construction back in.  Unknown keys in input are ignored and unknown
+kinds are skipped with a warning (forward compatibility: a snapshot from
+a newer build must not prevent loading the kinds this build knows).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import typing
 from typing import Any
 
@@ -30,6 +32,8 @@ from nos_tpu.kube.client import (
     KIND_ELASTIC_QUOTA, KIND_NODE, KIND_POD, KIND_POD_GROUP,
 )
 from nos_tpu.kube.objects import ConfigMap, Node, Pod
+
+logger = logging.getLogger(__name__)
 
 KIND_TYPES: dict[str, type] = {
     KIND_POD: Pod,
@@ -96,6 +100,10 @@ def load_state(data: dict, api: APIServer | None = None) -> APIServer:
     not re-run: the snapshot is already-admitted state)."""
     api = api or APIServer()
     for kind, objs in data.items():
+        if kind not in KIND_TYPES:
+            logger.warning("load_state: skipping unknown kind %r "
+                           "(%d object(s))", kind, len(objs))
+            continue
         for obj in objs:
             api.create(kind, from_dict(kind, obj))
     return api

@@ -36,12 +36,19 @@ from nos_tpu.topology import DEFAULT_REGISTRY
 logger = logging.getLogger(__name__)
 
 
-@functools.lru_cache(maxsize=16)
 def _gen_window_sizes(accel: str) -> tuple[int, ...]:
     try:
         gen = DEFAULT_REGISTRY.get(accel)
     except KeyError:
         return ()
+    # memoised on the frozen Generation itself: a registry override
+    # (load_overrides) installs a NEW Generation, so its sizes are
+    # recomputed instead of served stale from an accel-name key
+    return _window_sizes_of(gen)
+
+
+@functools.lru_cache(maxsize=64)
+def _window_sizes_of(gen) -> tuple[int, ...]:
     return tuple(sorted({gen.hosts_for(s) for s in gen.multihost_shapes()}))
 
 

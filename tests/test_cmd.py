@@ -335,8 +335,72 @@ class TestSnapshotAndExporterSource:
         payload = json.loads(out.read_text())
         assert payload["cluster"]["nodes_total"] == 1
 
+    def test_exporter_unknown_kind_skipped(self, tmp_path):
+        from nos_tpu.cmd import metricsexporter
+
+        src = tmp_path / "state.json"
+        src.write_text(json.dumps({
+            "Lease": [{"metadata": {"name": "l0"}}],
+            "Node": [],
+        }))
+        out = tmp_path / "payload.json"
+        rc = metricsexporter.main(["--source", str(src), "--out", str(out)])
+        assert rc == 0  # unknown kind skipped, known kinds loaded
+
+    def test_exporter_non_object_json_fails_cleanly(self, tmp_path):
+        from nos_tpu.cmd import metricsexporter
+
+        src = tmp_path / "state.json"
+        src.write_text("[1, 2, 3]")
+        rc = metricsexporter.main(["--source", str(src)])
+        assert rc == 1
+
     def test_exporter_bad_source_fails_cleanly(self):
         from nos_tpu.cmd import metricsexporter
 
         rc = metricsexporter.main(["--source", "/nonexistent/state.json"])
         assert rc == 1
+
+class TestAgentAutoGeneration:
+    """--generation auto: agents observe topology (discovery) and the
+    self-registered node advertises the OBSERVED block, not the
+    generation default (a 4-chip VM must not offer 8 chips)."""
+
+    def _observed(self):
+        from nos_tpu.device import discovery
+        from nos_tpu.topology import Shape, V5E
+
+        return discovery.DiscoveredTopology(
+            generation=V5E, host_block=Shape((2, 2)), num_local_chips=4,
+            num_hosts=1, source=discovery.SOURCE_ENV,
+            accelerator_type="v5litepod-4", origin=(0, 0))
+
+    def test_sliceagent_auto_advertises_observed_block(self, monkeypatch):
+        from nos_tpu.api import constants as C
+        from nos_tpu.api.config import AgentConfig
+        from nos_tpu.cmd.sliceagent import build_agent_main
+        from nos_tpu.device import discovery
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+
+        monkeypatch.setattr(discovery, "discover",
+                            lambda *a, **k: self._observed())
+        api = APIServer()
+        cfg = AgentConfig(node_name="auto-0", generation="auto")
+        build_agent_main(api, cfg)
+        node = api.get(KIND_NODE, "auto-0")
+        assert node.metadata.labels[C.LABEL_CHIP_COUNT] == "4"
+
+    def test_chipagent_auto_advertises_observed_block(self, monkeypatch):
+        from nos_tpu.api import constants as C
+        from nos_tpu.api.config import AgentConfig
+        from nos_tpu.cmd.chipagent import build_chipagent_main
+        from nos_tpu.device import discovery
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+
+        monkeypatch.setattr(discovery, "discover",
+                            lambda *a, **k: self._observed())
+        api = APIServer()
+        cfg = AgentConfig(node_name="auto-ts-0", generation="auto")
+        build_chipagent_main(api, cfg)
+        node = api.get(KIND_NODE, "auto-ts-0")
+        assert node.metadata.labels[C.LABEL_CHIP_COUNT] == "4"
