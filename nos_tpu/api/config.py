@@ -35,6 +35,10 @@ class ManagerConfig:
     health_probe_addr: str = ""   # "host:port", "" = disabled
     metrics_addr: str = ""        # "host:port", "" = disabled
     leader_election: bool = False
+    # Path to a kubeconfig: run against a real kube-apiserver via the
+    # REST substrate adapter (nos_tpu/kube/rest.py) instead of the
+    # in-memory API seam.  "" = in-memory (sim / tests).
+    kubeconfig: str = ""
 
     def validate(self) -> None:
         for field in ("health_probe_addr", "metrics_addr"):

@@ -161,3 +161,17 @@ class Main:
 
 def health_port(addr: str) -> int:
     return int(addr.rsplit(":", 1)[1]) if addr else 0
+
+
+def build_api(cfg):
+    """The substrate the main runs against: a real cluster when the
+    config names a kubeconfig (production ingress), the in-memory
+    APIServer otherwise (sim, tests, bench)."""
+    if getattr(cfg, "kubeconfig", ""):
+        from nos_tpu.kube.rest import KubeClient
+
+        logger.info("substrate: kube-apiserver via %s", cfg.kubeconfig)
+        return KubeClient.from_kubeconfig(cfg.kubeconfig)
+    from nos_tpu.kube.client import APIServer
+
+    return APIServer()

@@ -14,7 +14,7 @@ import logging
 import sys
 
 from nos_tpu.api.config import ConfigError, AgentConfig, load_agent_config
-from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd._runtime import Main, build_api
 from nos_tpu.kube.client import APIServer, KIND_NODE, NotFound
 
 
@@ -66,7 +66,7 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f"invalid config: {e}", file=sys.stderr)
         return 2
-    build_chipagent_main(APIServer(), cfg).run_until_stopped()
+    build_chipagent_main(build_api(cfg), cfg).run_until_stopped()
     return 0
 
 

@@ -13,7 +13,7 @@ import sys
 
 from nos_tpu.api.config import ConfigError, OperatorConfig, load_config
 from nos_tpu.api.elasticquota import install_quota_webhooks
-from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd._runtime import Main, build_api
 from nos_tpu.controllers.elasticquota import (
     CompositeElasticQuotaReconciler, ElasticQuotaReconciler,
 )
@@ -54,7 +54,7 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f'invalid config: {e}', file=sys.stderr)
         return 2
-    build_operator_main(APIServer(), cfg).run_until_stopped()
+    build_operator_main(build_api(cfg), cfg).run_until_stopped()
     return 0
 
 

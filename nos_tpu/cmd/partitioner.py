@@ -86,7 +86,7 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f'invalid config: {e}', file=sys.stderr)
         return 2
-    api = APIServer()
+    api = build_api(cfg)
     state = ClusterState()
     m, _ = build_partitioner_main(api, state, cfg)
     if args.sim:

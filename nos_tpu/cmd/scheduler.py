@@ -13,7 +13,7 @@ import logging
 import sys
 
 from nos_tpu.api.config import ConfigError, SchedulerConfig, load_config
-from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd._runtime import Main, build_api
 from nos_tpu.cmd.assembly import build_scheduler
 from nos_tpu.kube.client import APIServer
 
@@ -32,7 +32,7 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f'invalid config: {e}', file=sys.stderr)
         return 2
-    api = APIServer()
+    api = build_api(cfg)
     scheduler = build_scheduler(api, cfg.tpu_memory_gb_per_chip)
     m = Main("nos-tpu-scheduler", cfg.health_probe_addr, api=api)
     m.add_loop("scheduler", scheduler.run_cycle, cfg.cycle_interval_s)

@@ -15,7 +15,7 @@ import logging
 import sys
 
 from nos_tpu.api.config import ConfigError, AgentConfig, load_agent_config
-from nos_tpu.cmd._runtime import Main
+from nos_tpu.cmd._runtime import Main, build_api
 from nos_tpu.kube.client import APIServer, KIND_NODE, NotFound
 
 
@@ -52,7 +52,29 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
                                             generation=generation))
     main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
                         cfg.health_probe_addr, api=api)
-    agent = SliceAgent(api, cfg.node_name, runtime, FakePodResources())
+    # Device usage source follows the SAME production switch as the API
+    # substrate (cfg.kubeconfig): a real deployment reads the kubelet
+    # pod-resources gRPC socket, the in-memory sim/bench uses the fake —
+    # sniffing the host filesystem instead would let the two seams
+    # disagree (reference pkg/resource/lister.go:28 discipline).
+    if cfg.kubeconfig:
+        import os
+
+        from nos_tpu.device.podresources import (
+            DEFAULT_SOCKET, KubeletPodResourcesClient,
+        )
+
+        if os.path.exists(DEFAULT_SOCKET):
+            pod_resources = KubeletPodResourcesClient()
+        else:
+            logging.getLogger(__name__).warning(
+                "kubeconfig set but %s missing: falling back to fake "
+                "pod-resources (device usage will be empty)",
+                DEFAULT_SOCKET)
+            pod_resources = FakePodResources()
+    else:
+        pod_resources = FakePodResources()
+    agent = SliceAgent(api, cfg.node_name, runtime, pod_resources)
     agent.start()  # startup cleanup + first report (migagent.go:190-199)
     main.add_loop("sliceagent", agent.tick, cfg.report_interval_s)
     return main
@@ -73,7 +95,7 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f"invalid config: {e}", file=sys.stderr)
         return 2
-    build_agent_main(APIServer(), cfg).run_until_stopped()
+    build_agent_main(build_api(cfg), cfg).run_until_stopped()
     return 0
 
 

@@ -1,0 +1,73 @@
+"""Kubelet pod-resources gRPC client: the production PodResourcesClient.
+
+The reference dials the kubelet's pod-resources unix socket to learn
+which devices are allocated to running pods
+(pkg/resource/lister.go:28-38, client.go:39-87); this is the same
+client for `google.com/tpu` and the nos.tpu slice/timeshare profile
+resources.  The proto subset lives in api.proto (generated api_pb2.py is
+committed; regenerate with `protoc --python_out=. api.proto`).
+
+Everything above the PodResourcesClient seam keeps running against
+FakePodResources off-cluster (the reference's mock discipline).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.device.tpuclient import PodResourcesClient
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+_LIST_METHOD = "/v1.PodResourcesLister/List"
+
+# Resource prefixes whose device ids name TPU hardware.
+TPU_RESOURCE_PREFIXES = ("nos.tpu/", "google.com/tpu")
+
+
+class KubeletPodResourcesClient(PodResourcesClient):
+    """PodResourcesClient over the kubelet gRPC socket."""
+
+    def __init__(self, socket_path: str = DEFAULT_SOCKET,
+                 timeout_s: float = 5.0,
+                 resource_prefixes=TPU_RESOURCE_PREFIXES) -> None:
+        import grpc
+
+        from . import api_pb2
+
+        self._pb = api_pb2
+        self._timeout = timeout_s
+        self._prefixes = tuple(resource_prefixes)
+        target = socket_path if "://" in socket_path \
+            else f"unix://{socket_path}"
+        self._channel = grpc.insecure_channel(target)
+        self._list = self._channel.unary_unary(
+            _LIST_METHOD,
+            request_serializer=api_pb2.ListPodResourcesRequest
+            .SerializeToString,
+            response_deserializer=api_pb2.ListPodResourcesResponse
+            .FromString,
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def list_pod_resources(self):
+        """Raw ListPodResourcesResponse (all resources, all pods)."""
+        return self._list(self._pb.ListPodResourcesRequest(),
+                          timeout=self._timeout)
+
+    def used_device_ids(self) -> set[str]:
+        out: set[str] = set()
+        resp = self.list_pod_resources()
+        for pod in resp.pod_resources:
+            for container in pod.containers:
+                for dev in container.devices:
+                    if dev.resource_name.startswith(self._prefixes):
+                        out.update(dev.device_ids)
+        return out
+
+
+__all__ = ["DEFAULT_SOCKET", "KubeletPodResourcesClient",
+           "TPU_RESOURCE_PREFIXES"]

@@ -1,0 +1,386 @@
+"""KubeClient: the production substrate adapter — the APIServer surface
+over a real kube-apiserver's REST API.
+
+The whole control plane is written against the in-memory APIServer's
+method surface (create/get/update/patch/delete/list/watch plus the
+field-index helpers); this class implements the same surface over HTTP
+so the cmd/ mains run against a real cluster with `--kubeconfig`
+(reference analog: the controller-runtime client every main builds).
+Contract tests (tests/test_substrate.py) run the in-memory server and
+this client against the same assertions, the client talking to a
+k8s-REST-shaped stub that enforces the real server's awkward semantics
+(nodeName immutability, the /binding and /status subresources).
+
+Semantics mapping:
+- create/update/delete  -> POST/PUT/DELETE on the typed paths
+  (nos_tpu/kube/k8s_codec.py owns JSON <-> dataclass translation).
+- patch(mutate=...)     -> JSON **merge patch** of exactly the fields
+  the mutate callback changed (diff of the codec's before/after
+  encodings), so unmodeled server-side fields are never stripped or
+  overwritten.  A status change routes to the /status subresource; a
+  Pod gaining spec.nodeName routes through POST .../binding (nodeName
+  is immutable via PUT/PATCH on a real apiserver).
+- watch(fn)             -> informer: synchronous list replay as ADDED,
+  then a streaming thread that re-lists on every (re)connect and diffs
+  against what it already delivered, so events raced between list and
+  stream — or dropped across a reconnect/410 — are recovered.
+- register_admission    -> no-op warning: in a real cluster admission
+  runs server-side via the validating webhooks the chart installs.
+
+Auth: minimal kubeconfig — server, CA (file or data), bearer token or
+client certificate (file or data).  Exotic auth plugins are out of
+scope.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable
+
+from nos_tpu.kube.client import Conflict, NotFound, WatchFn
+from nos_tpu.kube.k8s_codec import KIND_REST, from_k8s, rest_path, to_k8s
+from nos_tpu.kube.objects import Pod
+
+logger = logging.getLogger(__name__)
+
+# Kinds whose status lives behind the /status subresource (the shipped
+# CRDs all declare it; Pod and PDB have it natively).
+_STATUS_SUBRESOURCE = {"Pod", "ElasticQuota", "CompositeElasticQuota",
+                       "PodGroup", "PodDisruptionBudget", "Node"}
+
+
+def merge_diff(old: Any, new: Any) -> Any:
+    """JSON merge patch (RFC 7386) turning `old` into `new`; None when
+    they are equal."""
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        return new if new != old else None
+    out = {}
+    for key in new:
+        if key not in old:
+            out[key] = new[key]
+        else:
+            delta = merge_diff(old[key], new[key])
+            if delta is not None:
+                out[key] = delta
+    for key in old:
+        if key not in new:
+            out[key] = None  # merge-patch deletion
+    return out or None
+
+
+def _b64_file(data: str, suffix: str) -> str:
+    import base64
+    import tempfile
+
+    tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False,
+                                      mode="wb")
+    tmp.write(base64.b64decode(data))
+    tmp.close()
+    return tmp.name
+
+
+class KubeConfig:
+    def __init__(self, server: str, token: str = "",
+                 ca_file: str = "", insecure: bool = False,
+                 client_cert_file: str = "",
+                 client_key_file: str = "") -> None:
+        self.server = server.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.insecure = insecure
+        self.client_cert_file = client_cert_file
+        self.client_key_file = client_key_file
+
+    @classmethod
+    def load(cls, path: str) -> "KubeConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f)
+        ctx_name = data.get("current-context", "")
+        contexts = {c["name"]: c["context"]
+                    for c in data.get("contexts") or []}
+        ctx = contexts.get(ctx_name) or next(iter(contexts.values()), {})
+        clusters = {c["name"]: c["cluster"]
+                    for c in data.get("clusters") or []}
+        users = {u["name"]: u["user"] for u in data.get("users") or []}
+        cluster = clusters.get(ctx.get("cluster", "")) \
+            or next(iter(clusters.values()), {})
+        user = users.get(ctx.get("user", "")) \
+            or next(iter(users.values()), {})
+        ca_file = cluster.get("certificate-authority", "")
+        if cluster.get("certificate-authority-data") and not ca_file:
+            ca_file = _b64_file(
+                cluster["certificate-authority-data"], ".crt")
+        cert_file = user.get("client-certificate", "")
+        if user.get("client-certificate-data") and not cert_file:
+            cert_file = _b64_file(user["client-certificate-data"], ".crt")
+        key_file = user.get("client-key", "")
+        if user.get("client-key-data") and not key_file:
+            key_file = _b64_file(user["client-key-data"], ".key")
+        return cls(
+            server=cluster.get("server", ""),
+            token=user.get("token", ""),
+            ca_file=ca_file,
+            insecure=bool(cluster.get("insecure-skip-tls-verify", False)),
+            client_cert_file=cert_file,
+            client_key_file=key_file,
+        )
+
+
+class KubeClient:
+    """APIServer-surface client over kube-apiserver REST."""
+
+    def __init__(self, config: KubeConfig, timeout_s: float = 10.0) -> None:
+        self._cfg = config
+        self._timeout = timeout_s
+        self._watch_stop = threading.Event()
+        self._watch_threads: list[threading.Thread] = []
+        if config.server.startswith("https"):
+            if config.insecure:
+                self._ssl = ssl._create_unverified_context()
+            else:
+                self._ssl = ssl.create_default_context(
+                    cafile=config.ca_file or None)
+            if config.client_cert_file:
+                self._ssl.load_cert_chain(
+                    config.client_cert_file,
+                    config.client_key_file or None)
+        else:
+            self._ssl = None
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "KubeClient":
+        return cls(KubeConfig.load(path))
+
+    # -- HTTP ---------------------------------------------------------------
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 query: str = "", timeout: float | None = None,
+                 content_type: str = "application/json"):
+        url = self._cfg.server + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        if self._cfg.token:
+            req.add_header("Authorization", f"Bearer {self._cfg.token}")
+        try:
+            return urllib.request.urlopen(
+                req, timeout=timeout or self._timeout, context=self._ssl)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(path) from None
+            if e.code == 409:
+                raise Conflict(path) from None
+            detail = e.read().decode(errors="replace")[:500]
+            raise RuntimeError(
+                f"{method} {path} -> HTTP {e.code}: {detail}") from None
+
+    def _json(self, method: str, path: str, body: dict | None = None,
+              query: str = "", content_type: str = "application/json"):
+        with self._request(method, path, body, query,
+                           content_type=content_type) as resp:
+            return json.load(resp)
+
+    # -- CRUD (APIServer surface) ------------------------------------------
+    def create(self, kind: str, obj: Any) -> Any:
+        ns = getattr(obj.metadata, "namespace", "")
+        data = self._json("POST", rest_path(kind, ns), to_k8s(kind, obj))
+        return from_k8s(kind, data)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        data = self._json("GET", rest_path(kind, namespace, name))
+        return from_k8s(kind, data)
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Any | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, kind: str, obj: Any) -> Any:
+        ns = getattr(obj.metadata, "namespace", "")
+        data = self._json("PUT", rest_path(kind, ns, obj.metadata.name),
+                          to_k8s(kind, obj))
+        return from_k8s(kind, data)
+
+    _MERGE = "application/merge-patch+json"
+
+    def patch(self, kind: str, name: str, namespace: str = "",
+              mutate: Callable[[Any], None] | None = None) -> Any:
+        """Merge-patch exactly the fields `mutate` changed.
+
+        The diff is computed between the codec's encodings of the object
+        before and after the callback, so fields this framework does not
+        model are never touched on the server.  Special routes:
+        - Pod spec.nodeName appearing -> POST .../binding (nodeName is
+          immutable through PUT/PATCH);
+        - status changes -> PATCH on the /status subresource.
+        """
+        obj = self.get(kind, name, namespace)
+        before = to_k8s(kind, obj)
+        if mutate is not None:
+            mutate(obj)
+        after = to_k8s(kind, obj)
+        delta = merge_diff(before, after) or {}
+        meta_delta = delta.get("metadata")
+        if meta_delta:  # keep label/annotation changes, drop rv noise
+            for noise in ("resourceVersion", "uid", "creationTimestamp"):
+                meta_delta.pop(noise, None)
+            if not meta_delta:
+                delta.pop("metadata")
+
+        path = rest_path(kind, namespace, name)
+        if kind == "Pod":
+            spec_delta = delta.get("spec") or {}
+            node_name = spec_delta.pop("nodeName", None)
+            if not spec_delta:
+                delta.pop("spec", None)
+            if node_name:
+                self._json("POST", f"{path}/binding", {
+                    "apiVersion": "v1", "kind": "Binding",
+                    "metadata": {"name": name, "namespace": namespace},
+                    "target": {"apiVersion": "v1", "kind": "Node",
+                               "name": node_name},
+                })
+        status_delta = None
+        if kind in _STATUS_SUBRESOURCE:
+            status_delta = delta.pop("status", None)
+        if delta:
+            self._json("PATCH", path, delta, content_type=self._MERGE)
+        if status_delta is not None:
+            self._json("PATCH", f"{path}/status",
+                       {"status": status_delta},
+                       content_type=self._MERGE)
+        return self.get(kind, name, namespace)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._request("DELETE", rest_path(kind, namespace, name)):
+            pass
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None,
+             filter_fn: Callable[[Any], bool] | None = None) -> list[Any]:
+        query = ""
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            query = f"labelSelector={urllib.request.quote(sel)}"
+        data = self._json("GET", rest_path(kind, namespace or ""),
+                          query=query)
+        out = [from_k8s(kind, item) for item in data.get("items") or []]
+        if namespace is not None:
+            out = [o for o in out
+                   if getattr(o.metadata, "namespace", "") == namespace]
+        if filter_fn is not None:
+            out = [o for o in out if filter_fn(o)]
+        return out
+
+    # -- watch --------------------------------------------------------------
+    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+        """Informer-style: replay existing objects as ADDED synchronously,
+        then stream; every (re)connect re-lists and diffs against what was
+        already delivered, so events raced between list and stream — or
+        dropped across a 410/reconnect — are recovered as synthetic
+        ADDED/MODIFIED/DELETED."""
+        stop = threading.Event()
+        # (namespace, name) -> resource_version already delivered
+        known: dict[tuple[str, str], int] = {}
+
+        def obj_key(obj) -> tuple[str, str]:
+            return (getattr(obj.metadata, "namespace", ""),
+                    obj.metadata.name)
+
+        def deliver(event: str, obj) -> None:
+            key = obj_key(obj)
+            if event == "DELETED":
+                known.pop(key, None)
+                fn(event, obj)
+                return
+            rv = obj.metadata.resource_version
+            prev = known.get(key)
+            if prev is None:
+                known[key] = rv
+                fn("ADDED", obj)
+            elif rv != prev:
+                known[key] = rv
+                fn("MODIFIED", obj)
+
+        def sync() -> str:
+            """List, diff against `known`, return the list rv."""
+            listing = self._json("GET", rest_path(kind, ""))
+            seen: set[tuple[str, str]] = set()
+            for item in listing.get("items") or []:
+                obj = from_k8s(kind, item)
+                seen.add(obj_key(obj))
+                deliver("MODIFIED", obj)
+            for ns, name in [k for k in known if k not in seen]:
+                deliver("DELETED", from_k8s(
+                    kind, {"metadata": {"name": name, "namespace": ns}}))
+            return str((listing.get("metadata") or {})
+                       .get("resourceVersion", ""))
+
+        rv = sync()  # synchronous initial replay (informer sync)
+
+        def pump() -> None:
+            last_rv = rv
+            while not stop.is_set() and not self._watch_stop.is_set():
+                try:
+                    q = "watch=true" + (
+                        f"&resourceVersion={last_rv}" if last_rv else "")
+                    with self._request("GET", rest_path(kind, ""),
+                                       query=q, timeout=330.0) as resp:
+                        # The stream is registered server-side once the
+                        # response headers arrive; a sync here recovers
+                        # anything that happened between the previous
+                        # list and this registration (deliver() dedups
+                        # by resourceVersion).
+                        last_rv = sync()
+                        for line in resp:
+                            if stop.is_set():
+                                return
+                            if not line.strip():
+                                continue
+                            evt = json.loads(line)
+                            if evt.get("type") == "ERROR":
+                                break  # e.g. 410 Gone: reconnect + sync
+                            obj = from_k8s(kind, evt.get("object") or {})
+                            deliver(evt.get("type", "MODIFIED"), obj)
+                except (OSError, ValueError, NotFound, Conflict,
+                        RuntimeError) as e:
+                    if stop.is_set() or self._watch_stop.is_set():
+                        return
+                    logger.debug("watch %s reconnect: %s", kind, e)
+                    stop.wait(1.0)
+
+        t = threading.Thread(target=pump, name=f"watch-{kind}", daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return stop.set
+
+    def close(self) -> None:
+        self._watch_stop.set()
+
+    # -- field-index helpers (APIServer parity) ----------------------------
+    def kinds(self) -> list[str]:
+        return [k for k in KIND_REST if self.list(k)]
+
+    def pods_by_phase(self, phase: str) -> list[Pod]:
+        return self.list("Pod", filter_fn=lambda p: p.status.phase == phase)
+
+    def pods_on_node(self, node_name: str) -> list[Pod]:
+        return self.list(
+            "Pod", filter_fn=lambda p: p.spec.node_name == node_name)
+
+    def register_admission(self, kind: str, fn) -> None:
+        # In a real cluster admission runs server-side through the
+        # validating webhooks the helm chart installs; the in-process
+        # callback only applies to the in-memory substrate.
+        logger.warning(
+            "register_admission(%s) ignored on the REST substrate: "
+            "install the chart's validating webhooks instead", kind)

@@ -86,9 +86,17 @@ def from_dict(kind: str, data: dict) -> Any:
 
 
 def dump_state(api: APIServer) -> dict:
-    """{kind: [object dicts]} for every populated store."""
+    """{kind: [object dicts]} for every populated store.
+
+    Enumerates the serializable kinds directly (one list() per kind)
+    rather than asking `api.kinds()` first — against the REST substrate
+    kinds() itself lists everything, which would double the apiserver
+    round trips per snapshot."""
     out: dict[str, list] = {}
-    for kind in api.kinds():
+    kinds = set(KIND_TYPES)
+    if isinstance(api, APIServer):  # in-memory enumeration is free
+        kinds |= set(api.kinds())
+    for kind in sorted(kinds):
         objs = api.list(kind)
         if objs:
             out[kind] = [to_dict(o) for o in objs]

@@ -1,0 +1,274 @@
+"""A kube-apiserver-shaped HTTP stub for substrate contract tests.
+
+Implements the REST subset KubeClient (nos_tpu/kube/rest.py) speaks:
+typed collection/object paths, POST/GET/PUT/DELETE, labelSelector on
+list, optimistic concurrency (409 on stale resourceVersion), and
+?watch=true streaming of JSON-line events.  State is a plain dict of raw
+k8s JSON objects — deliberately NOT the nos_tpu object model, so the
+codec is exercised for real.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PATH = re.compile(
+    r"^/(?:api|apis)/(?P<gv>v1|[\w.]+/v1alpha1|policy/v1)"
+    r"(?:/namespaces/(?P<ns>[\w.-]+))?"
+    r"/(?P<plural>[a-z]+)"
+    r"(?:/(?P<name>[\w.-]+))?"
+    r"(?:/(?P<sub>binding|status))?$")
+
+
+def merge_apply(target: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch."""
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            merge_apply(target[k], v)
+        else:
+            target[k] = v
+    return target
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.store: dict[str, dict[str, dict]] = {}   # plural -> key -> obj
+        self.rv = 0
+        self.watchers: dict[str, list[queue.Queue]] = {}
+
+    def key(self, ns: str | None, name: str) -> str:
+        return f"{ns}/{name}" if ns else name
+
+    def bump(self, obj: dict) -> None:
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+
+    def notify(self, plural: str, event: str, obj: dict) -> None:
+        for q in self.watchers.get(plural, []):
+            q.put({"type": event, "object": obj})
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.0"
+
+    def log_message(self, *args) -> None:
+        pass
+
+    def _send(self, code: int, body: dict | None = None) -> None:
+        data = json.dumps(body or {}).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _parse(self):
+        parsed = urllib.parse.urlparse(self.path)
+        m = _PATH.match(parsed.path)
+        if not m:
+            self._send(404, {"message": f"bad path {parsed.path}"})
+            return None
+        return m.group("ns"), m.group("plural"), m.group("name"), \
+            urllib.parse.parse_qs(parsed.query), m.group("sub")
+
+    def _body(self) -> dict:
+        return json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+
+    def do_GET(self):  # noqa: N802
+        parsed = self._parse()
+        if parsed is None:
+            return
+        ns, plural, name, query, _sub = parsed
+        st = self.state
+        with st.lock:
+            coll = st.store.setdefault(plural, {})
+            if name:
+                key = st.key(ns, name)
+                if key not in coll:
+                    return self._send(404, {"message": "not found"})
+                return self._send(200, coll[key])
+            items = list(coll.values())
+        if ns:
+            items = [o for o in items
+                     if (o.get("metadata") or {}).get("namespace") == ns]
+        sel = query.get("labelSelector", [""])[0]
+        if sel:
+            want = dict(kv.split("=", 1) for kv in sel.split(","))
+            items = [o for o in items
+                     if all(((o.get("metadata") or {}).get("labels") or {})
+                            .get(k) == v for k, v in want.items())]
+        if query.get("watch", ["false"])[0] == "true":
+            return self._watch(plural)
+        self._send(200, {"kind": "List",
+                         "metadata": {"resourceVersion": str(st.rv)},
+                         "items": items})
+
+    def _watch(self, plural: str) -> None:
+        st = self.state
+        q: queue.Queue = queue.Queue()
+        with st.lock:
+            st.watchers.setdefault(plural, []).append(q)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            while True:
+                try:
+                    evt = q.get(timeout=10.0)
+                except queue.Empty:
+                    return
+                self.wfile.write((json.dumps(evt) + "\n").encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            with st.lock:
+                if q in st.watchers.get(plural, []):
+                    st.watchers[plural].remove(q)
+
+    def do_POST(self):  # noqa: N802
+        parsed = self._parse()
+        if parsed is None:
+            return
+        ns, plural, name, _, sub = parsed
+        obj = self._body()
+        st = self.state
+        if sub == "binding":
+            # POST pods/{name}/binding: the ONLY way to set nodeName
+            # (spec.nodeName is immutable through PUT/PATCH)
+            with st.lock:
+                coll = st.store.setdefault(plural, {})
+                key = st.key(ns, name)
+                if key not in coll:
+                    return self._send(404, {"message": "not found"})
+                target = (obj.get("target") or {}).get("name", "")
+                coll[key].setdefault("spec", {})["nodeName"] = target
+                st.bump(coll[key])
+                st.notify(plural, "MODIFIED", coll[key])
+            return self._send(201, {"status": "Success"})
+        with st.lock:
+            coll = st.store.setdefault(plural, {})
+            name = (obj.get("metadata") or {}).get("name", "")
+            key = st.key(ns, name)
+            if key in coll:
+                return self._send(409, {"message": "already exists"})
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", f"stub-uid-{st.rv + 1}")
+            meta.setdefault("creationTimestamp",
+                            "2026-01-01T00:00:00Z")
+            if ns:
+                meta["namespace"] = ns
+            st.bump(obj)
+            coll[key] = obj
+            st.notify(plural, "ADDED", obj)
+        self._send(201, obj)
+
+    def do_PUT(self):  # noqa: N802
+        parsed = self._parse()
+        if parsed is None:
+            return
+        ns, plural, name, _, _sub = parsed
+        obj = self._body()
+        st = self.state
+        with st.lock:
+            coll = st.store.setdefault(plural, {})
+            key = st.key(ns, name)
+            if key not in coll:
+                return self._send(404, {"message": "not found"})
+            current = coll[key]
+            current_rv = (current.get("metadata") or {}) \
+                .get("resourceVersion")
+            sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if sent_rv and sent_rv != current_rv:
+                return self._send(409, {"message": "conflict"})
+            if plural == "pods":
+                old_nn = (current.get("spec") or {}).get("nodeName", "")
+                new_nn = (obj.get("spec") or {}).get("nodeName", "")
+                if new_nn != old_nn:
+                    return self._send(422, {
+                        "message": "spec.nodeName is immutable; "
+                                   "use the binding subresource"})
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", (current["metadata"]).get("uid"))
+            if ns:
+                meta["namespace"] = ns
+            st.bump(obj)
+            coll[key] = obj
+            st.notify(plural, "MODIFIED", obj)
+        self._send(200, obj)
+
+    def do_PATCH(self):  # noqa: N802
+        parsed = self._parse()
+        if parsed is None:
+            return
+        ns, plural, name, _, sub = parsed
+        patch = self._body()
+        st = self.state
+        with st.lock:
+            coll = st.store.setdefault(plural, {})
+            key = st.key(ns, name)
+            if key not in coll:
+                return self._send(404, {"message": "not found"})
+            current = coll[key]
+            if sub == "status":
+                # only the status stanza applies through /status
+                merge_apply(current.setdefault("status", {}),
+                            (patch.get("status") or {}))
+            else:
+                if plural == "pods":
+                    nn = (patch.get("spec") or {}).get("nodeName")
+                    old_nn = (current.get("spec") or {}) \
+                        .get("nodeName", "")
+                    if nn is not None and nn != old_nn:
+                        return self._send(422, {
+                            "message": "spec.nodeName is immutable; "
+                                       "use the binding subresource"})
+                patch.pop("status", None)  # status via /status only
+                merge_apply(current, patch)
+            st.bump(current)
+            st.notify(plural, "MODIFIED", current)
+        self._send(200, current)
+
+    def do_DELETE(self):  # noqa: N802
+        parsed = self._parse()
+        if parsed is None:
+            return
+        ns, plural, name, _, _sub = parsed
+        st = self.state
+        with st.lock:
+            coll = st.store.setdefault(plural, {})
+            key = st.key(ns, name)
+            if key not in coll:
+                return self._send(404, {"message": "not found"})
+            obj = coll.pop(key)
+            st.notify(plural, "DELETED", obj)
+        self._send(200, {"status": "Success"})
+
+
+class StubApiServer:
+    """Context manager exposing the stub's base URL."""
+
+    def __init__(self) -> None:
+        self.state = _State()
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def __enter__(self) -> "StubApiServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.httpd.shutdown()
