@@ -45,6 +45,9 @@ class ManagerConfig:
             addr = getattr(self, field)
             if addr and ":" not in addr:
                 raise ConfigError(f"{field} must be host:port, got {addr!r}")
+        if self.kubeconfig and not pathlib.Path(self.kubeconfig).is_file():
+            raise ConfigError(
+                f"kubeconfig {self.kubeconfig!r} does not exist")
 
 
 @dataclasses.dataclass

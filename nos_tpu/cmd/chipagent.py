@@ -38,6 +38,10 @@ def build_chipagent_main(api: APIServer, cfg: AgentConfig,
     try:
         api.get(KIND_NODE, cfg.node_name)
     except NotFound:
+        if not isinstance(api, APIServer):
+            raise ConfigError(
+                f"node {cfg.node_name!r} not found in the cluster "
+                f"(kubelet not registered yet, or --node is wrong)")
         from nos_tpu.testing.factory import make_tpu_node
 
         api.create(KIND_NODE, make_tpu_node(

@@ -44,12 +44,19 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
     try:
         api.get(KIND_NODE, cfg.node_name)
     except NotFound:
-        # standalone demo process: self-register the node object (a real
-        # deployment reads it from the cluster API server)
-        from nos_tpu.testing.factory import make_tpu_node
+        if isinstance(api, APIServer):
+            # standalone demo process: self-register the node object (a
+            # real deployment reads it from the cluster API server)
+            from nos_tpu.testing.factory import make_tpu_node
 
-        api.create(KIND_NODE, make_tpu_node(cfg.node_name,
-                                            generation=generation))
+            api.create(KIND_NODE, make_tpu_node(cfg.node_name,
+                                                generation=generation))
+        else:
+            # never fabricate nodes in a real cluster — a typo'd --node
+            # would make the planner carve phantom hardware
+            raise ConfigError(
+                f"node {cfg.node_name!r} not found in the cluster "
+                f"(kubelet not registered yet, or --node is wrong)")
     main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
                         cfg.health_probe_addr, api=api)
     # Device usage source follows the SAME production switch as the API
@@ -64,14 +71,16 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
             DEFAULT_SOCKET, KubeletPodResourcesClient,
         )
 
-        if os.path.exists(DEFAULT_SOCKET):
-            pod_resources = KubeletPodResourcesClient()
-        else:
-            logging.getLogger(__name__).warning(
-                "kubeconfig set but %s missing: falling back to fake "
-                "pod-resources (device usage will be empty)",
-                DEFAULT_SOCKET)
-            pod_resources = FakePodResources()
+        if not os.path.exists(DEFAULT_SOCKET):
+            # Refuse to start: an empty (fake) used-set would make
+            # startup_cleanup delete every carved slice on the node,
+            # including ones backing running pods.  A missing socket in
+            # production is a mount/config error, not a fallback case.
+            raise ConfigError(
+                f"kubeconfig is set but the kubelet pod-resources socket "
+                f"{DEFAULT_SOCKET} does not exist — mount "
+                f"/var/lib/kubelet/pod-resources into the agent pod")
+        pod_resources = KubeletPodResourcesClient()
     else:
         pod_resources = FakePodResources()
     agent = SliceAgent(api, cfg.node_name, runtime, pod_resources)

@@ -81,12 +81,6 @@ def _resources_in(data: dict) -> dict:
 _RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
 
 
-def _ts_out(epoch: float) -> str | None:
-    if not epoch:
-        return None
-    return time.strftime(_RFC3339, time.gmtime(epoch))
-
-
 def _ts_in(s: Any) -> float:
     if not s:
         return 0.0

@@ -252,12 +252,17 @@ class KubeClient:
         status_delta = None
         if kind in _STATUS_SUBRESOURCE:
             status_delta = delta.pop("status", None)
+        result = None
         if delta:
-            self._json("PATCH", path, delta, content_type=self._MERGE)
+            result = self._json("PATCH", path, delta,
+                                content_type=self._MERGE)
         if status_delta is not None:
-            self._json("PATCH", f"{path}/status",
-                       {"status": status_delta},
-                       content_type=self._MERGE)
+            result = self._json("PATCH", f"{path}/status",
+                                {"status": status_delta},
+                                content_type=self._MERGE)
+        if result is not None:
+            return from_k8s(kind, result)
+        # binding-only (or no-op) path: one GET for the server's view
         return self.get(kind, name, namespace)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
@@ -307,7 +312,10 @@ class KubeClient:
             if prev is None:
                 known[key] = rv
                 fn("ADDED", obj)
-            elif rv != prev:
+            elif rv > prev:
+                # strictly newer only: a reconnecting stream can replay
+                # events older than what sync() already delivered, and
+                # forwarding them would regress watchers to stale state
                 known[key] = rv
                 fn("MODIFIED", obj)
 

@@ -1,0 +1,325 @@
+"""Hardening tests (round-2 VERDICT #8): the envtest-discipline gaps.
+
+- kill/restart a controller and an agent mid-plan: all durable state
+  lives in node annotations (SURVEY.md §5 checkpoint/resume), so fresh
+  processes must resume the handshake where the dead ones left it;
+- native-shim fault injection through the actuator: the REAL C++ error
+  paths (rc=-1 infeasible create, unknown-device delete) plus a runtime
+  that fails transiently, asserting the duplicate-plan guard does not
+  wedge the retry;
+- packer property tests on random multisets: Python and native searches
+  agree on feasibility, placements actually tile (in-bounds, aligned,
+  non-overlapping), and feasibility is monotone under taking subsets;
+- a 64-host scale point bounding the scheduler cycle wall time.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import (
+    new_slice_partitioner_controller,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import Shape, V5E
+from nos_tpu.topology.annotations import (
+    spec_matches_status, spec_plan_id, status_plan_id,
+)
+
+
+class Cluster:
+    """Minimal decision plane over one fake host, with the ability to
+    'kill' (drop) and recreate each component."""
+
+    def __init__(self):
+        self.api = APIServer()
+        self.clock = [0.0]
+        self.state = ClusterState()
+        NodeController(self.api, self.state,
+                       SliceNodeInitializer(self.api)).bind()
+        PodController(self.api, self.state).bind()
+        self.partitioner = self._new_partitioner()
+        self.api.create(KIND_NODE, make_tpu_node("host-0"))
+        self.runtime = FakeTpuRuntime(V5E)
+        self.agent = self._new_agent()
+        self.scheduler = Scheduler(self.api, Framework())
+
+    def demand(self, shape: str, qty: int, name: str) -> None:
+        """Submit a pod and let the scheduler mark it unschedulable —
+        the partitioner only considers pods the scheduler gave up on
+        (ExtraResourcesCouldHelpScheduling)."""
+        self.api.create(KIND_POD, make_slice_pod(shape, qty, name=name))
+        self.scheduler.run_cycle()
+
+    def _new_partitioner(self):
+        ctl = new_slice_partitioner_controller(
+            self.api, self.state, batch_timeout_s=60.0, batch_idle_s=10.0,
+            clock=lambda: self.clock[0])
+        ctl.bind()
+        return ctl
+
+    def _new_agent(self) -> SliceAgent:
+        # same runtime (the hardware keeps its carved slices across an
+        # agent restart), fresh in-process state
+        return SliceAgent(self.api, "host-0", self.runtime,
+                          FakePodResources())
+
+    def node(self):
+        return self.api.get(KIND_NODE, "host-0")
+
+
+class TestKillRestartMidPlan:
+    def test_agent_restart_resumes_plan_from_annotations(self):
+        c = Cluster()
+        c.agent.start()
+        c.agent.tick()  # init geometry reported
+        # demand forces a repartition plan onto the node
+        c.demand("2x2", 2, "want")
+        c.clock[0] += 61.0
+        c.partitioner.process_if_ready()
+        node = c.node()
+        plan_id = spec_plan_id(node.metadata.annotations, family="slice")
+        assert plan_id, "partitioner wrote no plan"
+        assert not spec_matches_status(node.metadata.annotations)
+
+        # the agent dies before actuating; a FRESH agent (fresh
+        # SharedState, same hardware) must pick the plan up purely from
+        # the annotations
+        c.agent = c.agent2 = c._new_agent()
+        c.agent.start()
+        c.agent.tick()
+        node = c.node()
+        assert spec_matches_status(node.metadata.annotations)
+        assert status_plan_id(
+            node.metadata.annotations, family="slice") == plan_id
+
+    def test_partitioner_restart_honors_inflight_handshake(self):
+        c = Cluster()
+        c.agent.start()
+        c.agent.tick()
+        c.demand("2x2", 2, "want")
+        c.clock[0] += 61.0
+        c.partitioner.process_if_ready()
+        node = c.node()
+        plan_id = spec_plan_id(node.metadata.annotations, family="slice")
+        assert plan_id
+
+        # partitioner dies; its replacement sees the unreported plan and
+        # must NOT write a second plan while the handshake is open
+        c.partitioner = c._new_partitioner()
+        c.demand("1x1", 1, "more")
+        c.clock[0] += 61.0
+        c.partitioner.process_if_ready()
+        node = c.node()
+        assert spec_plan_id(
+            node.metadata.annotations, family="slice") == plan_id
+
+        # agent reports -> handshake closes -> the new partitioner may
+        # now plan for the extra demand
+        c.agent.tick()
+        c.clock[0] += 61.0
+        c.partitioner.process_if_ready()
+        node = c.node()
+        new_plan = spec_plan_id(node.metadata.annotations, family="slice")
+        assert new_plan and new_plan != plan_id
+
+
+class _FlakyRuntime:
+    """Delegating runtime whose create_slices fails until `heal()`."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.fail = True
+
+    def heal(self):
+        self.fail = False
+
+    def create_slices(self, unit_index, shapes):
+        if self.fail:
+            raise RuntimeError("injected: create_slices rc=-2")
+        return self._inner.create_slices(unit_index, shapes)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestNativeFaultInjection:
+    def test_real_shim_error_paths(self):
+        """The C++ shim's rc<0 paths surface as typed exceptions."""
+        from nos_tpu.device import native
+        from nos_tpu.topology.errors import DeviceNotFoundError
+
+        if not native.available():
+            pytest.skip("native shim did not build")
+        rt = native.NativeTpuRuntime(V5E)
+        with pytest.raises(DeviceNotFoundError):
+            rt.delete_slice("no-such-device")          # rc != 0
+        with pytest.raises(native.NativeSliceError):
+            rt.create_slices(0, [Shape.parse("2x4")] * 2)   # rc=-1
+
+    def test_actuator_retries_after_transient_create_failure(self):
+        c = Cluster()
+        flaky = _FlakyRuntime(c.runtime)
+        c.agent = SliceAgent(c.api, "host-0", flaky, FakePodResources())
+        c.agent.start()
+        c.agent.tick()
+        c.demand("2x2", 2, "want")
+        c.clock[0] += 61.0
+        c.partitioner.process_if_ready()
+
+        c.agent.tick()  # create fails (injected); must not wedge
+        node = c.node()
+        assert not spec_matches_status(node.metadata.annotations)
+
+        flaky.heal()
+        c.agent.tick()  # the SAME plan must be retried, not deduped away
+        node = c.node()
+        assert spec_matches_status(node.metadata.annotations)
+
+    def test_reporter_survives_listing_failure(self):
+        c = Cluster()
+
+        class BrokenList(_FlakyRuntime):
+            def list_devices(self):
+                if self.fail:
+                    raise RuntimeError("injected: truncated list output")
+                return self._inner.list_devices()
+
+        broken = BrokenList(c.runtime)
+        broken.fail = False
+        c.agent = SliceAgent(c.api, "host-0", broken, FakePodResources())
+        c.agent.start()
+        c.agent.tick()
+        broken.fail = True
+        with pytest.raises(RuntimeError):
+            c.agent.tick()   # the run loop logs this in production
+        broken.fail = False
+        c.agent.tick()       # recovery needs no restart
+        assert spec_matches_status(c.node().metadata.annotations)
+
+
+def _occupancy(placements, block: Shape) -> int:
+    bdims = tuple(block.dims) + (1,) * (3 - len(block.dims))
+    mask = 0
+    for pl in placements:
+        dims = tuple(pl.dims) + (1,) * (3 - len(pl.dims))
+        off = tuple(pl.offset) + (0,) * (3 - len(pl.offset))
+        for x in range(dims[0]):
+            for y in range(dims[1]):
+                for z in range(dims[2]):
+                    px, py, pz = off[0] + x, off[1] + y, off[2] + z
+                    assert px < bdims[0] and py < bdims[1] and pz < bdims[2]
+                    bit = 1 << (px * bdims[1] * bdims[2] + py * bdims[2] + pz)
+                    assert not (mask & bit), "overlapping placements"
+                    mask |= bit
+    return mask
+
+
+class TestPackerProperties:
+    SHAPES = [Shape.parse(s) for s in ("1x1", "1x2", "2x2", "1x4", "2x4")]
+
+    def _random_multiset(self, rng) -> dict:
+        counts: dict = {}
+        budget = V5E.host_block.chips + rng.randrange(0, 5)  # may overflow
+        while budget > 0:
+            s = rng.choice(self.SHAPES)
+            counts[s] = counts.get(s, 0) + 1
+            budget -= s.chips
+        return counts
+
+    def test_python_and_native_agree_and_tile(self):
+        from nos_tpu.device import native
+        from nos_tpu.topology import packing
+
+        rng = random.Random(7)
+        block = V5E.host_block
+        checked_native = 0
+        for _ in range(60):
+            counts = self._random_multiset(rng)
+            key = packing._counts_key(counts)
+            py = packing._pack_masks(block, key, occupied=0,
+                                     require_full=False)
+            if py is not None:
+                _occupancy(py, block)  # in-bounds, non-overlapping
+                placed = sorted(p.shape.canonical() for p in py)
+                want = sorted(s.canonical() for s, n in counts.items()
+                              for _ in range(n))
+                assert placed == want
+            if native.available():
+                nat = native.native_packer(block, key, 0, False)
+                if nat is not NotImplemented:
+                    checked_native += 1
+                    assert (nat is None) == (py is None), counts
+                    if nat is not None:
+                        _occupancy(nat, block)
+        if native.available():
+            assert checked_native >= 50
+
+    def test_feasibility_monotone_under_subsets(self):
+        from nos_tpu.topology import packing
+
+        rng = random.Random(11)
+        block = V5E.host_block
+        for _ in range(40):
+            counts = self._random_multiset(rng)
+            if not packing.feasible(block, counts):
+                continue
+            sub = dict(counts)
+            victim = rng.choice(list(sub))
+            sub[victim] -= 1
+            if not sub[victim]:
+                del sub[victim]
+            assert packing.feasible(block, sub), (counts, sub)
+
+    def test_require_full_is_an_exact_tiling(self):
+        from nos_tpu.topology import packing
+
+        block = V5E.host_block
+        res = packing.pack(block, {Shape.parse("2x2"): 2}, require_full=True)
+        assert res is not None
+        assert sum(p.shape.chips for p in res) == block.chips
+        assert packing.pack(block, {Shape.parse("2x2"): 1},
+                            require_full=True) is None
+
+
+class TestSchedulerScale64Hosts:
+    def test_cycle_p99_stays_sub_second(self):
+        api = APIServer()
+        for i in range(64):
+            node = make_tpu_node(
+                f"host-{i}", pod_id=f"pod-{i // 16}", host_index=i % 16,
+                status_geometry={"free": {"2x4": 1}, "used": {}})
+            api.create(KIND_NODE, node)
+        scheduler = Scheduler(
+            api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+
+        rng = random.Random(3)
+        for i in range(96):
+            shape = rng.choice(["1x1", "2x2", "2x4"])
+            api.create(KIND_POD, make_slice_pod(shape, 1, name=f"p{i}"))
+
+        cycles = []
+        for _ in range(12):
+            t0 = time.perf_counter()
+            scheduler.run_cycle()
+            cycles.append(time.perf_counter() - t0)
+        cycles.sort()
+        p99 = cycles[-1]
+        assert p99 < 1.0, f"64-host cycle p99 {p99:.3f}s"
+        bound = sum(1 for p in api.list(KIND_POD) if p.spec.node_name)
+        assert bound > 0
