@@ -29,8 +29,8 @@ class ConfigError(ValueError):
 @dataclasses.dataclass
 class ManagerConfig:
     """Shared manager knobs (the ControllerManagerConfigurationSpec embed:
-    health probe + metrics bind addresses; leader election is moot for the
-    in-memory substrate but kept for config parity)."""
+    health probe + metrics bind addresses; leader_election gates the run
+    loops behind a ConfigMap lease, nos_tpu/kube/leaderelection.py)."""
 
     health_probe_addr: str = ""   # "host:port", "" = disabled
     metrics_addr: str = ""        # "host:port", "" = disabled

@@ -26,15 +26,23 @@ class RunLoop(threading.Thread):
     is logged and counted, not fatal (level-triggered reconcile)."""
 
     def __init__(self, name: str, fn: Callable[[], object],
-                 interval_s: float, stop: threading.Event) -> None:
+                 interval_s: float, stop: threading.Event,
+                 gate: threading.Event | None = None) -> None:
         super().__init__(name=name, daemon=True)
         self._fn = fn
         self._interval = interval_s
         # NB: not `_stop` — threading.Thread uses that name internally.
         self._halt = stop
+        self._gate = gate        # tick only while set (leader election)
+
+    def set_gate(self, gate: threading.Event | None) -> None:
+        self._gate = gate
 
     def run(self) -> None:
         while not self._halt.is_set():
+            if self._gate is not None and not self._gate.is_set():
+                self._halt.wait(0.2)
+                continue
             t0 = time.perf_counter()
             try:
                 self._fn()
@@ -104,10 +112,27 @@ class Main:
         self._loops: list[RunLoop] = []
         self._server: http.server.ThreadingHTTPServer | None = None
         self._health_addr = health_addr
+        self._elector = None
+        self._leader_gate: threading.Event | None = None
 
     def add_loop(self, name: str, fn: Callable[[], object],
                  interval_s: float) -> None:
-        self._loops.append(RunLoop(name, fn, interval_s, self.stop))
+        self._loops.append(RunLoop(name, fn, interval_s, self.stop,
+                                   gate=self._leader_gate))
+
+    def attach_leader_election(self, elector) -> None:
+        """Gate every run loop on holding the lease (loops added before
+        or after this call are covered equally); the elector's
+        acquire/renew loop starts with the main.  Losing an acquired
+        lease stops the main — controller-runtime semantics: watch-bound
+        controllers cannot be un-bound, so a demoted process must exit
+        and rejoin as a candidate on restart."""
+        self._elector = elector
+        self._leader_gate = elector.is_leader
+        if elector.on_stopped_leading is None:
+            elector.on_stopped_leading = self.stop.set
+        for loop in self._loops:
+            loop.set_gate(self._leader_gate)
 
     def start(self) -> None:
         if self._health_addr:
@@ -120,6 +145,10 @@ class Main:
                              daemon=True).start()
             logger.info("%s: health/metrics on %s", self.name,
                         self._health_addr)
+        if self._elector is not None:
+            threading.Thread(
+                target=self._elector.run, args=(self.stop,),
+                name=f"{self.name}-leader-election", daemon=True).start()
         for loop in self._loops:
             loop.start()
         self.ready.set()

@@ -35,27 +35,44 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
         DEFAULT_REGISTRY.load_overrides(cfg.known_geometries_file)
     main = main or Main("nos-tpu-partitioner", cfg.health_probe_addr,
                         api=api)
-    NodeController(api, state, SliceNodeInitializer(api)).bind()
-    PodController(api, state).bind()
     controllers = []
-    if cfg.kind in (SLICE_KIND, HYBRID_KIND):
-        ctl = new_slice_partitioner_controller(
-            api, state, batch_timeout_s=cfg.batch_timeout_s,
-            batch_idle_s=cfg.batch_idle_s)
-        ctl.bind()
-        controllers.append(ctl)
-        main.add_loop("partitioner-slice", ctl.process_if_ready,
-                      cfg.poll_interval_s)
-    if cfg.kind in (TIMESHARE_KIND, HYBRID_KIND):
-        ctl = new_timeshare_partitioner_controller(
-            api, state, batch_timeout_s=cfg.batch_timeout_s,
-            batch_idle_s=cfg.batch_idle_s,
-            cm_name=cfg.device_plugin_cm_name,
-            cm_namespace=cfg.device_plugin_cm_namespace)
-        ctl.bind()
-        controllers.append(ctl)
-        main.add_loop("partitioner-timeshare", ctl.process_if_ready,
-                      cfg.poll_interval_s)
+
+    def bind_controllers() -> None:
+        """Watch-bound controllers write (node init, spec annotations),
+        so with leader election they bind only on GAINING the lease —
+        a standby replica must not reconcile."""
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        if cfg.kind in (SLICE_KIND, HYBRID_KIND):
+            ctl = new_slice_partitioner_controller(
+                api, state, batch_timeout_s=cfg.batch_timeout_s,
+                batch_idle_s=cfg.batch_idle_s)
+            ctl.bind()
+            controllers.append(ctl)
+            main.add_loop("partitioner-slice", ctl.process_if_ready,
+                          cfg.poll_interval_s)
+        if cfg.kind in (TIMESHARE_KIND, HYBRID_KIND):
+            ctl = new_timeshare_partitioner_controller(
+                api, state, batch_timeout_s=cfg.batch_timeout_s,
+                batch_idle_s=cfg.batch_idle_s,
+                cm_name=cfg.device_plugin_cm_name,
+                cm_namespace=cfg.device_plugin_cm_namespace)
+            ctl.bind()
+            controllers.append(ctl)
+            main.add_loop("partitioner-timeshare", ctl.process_if_ready,
+                          cfg.poll_interval_s)
+        for loop in main._loops:
+            if not loop.is_alive() and main.ready.is_set():
+                loop.start()   # loops added after main.start()
+
+    if cfg.leader_election:
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        main.attach_leader_election(LeaderElector(
+            api, "nos-tpu-partitioner-leader",
+            on_started_leading=bind_controllers))
+    else:
+        bind_controllers()
     return main, controllers
 
 

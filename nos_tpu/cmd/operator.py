@@ -25,6 +25,11 @@ def build_operator_main(api: APIServer, cfg: OperatorConfig,
                         main: Main | None = None) -> Main:
     main = main or Main("nos-tpu-operator", cfg.health_probe_addr,
                         api=api)
+    if cfg.leader_election:
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        main.attach_leader_election(
+            LeaderElector(api, "nos-tpu-operator-leader"))
     install_quota_webhooks(api)
     calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
     eq = ElasticQuotaReconciler(api, calc)

@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     api = build_api(cfg)
     scheduler = build_scheduler(api, cfg.tpu_memory_gb_per_chip)
     m = Main("nos-tpu-scheduler", cfg.health_probe_addr, api=api)
+    if cfg.leader_election:
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        m.attach_leader_election(
+            LeaderElector(api, "nos-tpu-scheduler-leader"))
     m.add_loop("scheduler", scheduler.run_cycle, cfg.cycle_interval_s)
     m.run_until_stopped()
     return 0

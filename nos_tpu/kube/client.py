@@ -110,6 +110,14 @@ class APIServer:
             store = self._stores[kind]
             if key not in store:
                 raise NotFound(f"{kind} {key}")
+            # PUT optimistic concurrency (k8s semantics): an object read
+            # at rv N cannot overwrite rv M != N.  rv 0 = unconditional.
+            sent_rv = getattr(obj.metadata, "resource_version", 0)
+            current_rv = store[key].metadata.resource_version
+            if sent_rv and sent_rv != current_rv:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {sent_rv} != "
+                    f"{current_rv}")
             self._admit(kind, obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv

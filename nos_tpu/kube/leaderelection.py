@@ -1,0 +1,172 @@
+"""Leader election over the API substrate (ConfigMap-lease pattern).
+
+Every reference main runs controller-runtime leader election backed by a
+coordination Lease (helm values.yaml:57,121,285); here the lease is a
+ConfigMap annotation record — the classic pre-Lease-API pattern — so the
+SAME implementation works against the in-memory APIServer and the REST
+substrate (the ConfigMap kind exists on both; a dedicated Lease kind
+would only exist on the latter).
+
+Protocol: the lease ConfigMap's annotations carry holder identity and a
+renew deadline.  A candidate acquires when the lease is absent, expired,
+or already its own; the holder renews every `renew_s`; anyone else
+re-checks after `retry_s`.  Clock skew tolerance comes from
+`lease_duration_s` being several renew intervals.  Acquire/renew writes
+go through create/update — PUT carries the read resourceVersion, so a
+lost race is a Conflict (409) on both substrates; merge-patch would have
+no optimistic concurrency on REST and allow split-brain.
+
+Semantics follow controller-runtime: callbacks fire on gaining
+leadership (bind controllers then), and LOSING an acquired lease is
+fatal — the owner is expected to shut down and restart as a candidate
+(a half-demoted process with live watch callbacks would keep writing).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+
+from nos_tpu.kube.client import Conflict, KIND_CONFIGMAP, NotFound
+from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+
+logger = logging.getLogger(__name__)
+
+ANN_HOLDER = "nos.tpu/leader"
+ANN_DEADLINE = "nos.tpu/lease-renew-deadline"
+
+
+class LeaderElector:
+    """Acquire/renew a named lease; `is_leader` is set while held.
+
+    `run(stop_event)` drives the acquire/renew loop (Main starts it on a
+    thread); Main gates every run loop on `is_leader`, so a non-leader
+    replica idles until the holder dies or releases."""
+
+    def __init__(self, api, name: str, namespace: str = "nos-tpu-system",
+                 identity: str | None = None,
+                 lease_duration_s: float = 15.0,
+                 renew_s: float = 5.0,
+                 retry_s: float = 2.0,
+                 clock=time.time,  # wall clock: deadlines cross processes
+                 on_started_leading=None,
+                 on_stopped_leading=None) -> None:
+        self._api = api
+        self._name = name
+        self._ns = namespace
+        self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
+        self._duration = lease_duration_s
+        self._renew = renew_s
+        self._retry = retry_s
+        self._clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = threading.Event()
+
+    # -- lease record --------------------------------------------------------
+    def _read(self) -> tuple[str, float] | None:
+        cm = self._api.try_get(KIND_CONFIGMAP, self._name, self._ns)
+        if cm is None:
+            return None
+        anns = cm.metadata.annotations
+        holder = anns.get(ANN_HOLDER, "")
+        try:
+            deadline = float(anns.get(ANN_DEADLINE, "0"))
+        except ValueError:
+            deadline = 0.0
+        return holder, deadline
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election step; returns True while this identity leads."""
+        now = self._clock()
+        deadline = now + self._duration
+        try:
+            cm = self._api.try_get(KIND_CONFIGMAP, self._name, self._ns)
+            if cm is None:
+                cm = ConfigMap(metadata=ObjectMeta(
+                    name=self._name, namespace=self._ns,
+                    annotations={ANN_HOLDER: self.identity,
+                                 ANN_DEADLINE: str(deadline)}))
+                try:
+                    self._api.create(KIND_CONFIGMAP, cm)
+                except NotFound:
+                    # the lease NAMESPACE is missing: unrecoverable
+                    # misconfiguration, not "someone else leads"
+                    logger.error(
+                        "leader election %s: cannot create lease in "
+                        "namespace %r (does it exist?)",
+                        self._name, self._ns)
+                    return False
+                logger.info("leader election %s: %s acquired",
+                            self._name, self.identity)
+                return True
+            anns = cm.metadata.annotations
+            holder = anns.get(ANN_HOLDER, "")
+            try:
+                held_until = float(anns.get(ANN_DEADLINE, "0"))
+            except ValueError:
+                held_until = 0.0
+            if holder != self.identity and held_until > now:
+                return False  # someone else holds a live lease
+            # CAS: the PUT carries the resourceVersion we just read, so
+            # a concurrent acquirer makes this a Conflict — merge-patch
+            # would have no such guard on the REST substrate.
+            anns[ANN_HOLDER] = self.identity
+            anns[ANN_DEADLINE] = str(deadline)
+            self._api.update(KIND_CONFIGMAP, cm)
+            if holder != self.identity:
+                logger.info("leader election %s: %s took over from %s",
+                            self._name, self.identity, holder or "<none>")
+            return True
+        except (Conflict, NotFound):
+            return False
+        except Exception as e:  # noqa: BLE001 — a blip must not end election
+            logger.warning("leader election %s: step failed (%s); retrying",
+                           self._name, e)
+            return False
+
+    def run(self, stop: threading.Event) -> None:
+        """Acquire/renew loop until `stop`; releases the lease on exit.
+        Losing an acquired lease invokes on_stopped_leading (fatal in
+        Main: a half-demoted process would keep writing via its watch
+        callbacks) and ends the loop."""
+        led = False
+        try:
+            while not stop.is_set():
+                if self.try_acquire_or_renew():
+                    if not led:
+                        led = True
+                        if self.on_started_leading is not None:
+                            self.on_started_leading()
+                    self.is_leader.set()
+                    stop.wait(self._renew)
+                else:
+                    if led:
+                        logger.error(
+                            "leader election %s: %s LOST the lease — "
+                            "stopping (restart to rejoin as candidate)",
+                            self._name, self.identity)
+                        self.is_leader.clear()
+                        if self.on_stopped_leading is not None:
+                            self.on_stopped_leading()
+                        return
+                    self.is_leader.clear()
+                    stop.wait(self._retry)
+        finally:
+            self.is_leader.clear()
+            self._release()
+
+    def _release(self) -> None:
+        """Drop the lease so a successor takes over immediately."""
+        try:
+            def mutate(cm: ConfigMap) -> None:
+                anns = cm.metadata.annotations
+                if anns.get(ANN_HOLDER) == self.identity:
+                    anns[ANN_DEADLINE] = "0"
+
+            self._api.patch(KIND_CONFIGMAP, self._name, self._ns,
+                            mutate=mutate)
+        except (Conflict, NotFound, OSError):
+            pass
