@@ -1,0 +1,115 @@
+"""TPU sharing comparison: inference latency under contention.
+
+The analog of the reference's gpu-sharing-comparison demo
+(demos/gpu-sharing-comparison/README.md:66-70, the source of every
+published number in BASELINE.md): N concurrent clients run inference
+against ONE v5e chip and we measure per-request latency as N grows.
+
+- "timeshare" is nos-tpu's fractional sharing: co-located workloads
+  submit to the same chip and the runtime interleaves them — like GPU
+  time-slicing, per-request latency degrades roughly linearly with the
+  number of sharers.
+- "dedicated slice" is the partitioner's isolation story: a workload
+  that owns its slice keeps N=1 latency no matter how many neighbors
+  run elsewhere (the MIG row of the reference's table, flat 0.34 s from
+  1 to 7 pods).  On this single-chip host that is the N=1 row — the
+  point of carving right-sized slices is that nobody shares a chip by
+  accident.
+
+Run on a TPU host:  python demos/tpu-sharing-comparison/run.py
+
+Prints one JSON line per client count plus a summary; paste the table
+into README.md when re-measuring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import statistics
+import sys
+import threading
+import time
+
+REQUESTS_PER_CLIENT = 6
+CLIENT_COUNTS = [1, 2, 4, 7]
+BATCH, SEQ = 8, 2048
+
+
+def build_model():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.llama import BENCH_350M, Llama
+
+    cfg = dataclasses.replace(BENCH_350M, attn_impl="flash", remat=False)
+    model = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (BATCH, SEQ), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), tokens)
+
+    @jax.jit
+    def infer(params, tokens):
+        # logits for the last position — a serving-shaped forward
+        return model.apply(params, tokens)[:, -1, :].sum()
+
+    infer(params, tokens)  # compile
+    return lambda: float(infer(params, tokens))
+
+
+def run_clients(request_fn, n_clients: int) -> list[float]:
+    latencies: list[float] = []
+    lock = threading.Lock()
+    start = threading.Barrier(n_clients)
+
+    def client() -> None:
+        start.wait()
+        request_fn()  # per-thread warm dispatch
+        for _ in range(REQUESTS_PER_CLIENT):
+            t0 = time.perf_counter()
+            request_fn()
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return latencies
+
+
+def main() -> None:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+    request = build_model()
+    rows = []
+    for n in CLIENT_COUNTS:
+        lats = run_clients(request, n)
+        row = {
+            "clients": n,
+            "mean_s": round(statistics.mean(lats), 4),
+            "p95_s": round(sorted(lats)[int(0.95 * (len(lats) - 1))], 4),
+            "requests": len(lats),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base = rows[0]["mean_s"]
+    print(json.dumps({
+        "summary": "timeshare contention vs dedicated slice",
+        "dedicated_mean_s": base,
+        "degradation": {str(r["clients"]): round(r["mean_s"] / base, 2)
+                        for r in rows},
+        "device": jax.devices()[0].device_kind,
+    }))
+
+
+if __name__ == "__main__":
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+    main()

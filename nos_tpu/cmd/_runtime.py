@@ -114,11 +114,20 @@ class Main:
         self._health_addr = health_addr
         self._elector = None
         self._leader_gate: threading.Event | None = None
+        self._loops_lock = threading.Lock()
+        self._started = False
 
     def add_loop(self, name: str, fn: Callable[[], object],
                  interval_s: float) -> None:
-        self._loops.append(RunLoop(name, fn, interval_s, self.stop,
-                                   gate=self._leader_gate))
+        """Thread-safe at any point in the lifecycle: a loop added after
+        start() (e.g. controllers bound on gaining a leader lease from
+        the elector thread) starts immediately."""
+        loop = RunLoop(name, fn, interval_s, self.stop,
+                       gate=self._leader_gate)
+        with self._loops_lock:
+            self._loops.append(loop)
+            if self._started:
+                loop.start()
 
     def attach_leader_election(self, elector) -> None:
         """Gate every run loop on holding the lease (loops added before
@@ -149,8 +158,11 @@ class Main:
             threading.Thread(
                 target=self._elector.run, args=(self.stop,),
                 name=f"{self.name}-leader-election", daemon=True).start()
-        for loop in self._loops:
-            loop.start()
+        with self._loops_lock:
+            self._started = True
+            for loop in self._loops:
+                if not loop.is_alive():
+                    loop.start()
         self.ready.set()
         logger.info("%s: %d run loop(s) started", self.name,
                     len(self._loops))

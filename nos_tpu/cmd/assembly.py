@@ -61,9 +61,6 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
             controllers.append(ctl)
             main.add_loop("partitioner-timeshare", ctl.process_if_ready,
                           cfg.poll_interval_s)
-        for loop in main._loops:
-            if not loop.is_alive() and main.ready.is_set():
-                loop.start()   # loops added after main.start()
 
     if cfg.leader_election:
         from nos_tpu.kube.leaderelection import LeaderElector

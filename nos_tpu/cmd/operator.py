@@ -25,23 +25,32 @@ def build_operator_main(api: APIServer, cfg: OperatorConfig,
                         main: Main | None = None) -> Main:
     main = main or Main("nos-tpu-operator", cfg.health_probe_addr,
                         api=api)
+    install_quota_webhooks(api)
+    calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
+
+    def bind_reconcilers() -> None:
+        """The reconcilers write (EQ status, overlap deletion), so with
+        leader election they bind only on GAINING the lease — a standby
+        replica must not reconcile."""
+        eq = ElasticQuotaReconciler(api, calc)
+        ceq = CompositeElasticQuotaReconciler(api, calc)
+        eq.bind()
+        ceq.bind()
+
+        def resync() -> None:
+            eq.reconcile_all()
+            ceq.reconcile_all()
+
+        main.add_loop("quota-resync", resync, cfg.resync_interval_s)
+
     if cfg.leader_election:
         from nos_tpu.kube.leaderelection import LeaderElector
 
-        main.attach_leader_election(
-            LeaderElector(api, "nos-tpu-operator-leader"))
-    install_quota_webhooks(api)
-    calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
-    eq = ElasticQuotaReconciler(api, calc)
-    ceq = CompositeElasticQuotaReconciler(api, calc)
-    eq.bind()
-    ceq.bind()
-
-    def resync() -> None:
-        eq.reconcile_all()
-        ceq.reconcile_all()
-
-    main.add_loop("quota-resync", resync, cfg.resync_interval_s)
+        main.attach_leader_election(LeaderElector(
+            api, "nos-tpu-operator-leader",
+            on_started_leading=bind_reconcilers))
+    else:
+        bind_reconcilers()
     return main
 
 

@@ -64,22 +64,18 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = threading.Event()
+        self._held_until = 0.0   # deadline of the last lease WE wrote
 
-    # -- lease record --------------------------------------------------------
-    def _read(self) -> tuple[str, float] | None:
-        cm = self._api.try_get(KIND_CONFIGMAP, self._name, self._ns)
-        if cm is None:
-            return None
-        anns = cm.metadata.annotations
-        holder = anns.get(ANN_HOLDER, "")
-        try:
-            deadline = float(anns.get(ANN_DEADLINE, "0"))
-        except ValueError:
-            deadline = 0.0
-        return holder, deadline
+    # election step outcomes
+    LEADING = "leading"   # we hold the lease (held_until refreshed)
+    BLOCKED = "blocked"   # another identity verifiably holds a live lease
+    ERROR = "error"       # could not tell (API blip, lost write race)
 
-    def try_acquire_or_renew(self) -> bool:
-        """One election step; returns True while this identity leads."""
+    def try_acquire_or_renew(self) -> str:
+        """One election step.  BLOCKED is definitive (we read someone
+        else's live lease); ERROR is not — a leader whose own lease has
+        not yet expired keeps leading through ERRORs (controller-runtime
+        retries until the renew deadline actually passes)."""
         now = self._clock()
         deadline = now + self._duration
         try:
@@ -98,10 +94,11 @@ class LeaderElector:
                         "leader election %s: cannot create lease in "
                         "namespace %r (does it exist?)",
                         self._name, self._ns)
-                    return False
+                    return self.ERROR
                 logger.info("leader election %s: %s acquired",
                             self._name, self.identity)
-                return True
+                self._held_until = deadline
+                return self.LEADING
             anns = cm.metadata.annotations
             holder = anns.get(ANN_HOLDER, "")
             try:
@@ -109,7 +106,7 @@ class LeaderElector:
             except ValueError:
                 held_until = 0.0
             if holder != self.identity and held_until > now:
-                return False  # someone else holds a live lease
+                return self.BLOCKED  # someone else holds a live lease
             # CAS: the PUT carries the resourceVersion we just read, so
             # a concurrent acquirer makes this a Conflict — merge-patch
             # would have no such guard on the REST substrate.
@@ -119,13 +116,14 @@ class LeaderElector:
             if holder != self.identity:
                 logger.info("leader election %s: %s took over from %s",
                             self._name, self.identity, holder or "<none>")
-            return True
+            self._held_until = deadline
+            return self.LEADING
         except (Conflict, NotFound):
-            return False
+            return self.ERROR   # lost a write race: re-read next step
         except Exception as e:  # noqa: BLE001 — a blip must not end election
             logger.warning("leader election %s: step failed (%s); retrying",
                            self._name, e)
-            return False
+            return self.ERROR
 
     def run(self, stop: threading.Event) -> None:
         """Acquire/renew loop until `stop`; releases the lease on exit.
@@ -135,25 +133,32 @@ class LeaderElector:
         led = False
         try:
             while not stop.is_set():
-                if self.try_acquire_or_renew():
+                outcome = self.try_acquire_or_renew()
+                if outcome == self.LEADING:
                     if not led:
                         led = True
                         if self.on_started_leading is not None:
                             self.on_started_leading()
                     self.is_leader.set()
                     stop.wait(self._renew)
-                else:
-                    if led:
-                        logger.error(
-                            "leader election %s: %s LOST the lease — "
-                            "stopping (restart to rejoin as candidate)",
-                            self._name, self.identity)
-                        self.is_leader.clear()
-                        if self.on_stopped_leading is not None:
-                            self.on_stopped_leading()
-                        return
-                    self.is_leader.clear()
+                    continue
+                if led and outcome == self.ERROR \
+                        and self._clock() < self._held_until:
+                    # our lease is still valid — a blip must not demote;
+                    # retry renewing until the deadline actually passes
                     stop.wait(self._retry)
+                    continue
+                if led:
+                    logger.error(
+                        "leader election %s: %s LOST the lease — "
+                        "stopping (restart to rejoin as candidate)",
+                        self._name, self.identity)
+                    self.is_leader.clear()
+                    if self.on_stopped_leading is not None:
+                        self.on_stopped_leading()
+                    return
+                self.is_leader.clear()
+                stop.wait(self._retry)
         finally:
             self.is_leader.clear()
             self._release()

@@ -23,8 +23,8 @@ class TestElector:
     def test_first_candidate_acquires(self):
         api = APIServer()
         e = LeaderElector(api, "lease", identity="a")
-        assert e.try_acquire_or_renew()
-        assert e.try_acquire_or_renew()  # renew keeps the lease
+        assert e.try_acquire_or_renew() == e.LEADING
+        assert e.try_acquire_or_renew() == e.LEADING  # renew keeps it
 
     def test_second_candidate_blocked_until_expiry(self):
         api = APIServer()
@@ -33,20 +33,20 @@ class TestElector:
                           lease_duration_s=15.0)
         b = LeaderElector(api, "lease", identity="b", clock=clock,
                           lease_duration_s=15.0)
-        assert a.try_acquire_or_renew()
-        assert not b.try_acquire_or_renew()
+        assert a.try_acquire_or_renew() == a.LEADING
+        assert b.try_acquire_or_renew() == b.BLOCKED
         clock.now += 16.0  # a's lease expires un-renewed
-        assert b.try_acquire_or_renew()
-        assert not a.try_acquire_or_renew()  # takeover sticks
+        assert b.try_acquire_or_renew() == b.LEADING
+        assert a.try_acquire_or_renew() == a.BLOCKED  # takeover sticks
 
     def test_release_hands_over_immediately(self):
         api = APIServer()
         clock = FakeClock()
         a = LeaderElector(api, "lease", identity="a", clock=clock)
         b = LeaderElector(api, "lease", identity="b", clock=clock)
-        assert a.try_acquire_or_renew()
+        assert a.try_acquire_or_renew() == a.LEADING
         a._release()
-        assert b.try_acquire_or_renew()  # no wait for expiry
+        assert b.try_acquire_or_renew() == b.LEADING  # no wait for expiry
 
     def test_run_loop_failover(self):
         api = APIServer()
@@ -67,6 +67,37 @@ class TestElector:
         assert b.is_leader.wait(3.0), "standby never took over"
         stop_b.set()
         tb.join(2.0)
+
+
+    def test_transient_error_does_not_demote_a_valid_leader(self):
+        """One failed renew while the lease is still live must not fire
+        the fatal demotion (controller-runtime retries until the renew
+        deadline actually passes)."""
+        api = APIServer()
+        died = threading.Event()
+        a = LeaderElector(api, "lease", identity="a",
+                          lease_duration_s=8.0, renew_s=0.05, retry_s=0.05,
+                          on_stopped_leading=died.set)
+        stop = threading.Event()
+        t = threading.Thread(target=a.run, args=(stop,), daemon=True)
+        t.start()
+        assert a.is_leader.wait(2.0)
+
+        real_update = api.update
+        fails = {"n": 3}
+
+        def flaky_update(kind, obj):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError("injected apiserver blip")
+            return real_update(kind, obj)
+
+        api.update = flaky_update
+        time.sleep(0.5)  # several failed renews, lease still valid
+        assert not died.is_set(), "a blip demoted a valid leader"
+        assert a.is_leader.is_set()
+        stop.set()
+        t.join(2.0)
 
 
     def test_losing_acquired_lease_is_fatal(self):
