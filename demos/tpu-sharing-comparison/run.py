@@ -93,7 +93,8 @@ def main() -> None:
         row = {
             "clients": n,
             "mean_s": round(statistics.mean(lats), 4),
-            "p95_s": round(sorted(lats)[int(0.95 * (len(lats) - 1))], 4),
+            # with 6 requests/client the honest tail statistic is the max
+            "max_s": round(max(lats), 4),
             "requests": len(lats),
         }
         rows.append(row)

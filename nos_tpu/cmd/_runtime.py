@@ -116,6 +116,12 @@ class Main:
         self._leader_gate: threading.Event | None = None
         self._loops_lock = threading.Lock()
         self._started = False
+        self._shutdown_hooks: list[Callable[[], None]] = []
+
+    def add_shutdown_hook(self, fn: Callable[[], None]) -> None:
+        """Run fn during shutdown() (e.g. stop the device-plugin gRPC
+        servers and unlink their sockets)."""
+        self._shutdown_hooks.append(fn)
 
     def add_loop(self, name: str, fn: Callable[[], object],
                  interval_s: float) -> None:
@@ -180,6 +186,11 @@ class Main:
         self.ready.clear()
         for loop in self._loops:
             loop.join(timeout=5.0)
+        for hook in self._shutdown_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.exception("%s: shutdown hook failed", self.name)
         if self._server is not None:
             self._server.shutdown()
         logger.info("%s: shut down", self.name)

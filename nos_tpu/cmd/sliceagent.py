@@ -83,7 +83,22 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
         pod_resources = KubeletPodResourcesClient()
     else:
         pod_resources = FakePodResources()
-    agent = SliceAgent(api, cfg.node_name, runtime, pod_resources)
+    plugin_manager = None
+    if cfg.kubeconfig:
+        from nos_tpu.device.deviceplugin import (
+            DevicePluginManager, PLUGINS_DIR,
+        )
+
+        if os.path.isdir(PLUGINS_DIR):
+            plugin_manager = DevicePluginManager(runtime)
+        else:
+            logging.getLogger(__name__).warning(
+                "kubelet device-plugins dir %s missing: slice resources "
+                "will not be advertised to the kubelet", PLUGINS_DIR)
+    agent = SliceAgent(api, cfg.node_name, runtime, pod_resources,
+                       plugin_manager=plugin_manager)
+    if plugin_manager is not None:
+        main.add_shutdown_hook(plugin_manager.stop)
     agent.start()  # startup cleanup + first report (migagent.go:190-199)
     main.add_loop("sliceagent", agent.tick, cfg.report_interval_s)
     return main

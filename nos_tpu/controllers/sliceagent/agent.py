@@ -24,13 +24,15 @@ from .shared import SharedState
 class SliceAgent:
     def __init__(self, api: APIServer, node_name: str,
                  runtime: TpuRuntimeClient,
-                 pod_resources: PodResourcesClient) -> None:
+                 pod_resources: PodResourcesClient,
+                 plugin_manager=None) -> None:
         self.node_name = node_name
         self.runtime = runtime
         self.pod_resources = pod_resources
         self.client = SliceDeviceClient(runtime, pod_resources)
         self.shared = SharedState()
-        self.plugin = DevicePluginClient(api, node_name, runtime)
+        self.plugin = DevicePluginClient(api, node_name, runtime,
+                                         manager=plugin_manager)
         self.reporter = SliceReporter(api, node_name, self.client, self.shared)
         self.actuator = SliceActuator(api, node_name, self.client, self.shared,
                                       self.plugin)

@@ -1,0 +1,242 @@
+"""Kubelet device-plugin server: advertise carved slice devices for real.
+
+The reference rides the out-of-tree NVIDIA device plugin and reloads it
+with a pod-delete hammer (pkg/gpu/client.go:51-135) or an MPS ConfigMap;
+nos-tpu ships its OWN plugin because the resources it advertises are the
+partitioner's carved slice profiles (`nos.tpu/slice-2x4`, ...), which no
+stock plugin knows.  One `SliceDevicePlugin` serves a gRPC DevicePlugin
+endpoint per advertised resource name:
+
+- register with the kubelet Registration service on kubelet.sock;
+- stream the current device inventory on ListAndWatch, re-sending
+  whenever the sliceagent's actuation changes the carved geometry (the
+  generation-stamped re-advertise that replaces the reference's restart
+  hammer — SURVEY.md §2.8 device data plane);
+- answer Allocate with the device ids as env (`NOS_TPU_SLICE_IDS`), so
+  the workload can pin its jax process to the carved chips.
+
+The proto subset is deviceplugin.proto (generated deviceplugin_pb2.py
+committed; regenerate with `protoc --python_out=. deviceplugin.proto`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
+PLUGINS_DIR = "/var/lib/kubelet/device-plugins"
+API_VERSION = "v1beta1"
+ENV_DEVICE_IDS = "NOS_TPU_SLICE_IDS"
+
+
+class SliceDevicePlugin:
+    """One DevicePlugin gRPC server advertising one resource name."""
+
+    def __init__(self, resource_name: str,
+                 list_devices: Callable[[], list[str]],
+                 plugins_dir: str = PLUGINS_DIR,
+                 kubelet_socket: str = KUBELET_SOCKET) -> None:
+        import grpc
+
+        from . import deviceplugin_pb2
+
+        self._pb = deviceplugin_pb2
+        self._grpc = grpc
+        self.resource_name = resource_name
+        self._list_devices = list_devices
+        self._plugins_dir = plugins_dir
+        self._kubelet_socket = kubelet_socket
+        self._endpoint = (
+            "nos-tpu-" + resource_name.replace("/", "-") + ".sock")
+        self._stop = threading.Event()
+        self._changed = threading.Condition()
+        self._version = 0        # bumped by notify_changed (missed-wakeup proof)
+        self._server = None
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self._plugins_dir, self._endpoint)
+
+    # -- DevicePlugin service ------------------------------------------------
+    def _devices(self):
+        return self._pb.ListAndWatchResponse(devices=[
+            self._pb.Device(ID=did, health="Healthy")
+            for did in sorted(self._list_devices())
+        ])
+
+    def _list_and_watch(self, request, context):
+        """Stream the inventory; re-send on every notify_changed().  The
+        change counter makes notifications level-triggered: one fired
+        between the snapshot check and the wait cannot be missed."""
+        last = None
+        seen_version = -1
+        while not self._stop.is_set():
+            resp = self._devices()
+            snapshot = tuple(d.ID for d in resp.devices)
+            if snapshot != last:
+                last = snapshot
+                yield resp
+            with self._changed:
+                if seen_version == self._version:
+                    self._changed.wait(timeout=5.0)
+                seen_version = self._version
+
+    def _allocate(self, request, context):
+        responses = []
+        for creq in request.container_requests:
+            ids = list(creq.devices_IDs)
+            responses.append(self._pb.ContainerAllocateResponse(
+                envs={ENV_DEVICE_IDS: ",".join(ids)}))
+        return self._pb.AllocateResponse(container_responses=responses)
+
+    def _options(self, request, context):
+        return self._pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=False)
+
+    # -- lifecycle -----------------------------------------------------------
+    def serve(self) -> None:
+        """Bind the plugin socket and start serving."""
+        import concurrent.futures
+
+        grpc, pb = self._grpc, self._pb
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.DevicePlugin", {
+                "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+                    self._options,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=pb.DevicePluginOptions
+                    .SerializeToString),
+                "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                    self._list_and_watch,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=pb.ListAndWatchResponse
+                    .SerializeToString),
+                "Allocate": grpc.unary_unary_rpc_method_handler(
+                    self._allocate,
+                    request_deserializer=pb.AllocateRequest.FromString,
+                    response_serializer=pb.AllocateResponse
+                    .SerializeToString),
+            })
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((handler,))
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        logger.info("device plugin %s serving on %s",
+                    self.resource_name, self.socket_path)
+
+    def register(self) -> None:
+        """Dial the kubelet Registration service and announce this
+        plugin's endpoint + resource name."""
+        grpc, pb = self._grpc, self._pb
+        channel = grpc.insecure_channel(f"unix://{self._kubelet_socket}")
+        try:
+            register = channel.unary_unary(
+                "/v1beta1.Registration/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString)
+            register(pb.RegisterRequest(
+                version=API_VERSION,
+                endpoint=self._endpoint,
+                resource_name=self.resource_name,
+                options=pb.DevicePluginOptions()), timeout=5.0)
+            logger.info("device plugin %s registered with kubelet",
+                        self.resource_name)
+        finally:
+            channel.close()
+
+    def notify_changed(self) -> None:
+        """Re-advertise: the sliceagent calls this after actuating a plan
+        (the generation-stamped reload replacing the restart hammer)."""
+        with self._changed:
+            self._version += 1
+            self._changed.notify_all()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.notify_changed()
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+
+class DevicePluginManager:
+    """One SliceDevicePlugin per carved resource name, kept in sync with
+    the runtime's device list.  The sliceagent's DevicePluginClient calls
+    sync() after every actuation: new profiles get a served+registered
+    plugin, existing ones re-advertise, vanished ones keep serving an
+    empty inventory (kubelet wants the resource to drop to 0, not the
+    endpoint to disappear)."""
+
+    def __init__(self, runtime, plugins_dir: str = PLUGINS_DIR,
+                 kubelet_socket: str = KUBELET_SOCKET) -> None:
+        self._runtime = runtime
+        self._plugins_dir = plugins_dir
+        self._kubelet_socket = kubelet_socket
+        self._plugins: dict[str, SliceDevicePlugin] = {}
+        self._registered: set[str] = set()
+        self._kubelet_id: tuple | None = None   # (st_dev, st_ino)
+
+    def _kubelet_identity(self) -> tuple | None:
+        try:
+            st = os.stat(self._kubelet_socket)
+            return (st.st_dev, st.st_ino)
+        except OSError:
+            return None
+
+    def _register(self, resource: str, plugin: SliceDevicePlugin) -> None:
+        try:
+            plugin.register()
+            self._registered.add(resource)
+        except Exception as e:  # noqa: BLE001 — kubelet may be restarting
+            logger.warning("device plugin %s: registration failed (%s); "
+                           "will retry next sync", resource, e)
+
+    def _ids_for(self, resource: str) -> list[str]:
+        return [d.device_id for d in self._runtime.list_devices()
+                if d.resource_name == resource]
+
+    def sync(self) -> None:
+        # A recreated kubelet.sock means the kubelet restarted and forgot
+        # every plugin registration: re-register them all.
+        kubelet_id = self._kubelet_identity()
+        if kubelet_id != self._kubelet_id:
+            if self._kubelet_id is not None:
+                logger.info("kubelet socket changed: re-registering "
+                            "%d plugin(s)", len(self._plugins))
+            self._kubelet_id = kubelet_id
+            self._registered.clear()
+        current = {d.resource_name for d in self._runtime.list_devices()}
+        for resource in sorted(current - set(self._plugins)):
+            plugin = SliceDevicePlugin(
+                resource,
+                lambda r=resource: self._ids_for(r),
+                plugins_dir=self._plugins_dir,
+                kubelet_socket=self._kubelet_socket)
+            plugin.serve()
+            self._plugins[resource] = plugin
+        for resource, plugin in self._plugins.items():
+            if resource not in self._registered:
+                self._register(resource, plugin)
+            plugin.notify_changed()
+
+    def stop(self) -> None:
+        for plugin in self._plugins.values():
+            plugin.stop()
+
+
+__all__ = ["API_VERSION", "DevicePluginManager", "ENV_DEVICE_IDS",
+           "KUBELET_SOCKET", "PLUGINS_DIR", "SliceDevicePlugin"]

@@ -20,14 +20,21 @@ from .tpuclient import TpuRuntimeClient
 
 class DevicePluginClient:
     def __init__(self, api: APIServer, node_name: str,
-                 runtime: TpuRuntimeClient) -> None:
+                 runtime: TpuRuntimeClient, manager=None) -> None:
         self._api = api
         self._node_name = node_name
         self._runtime = runtime
+        # Optional kubelet-facing gRPC plugin manager
+        # (nos_tpu/device/deviceplugin.DevicePluginManager): on a real
+        # node the same refresh that updates the node object also
+        # re-advertises through the device-plugin API.
+        self._manager = manager
 
     def refresh(self) -> int:
         """Re-advertise slice resources from carved devices; returns the new
         plugin generation."""
+        if self._manager is not None:
+            self._manager.sync()
         counts: dict[str, int] = {}
         for d in self._runtime.list_devices():
             counts[d.resource_name] = counts.get(d.resource_name, 0) + 1

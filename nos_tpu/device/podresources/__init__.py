@@ -4,8 +4,9 @@ The reference dials the kubelet's pod-resources unix socket to learn
 which devices are allocated to running pods
 (pkg/resource/lister.go:28-38, client.go:39-87); this is the same
 client for `google.com/tpu` and the nos.tpu slice/timeshare profile
-resources.  The proto subset lives in api.proto (generated api_pb2.py is
-committed; regenerate with `protoc --python_out=. api.proto`).
+resources.  The proto subset lives in podresources.proto (generated
+podresources_pb2.py is committed; regenerate with
+`protoc --python_out=. podresources.proto`).
 
 Everything above the PodResourcesClient seam keeps running against
 FakePodResources off-cluster (the reference's mock discipline).
@@ -34,9 +35,9 @@ class KubeletPodResourcesClient(PodResourcesClient):
                  resource_prefixes=TPU_RESOURCE_PREFIXES) -> None:
         import grpc
 
-        from . import api_pb2
+        from . import podresources_pb2
 
-        self._pb = api_pb2
+        self._pb = podresources_pb2
         self._timeout = timeout_s
         self._prefixes = tuple(resource_prefixes)
         target = socket_path if "://" in socket_path \
@@ -44,9 +45,9 @@ class KubeletPodResourcesClient(PodResourcesClient):
         self._channel = grpc.insecure_channel(target)
         self._list = self._channel.unary_unary(
             _LIST_METHOD,
-            request_serializer=api_pb2.ListPodResourcesRequest
+            request_serializer=podresources_pb2.ListPodResourcesRequest
             .SerializeToString,
-            response_deserializer=api_pb2.ListPodResourcesResponse
+            response_deserializer=podresources_pb2.ListPodResourcesResponse
             .FromString,
         )
 

@@ -1,0 +1,106 @@
+"""Device-plugin gRPC server tests: real grpc over unix sockets with a
+kubelet-shaped stub (SURVEY.md §2.8 device data plane — the piece that
+advertises carved slice profiles to the kubelet for real)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+
+import grpc
+import pytest
+
+from nos_tpu.device.deviceplugin import (
+    API_VERSION, ENV_DEVICE_IDS, SliceDevicePlugin,
+)
+from nos_tpu.device.deviceplugin import deviceplugin_pb2 as api_pb2
+
+
+@pytest.fixture
+def kubelet(tmp_path):
+    """A Registration-service stub recording RegisterRequests."""
+    requests: queue.Queue = queue.Queue()
+
+    def register(request, context):
+        requests.put(request)
+        return api_pb2.Empty()
+
+    handler = grpc.method_handlers_generic_handler(
+        "v1beta1.Registration",
+        {"Register": grpc.unary_unary_rpc_method_handler(
+            register,
+            request_deserializer=api_pb2.RegisterRequest.FromString,
+            response_serializer=api_pb2.Empty.SerializeToString)})
+    server = grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((handler,))
+    sock = tmp_path / "kubelet.sock"
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield str(sock), requests
+    server.stop(0)
+
+
+@pytest.fixture
+def plugin(tmp_path, kubelet):
+    kubelet_sock, _ = kubelet
+    devices = {"ids": ["tpu-0-2x2-1", "tpu-0-2x2-2"]}
+    p = SliceDevicePlugin(
+        "nos.tpu/slice-2x2", lambda: list(devices["ids"]),
+        plugins_dir=str(tmp_path), kubelet_socket=kubelet_sock)
+    p.serve()
+    yield p, devices
+    p.stop()
+
+
+def _plugin_channel(p: SliceDevicePlugin):
+    return grpc.insecure_channel(f"unix://{p.socket_path}")
+
+
+class TestDevicePlugin:
+    def test_registers_with_kubelet(self, plugin, kubelet):
+        p, _ = plugin
+        _, requests = kubelet
+        p.register()
+        req = requests.get(timeout=5.0)
+        assert req.version == API_VERSION
+        assert req.resource_name == "nos.tpu/slice-2x2"
+        assert req.endpoint == p.socket_path.rsplit("/", 1)[-1]
+
+    def test_list_and_watch_streams_inventory_and_changes(self, plugin):
+        p, devices = plugin
+        channel = _plugin_channel(p)
+        stream = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=api_pb2.Empty.SerializeToString,
+            response_deserializer=api_pb2.ListAndWatchResponse.FromString,
+        )(api_pb2.Empty())
+        first = next(stream)
+        assert sorted(d.ID for d in first.devices) == [
+            "tpu-0-2x2-1", "tpu-0-2x2-2"]
+        assert all(d.health == "Healthy" for d in first.devices)
+
+        # actuation changes the carved geometry -> re-advertise
+        devices["ids"] = ["tpu-0-2x2-1"]
+        got = queue.Queue()
+        threading.Thread(target=lambda: got.put(next(stream)),
+                         daemon=True).start()
+        p.notify_changed()
+        second = got.get(timeout=5.0)
+        assert [d.ID for d in second.devices] == ["tpu-0-2x2-1"]
+        channel.close()
+
+    def test_allocate_returns_device_ids_env(self, plugin):
+        p, _ = plugin
+        channel = _plugin_channel(p)
+        allocate = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=api_pb2.AllocateRequest.SerializeToString,
+            response_deserializer=api_pb2.AllocateResponse.FromString)
+        resp = allocate(api_pb2.AllocateRequest(container_requests=[
+            api_pb2.ContainerAllocateRequest(
+                devices_IDs=["tpu-0-2x2-2"])]), timeout=5.0)
+        assert resp.container_responses[0].envs[ENV_DEVICE_IDS] == \
+            "tpu-0-2x2-2"
+        channel.close()

@@ -146,7 +146,7 @@ class TestPodResourcesClient:
     def kubelet(self, tmp_path):
         import grpc
 
-        from nos_tpu.device.podresources import api_pb2
+        from nos_tpu.device.podresources import podresources_pb2 as api_pb2
 
         class Lister:
             def List(self, request, context):  # noqa: N802 — kubelet API
