@@ -1,0 +1,76 @@
+"""Autoregressive generation for the Llama family.
+
+The reference is an infrastructure project with no model code at all
+(SURVEY.md §2.8) — the model family here exists to validate carved
+slices end-to-end, and a serving-shaped entry point belongs with it:
+the sharing demo (demos/tpu-sharing-comparison) measures inference
+latency, and `generate` is the loop a user would actually serve.
+
+TPU-first shape discipline: the whole decode runs inside ONE jit with a
+`lax.scan` over steps and a fixed-width token buffer — no per-token
+retrace, no dynamic shapes.  Each step re-runs the forward over the full
+buffer and reads the logits at the current position (O(L·S²) total).
+That trades FLOPs for simplicity and for exercising exactly the
+flash-attention path the training stack uses; a KV-cache decode is a
+future optimization, not a correctness feature, and the interface
+(`generate(params, prompt, steps)`) will not change when it lands.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.llama import Llama
+
+
+def generate(model: Llama, params, prompt: jax.Array, steps: int,
+             temperature: float = 0.0,
+             rng: jax.Array | None = None) -> jax.Array:
+    """Append `steps` sampled tokens to `prompt` [B, P] -> [B, P+steps].
+
+    temperature 0 = greedy; otherwise softmax sampling at the given
+    temperature.  Jit-compatible: wrap in jax.jit with
+    `static_argnums=(0, 3, 4)` (temperature is branched on at trace
+    time) or use `make_generate`.
+    """
+    batch, prompt_len = prompt.shape
+    total = prompt_len + steps
+    if total > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + steps ({steps}) = {total} exceeds "
+            f"max_seq_len {model.cfg.max_seq_len}: positions past it are "
+            f"out of distribution for RoPE")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    buf = jnp.pad(prompt.astype(jnp.int32), ((0, 0), (0, steps)))
+
+    def step(carry, _):
+        buf, pos, rng = carry
+        logits = model.apply(params, buf)           # [B, total, V]
+        # logits at pos-1 predict the token at pos
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, pos - 1, 1, axis=1)[:, 0, :]    # [B, V]
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(last, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        buf = buf.at[:, pos].set(nxt)
+        return (buf, pos + 1, rng), nxt
+
+    (buf, _, _), _ = jax.lax.scan(
+        step, (buf, jnp.int32(prompt_len), rng), None, length=steps)
+    return buf
+
+
+def make_generate(model: Llama, steps: int, temperature: float = 0.0):
+    """Jitted generate closed over the model and step count:
+    (params, prompt [B, P], rng?) -> [B, P+steps]."""
+    def fn(params, prompt, rng=None):
+        return generate(model, params, prompt, steps,
+                        temperature=temperature, rng=rng)
+
+    return jax.jit(fn)
