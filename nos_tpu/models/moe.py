@@ -1,0 +1,248 @@
+"""Mixture-of-experts model family (expert parallelism over the `ep` axis).
+
+The reference has no model code; the model families here exist so carved
+slices are validated by real multi-host JAX workloads (SURVEY.md §2.8),
+and MoE is the workload class that exercises the `ep` mesh axis the way
+FSDP/TP/SP are exercised by the dense Llama.
+
+TPU-first dispatch (the GShard/Switch einsum formulation — everything is
+a matmul, so the MXU does the routing):
+
+- router logits -> top-k softmax weights per token (fp32);
+- fixed per-expert **capacity** C = ceil(tokens/E · capacity_factor);
+  one-hot position-in-expert buffers give a dispatch tensor [T, E, C]
+  and a combine tensor (dispatch · gate weight);
+- `expert_in[e, c, d] = Σ_t dispatch[t, e, c] · x[t, d]` — a matmul;
+- per-expert SwiGLU with stacked weights [E, d, f] sharded over `ep`
+  (logical axis "experts"), so XLA turns dispatch/combine into
+  all-to-alls across the expert shards;
+- `y[t, d] = Σ_{e,c} combine[t, e, c] · expert_out[e, c, d]`.
+
+Tokens over a full expert's capacity are dropped (their combine weight
+is zero) — standard Switch behavior; capacity_factor controls the drop
+rate.  Static shapes throughout: no gather/scatter, no dynamic sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.llama import (
+    Attention, LlamaConfig, RMSNorm, _chunked_xent, rope_tables,
+)
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # auxiliary load-balancing loss weight (Switch §2.2 style)
+    router_aux_weight: float = 0.01
+
+
+# Small config for tests and the CPU dryrun.
+TINY_MOE = MoEConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+    dtype=jnp.float32, num_experts=4, top_k=2,
+)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts with einsum dispatch/combine."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        bsz, seq, d = x.shape
+        tokens = bsz * seq
+        num_e, k = cfg.num_experts, cfg.top_k
+        capacity = max(1, math.ceil(tokens * k / num_e
+                                    * cfg.capacity_factor))
+
+        xt = x.reshape(tokens, d)
+
+        router = nn.DenseGeneral(
+            num_e, axis=-1, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="router",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "experts")))
+        logits = router(xt.astype(jnp.float32))           # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k choices, each a one-hot over experts
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)     # [T, k]
+        # renormalize the kept gates (Mixtral convention)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+        # position of each (token, choice) within its expert's buffer:
+        # cumulative count of prior assignments to the same expert
+        choice_onehot = jax.nn.one_hot(gate_idx, num_e,
+                                       dtype=jnp.float32)  # [T, k, E]
+        flat = choice_onehot.reshape(tokens * k, num_e)     # choice-major? no:
+        # token-major flattening keeps earlier tokens earlier in buffers
+        position = (jnp.cumsum(flat, axis=0) - flat)        # [T*k, E]
+        pos_in_expert = jnp.sum(position * flat, axis=-1).astype(jnp.int32)
+        kept = pos_in_expert < capacity                      # [T*k]
+        pos_onehot = jax.nn.one_hot(pos_in_expert, capacity,
+                                    dtype=jnp.float32) * kept[:, None]
+
+        # dispatch[t*k, e, c]; fold the k choices back onto tokens
+        dispatch_k = flat[:, :, None] * pos_onehot[:, None, :]
+        dispatch = dispatch_k.reshape(tokens, k, num_e, capacity)
+        combine = jnp.sum(
+            dispatch * gate_vals.reshape(tokens, k, 1, 1), axis=1)  # [T,E,C]
+        dispatch = jnp.sum(dispatch, axis=1)                         # [T,E,C]
+
+        # expert buffers: [E, C, D] — a matmul over tokens
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(cfg.dtype), xt.astype(cfg.dtype),
+            preferred_element_type=jnp.float32).astype(cfg.dtype)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("experts", "capacity", "embed"))
+
+        def expert_param(name, shape, logical):
+            return self.param(
+                name, nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), logical), shape,
+                cfg.param_dtype)
+
+        f = cfg.intermediate_size
+        w_gate = expert_param("w_gate", (num_e, d, f),
+                              ("experts", "embed", "mlp"))
+        w_up = expert_param("w_up", (num_e, d, f),
+                            ("experts", "embed", "mlp"))
+        w_down = expert_param("w_down", (num_e, f, d),
+                              ("experts", "mlp", "embed"))
+
+        h = nn.silu(jnp.einsum(
+            "ecd,edf->ecf", expert_in, w_gate.astype(cfg.dtype),
+            preferred_element_type=jnp.float32).astype(cfg.dtype))
+        h = h * jnp.einsum(
+            "ecd,edf->ecf", expert_in, w_up.astype(cfg.dtype),
+            preferred_element_type=jnp.float32).astype(cfg.dtype)
+        h = nn.with_logical_constraint(h, ("experts", "capacity", "mlp"))
+        expert_out = jnp.einsum(
+            "ecf,efd->ecd", h, w_down.astype(cfg.dtype),
+            preferred_element_type=jnp.float32).astype(cfg.dtype)
+
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(cfg.dtype), expert_out,
+            preferred_element_type=jnp.float32).astype(cfg.dtype)
+
+        # Switch-style load-balance auxiliary (Switch §2.2 eq. 4):
+        # alpha * E * sum_i f_i * P_i — equals 1.0 under uniform routing
+        # for any E, so the pressure does not weaken as experts are added.
+        top1 = jax.nn.one_hot(gate_idx[:, 0], num_e, dtype=jnp.float32)
+        aux = num_e * jnp.sum(
+            jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+        self.sow("losses", "router_aux", cfg.router_aux_weight * aux)
+
+        return y.reshape(bsz, seq, d)
+
+
+class MoEBlock(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, rope):
+        cfg = self.cfg
+        x = x + Attention(cfg, None, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), rope)
+        x = x + MoEMLP(cfg, name="moe")(
+            RMSNorm(cfg.norm_eps, name="moe_norm")(x))
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class MoELlama(nn.Module):
+    """Decoder-only MoE LM with the same __call__ contract as Llama:
+    (tokens) -> logits, (tokens, targets) -> scalar loss (+ router aux)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, targets=None):
+        cfg = self.cfg
+        embed = self.param(
+            "embed", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed[tokens].astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None], tokens.shape)
+        rope = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+        block = MoEBlock
+        if cfg.remat:
+            block = nn.remat(MoEBlock, prevent_cse=True)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, rope)
+
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if targets is not None:
+            # xent only; the router aux terms are sown into the "losses"
+            # collection and summed by moe_loss() (apply with mutable)
+            return _chunked_xent(x, embed, targets, cfg.loss_chunk,
+                                 cfg.dtype)
+        logits = jnp.einsum(
+            "bse,ve->bsv", x, embed.astype(cfg.dtype),
+            preferred_element_type=jnp.float32)
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def make_ep_trainer(model: MoELlama, mesh, example_tokens):
+    """Sharded init + jitted adam train step for an MoE model over a mesh
+    with an `ep` axis (shared by tests and the driver dryrun — the
+    harness must not fork between them).
+
+    Returns (params, opt_state, step) with step(params, opt_state,
+    tokens) -> (params, opt_state, loss); tokens must carry
+    parallel.mesh.batch_sharding(mesh)."""
+    import optax
+
+    from nos_tpu.parallel.mesh import DEFAULT_RULES
+
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        abstract = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(1), example_tokens))
+    logical = nn.get_partition_spec(abstract)
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, DEFAULT_RULES)
+
+    def init():
+        with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+            return model.init(jax.random.PRNGKey(1), example_tokens)
+
+    params = jax.jit(init, out_shardings=shardings)()["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, toks):
+        with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+            loss, grads = jax.value_and_grad(
+                lambda p: moe_loss(model, p, toks))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return params, opt_state, step
+
+
+def moe_loss(model: MoELlama, params, tokens) -> jax.Array:
+    """Next-token loss + router load-balance auxiliary (the sown
+    "losses" collection summed across layers)."""
+    loss, variables = model.apply(
+        {"params": params}, tokens, targets=tokens, mutable=["losses"])
+    aux_terms = jax.tree_util.tree_leaves(variables.get("losses", {}))
+    if aux_terms:
+        loss = loss + sum(jnp.sum(t) for t in aux_terms)
+    return loss

@@ -9,6 +9,7 @@ the workload-side counterpart that turns the carved slice's devices into a
 - ``fsdp`` — data parallelism with sharded params/optimizer (ZeRO-3 style)
 - ``tp``   — tensor parallelism (megatron-style within attention/MLP)
 - ``sp``   — sequence/context parallelism (ring attention over ICI)
+- ``ep``   — expert parallelism (MoE experts sharded across devices)
 
 XLA inserts the collectives; shardings are expressed as NamedSharding /
 PartitionSpec over these axes (the scaling-book recipe: pick a mesh, annotate,
@@ -24,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "tp", "sp", "ep")
 
 # Logical (model) axes -> mesh axes.  The flax logical-partitioning rules
 # used by all nos_tpu models (nos_tpu/models/).
@@ -38,6 +39,10 @@ DEFAULT_RULES = (
     ("vocab", "tp"),
     ("layers", None),
     ("head_dim", None),
+    # MoE (nos_tpu/models/moe.py): experts shard over ep; each expert's
+    # capacity buffer stays whole on its device
+    ("experts", "ep"),
+    ("capacity", None),
 )
 
 
@@ -49,13 +54,15 @@ class MeshSpec:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep
 
     def shape(self) -> dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                "sp": self.sp, "ep": self.ep}
 
     @staticmethod
     def parse(text: str) -> "MeshSpec":
@@ -67,7 +74,7 @@ class MeshSpec:
             return MeshSpec(**{k.strip(): int(v) for k, v in kv.items()})
         dims = sorted((int(d) for d in text.split("x")), reverse=True)
         axes = ["fsdp", "tp", "sp"]
-        out = {"dp": 1, "fsdp": 1, "tp": 1, "sp": 1}
+        out = {"dp": 1, "fsdp": 1, "tp": 1, "sp": 1, "ep": 1}
         for ax, d in zip(axes, dims):
             out[ax] = d
         for d in dims[len(axes):]:
@@ -102,7 +109,8 @@ def make_mesh(spec: MeshSpec | None = None,
             f"mesh spec {spec.shape()} needs {spec.size} devices, "
             f"got {len(devices)}"
         )
-    arr = np.array(devices).reshape(spec.dp, spec.fsdp, spec.tp, spec.sp)
+    arr = np.array(devices).reshape(spec.dp, spec.fsdp, spec.tp, spec.sp,
+                                    spec.ep)
     return Mesh(arr, AXES)
 
 

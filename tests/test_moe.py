@@ -1,0 +1,104 @@
+"""MoE family tests: routing math, dense equivalence, expert-parallel
+sharding over the `ep` mesh axis (8 virtual CPU devices)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from nos_tpu.models.moe import MoEConfig, MoELlama, MoEMLP, TINY_MOE, moe_loss
+from nos_tpu.parallel.mesh import DEFAULT_RULES, MeshSpec, make_mesh
+
+
+@pytest.fixture
+def tokens():
+    return jax.random.randint(
+        jax.random.PRNGKey(0), (2, 64), 0, TINY_MOE.vocab_size, jnp.int32)
+
+
+class TestMoEMLP:
+    def test_single_expert_equals_dense_swiglu(self):
+        """E=1/k=1 with ample capacity routes everything through the one
+        expert at gate weight 1.0 — exactly a dense SwiGLU."""
+        cfg = dataclasses.replace(TINY_MOE, num_experts=1, top_k=1,
+                                  capacity_factor=2.0)
+        layer = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, cfg.hidden_size),
+                              jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(3), x)
+        y, _ = layer.apply(variables, x, mutable=["losses"])
+
+        p = nn.meta.unbox(variables)["params"]
+        ref = jnp.einsum(
+            "bsd,df->bsf", x, p["w_gate"][0])
+        ref = nn.silu(ref) * jnp.einsum("bsd,df->bsf", x, p["w_up"][0])
+        ref = jnp.einsum("bsf,fd->bsd", ref, p["w_down"][0])
+        assert jnp.max(jnp.abs(y - ref)) < 1e-4
+
+    def test_capacity_drops_overflow_tokens(self):
+        """capacity_factor -> tiny: most tokens are dropped (output ~0
+        for them), none crash, shapes stay static."""
+        cfg = dataclasses.replace(TINY_MOE, capacity_factor=0.05)
+        layer = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 32, cfg.hidden_size),
+                              jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(3), x)
+        y, _ = layer.apply(variables, x, mutable=["losses"])
+        assert y.shape == x.shape
+        # with capacity 1 per expert, at most E tokens can produce output
+        nonzero = jnp.sum(jnp.any(jnp.abs(y[0]) > 1e-9, axis=-1))
+        assert int(nonzero) <= cfg.num_experts * cfg.top_k
+
+    def test_router_aux_is_sown(self):
+        layer = MoEMLP(TINY_MOE)
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 16,
+                                                      TINY_MOE.hidden_size),
+                              jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(3), x)
+        _, state = layer.apply(variables, x, mutable=["losses"])
+        leaves = jax.tree_util.tree_leaves(state["losses"])
+        assert leaves and all(jnp.isfinite(v).all() for v in leaves)
+
+
+class TestMoELlama:
+    def test_forward_and_loss_finite(self, tokens):
+        model = MoELlama(TINY_MOE)
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        logits = model.apply({"params": params}, tokens)
+        assert logits.shape == (2, 64, TINY_MOE.vocab_size)
+        loss = moe_loss(model, params, tokens)
+        assert jnp.isfinite(loss)
+
+    def test_grads_flow_to_every_expert_weight(self, tokens):
+        model = MoELlama(TINY_MOE)
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        grads = jax.grad(lambda p: moe_loss(model, p, tokens))(params)
+        flat = jax.tree_util.tree_leaves_with_path(grads)
+        moe_leaves = [(p, g) for p, g in flat if "w_gate" in str(p)]
+        assert moe_leaves
+        for path, g in moe_leaves:
+            assert bool(jnp.any(g != 0)), path
+
+    def test_expert_parallel_step_over_ep_mesh(self, tokens):
+        """The ep-axis crown check: jit a full MoE train step over a mesh
+        with ep=2, expert weights sharded on ep, one optimizer step, loss
+        finite — the same harness dryrun_multichip drives."""
+        from nos_tpu.models.moe import make_ep_trainer
+        from nos_tpu.parallel.mesh import batch_sharding
+
+        mesh = make_mesh(MeshSpec(fsdp=2, tp=1, sp=2, ep=2))
+        model = MoELlama(TINY_MOE)
+        params, opt_state, step = make_ep_trainer(model, mesh, tokens)
+
+        # expert weights actually sharded over ep
+        w_gate = nn.meta.unbox(params)["layer_0"]["moe"]["w_gate"]
+        assert "ep" in str(w_gate.sharding.spec), w_gate.sharding.spec
+
+        toks = jax.device_put(tokens, batch_sharding(mesh))
+        params, opt_state, loss = step(params, opt_state, toks)
+        assert jnp.isfinite(loss)
