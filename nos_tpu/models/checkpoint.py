@@ -1,0 +1,70 @@
+"""Sharded training-state checkpointing (orbax).
+
+Control-plane checkpoint/resume lives in the API substrate — all durable
+state is annotations/CRDs, `kube/serialize.py` is the format, and every
+controller restarts stateless (SURVEY.md §5).  This module is the
+COMPUTE-side counterpart: save/restore a `ShardedTrainer`'s TrainState
+with its NamedShardings intact, so a gang that was preempted (the
+capacity scheduler evicts whole gangs) resumes on a re-carved slice from
+its last step instead of from scratch.
+
+Orbax handles the sharded array I/O; restore takes the *abstract* state
+of a freshly built trainer as the target, so arrays come back with the
+new mesh's shardings even if the gang landed on different physical hosts
+(same mesh shape).  Saves are synchronous by default — the train loop
+decides its own cadence, and a checkpoint that is still in flight when
+preemption lands is exactly the failure this exists to prevent.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+
+import jax
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Step-numbered TrainState checkpoints under one directory."""
+
+    def __init__(self, directory: str | pathlib.Path,
+                 max_to_keep: int = 3) -> None:
+        self._mngr = ocp.CheckpointManager(
+            pathlib.Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=False),
+        )
+
+    def save(self, step: int, state) -> None:
+        import flax.linen as nn
+
+        # store plain arrays: the flax partitioning boxes are metadata the
+        # resuming trainer re-derives from its own mesh/rules
+        self._mngr.save(step, args=ocp.args.StandardSave(
+            nn.meta.unbox(state)))
+        self._mngr.wait_until_finished()
+        logger.info("checkpoint: saved step %d", step)
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like, step: int | None = None):
+        """Restore into the structure/shardings of `state_like` —
+        preferably `trainer.abstract_state()` (shape/dtype/sharding only,
+        no materialized init to pay for and throw away at resume time); a
+        concrete TrainState also works."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, state_like)
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        logger.info("checkpoint: restored step %d", step)
+        return restored
+
+    def close(self) -> None:
+        self._mngr.close()

@@ -77,6 +77,21 @@ class ShardedTrainer:
         logical = nn.get_partition_spec(abstract)
         return nn.logical_to_mesh_sharding(logical, self.mesh, self.rules)
 
+    def abstract_state(self) -> TrainState:
+        """The state's shape/dtype/sharding skeleton WITHOUT materializing
+        arrays — the restore target for models/checkpoint.py (resuming
+        from a checkpoint must not pay a full init's HBM + compute)."""
+        with self.mesh, nn.logical_axis_rules(self.rules):
+            abstract = jax.eval_shape(
+                self._create_state, jax.random.PRNGKey(0))
+        # unbox the flax partitioning metadata so the tree aligns with the
+        # NamedSharding tree (checkpoints store plain arrays)
+        abstract = nn.meta.unbox(abstract)
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, self.state_shardings,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
     def init_state(self, seed: int = 0) -> TrainState:
         def make(rng):
             with self.mesh, nn.logical_axis_rules(self.rules):

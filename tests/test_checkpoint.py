@@ -1,0 +1,85 @@
+"""Compute-side checkpoint/resume (orbax over sharded TrainState).
+
+The scenario the capacity scheduler creates: a gang is preempted
+(whole-gang eviction), the partitioner re-carves, and the job must
+resume from its last step on a fresh process with a fresh mesh — the
+restored state continues EXACTLY as the original would have."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models.checkpoint import TrainCheckpointer
+from nos_tpu.models.llama import TINY
+from nos_tpu.models.train import ShardedTrainer
+from nos_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+@pytest.fixture
+def trained():
+    mesh = make_mesh(MeshSpec(fsdp=2, tp=2, sp=2))
+    cfg = dataclasses.replace(TINY, attn_impl="ring")
+    trainer = ShardedTrainer(cfg, mesh, batch_size=4, seq_len=64)
+    state = trainer.init_state(0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 64), 0, cfg.vocab_size, jnp.int32)
+    state, _ = trainer.train_step()(state, tokens)
+    return cfg, trainer, state, tokens
+
+
+class TestTrainCheckpointer:
+    def test_resume_continues_identically(self, trained, tmp_path):
+        cfg, trainer, state, tokens = trained
+        ck = TrainCheckpointer(tmp_path)
+        try:
+            ck.save(int(state.step), state)
+            assert ck.latest_step() == int(state.step)
+
+            # a fresh process: new trainer, new mesh object, restore into
+            # the ABSTRACT state (no materialized init paid at resume)
+            trainer2 = ShardedTrainer(
+                cfg, make_mesh(MeshSpec(fsdp=2, tp=2, sp=2)),
+                batch_size=4, seq_len=64)
+            restored = ck.restore(trainer2.abstract_state())
+            assert int(restored.step) == int(state.step)
+
+            # every leaf restored bit-identically
+            import flax.linen as nn
+
+            orig_leaves = jax.tree_util.tree_leaves(nn.meta.unbox(state))
+            rest_leaves = jax.tree_util.tree_leaves(restored)
+            assert len(orig_leaves) == len(rest_leaves)
+            for a, b in zip(orig_leaves, rest_leaves):
+                if hasattr(a, "shape"):
+                    assert bool(jnp.array_equal(a, b))
+
+            _, loss_orig = trainer.train_step()(state, tokens)
+            _, loss_resumed = trainer2.train_step()(restored, tokens)
+            assert float(loss_orig) == pytest.approx(
+                float(loss_resumed), abs=1e-5)
+        finally:
+            ck.close()
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        ck = TrainCheckpointer(tmp_path)
+        try:
+            with pytest.raises(FileNotFoundError):
+                ck.restore(state_like={"x": jnp.zeros(3)})
+        finally:
+            ck.close()
+
+    def test_max_to_keep_prunes_old_steps(self, trained, tmp_path):
+        _, _, state, _ = trained
+        ck = TrainCheckpointer(tmp_path, max_to_keep=2)
+        try:
+            for step in (1, 2, 3):
+                ck.save(step, state)
+            assert ck.latest_step() == 3
+            steps = set(ck._mngr.all_steps())
+            assert 1 not in steps and {2, 3} <= steps
+        finally:
+            ck.close()
