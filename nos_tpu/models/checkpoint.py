@@ -55,12 +55,15 @@ class TrainCheckpointer:
         """Restore into the structure/shardings of `state_like` —
         preferably `trainer.abstract_state()` (shape/dtype/sharding only,
         no materialized init to pay for and throw away at resume time); a
-        concrete TrainState also works."""
+        concrete TrainState also works (its flax partitioning boxes are
+        unboxed to match what save() wrote)."""
+        import flax.linen as nn
+
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
         abstract = jax.tree_util.tree_map(
-            ocp.utils.to_shape_dtype_struct, state_like)
+            ocp.utils.to_shape_dtype_struct, nn.meta.unbox(state_like))
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(abstract))
         logger.info("checkpoint: restored step %d", step)

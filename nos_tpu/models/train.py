@@ -75,21 +75,18 @@ class ShardedTrainer:
             abstract = jax.eval_shape(
                 self._create_state, jax.random.PRNGKey(0))
         logical = nn.get_partition_spec(abstract)
+        # keep the unboxed skeleton: abstract_state() reuses it instead of
+        # re-tracing the whole model init on the resume hot path
+        self._abstract = nn.meta.unbox(abstract)
         return nn.logical_to_mesh_sharding(logical, self.mesh, self.rules)
 
     def abstract_state(self) -> TrainState:
         """The state's shape/dtype/sharding skeleton WITHOUT materializing
         arrays — the restore target for models/checkpoint.py (resuming
         from a checkpoint must not pay a full init's HBM + compute)."""
-        with self.mesh, nn.logical_axis_rules(self.rules):
-            abstract = jax.eval_shape(
-                self._create_state, jax.random.PRNGKey(0))
-        # unbox the flax partitioning metadata so the tree aligns with the
-        # NamedSharding tree (checkpoints store plain arrays)
-        abstract = nn.meta.unbox(abstract)
         return jax.tree_util.tree_map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
-            abstract, self.state_shardings,
+            self._abstract, self.state_shardings,
             is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
 
     def init_state(self, seed: int = 0) -> TrainState:

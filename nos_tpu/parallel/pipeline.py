@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a `pp` axis.
+
+The scaling-book recipe, not a port: stage parameters are stacked on a
+leading axis sharded over `pp` (each device holds one stage), activations
+flow stage-to-stage with `lax.ppermute` inside `shard_map`, and a
+`lax.scan` over M + P - 1 ticks runs the skewed schedule — stage i
+processes microbatch m at tick m + i, so after the P-1-tick fill bubble
+every stage computes on every tick.  Static shapes throughout; the
+activation shape must equal the stage input shape (true for transformer
+blocks: [microbatch, seq, embed]).
+
+This is the compute-side counterpart of the gang scheduler's multi-host
+windows: a carved 1-D chain of hosts IS a pp axis (ICI neighbors), and
+`pipeline_apply` is how a workload uses it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params,
+                   x: jax.Array, num_microbatches: int,
+                   axis: str = "pp") -> jax.Array:
+    """Run `x` through P pipeline stages.
+
+    - `stage_params`: pytree whose leaves have a leading axis of size P
+      (one slice per stage), sharded over `axis`;
+    - `stage_fn(params_for_stage, activation) -> activation`, shape
+      preserving;
+    - `x`: [batch, ...] with batch divisible by `num_microbatches`.
+
+    Returns stage P-1's output for every microbatch, reassembled to [batch,
+    ...] and replicated across the pp axis.
+    """
+    num_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"{num_microbatches} microbatches")
+    micro = x.reshape(num_microbatches, batch // num_microbatches,
+                      *x.shape[1:])
+
+    def per_device(params, micro):
+        # shard_map hands each device its stage slice with leading dim 1
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = lax.axis_index(axis)
+        last = num_stages - 1
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        zero_act = jnp.zeros_like(micro[0])
+        outbuf = jnp.zeros_like(micro)
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # stage 0 feeds itself from the microbatch queue (clamped
+            # index: past the queue it computes garbage that no one
+            # collects); later stages consume the permuted activation
+            feed = lax.dynamic_index_in_dim(
+                micro, jnp.clip(t, 0, num_microbatches - 1), axis=0,
+                keepdims=False)
+            inp = jnp.where(idx == 0, feed, state)
+            out = stage_fn(params, inp)
+            # the last stage finishes microbatch m = t - (P-1)
+            m = t - last
+            collect = (idx == last) & (m >= 0)
+            m_clamped = jnp.clip(m, 0, num_microbatches - 1)
+            outbuf = jnp.where(
+                collect,
+                lax.dynamic_update_index_in_dim(outbuf, out, m_clamped,
+                                                axis=0),
+                outbuf)
+            state = lax.ppermute(out, axis, perm)  # non-receivers get 0
+            return (state, outbuf), None
+
+        ticks = jnp.arange(num_microbatches + num_stages - 1)
+        (_, outbuf), _ = lax.scan(tick, (zero_act, outbuf), ticks)
+        # replicate the last stage's collected outputs to every pp rank
+        return lax.psum(
+            jnp.where(idx == last, outbuf, jnp.zeros_like(outbuf)), axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    out = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(spec_params, P()), out_specs=P(),
+        # the psum-of-masked-outbuf replication is not inferable
+        check_vma=False,
+    )(stage_params, micro)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params: list):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage
+    axis (what pipeline_apply shards over pp)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)

@@ -64,6 +64,20 @@ class TestTrainCheckpointer:
         finally:
             ck.close()
 
+    def test_restore_into_concrete_state_also_works(self, trained,
+                                                     tmp_path):
+        cfg, _, state, _ = trained
+        ck = TrainCheckpointer(tmp_path)
+        try:
+            ck.save(int(state.step), state)
+            trainer2 = ShardedTrainer(
+                cfg, make_mesh(MeshSpec(fsdp=2, tp=2, sp=2)),
+                batch_size=4, seq_len=64)
+            restored = ck.restore(trainer2.init_state(seed=9))
+            assert int(restored.step) == int(state.step)
+        finally:
+            ck.close()
+
     def test_restore_without_checkpoint_raises(self, tmp_path):
         ck = TrainCheckpointer(tmp_path)
         try:
