@@ -1,0 +1,120 @@
+"""Token-batch input pipeline for the training stack.
+
+The reference is an infrastructure project; nos-tpu's model stack exists
+to validate carved slices with real training jobs, and a training job
+needs an input story.  TPU-first constraints shape the design:
+
+- batches are fixed-shape [batch, seq_len] int32 windows over a flat
+  token stream (static shapes: nothing here ever retraces the step);
+- the stream is a numpy array or a memmapped token file — HBM never
+  holds the corpus, only the in-flight batches;
+- epochs are deterministic permutations of the non-overlapping windows
+  (seed + epoch => order), so a resumed job (models/checkpoint.py) can
+  reproduce the exact batch sequence by fast-forwarding `start_step`;
+- `device_iter` double-buffers: the NEXT batch's host->device transfer
+  overlaps the CURRENT step's compute (jax dispatch is async), with the
+  mesh's canonical batch sharding applied on the way in.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+class TokenLoader:
+    """Deterministic [batch, seq_len] windows over a flat token stream."""
+
+    def __init__(self, tokens: np.ndarray, batch_size: int, seq_len: int,
+                 seed: int = 0) -> None:
+        if tokens.ndim != 1:
+            raise ValueError(f"token stream must be flat, got shape "
+                             f"{tokens.shape}")
+        self.tokens = tokens
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.windows_per_epoch = len(tokens) // seq_len
+        self.steps_per_epoch = self.windows_per_epoch // batch_size
+        self._order_cache: tuple[int, np.ndarray] | None = None
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"stream of {len(tokens)} tokens yields "
+                f"{self.windows_per_epoch} windows of {seq_len} — fewer "
+                f"than one batch of {batch_size}")
+
+    @classmethod
+    def from_memmap(cls, path: str | pathlib.Path, batch_size: int,
+                    seq_len: int, dtype=np.uint16,
+                    seed: int = 0) -> "TokenLoader":
+        """A binary token file (e.g. uint16 little-endian, the common
+        packed-corpus format), memory-mapped — the OS pages it."""
+        tokens = np.memmap(path, dtype=dtype, mode="r")
+        return cls(tokens, batch_size, seq_len, seed=seed)
+
+    @classmethod
+    def synthetic(cls, vocab_size: int, num_tokens: int, batch_size: int,
+                  seq_len: int, seed: int = 0) -> "TokenLoader":
+        """Deterministic fake stream (benchmarks, tests, dryruns)."""
+        rng = np.random.default_rng(seed)
+        tokens = rng.integers(0, vocab_size, size=num_tokens,
+                              dtype=np.int32)
+        return cls(tokens, batch_size, seq_len, seed=seed)
+
+    # -- batch addressing ---------------------------------------------------
+    def _order(self, epoch: int) -> np.ndarray:
+        # one permutation per EPOCH, cached: regenerating it per batch
+        # would cost O(windows) RNG work every step on a large corpus
+        if self._order_cache is None or self._order_cache[0] != epoch:
+            rng = np.random.default_rng((self.seed, epoch))
+            self._order_cache = (epoch, rng.permutation(
+                self.windows_per_epoch))
+        return self._order_cache[1]
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """The [batch, seq_len] int32 batch for global step `step` —
+        pure addressing, so resume = start iterating at the right step."""
+        epoch, within = divmod(step, self.steps_per_epoch)
+        order = self._order(epoch)
+        idx = order[within * self.batch_size:(within + 1) * self.batch_size]
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        for row, w in enumerate(idx):
+            start = int(w) * self.seq_len
+            out[row] = self.tokens[start:start + self.seq_len]
+        return out
+
+    def batches(self, start_step: int = 0) -> Iterator[np.ndarray]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+    # -- device feeding -----------------------------------------------------
+    def device_iter(self, mesh=None, start_step: int = 0,
+                    num_steps: int | None = None) -> Iterator[jax.Array]:
+        """Batches on device with the canonical batch sharding, one batch
+        prefetched ahead of the consumer (transfer overlaps compute)."""
+        from nos_tpu.parallel.mesh import batch_sharding
+
+        sharding = batch_sharding(mesh) if mesh is not None else None
+
+        def put(arr: np.ndarray) -> jax.Array:
+            return (jax.device_put(arr, sharding) if sharding is not None
+                    else jax.device_put(arr))
+
+        it = self.batches(start_step)
+        if num_steps is not None:
+            import itertools
+
+            it = itertools.islice(it, num_steps)
+        pending = None
+        for arr in it:
+            nxt = put(arr)     # dispatch transfer before yielding previous
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
