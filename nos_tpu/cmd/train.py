@@ -1,0 +1,225 @@
+"""Training main: the compute-side entrypoint the carved slices serve.
+
+    python -m nos_tpu.cmd.train --config train.yaml
+
+Composes the whole model stack from one typed config: mesh (from a
+MeshSpec string, with multi-host jax.distributed initialization driven
+by the Cloud TPU env when several workers are present), model + sharded
+trainer, deterministic token loader (memmapped corpus or synthetic),
+periodic orbax checkpoints, and resume — restarting the process (e.g.
+after the capacity scheduler preempted the gang and the partitioner
+re-carved) continues from the last checkpoint with the exact batch
+sequence.
+
+This is the workload side of the framework: the control plane carves a
+slice and gang-schedules the pods; each pod runs this main.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import pathlib
+import sys
+import time
+
+from nos_tpu.api.config import ConfigError, load_config
+
+logger = logging.getLogger("nos_tpu.cmd.train")
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    model: str = "bench350m"      # tiny | bench350m | llama3-8b
+    attn_impl: str = "flash"
+    remat_policy: str = "mats"
+    scan_layers: bool = True
+    batch_size: int = 8
+    seq_len: int = 2048
+    steps: int = 100
+    # MeshSpec string, e.g. "fsdp=4,tp=2,sp=2" or a topology "2x2x4";
+    # "" = a sensible factorization of the visible devices.
+    mesh: str = ""
+    # Packed uint16 token file; "" = deterministic synthetic stream.
+    data_path: str = ""
+    data_seed: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 50
+    resume: bool = True
+    log_every: int = 10
+    # "host:port" to serve /healthz + /metrics (loss gauge etc.); "" = off.
+    health_probe_addr: str = ""
+
+    def validate(self) -> None:
+        if self.model not in _MODELS:
+            raise ConfigError(
+                f"model must be one of {sorted(_MODELS)}, got {self.model!r}")
+        if self.batch_size <= 0 or self.seq_len <= 0 or self.steps <= 0:
+            raise ConfigError("batch_size, seq_len, steps must be positive")
+        if self.checkpoint_every <= 0:
+            raise ConfigError("checkpoint_every must be positive")
+        if self.data_path and not pathlib.Path(self.data_path).is_file():
+            raise ConfigError(f"data_path {self.data_path!r} does not exist")
+
+
+_MODELS = {"tiny": "TINY", "bench350m": "BENCH_350M", "llama3-8b": "LLAMA3_8B"}
+
+
+def maybe_init_distributed() -> None:
+    """Multi-host: initialize jax.distributed from the Cloud TPU env
+    (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID) when several workers exist.
+    Single-host runs skip it entirely."""
+    import os
+
+    hosts = [h for h in
+             os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hosts) <= 1:
+        return
+    import jax
+
+    worker_raw = os.environ.get("TPU_WORKER_ID")
+    if worker_raw is None:
+        # every worker defaulting to id 0 would deadlock the coordinator
+        # with duplicate process ids and no hint why
+        raise RuntimeError(
+            f"TPU_WORKER_HOSTNAMES lists {len(hosts)} workers but "
+            f"TPU_WORKER_ID is unset — cannot identify this process")
+    worker_id = int(worker_raw)
+    jax.distributed.initialize(
+        coordinator_address=f"{hosts[0]}:8476",
+        num_processes=len(hosts), process_id=worker_id)
+    logger.info("jax.distributed: worker %d/%d (coordinator %s)",
+                worker_id, len(hosts), hosts[0])
+
+
+def build(cfg: TrainConfig):
+    """(trainer, loader, checkpointer, start_state, start_step) from the
+    config — separated from main() so tests drive it on a CPU mesh."""
+    import jax
+
+    from nos_tpu.models import llama
+    from nos_tpu.models.data import TokenLoader
+    from nos_tpu.models.train import ShardedTrainer
+    from nos_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    model_cfg = dataclasses.replace(
+        getattr(llama, _MODELS[cfg.model]),
+        attn_impl=cfg.attn_impl, remat_policy=cfg.remat_policy,
+        scan_layers=cfg.scan_layers)
+    spec = (MeshSpec.parse(cfg.mesh) if cfg.mesh
+            else MeshSpec.for_device_count(len(jax.devices())))
+    mesh = make_mesh(spec, devices=jax.devices()[:spec.size])
+    trainer = ShardedTrainer(model_cfg, mesh, batch_size=cfg.batch_size,
+                             seq_len=cfg.seq_len)
+
+    if cfg.data_path:
+        loader = TokenLoader.from_memmap(
+            cfg.data_path, cfg.batch_size, cfg.seq_len, seed=cfg.data_seed)
+    else:
+        loader = TokenLoader.synthetic(
+            model_cfg.vocab_size,
+            num_tokens=max(cfg.batch_size * cfg.seq_len * 8, 1 << 16),
+            batch_size=cfg.batch_size, seq_len=cfg.seq_len,
+            seed=cfg.data_seed)
+
+    checkpointer = None
+    start_step = 0
+    state = None
+    if cfg.checkpoint_dir:
+        from nos_tpu.models.checkpoint import TrainCheckpointer
+
+        checkpointer = TrainCheckpointer(cfg.checkpoint_dir)
+        latest = checkpointer.latest_step()
+        if latest is not None and not cfg.resume:
+            # a fresh run writing into an old run's directory would have
+            # its saves silently skipped and later resumes would mix runs
+            raise ConfigError(
+                f"checkpoint_dir {cfg.checkpoint_dir!r} already holds "
+                f"step {latest} and resume is false — use a fresh "
+                f"directory or enable resume")
+        if cfg.resume and latest is not None:
+            state = checkpointer.restore(trainer.abstract_state())
+            start_step = latest
+            logger.info("resuming from checkpoint step %d", start_step)
+    if state is None:
+        state = trainer.init_state(0)
+    return trainer, loader, checkpointer, state, start_step
+
+
+def train(cfg: TrainConfig) -> float | None:
+    """Run the loop; returns the final loss, or None when the checkpoint
+    already covers every requested step (nothing to do)."""
+    from nos_tpu.exporter.metrics import REGISTRY
+
+    trainer, loader, checkpointer, state, start_step = build(cfg)
+    if start_step >= cfg.steps:
+        logger.info("checkpoint step %d >= steps %d: training already "
+                    "complete", start_step, cfg.steps)
+        if checkpointer is not None:
+            checkpointer.close()
+        return None
+    step_fn = trainer.train_step()
+    loss = float("nan")
+    t0 = time.perf_counter()
+    logged_at = start_step
+    batches = loader.device_iter(
+        mesh=trainer.mesh, start_step=start_step,
+        num_steps=cfg.steps - start_step)
+    for step, batch in enumerate(batches, start=start_step + 1):
+        state, loss_arr = step_fn(state, batch)
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            loss = float(loss_arr)
+            dt = time.perf_counter() - t0
+            interval = step - logged_at
+            tokens_s = (interval * cfg.batch_size * cfg.seq_len
+                        / max(dt, 1e-9))
+            logger.info("step %d/%d loss %.4f (%.0f tokens/s)",
+                        step, cfg.steps, loss, tokens_s)
+            REGISTRY.set("nos_tpu_train_loss", loss)
+            REGISTRY.set("nos_tpu_train_step", float(step))
+            logged_at = step
+            t0 = time.perf_counter()
+        if checkpointer is not None and step % cfg.checkpoint_every == 0:
+            checkpointer.save(step, state)
+    if checkpointer is not None:
+        if cfg.steps % cfg.checkpoint_every:
+            checkpointer.save(cfg.steps, state)
+        checkpointer.close()
+    return float(loss)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--config", default=None,
+                    help="YAML/JSON TrainConfig file")
+    args = ap.parse_args(argv)
+    try:
+        cfg = load_config(args.config, TrainConfig)
+    except ConfigError as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        return 2
+    maybe_init_distributed()
+    health = None
+    if cfg.health_probe_addr:
+        from nos_tpu.cmd._runtime import Main
+
+        health = Main("nos-tpu-train", cfg.health_probe_addr)
+        health.start()  # serves /healthz + /metrics (loss/step gauges)
+    try:
+        loss = train(cfg)
+    finally:
+        if health is not None:
+            health.shutdown()
+    if loss is None:
+        logger.info("done: already complete")
+    else:
+        logger.info("done: final loss %.4f", loss)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

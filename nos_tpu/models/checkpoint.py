@@ -38,15 +38,23 @@ class TrainCheckpointer:
                 max_to_keep=max_to_keep, enable_async_checkpointing=False),
         )
 
-    def save(self, step: int, state) -> None:
+    def save(self, step: int, state) -> bool:
         import flax.linen as nn
 
         # store plain arrays: the flax partitioning boxes are metadata the
         # resuming trainer re-derives from its own mesh/rules
-        self._mngr.save(step, args=ocp.args.StandardSave(
+        saved = self._mngr.save(step, args=ocp.args.StandardSave(
             nn.meta.unbox(state)))
         self._mngr.wait_until_finished()
+        if not saved:
+            # orbax declines saves to an already-existing step — silent
+            # loss of a checkpoint must not look like success
+            logger.warning("checkpoint: step %d already exists, NOT "
+                           "overwritten (reusing a checkpoint_dir across "
+                           "runs without resume?)", step)
+            return False
         logger.info("checkpoint: saved step %d", step)
+        return True
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()

@@ -1,0 +1,65 @@
+"""Training main tests: config-driven loop, checkpoint cadence, resume."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from nos_tpu.api.config import ConfigError
+from nos_tpu.cmd.train import TrainConfig, build, train
+
+
+def tiny_cfg(**kw) -> TrainConfig:
+    base = dict(model="tiny", attn_impl="ring", batch_size=4, seq_len=64,
+                steps=6, mesh="fsdp=2,tp=2,sp=2", log_every=3,
+                checkpoint_every=3)
+    base.update(kw)
+    cfg = TrainConfig(**base)
+    cfg.validate()
+    return cfg
+
+
+class TestTrainMain:
+    def test_loop_runs_and_checkpoints(self, tmp_path):
+        cfg = tiny_cfg(checkpoint_dir=str(tmp_path / "ck"))
+        loss = train(cfg)
+        assert math.isfinite(loss)
+        from nos_tpu.models.checkpoint import TrainCheckpointer
+
+        ck = TrainCheckpointer(cfg.checkpoint_dir)
+        try:
+            assert ck.latest_step() == cfg.steps
+        finally:
+            ck.close()
+
+    def test_resume_picks_up_from_latest(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        train(tiny_cfg(checkpoint_dir=ckdir, steps=6))
+        # a "restarted pod": same config, more steps — must resume at 6
+        cfg2 = tiny_cfg(checkpoint_dir=ckdir, steps=9)
+        _, _, _, state, start_step = build(cfg2)
+        assert start_step == 6
+        assert int(state.step) == 6
+        loss = train(cfg2)
+        assert math.isfinite(loss)
+
+    def test_already_complete_returns_none(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        train(tiny_cfg(checkpoint_dir=ckdir, steps=6))
+        assert train(tiny_cfg(checkpoint_dir=ckdir, steps=6)) is None
+        assert train(tiny_cfg(checkpoint_dir=ckdir, steps=3)) is None
+
+    def test_fresh_run_into_used_dir_rejected(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        train(tiny_cfg(checkpoint_dir=ckdir, steps=6))
+        with pytest.raises(ConfigError, match="resume"):
+            build(tiny_cfg(checkpoint_dir=ckdir, steps=6, resume=False))
+
+    def test_invalid_model_rejected(self):
+        with pytest.raises(ConfigError, match="model"):
+            tiny_cfg(model="gpt17")
+
+    def test_missing_data_path_rejected(self):
+        with pytest.raises(ConfigError, match="data_path"):
+            tiny_cfg(data_path="/nonexistent/corpus.bin")
