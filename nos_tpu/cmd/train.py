@@ -24,13 +24,16 @@ import pathlib
 import sys
 import time
 
-from nos_tpu.api.config import ConfigError, load_config
+from nos_tpu.api.config import ConfigError, ManagerConfig, load_config
 
 logger = logging.getLogger("nos_tpu.cmd.train")
 
 
 @dataclasses.dataclass
-class TrainConfig:
+class TrainConfig(ManagerConfig):
+    """health_probe_addr/metrics_addr (+ validation) come from the
+    ManagerConfig embed, like every other main."""
+
     model: str = "bench350m"      # tiny | bench350m | llama3-8b
     attn_impl: str = "flash"
     remat_policy: str = "mats"
@@ -48,10 +51,9 @@ class TrainConfig:
     checkpoint_every: int = 50
     resume: bool = True
     log_every: int = 10
-    # "host:port" to serve /healthz + /metrics (loss gauge etc.); "" = off.
-    health_probe_addr: str = ""
 
     def validate(self) -> None:
+        super().validate()
         if self.model not in _MODELS:
             raise ConfigError(
                 f"model must be one of {sorted(_MODELS)}, got {self.model!r}")
@@ -85,7 +87,15 @@ def maybe_init_distributed() -> None:
         raise RuntimeError(
             f"TPU_WORKER_HOSTNAMES lists {len(hosts)} workers but "
             f"TPU_WORKER_ID is unset — cannot identify this process")
-    worker_id = int(worker_raw)
+    try:
+        worker_id = int(worker_raw)
+    except ValueError:
+        raise RuntimeError(
+            f"TPU_WORKER_ID={worker_raw!r} is not an integer") from None
+    if not 0 <= worker_id < len(hosts):
+        raise RuntimeError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hosts)} workers")
     jax.distributed.initialize(
         coordinator_address=f"{hosts[0]}:8476",
         num_processes=len(hosts), process_id=worker_id)
@@ -202,6 +212,10 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f"invalid config: {e}", file=sys.stderr)
         return 2
+    # honor timeshare/slice grants BEFORE the first jax import
+    from nos_tpu.device.workload_env import apply as apply_workload_env
+
+    apply_workload_env()
     maybe_init_distributed()
     health = None
     if cfg.health_probe_addr:

@@ -1,0 +1,77 @@
+"""Workload-side device environment: honor what the scheduler granted.
+
+The control plane's grants reach the container as environment variables
+(the device plugin's Allocate response — `NOS_TPU_SLICE_IDS` — plus the
+pod's own resource requests mirrored by the operator); this module is
+what the workload calls BEFORE its first jax import so the process
+actually respects them:
+
+- a **timeshare** grant (`nos.tpu/tpu-<N>gb`) caps jax's HBM usage at
+  the granted fraction via XLA_PYTHON_CLIENT_MEM_FRACTION — without it,
+  jax preallocates ~75% of HBM and the co-located sharers the timeshare
+  plan promised would OOM each other (the MPS-resource-limit analog).
+  The chip's HBM size comes from topology discovery (env metadata, no
+  jax), so the fraction is right on every generation;
+- a **slice** grant's device ids are surfaced to the workload
+  (TPU_VISIBLE_SLICE_IDS) for job-side tooling and debugging.  Chip-level
+  visibility enforcement (the TPU_VISIBLE_CHIPS analog of MIG device
+  visibility) needs the agent to export the slice's chip coordinates —
+  not wired yet, and not claimed.
+
+Analog of what the NVIDIA stack does implicitly through MPS
+active-thread percentage and MIG device visibility; on TPU the runtime
+has no such enforcement layer, so the framework provides the cooperative
+one and the sharing demo (demos/tpu-sharing-comparison) measures its
+behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENV_TIMESHARE_GB = "NOS_TPU_TIMESHARE_GB"
+ENV_SLICE_IDS = "NOS_TPU_SLICE_IDS"
+# Leave headroom below the granted fraction: XLA's allocator needs slack
+# for fragmentation, and N sharers at exactly 1/N would collectively
+# exceed HBM.
+SAFETY = 0.9
+
+
+def apply(environ=os.environ,
+          hbm_gb_per_chip: int | None = None) -> dict[str, str]:
+    """Derive jax/XLA env settings from the scheduler's grants; returns
+    what was set.  Must run before the first jax import."""
+    applied: dict[str, str] = {}
+    if hbm_gb_per_chip is None:
+        # jax-free discovery (env metadata / configured fallback): an
+        # 8 GB grant must cap 8/95 on v5p, not 8/16
+        from nos_tpu.device import discovery
+
+        hbm_gb_per_chip = discovery.discover(
+            allow_jax=False, environ=environ).generation.hbm_gb_per_chip
+    granted = environ.get(ENV_TIMESHARE_GB, "")
+    if granted:
+        try:
+            gb = float(granted)
+        except ValueError:
+            logger.warning("ignoring unparseable %s=%r",
+                           ENV_TIMESHARE_GB, granted)
+            gb = 0.0
+        if gb > 0:
+            fraction = min(gb / hbm_gb_per_chip * SAFETY, 0.95)
+            applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{fraction:.3f}"
+            # growing allocation within the cap plays nicer with sharers
+            # than preallocating the whole fraction up front
+            applied["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
+    slice_ids = environ.get(ENV_SLICE_IDS, "")
+    if slice_ids:
+        # the carved devices this pod owns (device-plugin Allocate env),
+        # surfaced for job-side tooling/debugging — see module docstring
+        applied["TPU_VISIBLE_SLICE_IDS"] = slice_ids
+    for key, value in applied.items():
+        environ.setdefault(key, value)
+        logger.info("workload env: %s=%s", key, environ[key])
+    return applied

@@ -6,6 +6,8 @@ and fall back to the configured generation off-TPU.  The real-hardware
 closure of the loop lives in tests/test_e2e_device.py.
 """
 
+import pytest
+
 from nos_tpu.device import discovery
 from nos_tpu.topology import Shape, V4, V5E, V5P
 
@@ -131,3 +133,47 @@ class TestFakeFallbackTopology:
         name, block = rt.topology()
         assert name == "tpu-v5e"
         assert block == Shape((2, 2))
+
+
+class TestWorkloadEnv:
+    def test_timeshare_grant_caps_hbm_fraction(self):
+        from nos_tpu.device import workload_env
+
+        env = {"NOS_TPU_TIMESHARE_GB": "8"}
+        applied = workload_env.apply(env, hbm_gb_per_chip=16)
+        assert float(applied["XLA_PYTHON_CLIENT_MEM_FRACTION"]) == \
+            pytest.approx(0.45)  # 8/16 * 0.9 safety
+        assert env["XLA_PYTHON_CLIENT_PREALLOCATE"] == "false"
+
+    def test_hbm_size_discovered_per_generation(self):
+        """An 8 GB grant on a v5p host (95 GB HBM) must cap ~8/95, not
+        8/16 — the discovery env path supplies the generation."""
+        from nos_tpu.device import workload_env
+
+        env = {"NOS_TPU_TIMESHARE_GB": "8",
+               "TPU_ACCELERATOR_TYPE": "v5p-16"}
+        applied = workload_env.apply(env)  # hbm from discovery
+        assert float(applied["XLA_PYTHON_CLIENT_MEM_FRACTION"]) == \
+            pytest.approx(8 / 95 * 0.9, abs=1e-3)
+
+    def test_existing_settings_not_clobbered(self):
+        from nos_tpu.device import workload_env
+
+        env = {"NOS_TPU_TIMESHARE_GB": "4",
+               "XLA_PYTHON_CLIENT_MEM_FRACTION": "0.10"}
+        workload_env.apply(env, hbm_gb_per_chip=16)
+        assert env["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.10"
+
+    def test_garbage_and_absent_grants_are_noops(self):
+        from nos_tpu.device import workload_env
+
+        assert workload_env.apply({}, 16) == {}
+        env = {"NOS_TPU_TIMESHARE_GB": "banana"}
+        assert workload_env.apply(env, 16) == {}
+
+    def test_slice_ids_passed_through(self):
+        from nos_tpu.device import workload_env
+
+        env = {"NOS_TPU_SLICE_IDS": "tpu-0-2x2-1"}
+        applied = workload_env.apply(env, 16)
+        assert applied["TPU_VISIBLE_SLICE_IDS"] == "tpu-0-2x2-1"

@@ -63,3 +63,22 @@ class TestTrainMain:
     def test_missing_data_path_rejected(self):
         with pytest.raises(ConfigError, match="data_path"):
             tiny_cfg(data_path="/nonexistent/corpus.bin")
+
+
+    def test_health_addr_validated_like_other_mains(self):
+        with pytest.raises(ConfigError, match="host:port"):
+            tiny_cfg(health_probe_addr="8080")
+
+    def test_bad_worker_id_env_fails_fast(self, monkeypatch):
+        from nos_tpu.cmd.train import maybe_init_distributed
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+        with pytest.raises(RuntimeError, match="unset"):
+            maybe_init_distributed()
+        monkeypatch.setenv("TPU_WORKER_ID", "worker-1")
+        with pytest.raises(RuntimeError, match="not an integer"):
+            maybe_init_distributed()
+        monkeypatch.setenv("TPU_WORKER_ID", "5")
+        with pytest.raises(RuntimeError, match="out of range"):
+            maybe_init_distributed()
