@@ -52,6 +52,25 @@ def build_chipagent_main(api: APIServer, cfg: AgentConfig,
     agent = ChipAgent(api, cfg.node_name)
     agent.start()  # raises on slice nodes (the gpuagent guard)
     main.add_loop("chipagent", agent.tick, cfg.report_interval_s)
+    if cfg.kubeconfig:
+        # production: advertise the node's timeshare profiles to the
+        # kubelet as device-plugin replicas whose Allocate hands each
+        # workload its HBM grant (device/workload_env.py enforces it)
+        import os
+
+        from nos_tpu.device.deviceplugin import (
+            PLUGINS_DIR, TimesharePluginManager,
+        )
+
+        if os.path.isdir(PLUGINS_DIR):
+            manager = TimesharePluginManager(api, cfg.node_name)
+            main.add_loop("timeshare-plugins", manager.sync,
+                          cfg.report_interval_s)
+            main.add_shutdown_hook(manager.stop)
+        else:
+            logging.getLogger(__name__).warning(
+                "kubelet device-plugins dir %s missing: timeshare "
+                "profiles will not be advertised", PLUGINS_DIR)
     return main
 
 

@@ -35,12 +35,18 @@ ENV_DEVICE_IDS = "NOS_TPU_SLICE_IDS"
 
 
 class SliceDevicePlugin:
-    """One DevicePlugin gRPC server advertising one resource name."""
+    """One DevicePlugin gRPC server advertising one resource name.
+
+    `allocate_envs(device_ids) -> {env: value}` customizes the Allocate
+    response; the default hands the granted device ids to the workload
+    (NOS_TPU_SLICE_IDS, consumed by device/workload_env.py)."""
 
     def __init__(self, resource_name: str,
                  list_devices: Callable[[], list[str]],
                  plugins_dir: str = PLUGINS_DIR,
-                 kubelet_socket: str = KUBELET_SOCKET) -> None:
+                 kubelet_socket: str = KUBELET_SOCKET,
+                 allocate_envs: Callable[[list[str]], dict] | None = None,
+                 ) -> None:
         import grpc
 
         from . import deviceplugin_pb2
@@ -51,6 +57,8 @@ class SliceDevicePlugin:
         self._list_devices = list_devices
         self._plugins_dir = plugins_dir
         self._kubelet_socket = kubelet_socket
+        self._allocate_envs = allocate_envs or (
+            lambda ids: {ENV_DEVICE_IDS: ",".join(ids)})
         self._endpoint = (
             "nos-tpu-" + resource_name.replace("/", "-") + ".sock")
         self._stop = threading.Event()
@@ -91,7 +99,8 @@ class SliceDevicePlugin:
         for creq in request.container_requests:
             ids = list(creq.devices_IDs)
             responses.append(self._pb.ContainerAllocateResponse(
-                envs={ENV_DEVICE_IDS: ",".join(ids)}))
+                envs={k: str(v)
+                      for k, v in self._allocate_envs(ids).items()}))
         return self._pb.AllocateResponse(container_responses=responses)
 
     def _options(self, request, context):
@@ -209,6 +218,17 @@ class DevicePluginManager:
         return [d.device_id for d in self._runtime.list_devices()
                 if d.resource_name == resource]
 
+    # -- subclass hooks ------------------------------------------------------
+    def _current_resources(self) -> set[str]:
+        return {d.resource_name for d in self._runtime.list_devices()}
+
+    def _make_plugin(self, resource: str) -> SliceDevicePlugin:
+        return SliceDevicePlugin(
+            resource,
+            lambda r=resource: self._ids_for(r),
+            plugins_dir=self._plugins_dir,
+            kubelet_socket=self._kubelet_socket)
+
     def sync(self) -> None:
         # A recreated kubelet.sock means the kubelet restarted and forgot
         # every plugin registration: re-register them all.
@@ -219,13 +239,9 @@ class DevicePluginManager:
                             "%d plugin(s)", len(self._plugins))
             self._kubelet_id = kubelet_id
             self._registered.clear()
-        current = {d.resource_name for d in self._runtime.list_devices()}
-        for resource in sorted(current - set(self._plugins)):
-            plugin = SliceDevicePlugin(
-                resource,
-                lambda r=resource: self._ids_for(r),
-                plugins_dir=self._plugins_dir,
-                kubelet_socket=self._kubelet_socket)
+        for resource in sorted(self._current_resources()
+                               - set(self._plugins)):
+            plugin = self._make_plugin(resource)
             plugin.serve()
             self._plugins[resource] = plugin
         for resource, plugin in self._plugins.items():
@@ -238,5 +254,80 @@ class DevicePluginManager:
             plugin.stop()
 
 
+class TimeshareReplicaPlugin(SliceDevicePlugin):
+    """Fractional-HBM profiles (`nos.tpu/tpu-<N>gb`) as device-plugin
+    replicas: the advertised count is how many sharers the timeshare
+    plan allows, and Allocate hands the workload its HBM grant — gb x
+    the number of granted replicas, under a per-profile env key so a
+    container holding several profiles sums its grants
+    (device/workload_env.granted_timeshare_gb, which turns the total
+    into an XLA memory cap before the first jax import).  This replaces
+    the reference's out-of-tree MPS device plugin + per-client
+    active-thread/memory limits (SURVEY.md §2.8 device data plane).
+
+    NOT nos_tpu.device.timeshare_plugin.TimeshareDevicePlugin — that one
+    patches node allocatable in-sim; this one speaks kubelet gRPC."""
+
+    def __init__(self, resource_name: str, gb: int,
+                 num_replicas: Callable[[], int],
+                 plugins_dir: str = PLUGINS_DIR,
+                 kubelet_socket: str = KUBELET_SOCKET) -> None:
+        from nos_tpu.device.workload_env import ENV_TIMESHARE_GB
+
+        suffix = resource_name.rsplit("/", 1)[-1].replace("-", "_")
+
+        def list_devices() -> list[str]:
+            n = max(0, int(num_replicas()))
+            return [f"{resource_name.rsplit('/', 1)[-1]}::{i}"
+                    for i in range(n)]
+
+        super().__init__(
+            resource_name, list_devices, plugins_dir=plugins_dir,
+            kubelet_socket=kubelet_socket,
+            allocate_envs=lambda ids: {
+                f"{ENV_TIMESHARE_GB}_{suffix}": gb * len(ids),
+                ENV_DEVICE_IDS: ",".join(ids),
+            })
+
+
+class TimesharePluginManager(DevicePluginManager):
+    """Device plugins for the timeshare profiles a node advertises: the
+    chipagent syncs replica counts from the node's allocatable each tick
+    (the timeshare plan's generation-stamped re-advertise flows through
+    here to the kubelet)."""
+
+    def __init__(self, api, node_name: str,
+                 plugins_dir: str = PLUGINS_DIR,
+                 kubelet_socket: str = KUBELET_SOCKET) -> None:
+        super().__init__(runtime=None, plugins_dir=plugins_dir,
+                         kubelet_socket=kubelet_socket)
+        self._api = api
+        self._node_name = node_name
+        self._counts: dict[str, int] = {}
+
+    def _current_resources(self) -> set[str]:
+        from nos_tpu.api import constants as C
+        from nos_tpu.kube.client import KIND_NODE
+
+        node = self._api.get(KIND_NODE, self._node_name)
+        current: dict[str, int] = {}
+        for res, qty in node.status.allocatable.items():
+            if C.TIMESHARE_RESOURCE_RE.match(res):
+                current[res] = int(qty)
+        self._counts = current
+        return set(current)
+
+    def _make_plugin(self, resource: str) -> SliceDevicePlugin:
+        from nos_tpu.api import constants as C
+
+        gb = int(C.TIMESHARE_RESOURCE_RE.match(resource).group("gb"))
+        return TimeshareReplicaPlugin(
+            resource, gb=gb,
+            num_replicas=lambda r=resource: self._counts.get(r, 0),
+            plugins_dir=self._plugins_dir,
+            kubelet_socket=self._kubelet_socket)
+
+
 __all__ = ["API_VERSION", "DevicePluginManager", "ENV_DEVICE_IDS",
-           "KUBELET_SOCKET", "PLUGINS_DIR", "SliceDevicePlugin"]
+           "KUBELET_SOCKET", "PLUGINS_DIR", "SliceDevicePlugin",
+           "TimeshareReplicaPlugin", "TimesharePluginManager"]

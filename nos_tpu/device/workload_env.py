@@ -32,8 +32,24 @@ import os
 
 logger = logging.getLogger(__name__)
 
+# One env var per granted profile (the device plugin appends the profile
+# suffix so a container holding several profiles does not have their
+# grants clobber each other in the kubelet's env merge); the bare key is
+# accepted too.  The workload's cap is the SUM of every grant.
 ENV_TIMESHARE_GB = "NOS_TPU_TIMESHARE_GB"
 ENV_SLICE_IDS = "NOS_TPU_SLICE_IDS"
+
+
+def granted_timeshare_gb(environ) -> float:
+    total = 0.0
+    for key, value in environ.items():
+        if key == ENV_TIMESHARE_GB or key.startswith(
+                ENV_TIMESHARE_GB + "_"):
+            try:
+                total += float(value)
+            except ValueError:
+                logger.warning("ignoring unparseable %s=%r", key, value)
+    return total
 # Leave headroom below the granted fraction: XLA's allocator needs slack
 # for fragmentation, and N sharers at exactly 1/N would collectively
 # exceed HBM.
@@ -52,20 +68,13 @@ def apply(environ=os.environ,
 
         hbm_gb_per_chip = discovery.discover(
             allow_jax=False, environ=environ).generation.hbm_gb_per_chip
-    granted = environ.get(ENV_TIMESHARE_GB, "")
-    if granted:
-        try:
-            gb = float(granted)
-        except ValueError:
-            logger.warning("ignoring unparseable %s=%r",
-                           ENV_TIMESHARE_GB, granted)
-            gb = 0.0
-        if gb > 0:
-            fraction = min(gb / hbm_gb_per_chip * SAFETY, 0.95)
-            applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{fraction:.3f}"
-            # growing allocation within the cap plays nicer with sharers
-            # than preallocating the whole fraction up front
-            applied["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
+    gb = granted_timeshare_gb(environ)
+    if gb > 0:
+        fraction = min(gb / hbm_gb_per_chip * SAFETY, 0.95)
+        applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{fraction:.3f}"
+        # growing allocation within the cap plays nicer with sharers
+        # than preallocating the whole fraction up front
+        applied["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
     slice_ids = environ.get(ENV_SLICE_IDS, "")
     if slice_ids:
         # the carved devices this pod owns (device-plugin Allocate env),

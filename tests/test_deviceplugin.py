@@ -104,3 +104,91 @@ class TestDevicePlugin:
         assert resp.container_responses[0].envs[ENV_DEVICE_IDS] == \
             "tpu-0-2x2-2"
         channel.close()
+
+
+class TestTimesharePlugin:
+    def test_replicas_and_hbm_grant_env(self, tmp_path, kubelet):
+        from nos_tpu.device.deviceplugin import TimeshareReplicaPlugin
+
+        kubelet_sock, _ = kubelet
+        replicas = {"n": 3}
+        p = TimeshareReplicaPlugin(
+            "nos.tpu/tpu-8gb", gb=8, num_replicas=lambda: replicas["n"],
+            plugins_dir=str(tmp_path), kubelet_socket=kubelet_sock)
+        p.serve()
+        try:
+            channel = _plugin_channel(p)
+            stream = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=api_pb2.Empty.SerializeToString,
+                response_deserializer=api_pb2.ListAndWatchResponse
+                .FromString)(api_pb2.Empty())
+            first = next(stream)
+            assert len(first.devices) == 3
+            assert all(d.ID.startswith("tpu-8gb::") for d in first.devices)
+
+            allocate = channel.unary_unary(
+                "/v1beta1.DevicePlugin/Allocate",
+                request_serializer=api_pb2.AllocateRequest
+                .SerializeToString,
+                response_deserializer=api_pb2.AllocateResponse.FromString)
+            # TWO replicas granted -> the env carries 2 x 8 GB
+            resp = allocate(api_pb2.AllocateRequest(container_requests=[
+                api_pb2.ContainerAllocateRequest(
+                    devices_IDs=["tpu-8gb::1", "tpu-8gb::2"])]),
+                timeout=5.0)
+            envs = resp.container_responses[0].envs
+            assert envs["NOS_TPU_TIMESHARE_GB_tpu_8gb"] == "16"
+            channel.close()
+        finally:
+            p.stop()
+
+    def test_grants_sum_into_workload_env_cap(self):
+        """The full loop, mixed profiles: per-profile Allocate envs sum
+        into one XLA HBM cap."""
+        from nos_tpu.device import workload_env
+
+        env = {"NOS_TPU_TIMESHARE_GB_tpu_8gb": "8",
+               "NOS_TPU_TIMESHARE_GB_tpu_4gb": "4",
+               "TPU_ACCELERATOR_TYPE": "v5litepod-8"}
+        applied = workload_env.apply(env)
+        assert float(applied["XLA_PYTHON_CLIENT_MEM_FRACTION"]) == \
+            pytest.approx(12 / 16 * 0.9)
+
+
+class TestTimesharePluginManager:
+    def test_syncs_from_node_allocatable(self, tmp_path, kubelet):
+        from nos_tpu.device.deviceplugin import TimesharePluginManager
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+        from nos_tpu.testing.factory import make_tpu_node
+
+        kubelet_sock, requests = kubelet
+        api = APIServer()
+        node = make_tpu_node("ts-0", partitioning="timeshare")
+        node.status.allocatable["nos.tpu/tpu-8gb"] = 2.0
+        node.status.allocatable["nos.tpu/tpu-4gb"] = 4.0
+        api.create(KIND_NODE, node)
+
+        mgr = TimesharePluginManager(
+            api, "ts-0", plugins_dir=str(tmp_path),
+            kubelet_socket=kubelet_sock)
+        try:
+            mgr.sync()
+            assert set(mgr._plugins) == {"nos.tpu/tpu-8gb",
+                                         "nos.tpu/tpu-4gb"}
+            # both registered with the kubelet stub
+            names = {requests.get(timeout=5.0).resource_name
+                     for _ in range(2)}
+            assert names == {"nos.tpu/tpu-8gb", "nos.tpu/tpu-4gb"}
+            # replica counts follow the node
+            p8 = mgr._plugins["nos.tpu/tpu-8gb"]
+            assert len(p8._devices().devices) == 2
+
+            def shrink(n):
+                n.status.allocatable["nos.tpu/tpu-8gb"] = 1.0
+
+            api.patch(KIND_NODE, "ts-0", mutate=shrink)
+            mgr.sync()
+            assert len(p8._devices().devices) == 1
+        finally:
+            mgr.stop()
