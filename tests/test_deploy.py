@@ -160,7 +160,7 @@ class TestRenderedConfigsLoad:
 class TestDockerfiles:
     def test_one_dockerfile_per_component(self):
         components = {"operator", "partitioner", "scheduler", "sliceagent",
-                      "chipagent", "metricsexporter"}
+                      "chipagent", "metricsexporter", "train"}
         found = {p.parent.name for p in BUILD.glob("*/Dockerfile")}
         assert found == components
         assert (BUILD / "Dockerfile.base").exists()
