@@ -19,12 +19,12 @@ from dataclasses import dataclass, field
 from nos_tpu.kube.client import (
     APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA,
 )
-from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.objects import FastCopy, ObjectMeta
 from nos_tpu.kube.resources import ResourceList
 
 
 @dataclass
-class ElasticQuotaSpec:
+class ElasticQuotaSpec(FastCopy):
     # min is the quantity of resources guaranteed to the namespace.
     min: ResourceList = field(default_factory=dict)
     # max is the upper bound of consumable resources; empty = unbounded
@@ -33,12 +33,12 @@ class ElasticQuotaSpec:
 
 
 @dataclass
-class ElasticQuotaStatus:
+class ElasticQuotaStatus(FastCopy):
     used: ResourceList = field(default_factory=dict)
 
 
 @dataclass
-class ElasticQuota:
+class ElasticQuota(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
     status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
@@ -50,7 +50,7 @@ class ElasticQuota:
 
 
 @dataclass
-class CompositeElasticQuotaSpec:
+class CompositeElasticQuotaSpec(FastCopy):
     # namespaces this quota spans (≥1 — compositeelasticquota_types.go:40).
     namespaces: list[str] = field(default_factory=list)
     min: ResourceList = field(default_factory=dict)
@@ -58,7 +58,7 @@ class CompositeElasticQuotaSpec:
 
 
 @dataclass
-class CompositeElasticQuota:
+class CompositeElasticQuota(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: CompositeElasticQuotaSpec = field(default_factory=CompositeElasticQuotaSpec)
     status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)

@@ -13,24 +13,24 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.kube.objects import FastCopy, ObjectMeta, RUNNING
 
 
 @dataclass
-class PodDisruptionBudgetSpec:
+class PodDisruptionBudgetSpec(FastCopy):
     min_available: int = 0
     selector: dict[str, str] = field(default_factory=dict)  # label match
 
 
 @dataclass
-class PodDisruptionBudgetStatus:
+class PodDisruptionBudgetStatus(FastCopy):
     disruptions_allowed: int = 0
     current_healthy: int = 0
     desired_healthy: int = 0
 
 
 @dataclass
-class PodDisruptionBudget:
+class PodDisruptionBudget(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodDisruptionBudgetSpec = field(
         default_factory=PodDisruptionBudgetSpec)

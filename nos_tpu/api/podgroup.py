@@ -13,11 +13,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.objects import FastCopy, ObjectMeta
 
 
 @dataclass
-class PodGroupSpec:
+class PodGroupSpec(FastCopy):
     # Gang size: schedule no member until this many exist, then all at once.
     min_member: int = 1
     # Requested JAX mesh shape ("2x2x4"); empty = no topology constraint.
@@ -25,13 +25,13 @@ class PodGroupSpec:
 
 
 @dataclass
-class PodGroupStatus:
+class PodGroupStatus(FastCopy):
     phase: str = "Pending"          # Pending | Scheduled
     scheduled: int = 0
 
 
 @dataclass
-class PodGroup:
+class PodGroup(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodGroupSpec = field(default_factory=PodGroupSpec)
     status: PodGroupStatus = field(default_factory=PodGroupStatus)

@@ -8,6 +8,7 @@ Analog of the corev1 types used throughout the reference.
 
 from __future__ import annotations
 
+import copy
 import itertools
 from dataclasses import dataclass, field, replace
 
@@ -15,13 +16,50 @@ from .resources import ResourceList
 
 _uid_counter = itertools.count(1)
 
+# The APIServer deep-copies on every read/write to keep real-API-server
+# value isolation, which makes object copying THE control-plane hot path
+# (20M generic-deepcopy frames per simulated v5e-256 trace).  These object
+# trees are acyclic and alias-free, so a direct structural copy preserves
+# deepcopy semantics at a fraction of the dispatch cost.
+_ATOMIC = (str, int, float, bool, type(None))
+
+
+def _fast_copy(v, memo):
+    t = v.__class__
+    if t in _ATOMIC:
+        return v
+    if t is dict:
+        return {k: _fast_copy(x, memo) for k, x in v.items()}
+    if t is list:
+        return [_fast_copy(x, memo) for x in v]
+    if t is tuple:
+        return tuple(_fast_copy(x, memo) for x in v)
+    dc = getattr(v, "__deepcopy__", None)
+    if dc is not None:
+        return dc(memo)
+    return copy.deepcopy(v, memo)
+
+
+class FastCopy:
+    """Mixin: structural __deepcopy__ for the kube/CRD object model.
+
+    Copies every instance attribute (including ones tests bolt on), so it
+    is behavior-compatible with generic deepcopy for these trees."""
+
+    def __deepcopy__(self, memo):
+        new = object.__new__(self.__class__)
+        nd = new.__dict__
+        for k, v in self.__dict__.items():
+            nd[k] = _fast_copy(v, memo)
+        return new
+
 
 def new_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 
 
 @dataclass
-class ObjectMeta:
+class ObjectMeta(FastCopy):
     name: str = ""
     namespace: str = ""
     uid: str = field(default_factory=new_uid)
@@ -34,13 +72,13 @@ class ObjectMeta:
 
 
 @dataclass
-class Container:
+class Container(FastCopy):
     name: str = "main"
     resources: ResourceList = field(default_factory=dict)
 
 
 @dataclass
-class PodSpec:
+class PodSpec(FastCopy):
     containers: list[Container] = field(default_factory=list)
     init_containers: list[Container] = field(default_factory=list)
     overhead: ResourceList = field(default_factory=dict)
@@ -51,7 +89,7 @@ class PodSpec:
 
 
 @dataclass
-class PodCondition:
+class PodCondition(FastCopy):
     type: str
     status: str
     reason: str = ""
@@ -66,14 +104,14 @@ FAILED = "Failed"
 
 
 @dataclass
-class PodStatus:
+class PodStatus(FastCopy):
     phase: str = PENDING
     conditions: list[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
 
 
 @dataclass
-class Pod:
+class Pod(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     status: PodStatus = field(default_factory=PodStatus)
@@ -100,13 +138,13 @@ class Pod:
 
 
 @dataclass
-class NodeStatus:
+class NodeStatus(FastCopy):
     allocatable: ResourceList = field(default_factory=dict)
     capacity: ResourceList = field(default_factory=dict)
 
 
 @dataclass
-class Node:
+class Node(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     status: NodeStatus = field(default_factory=NodeStatus)
 
@@ -116,7 +154,7 @@ class Node:
 
 
 @dataclass
-class ConfigMap:
+class ConfigMap(FastCopy):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     data: dict[str, str] = field(default_factory=dict)
 

@@ -27,6 +27,13 @@ class SliceUnit:
     used: dict[Shape, int] = field(default_factory=dict)
     free: dict[Shape, int] = field(default_factory=dict)
 
+    def __deepcopy__(self, memo):
+        # Planner snapshot forks clone every unit (hot path).  Shape keys
+        # and the Generation are frozen — share them; only the two
+        # mutable count tables need copying.
+        return SliceUnit(generation=self.generation, index=self.index,
+                         used=dict(self.used), free=dict(self.free))
+
     # -- derived tables ----------------------------------------------------
     def allowed_geometries(self) -> list[dict[Shape, int]]:
         table = enumerate_tilings(

@@ -22,6 +22,11 @@ class TimeshareUnit:
     used: dict[int, int] = field(default_factory=dict)   # gb -> count
     free: dict[int, int] = field(default_factory=dict)
 
+    def __deepcopy__(self, memo):
+        # Hot on planner snapshot forks; all keys/values are ints.
+        return TimeshareUnit(hbm_gb=self.hbm_gb, index=self.index,
+                             used=dict(self.used), free=dict(self.free))
+
     def _gb(self, table: Mapping[int, int]) -> int:
         return sum(gb * c for gb, c in table.items())
 
