@@ -21,6 +21,8 @@ from nos_tpu.partitioning.timeshare.snapshot_taker import (
 )
 from nos_tpu.device.timeshare_plugin import TimeshareDevicePlugin
 
+from nos_tpu.controllers.kubelet import admit_bound_pods
+
 from .reporter import ChipReporter
 
 logger = logging.getLogger(__name__)
@@ -49,5 +51,9 @@ class ChipAgent:
     def tick(self) -> None:
         """One plugin-apply + report cycle (event-driven + periodic in the
         reference, polled by the run loop here)."""
+        # kubelet-phase sim first (no-op against a real substrate, where
+        # the actual kubelet owns the transition): admission precedes
+        # device-usage reporting, as on a real node
+        admit_bound_pods(self._api, self._node_name)
         self.plugin.tick()
         self.reporter.reconcile()

@@ -1,0 +1,40 @@
+"""Kubelet admission sim: Pending -> Running for pods bound to a node.
+
+In Kubernetes the scheduler only writes the binding (spec.nodeName via the
+/binding subresource); the *kubelet* observes the binding, starts the
+containers and reports status.phase=Running.  The reference relies on that
+split everywhere its PDB health / gang liveness / quota usage accounting
+reads pod phases.
+
+Against the in-memory APIServer there is no kubelet, so the node agents
+(the per-node daemons that play the kubelet-adjacent role here) perform
+the phase transition on their tick.  Against a real substrate
+(kube/rest.py KubeClient) the actual kubelet owns the transition and this
+helper declines to act — marking a pod Running before its containers
+start would inflate PDB current_healthy and gang liveness, exactly the
+failure mode this split exists to prevent.
+"""
+
+from __future__ import annotations
+
+from nos_tpu.kube.client import APIServer, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+
+
+def admit_bound_pods(api, node_name: str) -> int:
+    """Move Pending pods bound to `node_name` to Running; returns how many
+    were admitted.  No-op on non-sim substrates (real kubelet's job)."""
+    if not isinstance(api, APIServer):
+        return 0
+    admitted = 0
+    for pod in api.list(
+            KIND_POD,
+            filter_fn=lambda p: (p.spec.node_name == node_name
+                                 and p.status.phase == PENDING)):
+        def mutate(p):
+            if p.spec.node_name == node_name and p.status.phase == PENDING:
+                p.status.phase = RUNNING
+        api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                  mutate=mutate)
+        admitted += 1
+    return admitted

@@ -16,6 +16,8 @@ from nos_tpu.device.tpuclient import (
     PodResourcesClient, SliceDeviceClient, TpuRuntimeClient,
 )
 
+from nos_tpu.controllers.kubelet import admit_bound_pods
+
 from .actuator import SliceActuator
 from .reporter import SliceReporter
 from .shared import SharedState
@@ -26,6 +28,7 @@ class SliceAgent:
                  runtime: TpuRuntimeClient,
                  pod_resources: PodResourcesClient,
                  plugin_manager=None) -> None:
+        self.api = api
         self.node_name = node_name
         self.runtime = runtime
         self.pod_resources = pod_resources
@@ -44,6 +47,10 @@ class SliceAgent:
 
     def tick(self) -> bool:
         """One report+actuate cycle; returns True if devices changed."""
+        # kubelet-phase sim first (no-op against a real substrate, where
+        # the actual kubelet owns the transition): admission precedes
+        # device-usage reporting, as on a real node
+        admit_bound_pods(self.api, self.node_name)
         self.reporter.reconcile()
         changed = self.actuator.reconcile()
         if changed:

@@ -464,9 +464,13 @@ class Scheduler:
         return key
 
     def _bind(self, pod: Pod, node_name: str) -> None:
+        # Binding only (the /binding subresource against a real substrate).
+        # phase=Running is the KUBELET's claim, not the scheduler's — the
+        # node agents make it for the in-memory substrate
+        # (controllers/kubelet.py); asserting it here would inflate PDB
+        # current_healthy and gang liveness before containers exist.
         def mutate(p: Pod) -> None:
             p.spec.node_name = node_name
-            p.status.phase = RUNNING
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]

@@ -90,3 +90,14 @@ def make_slice_pod(shape: str | Shape, qty: int = 1, **kw) -> Pod:
 def make_timeshare_pod(gb: int, qty: int = 1, **kw) -> Pod:
     res = {timeshare_resource_name(gb): qty, "cpu": 1.0}
     return make_pod(resources=res, **kw)
+
+
+def admit_all(api) -> int:
+    """Kubelet-phase sim for agent-less tests: admit (Pending -> Running)
+    every bound pod on every node.  Tests that run real node agents get
+    this from the agents' tick instead (controllers/kubelet.py)."""
+    from nos_tpu.controllers.kubelet import admit_bound_pods
+    from nos_tpu.kube.client import KIND_NODE
+
+    return sum(admit_bound_pods(api, node.metadata.name)
+               for node in api.list(KIND_NODE))

@@ -153,6 +153,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(404, {"message": "not found"})
                 target = (obj.get("target") or {}).get("name", "")
                 coll[key].setdefault("spec", {})["nodeName"] = target
+                # The cluster side of the split: once bound, the node's
+                # kubelet starts the containers and reports Running.  The
+                # stub plays that kubelet (the scheduler/agents must NOT —
+                # controllers/kubelet.py declines on real substrates).
+                coll[key].setdefault("status", {})["phase"] = "Running"
                 st.bump(coll[key])
                 st.notify(plural, "MODIFIED", coll[key])
             return self._send(201, {"status": "Success"})

@@ -113,8 +113,9 @@ def test_pending_pod_triggers_repartition_and_schedules():
     assert spec_matches_status(node.metadata.annotations)
     assert node.status.allocatable.get("nos.tpu/slice-2x2") == 2.0
 
-    # now the pod schedules
+    # now the pod schedules; the agent (kubelet sim) admits it
     assert h.scheduler.run_cycle() >= 1
+    h.agent.tick()
     bound = h.api.get(KIND_POD, "train-1", "default")
     assert bound.spec.node_name == "host-0"
     assert bound.status.phase == RUNNING

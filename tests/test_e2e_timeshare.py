@@ -89,13 +89,13 @@ def test_fractional_sharing_end_to_end():
         plan_id_from_key("ts-0", keys[0])
 
     assert h.scheduler.run_cycle() >= 4
+    h.agent.tick()  # kubelet-phase sim: the agent admits the bound pods
     for i in range(4):
         pod = h.api.get(KIND_POD, f"infer-{i}", "default")
         assert pod.spec.node_name == "ts-0"
         assert pod.status.phase == RUNNING
 
-    # reporter attributes usage per chip
-    h.agent.tick()
+    # reporter attributes usage per chip (tick also re-reports)
     status = parse_status_annotations(h.get_node().metadata.annotations)
     used = sum(a.quantity for a in status if a.status == "used")
     assert used == 4

@@ -28,7 +28,7 @@ from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalcul
 from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
 from nos_tpu.scheduler.framework import CycleState, Framework, NodeResourcesFit, SharedLister
 from nos_tpu.scheduler.scheduler import Scheduler
-from nos_tpu.testing.factory import make_node, make_pod
+from nos_tpu.testing.factory import admit_all, make_node, make_pod
 
 TPU_MEM = C.RESOURCE_TPU_MEMORY
 CALC = TPUResourceCalculator(hbm_gb_per_chip=16)
@@ -246,6 +246,7 @@ class TestEndToEndSchedulingWithQuota:
                 name=f"b-{i}", namespace="ns-b",
                 resources={C.RESOURCE_TPU: 4}, creation_timestamp=float(i)))
         assert sched.run_cycle() == 2
+        admit_all(api)  # kubelet-phase sim: victims must be Running
         eq_rec.reconcile_all()
         labels = {p.metadata.name: p.metadata.labels.get(C.LABEL_CAPACITY)
                   for p in api.list(KIND_POD, namespace="ns-b")}

@@ -21,7 +21,9 @@ from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
 from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
 from nos_tpu.scheduler.gang import TopologyFilter
 from nos_tpu.scheduler.scheduler import Scheduler
-from nos_tpu.testing.factory import make_node, make_pod, make_slice_pod, make_tpu_node
+from nos_tpu.testing.factory import (
+    admit_all, make_node, make_pod, make_slice_pod, make_tpu_node,
+)
 from nos_tpu.topology import V5E
 
 
@@ -163,6 +165,8 @@ class TestGangWithPartitioner:
         for a in agents:
             a.tick()
         assert sched.run_cycle() == 4
+        for a in agents:
+            a.tick()  # kubelet-phase sim: agents admit the bound pods
         for i in range(4):
             assert api.get(KIND_POD, f"w-{i}", "default").status.phase == RUNNING
 
@@ -248,6 +252,7 @@ class TestGangPreemption:
                 f"b-{i}", "borrower", namespace="ns-b",
                 creation_timestamp=float(i)))
         assert sched.run_cycle() == 2
+        admit_all(api)  # kubelet-phase sim: victims must be Running
         from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
         ElasticQuotaReconciler(api, calc).reconcile_all()
         # ns-a claims its min back with one 8-chip pod: one member of the
@@ -292,6 +297,7 @@ class TestGangPreemption:
                 f"b-{i}", "borrower", chips=6, namespace="ns-b",
                 creation_timestamp=float(i)))
         assert sched.run_cycle() == 2
+        admit_all(api)  # kubelet-phase sim: victims must be Running
         from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
         ElasticQuotaReconciler(api, calc).reconcile_all()
         # claimant gang: 8 members x 2 chips = its full 256 GB min; any
@@ -336,6 +342,7 @@ class TestGangPreemption:
                 f"b-{i}", "borrower", namespace="ns-b",
                 creation_timestamp=float(i)))
         assert sched.run_cycle() == 2
+        admit_all(api)  # kubelet-phase sim: victims must be Running
         from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
         ElasticQuotaReconciler(api, calc).reconcile_all()
         create_pod_group(api, "claimant", min_member=3, namespace="ns-a")
@@ -379,6 +386,7 @@ class TestGangPreemption:
                 f"b-{i}", "borrower", namespace="ns-b",
                 creation_timestamp=float(i)))
         assert sched.run_cycle() == 2
+        admit_all(api)  # kubelet-phase sim: victims must be Running
         from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
         ElasticQuotaReconciler(api, calc).reconcile_all()
         # ns-a's gang claims its min (2 x 8 chips = its entire guarantee)
@@ -390,6 +398,7 @@ class TestGangPreemption:
         sched.run_cycle()  # no fit -> gang preemption evicts borrower gang
         assert api.list(KIND_POD, namespace="ns-b") == []
         assert sched.run_cycle() == 2  # freed capacity: claimant binds
+        admit_all(api)  # kubelet-phase sim
         for i in range(2):
             pod = api.get(KIND_POD, f"a-{i}", "ns-a")
             assert pod.spec.node_name

@@ -1,0 +1,52 @@
+"""Kubelet-phase split: the scheduler binds, the node agent admits.
+
+In Kubernetes the scheduler writes only the binding; the kubelet reports
+phase=Running.  Round-2 review flagged that conflating the two in the
+scheduler would inflate PDB current_healthy / gang liveness against a real
+substrate — these tests pin the split (nos_tpu/controllers/kubelet.py).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.controllers.kubelet import admit_bound_pods
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_node, make_pod
+
+
+def make_cluster():
+    api = APIServer()
+    api.create(KIND_NODE, make_node(
+        "node-0", allocatable={"cpu": 8.0, C.RESOURCE_TPU: 8.0}))
+    return api, Scheduler(api, Framework([NodeResourcesFit()]))
+
+
+def test_scheduler_binds_without_claiming_running():
+    api, sched = make_cluster()
+    api.create(KIND_POD, make_pod(name="p", resources={C.RESOURCE_TPU: 4}))
+    assert sched.run_cycle() == 1
+    pod = api.get(KIND_POD, "p", "default")
+    assert pod.spec.node_name == "node-0"
+    assert pod.status.phase == PENDING   # kubelet's claim, not ours
+
+
+def test_admit_transitions_only_bound_pods_on_node():
+    api, sched = make_cluster()
+    api.create(KIND_POD, make_pod(name="p", resources={C.RESOURCE_TPU: 4}))
+    api.create(KIND_POD, make_pod(name="q", resources={C.RESOURCE_TPU: 16}))
+    sched.run_cycle()
+    assert admit_bound_pods(api, "node-0") == 1
+    assert api.get(KIND_POD, "p", "default").status.phase == RUNNING
+    # unbound pod untouched; second admit is a no-op
+    assert api.get(KIND_POD, "q", "default").status.phase == PENDING
+    assert admit_bound_pods(api, "node-0") == 0
+
+
+def test_admit_declines_on_non_sim_substrate():
+    class NotTheSim:  # a real-substrate client is not an APIServer
+        pass
+
+    assert admit_bound_pods(NotTheSim(), "node-0") == 0

@@ -149,6 +149,7 @@ class TestNativeEndToEnd:
         assert pc.process_if_ready()
         agent.tick()
         assert sched.run_cycle() == 2
+        agent.tick()  # kubelet-phase sim: the agent admits the bound pods
         for i in range(2):
             assert api.get(KIND_POD, f"p-{i}", "default").status.phase == RUNNING
 
