@@ -150,6 +150,24 @@ def bench_attention(jax, jnp, flash_attention, dense_attention, peak):
     }
 
 
+def make_step_chain(jax, trainer, state, tokens):
+    """iters -> thunk running `iters` data-dependently chained train steps
+    inside one jit (see module docstring for why); shared by this bench and
+    scripts/mfu_explore.py so sweep numbers stay comparable."""
+    step = trainer._step
+
+    def make(iters):
+        @jax.jit
+        def run(state, tokens):
+            def body(i, carry):
+                st, _ = carry
+                return step(st, tokens)
+            _, loss = jax.lax.fori_loop(0, iters, body, (state, 0.0))
+            return loss
+        return lambda: float(run(state, tokens))
+    return make
+
+
 def bench_train_step(jax, jnp, peak):
     import flax.linen as nn
 
@@ -170,17 +188,7 @@ def bench_train_step(jax, jnp, peak):
         jax.random.PRNGKey(1), (BATCH, SEQ), 0, cfg.vocab_size,
         dtype=jnp.int32)
 
-    step = trainer._step  # chain inside one jit (see module docstring)
-
-    def make_step(iters):
-        @jax.jit
-        def run(state, tokens):
-            def body(i, carry):
-                st, _ = carry
-                return step(st, tokens)
-            _, loss = jax.lax.fori_loop(0, iters, body, (state, 0.0))
-            return loss
-        return lambda: float(run(state, tokens))
+    make_step = make_step_chain(jax, trainer, state, tokens)
 
     # breakdown pieces: forward-only loss, forward+backward (grads kept
     # live by consuming one element of every leaf)
@@ -255,10 +263,19 @@ def main() -> None:
         "observed_host_block": disc.host_block.name,
         "peak_tflops": peak / 1e12,
     }
-    out.update(bench_matmul_roofline(jax, jnp))
-    out.update(bench_attention(jax, jnp, flash_attention, dense_attention,
-                               peak))
-    out.update(bench_train_step(jax, jnp, peak))
+    import sys
+
+    def timed(label, fn, *a):
+        t0 = time.perf_counter()
+        r = fn(*a)
+        print(f"[bench_compute] {label}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        return r
+
+    out.update(timed("roofline", bench_matmul_roofline, jax, jnp))
+    out.update(timed("attention", bench_attention, jax, jnp,
+                     flash_attention, dense_attention, peak))
+    out.update(timed("train_step", bench_train_step, jax, jnp, peak))
     print(json.dumps(out))
 
 

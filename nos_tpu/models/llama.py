@@ -57,6 +57,13 @@ class LlamaConfig:
     # never re-run in backward; "dots" saves all non-batch matmul outputs).
     remat_policy: str = "nothing"
     scan_layers: bool = True
+    # Fuse the q/k/v projections into one [E, H+2Hkv, D] matmul and the
+    # MLP gate/up into one [E, 2I] matmul: fewer, wider MXU dispatches and
+    # one HBM read of x instead of three (hardware exploration r3 — the
+    # step breakdown located the MFU remainder in the K=hidden contraction
+    # matmuls, not the attention kernels).
+    fused_qkv: bool = False
+    fused_gate_up: bool = False
 
 
 # Llama-3-8B (meta-llama/Meta-Llama-3-8B) — the BASELINE config #4 workload.
@@ -92,13 +99,14 @@ _REMAT_POLICIES = {
     "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     "attn": lambda: jax.checkpoint_policies.save_only_these_names("attn_out"),
     "mlp": lambda: jax.checkpoint_policies.save_only_these_names(
-        "mlp_gate", "mlp_up"),
+        "mlp_gate", "mlp_up", "mlp_gate_up"),
     "mats": lambda: jax.checkpoint_policies.save_only_these_names(
-        "attn_out", "mlp_gate", "mlp_up"),
+        "attn_out", "mlp_gate", "mlp_up", "mlp_gate_up"),
     # everything matmul-shaped saved; backward recomputes only the cheap
     # elementwise/norm chain
     "all_mats": lambda: jax.checkpoint_policies.save_only_these_names(
-        "attn_q", "attn_k", "attn_v", "attn_out", "mlp_gate", "mlp_up"),
+        "attn_q", "attn_k", "attn_v", "attn_qkv", "attn_out",
+        "mlp_gate", "mlp_up", "mlp_gate_up"),
 }
 
 
@@ -144,15 +152,27 @@ class Attention(nn.Module):
             param_dtype=cfg.param_dtype, name=name,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), logical))
-        q = dense((cfg.num_heads, cfg.head_dim),
-                  ("embed", "heads", "head_dim"), "q_proj")(x)
-        k = dense((cfg.num_kv_heads, cfg.head_dim),
-                  ("embed", "kv_heads", "head_dim"), "k_proj")(x)
-        v = dense((cfg.num_kv_heads, cfg.head_dim),
-                  ("embed", "kv_heads", "head_dim"), "v_proj")(x)
-        q = ad_checkpoint.checkpoint_name(q, "attn_q")
-        k = ad_checkpoint.checkpoint_name(k, "attn_k")
-        v = ad_checkpoint.checkpoint_name(v, "attn_v")
+        if cfg.fused_qkv:
+            nh, nkv = cfg.num_heads, cfg.num_kv_heads
+            qkv = dense((nh + 2 * nkv, cfg.head_dim),
+                        ("embed", "heads", "head_dim"), "qkv_proj")(x)
+            qkv = ad_checkpoint.checkpoint_name(qkv, "attn_qkv")
+            q = qkv[:, :, :nh]
+            k = qkv[:, :, nh:nh + nkv]
+            v = qkv[:, :, nh + nkv:]
+        else:
+            q = dense((cfg.num_heads, cfg.head_dim),
+                      ("embed", "heads", "head_dim"), "q_proj")(x)
+            k = dense((cfg.num_kv_heads, cfg.head_dim),
+                      ("embed", "kv_heads", "head_dim"), "k_proj")(x)
+            v = dense((cfg.num_kv_heads, cfg.head_dim),
+                      ("embed", "kv_heads", "head_dim"), "v_proj")(x)
+            # Only the unfused branch names the slices: in the fused
+            # branch "attn_qkv" is already saved and naming the q/k/v
+            # views too would store the same bytes twice under all_mats.
+            q = ad_checkpoint.checkpoint_name(q, "attn_q")
+            k = ad_checkpoint.checkpoint_name(k, "attn_k")
+            v = ad_checkpoint.checkpoint_name(v, "attn_v")
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
         q = _rope(q, rope)
@@ -194,12 +214,21 @@ class MLP(nn.Module):
             param_dtype=cfg.param_dtype, name=name,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), logical))
-        gate = dense(cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(x)
-        up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
-        # Named so selective remat can save them: recomputing gate/up is
-        # ~half the per-layer matmul FLOPs, the dominant remat expense.
-        gate = ad_checkpoint.checkpoint_name(gate, "mlp_gate")
-        up = ad_checkpoint.checkpoint_name(up, "mlp_up")
+        if cfg.fused_gate_up:
+            gate_up = dense(2 * cfg.intermediate_size, ("embed", "mlp"),
+                            "gate_up_proj")(x)
+            gate_up = ad_checkpoint.checkpoint_name(gate_up, "mlp_gate_up")
+            gate = gate_up[..., :cfg.intermediate_size]
+            up = gate_up[..., cfg.intermediate_size:]
+        else:
+            gate = dense(cfg.intermediate_size, ("embed", "mlp"),
+                         "gate_proj")(x)
+            up = dense(cfg.intermediate_size, ("embed", "mlp"), "up_proj")(x)
+            # Named so selective remat can save them: recomputing gate/up
+            # is ~half the per-layer matmul FLOPs, the dominant remat
+            # expense.
+            gate = ad_checkpoint.checkpoint_name(gate, "mlp_gate")
+            up = ad_checkpoint.checkpoint_name(up, "mlp_up")
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
         return dense(cfg.hidden_size, ("mlp", "embed"), "down_proj")(h)

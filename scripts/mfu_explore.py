@@ -1,0 +1,78 @@
+"""MFU exploration on the real chip: sweep train-step configs.
+
+Times the BENCH_350M train step across {fused projections} x {batch} x
+{remat policy} using bench_compute's slope methodology, printing one JSON
+line per variant so the best config can be promoted into bench_compute.py.
+
+Usage: python scripts/mfu_explore.py [--quick]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bench_compute import PEAK_TFLOPS, DEFAULT_PEAK, _slope, \
+    make_step_chain, model_flops_per_step  # noqa: E402
+from nos_tpu.models.llama import BENCH_350M  # noqa: E402
+from nos_tpu.models.train import ShardedTrainer  # noqa: E402
+from nos_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: E402
+
+SEQ = 2048
+
+
+def time_variant(batch, fused, remat_policy, peak):
+    cfg = dataclasses.replace(
+        BENCH_350M, attn_impl="flash", remat_policy=remat_policy,
+        scan_layers=False, fused_qkv=fused, fused_gate_up=fused)
+    mesh = make_mesh(MeshSpec.for_device_count(1), devices=jax.devices()[:1])
+    trainer = ShardedTrainer(cfg, mesh, batch_size=batch, seq_len=SEQ)
+    state = trainer.init_state(0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, SEQ), 0, cfg.vocab_size, jnp.int32)
+    t = _slope(make_step_chain(jax, trainer, state, tokens),
+               n1=4, n2=12, reps=3)
+    flops = model_flops_per_step(cfg, batch, SEQ)
+    return {
+        "batch": batch, "fused": fused, "remat": remat_policy,
+        "step_ms": round(t * 1e3, 2),
+        "tokens_per_s": round(batch * SEQ / t),
+        "mfu": round(flops / t / peak, 4),
+    }
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind),
+                DEFAULT_PEAK)
+    quick = "--quick" in sys.argv
+    variants = [
+        (8, False, "mats"),    # round-2 best (control)
+        (8, True, "mats"),
+        (16, True, "mats"),
+        (16, False, "mats"),
+        (16, True, "all_mats"),
+        (32, True, "mats"),
+    ]
+    if quick:
+        variants = variants[:3]
+    for batch, fused, remat in variants:
+        try:
+            r = time_variant(batch, fused, remat, peak)
+        except Exception as e:  # noqa: BLE001 — keep sweeping (OOM etc.)
+            r = {"batch": batch, "fused": fused, "remat": remat,
+                 "error": str(e)[:200]}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,38 @@ class TestLlama:
         assert jnp.allclose(l1[0, :10], l2[0, :10], atol=1e-5)
         assert not jnp.allclose(l1[0, 10:], l2[0, 10:], atol=1e-5)
 
+    def test_fused_projections_match_unfused(self):
+        """fused_qkv / fused_gate_up are pure layout changes: stitching the
+        unfused kernels into the fused shapes must reproduce the logits
+        bit-for-bit modulo matmul tiling (tight atol)."""
+        import flax
+        import flax.linen as nn
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 16), 0, TINY.vocab_size, jnp.int32)
+        cfg = dataclasses.replace(TINY, scan_layers=False)
+        base = Llama(cfg)
+        vs = base.init(jax.random.PRNGKey(0), tokens)
+        ref = base.apply(vs, tokens)
+
+        fused_cfg = dataclasses.replace(
+            cfg, fused_qkv=True, fused_gate_up=True)
+        fused_params = nn.meta.unbox(flax.core.unfreeze(vs))["params"]
+        for lyr in (f"layer_{i}" for i in range(TINY.num_layers)):
+            attn = fused_params[lyr]["attn"]
+            qkv = jnp.concatenate(
+                [attn.pop("q_proj")["kernel"],
+                 attn.pop("k_proj")["kernel"],
+                 attn.pop("v_proj")["kernel"]], axis=1)
+            attn["qkv_proj"] = {"kernel": qkv}
+            mlp = fused_params[lyr]["mlp"]
+            gate_up = jnp.concatenate(
+                [mlp.pop("gate_proj")["kernel"],
+                 mlp.pop("up_proj")["kernel"]], axis=1)
+            mlp["gate_up_proj"] = {"kernel": gate_up}
+        out = Llama(fused_cfg).apply({"params": fused_params}, tokens)
+        assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
 
 class TestShardedTrainer:
     def test_fsdp_tp_sp_training_step(self):
