@@ -36,6 +36,15 @@ PEAK_TFLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
                "v5": 197e12}
 DEFAULT_PEAK = 197e12
 
+
+def peak_for(device_kind: str) -> float:
+    """Nominal bf16 peak FLOP/s for a jax device_kind string (shared with
+    scripts/mfu_explore.py so both judge MFU against the same peak)."""
+    kind = device_kind.lower()
+    return next((v for k, v in PEAK_TFLOPS.items() if k in kind),
+                DEFAULT_PEAK)
+
+
 BATCH = 8
 SEQ = 2048
 
@@ -252,9 +261,7 @@ def main() -> None:
     from nos_tpu.parallel.ring import dense_attention
 
     disc = discovery.discover()
-    device_kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in PEAK_TFLOPS.items() if k in device_kind),
-                DEFAULT_PEAK)
+    peak = peak_for(jax.devices()[0].device_kind)
 
     out = {
         "platform": "tpu",

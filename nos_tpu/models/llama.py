@@ -59,9 +59,13 @@ class LlamaConfig:
     scan_layers: bool = True
     # Fuse the q/k/v projections into one [E, H+2Hkv, D] matmul and the
     # MLP gate/up into one [E, 2I] matmul: fewer, wider MXU dispatches and
-    # one HBM read of x instead of three (hardware exploration r3 — the
-    # step breakdown located the MFU remainder in the K=hidden contraction
-    # matmuls, not the attention kernels).
+    # one HBM read of x instead of three.  Measured a wash on the v5e at
+    # the bench shapes (the post-matmul slices force relayouts), so both
+    # default off.  Caveat under tp>1: the q/k/v split points (H, H+Hkv)
+    # are generally not shard boundaries of the combined heads axis, so
+    # slicing forces per-layer resharding — keep fusion off for
+    # tensor-parallel runs unless resharding is measured cheaper than the
+    # extra HBM reads.
     fused_qkv: bool = False
     fused_gate_up: bool = False
 
@@ -107,6 +111,15 @@ _REMAT_POLICIES = {
     "all_mats": lambda: jax.checkpoint_policies.save_only_these_names(
         "attn_q", "attn_k", "attn_v", "attn_qkv", "attn_out",
         "mlp_gate", "mlp_up", "mlp_gate_up"),
+    # the post-rope q/k (+ v) instead of the projection outputs: the
+    # backward recomputes neither the qkv matmuls nor rope — the two
+    # dominant recompute costs the step breakdown attributes to "mats".
+    # "attn_qkv" is in the list for the fused_qkv branch, where the
+    # unfused "attn_v" name is never emitted: without it the backward
+    # would re-run the whole fused projection just to rebuild v.
+    "rots": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_q_rot", "attn_k_rot", "attn_v", "attn_qkv", "attn_out",
+        "mlp_gate", "mlp_up", "mlp_gate_up"),
 }
 
 
@@ -119,11 +132,22 @@ def rope_tables(positions: jax.Array, dim: int, theta: float):
 
 
 def _rope(x: jax.Array, rope: tuple[jax.Array, jax.Array]) -> jax.Array:
-    """Rotary position embedding over the last dim of [B, S, H, D]."""
+    """Rotary position embedding over the last dim of [B, S, H, D].
+
+    Rotate-half convention (pairs are (i, i+D/2), as in the HF Llama
+    layout) rather than the interleaved (2i, 2i+1) one: the halves are
+    contiguous lane slices, where interleaving costs strided VPU
+    access + a stack/reshape in every layer's forward AND its remat
+    recompute — measured +0.9 MFU points on the v5e at the bench shapes.
+    The convention is framework-internal (every consumer shares this
+    function); checkpoints are not interchangeable across conventions."""
     cos, sin = rope
-    x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
-    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.reshape(x.shape).astype(x.dtype)
+    d2 = x.shape[-1] // 2
+    x1 = x[..., :d2].astype(jnp.float32)
+    x2 = x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
 
 
 class RMSNorm(nn.Module):
@@ -175,8 +199,8 @@ class Attention(nn.Module):
             v = ad_checkpoint.checkpoint_name(v, "attn_v")
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
-        q = _rope(q, rope)
-        k = _rope(k, rope)
+        q = ad_checkpoint.checkpoint_name(_rope(q, rope), "attn_q_rot")
+        k = ad_checkpoint.checkpoint_name(_rope(k, rope), "attn_k_rot")
         n_rep = cfg.num_heads // cfg.num_kv_heads
         k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
 

@@ -18,8 +18,8 @@ sys.path.insert(0, ".")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-from bench_compute import PEAK_TFLOPS, DEFAULT_PEAK, _slope, \
-    make_step_chain, model_flops_per_step  # noqa: E402
+from bench_compute import _slope, make_step_chain, model_flops_per_step, \
+    peak_for  # noqa: E402
 from nos_tpu.models.llama import BENCH_350M  # noqa: E402
 from nos_tpu.models.train import ShardedTrainer  # noqa: E402
 from nos_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: E402
@@ -51,9 +51,7 @@ def main():
     if jax.default_backend() != "tpu":
         print(json.dumps({"skipped": "not on tpu"}))
         return
-    kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind),
-                DEFAULT_PEAK)
+    peak = peak_for(jax.devices()[0].device_kind)
     quick = "--quick" in sys.argv
     variants = [
         (8, False, "mats"),    # round-2 best (control)

@@ -191,6 +191,35 @@ class TestLlama:
         assert jnp.max(jnp.abs(out - ref)) < 1e-5
 
 
+class TestRematPolicies:
+    def test_all_policies_compute_identical_loss_and_grads(self):
+        """Remat changes what backward recomputes, never the math: every
+        policy must produce the same loss and gradients."""
+        from nos_tpu.models.llama import _REMAT_POLICIES
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(7), (2, 16), 0, TINY.vocab_size, jnp.int32)
+        ref_loss = ref_grads = None
+        for policy in _REMAT_POLICIES:
+            cfg = dataclasses.replace(TINY, remat_policy=policy)
+            model = Llama(cfg)
+            vs = model.init(jax.random.PRNGKey(0), tokens)
+
+            def loss_fn(v):
+                return model.apply(v, tokens, targets=tokens)
+
+            loss, grads = jax.value_and_grad(loss_fn)(vs)
+            if ref_loss is None:
+                ref_loss, ref_grads = loss, grads
+                continue
+            assert jnp.allclose(loss, ref_loss, atol=1e-5), policy
+            jax.tree_util.tree_map(
+                lambda a, b: None if jnp.allclose(a, b, atol=1e-4)
+                else (_ for _ in ()).throw(
+                    AssertionError(f"grad mismatch under {policy}")),
+                ref_grads, grads)
+
+
 class TestShardedTrainer:
     def test_fsdp_tp_sp_training_step(self):
         cfg = dataclasses.replace(TINY, attn_impl="ring")
