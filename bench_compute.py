@@ -20,6 +20,10 @@ tunnel), so each measurement chains N iterations data-dependently inside a
 single jit (lax.fori_loop) and fetches a scalar to force completion; the
 per-iteration time is the slope between a small and a large N over
 min-of-reps, which cancels the ~100 ms tunnel round-trip exactly.
+N is passed as a *traced* scalar (dynamic while trip count), so the small
+and large chains share ONE compiled program — remote compiles through the
+tunnel run minutes each for the unrolled 24-layer step, and compiling per
+N was the bulk of the bench's wall time.
 
 Prints one JSON object with all metrics; bench.py merges it into the
 driver's single benchmark line.
@@ -93,14 +97,16 @@ def bench_matmul_roofline(jax, jnp) -> dict:
     x = jax.random.normal(jax.random.PRNGKey(9), (n, n), jnp.bfloat16)
     w = jax.random.normal(jax.random.PRNGKey(8), (n, n), jnp.bfloat16)
 
+    @jax.jit
+    def run(x, iters):
+        def body(i, acc):
+            y = jnp.dot(acc, w, preferred_element_type=jnp.float32)
+            return (y * (1.0 / n)).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, iters, body, x)[0, 0]
+
     def make(iters):
-        @jax.jit
-        def run(x):
-            def body(i, acc):
-                y = jnp.dot(acc, w, preferred_element_type=jnp.float32)
-                return (y * (1.0 / n)).astype(jnp.bfloat16)
-            return jax.lax.fori_loop(0, iters, body, x)[0, 0]
-        return lambda: float(run(x))
+        i = jnp.int32(iters)   # traced trip count: one compile for all N
+        return lambda: float(run(x, i))
 
     t = _slope(make, n1=10, n2=40, reps=3)
     return {"matmul_roofline_tflops": round(2 * n ** 3 / t / 1e12, 1)}
@@ -116,12 +122,14 @@ def bench_attention(jax, jnp, flash_attention, dense_attention, peak):
     bwd_flops = 3.5 * fwd_flops
 
     def fwd_maker(attn):
+        @jax.jit
+        def run(q, k, v, iters):
+            return jax.lax.fori_loop(
+                0, iters, lambda i, acc: attn(acc, k, v), q)[0, 0, 0, 0]
+
         def make(iters):
-            @jax.jit
-            def run(q, k, v):
-                return jax.lax.fori_loop(
-                    0, iters, lambda i, acc: attn(acc, k, v), q)[0, 0, 0, 0]
-            return lambda: float(run(q, k, v))
+            i = jnp.int32(iters)
+            return lambda: float(run(q, k, v, i))
         return make
 
     def grad_maker(attn):
@@ -132,12 +140,14 @@ def bench_attention(jax, jnp, flash_attention, dense_attention, peak):
             gq, gk, gv = jax.grad(loss, (0, 1, 2))(qx, k, v)
             return gq + gk + gv  # all three kernels stay live
 
+        @jax.jit
+        def run(q, k, v, iters):
+            return jax.lax.fori_loop(
+                0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
+
         def make(iters):
-            @jax.jit
-            def run(q, k, v):
-                return jax.lax.fori_loop(
-                    0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
-            return lambda: float(run(q, k, v))
+            i = jnp.int32(iters)
+            return lambda: float(run(q, k, v, i))
         return make
 
     flash = lambda q, k, v: flash_attention(q, k, v, True)   # noqa: E731
@@ -163,17 +173,20 @@ def make_step_chain(jax, trainer, state, tokens):
     """iters -> thunk running `iters` data-dependently chained train steps
     inside one jit (see module docstring for why); shared by this bench and
     scripts/mfu_explore.py so sweep numbers stay comparable."""
+    import jax.numpy as jnp
     step = trainer._step
 
+    @jax.jit
+    def run(state, tokens, iters):
+        def body(i, carry):
+            st, _ = carry
+            return step(st, tokens)
+        _, loss = jax.lax.fori_loop(0, iters, body, (state, 0.0))
+        return loss
+
     def make(iters):
-        @jax.jit
-        def run(state, tokens):
-            def body(i, carry):
-                st, _ = carry
-                return step(st, tokens)
-            _, loss = jax.lax.fori_loop(0, iters, body, (state, 0.0))
-            return loss
-        return lambda: float(run(state, tokens))
+        i = jnp.int32(iters)
+        return lambda: float(run(state, tokens, i))
     return make
 
 
@@ -207,14 +220,16 @@ def bench_train_step(jax, jnp, peak):
                                        targets=toks)
 
     def chain(fn):
+        @jax.jit
+        def run(params, toks, iters):
+            def body(i, acc):
+                t2 = toks + (acc > 1e30).astype(jnp.int32)
+                return fn(params, t2)
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+
         def make(iters):
-            @jax.jit
-            def run(params, toks):
-                def body(i, acc):
-                    t2 = toks + (acc > 1e30).astype(jnp.int32)
-                    return fn(params, t2)
-                return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
-            return lambda: float(run(state.params, tokens))
+            i = jnp.int32(iters)
+            return lambda: float(run(state.params, tokens, i))
         return make
 
     def fwd_bwd(params, toks):

@@ -88,6 +88,15 @@ def spec_plan_annotation(family: str = "slice") -> str:
 def status_plan_annotation(family: str = "slice") -> str:
     return f"{ANNOT_STATUS_PLAN_PREFIX}.{family}"
 
+
+# Gang window lease: stamped by the scheduler on every host of the aligned
+# window a stuck multi-host gang is draining toward (value "<ns>/<gang>").
+# The partitioner reads it — the per-node loop re-carves leased hosts last
+# and the group pass prefers the leased window — so both planes converge on
+# the SAME window instead of draining different ones (no reference analog;
+# the nomination concept applied to host windows).
+ANNOT_GANG_LEASE = f"{GROUP}/gang-window-lease"
+
 # Requested JAX mesh shape for a workload pod, e.g. "2x2x4" — lets the slice
 # shape chooser carve slices with usable ICI topology (SURVEY.md §2.8).
 ANNOT_MESH = f"{GROUP}/mesh"

@@ -1,24 +1,44 @@
-"""Kubelet admission sim: Pending -> Running for pods bound to a node.
+"""Kubelet sim: admission (Pending -> Running) + device allocation.
 
 In Kubernetes the scheduler only writes the binding (spec.nodeName via the
-/binding subresource); the *kubelet* observes the binding, starts the
+/binding subresource); the *kubelet* observes it, calls the device
+plugin's Allocate for every requested extended resource, starts the
 containers and reports status.phase=Running.  The reference relies on that
-split everywhere its PDB health / gang liveness / quota usage accounting
-reads pod phases.
+split everywhere: its "used" devices are exactly the kubelet
+pod-resources allocations (pkg/resource/lister.go:28), which is what
+stops the migagent from deleting a MIG device under a freshly bound pod.
 
 Against the in-memory APIServer there is no kubelet, so the node agents
-(the per-node daemons that play the kubelet-adjacent role here) perform
-the phase transition on their tick.  Against a real substrate
-(kube/rest.py KubeClient) the actual kubelet owns the transition and this
-helper declines to act — marking a pod Running before its containers
-start would inflate PDB current_healthy and gang liveness, exactly the
-failure mode this split exists to prevent.
+run this sim.  Two layers:
+
+- `admit_bound_pods(api, node)` — plain phase transition, for agent-less
+  tests and timeshare nodes (replicas are fungible; the chipagent's
+  plugin accounts HBM grants separately).
+- `KubeletSim` — the slice-node version: a pod is admitted only once
+  every slice it requests is matched to a FREE carved device, and that
+  allocation is recorded in the (fake) pod-resources view — so the
+  actuator's delete-free-then-create sees bound pods' devices as USED at
+  apply time, exactly like the reference's NVML ∩ pod-resources view.
+  Binds a synchronous pod watch (allocation happens in the binder's
+  notify, atomic with the bind) plus an idempotent per-tick sweep as the
+  retry path.
+
+Against a real substrate (kube/rest.py KubeClient) both layers decline —
+the actual kubelet owns admission and allocation; claiming Running or
+used-ness from here would inflate PDB current_healthy, gang liveness and
+the device view.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+
 from nos_tpu.kube.client import APIServer, KIND_POD
 from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.kube.resources import pod_request
+
+logger = logging.getLogger(__name__)
 
 
 def admit_bound_pods(api, node_name: str) -> int:
@@ -38,3 +58,120 @@ def admit_bound_pods(api, node_name: str) -> int:
                   mutate=mutate)
         admitted += 1
     return admitted
+
+
+class KubeletSim:
+    """Device-backed admission for one slice node (see module docstring).
+
+    `device_client` is a SliceDeviceClient; `pod_resources` must offer
+    allocate/release (the stateful fake) — with either absent, admission
+    degrades to the plain phase transition."""
+
+    def __init__(self, api, node_name: str, device_client=None,
+                 pod_resources=None) -> None:
+        self._api = api
+        self._node = node_name
+        self._client = device_client
+        self._res = (pod_resources
+                     if hasattr(pod_resources, "allocate") else None)
+        self._active = isinstance(api, APIServer)
+        self._unsub = None
+        # The watch callback runs on the binder's thread while sweep()
+        # runs on the agent's run loop: the read-devices -> pick ->
+        # allocate sequence must be atomic or two pods can be handed the
+        # same device (and sweep's GC could release a concurrent
+        # event-path allocation it never saw).  RLock: _try_admit's own
+        # phase patch notifies this very watcher on the same thread.
+        self._lock = threading.RLock()
+
+    # -- wiring -------------------------------------------------------------
+    def bind(self) -> None:
+        """Subscribe to pod events: allocation+admission run synchronously
+        with the scheduler's bind notification, closing the window where
+        the actuator could still see a just-bound pod's device as free."""
+        if self._active and self._unsub is None:
+            self._unsub = self._api.watch(KIND_POD, self._on_event)
+
+    def unbind(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    def _on_event(self, event: str, pod) -> None:
+        if getattr(pod.spec, "node_name", "") != self._node:
+            return
+        with self._lock:
+            if event == "DELETED":
+                if self._res is not None:
+                    self._res.release(pod.key)
+            elif pod.status.phase == PENDING:
+                self._try_admit(pod)
+
+    # -- per-tick retry / GC ------------------------------------------------
+    def sweep(self) -> int:
+        """Idempotent: admit any bound Pending pods (retry after a failed
+        allocation), re-record allocations for Running pods that have
+        none (agent restart: the pod-resources view is rebuilt, like the
+        kubelet's checkpoint recovery), and release allocations whose
+        pods are gone."""
+        if not self._active:
+            return 0
+        # Lock order must match the event path, where the APIServer lock
+        # is already held when _lock is taken (watch callbacks fire under
+        # it): APIServer first, then _lock — else AB/BA deadlock.
+        with self._api.locked(), self._lock:
+            pods = self._api.list(
+                KIND_POD,
+                filter_fn=lambda p: p.spec.node_name == self._node)
+            if self._res is not None:
+                live = {p.key for p in pods}
+                allocated = set(self._res.allocated_pod_keys())
+                for key in allocated - live:
+                    self._res.release(key)
+                for pod in pods:
+                    if pod.status.phase == RUNNING \
+                            and pod.key not in allocated:
+                        self._try_admit(pod)
+            admitted = 0
+            for pod in pods:
+                if pod.status.phase == PENDING:
+                    admitted += self._try_admit(pod)
+            return admitted
+
+    # -- admission ----------------------------------------------------------
+    def _try_admit(self, pod) -> int:
+        from nos_tpu.topology import FREE
+        from nos_tpu.topology.profile import (
+            extract_slice_requests, slice_resource_name,
+        )
+
+        if self._client is not None and self._res is not None:
+            requests = extract_slice_requests(pod_request(pod))
+            if requests:
+                by_resource: dict[str, list] = {}
+                for d in self._client.get_devices():
+                    if d.status == FREE:
+                        by_resource.setdefault(
+                            d.resource_name, []).append(d.device_id)
+                picked: set[str] = set()
+                for shape, qty in requests.items():
+                    pool = by_resource.get(slice_resource_name(shape), [])
+                    if len(pool) < qty:
+                        logger.debug(
+                            "kubelet sim: %s waits for %s x%d on %s",
+                            pod.key, shape, qty, self._node)
+                        return 0           # retry on a later sweep
+                    picked |= set(pool[:qty])
+                    del pool[:qty]
+                self._res.allocate(pod.key, picked)
+
+        node, phase = self._node, pod.status.phase
+        if phase != PENDING:
+            return 0
+
+        def mutate(p):
+            if p.spec.node_name == node and p.status.phase == PENDING:
+                p.status.phase = RUNNING
+        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                        mutate=mutate)
+        return 1

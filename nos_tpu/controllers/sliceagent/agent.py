@@ -16,7 +16,7 @@ from nos_tpu.device.tpuclient import (
     PodResourcesClient, SliceDeviceClient, TpuRuntimeClient,
 )
 
-from nos_tpu.controllers.kubelet import admit_bound_pods
+from nos_tpu.controllers.kubelet import KubeletSim
 
 from .actuator import SliceActuator
 from .reporter import SliceReporter
@@ -39,18 +39,29 @@ class SliceAgent:
         self.reporter = SliceReporter(api, node_name, self.client, self.shared)
         self.actuator = SliceActuator(api, node_name, self.client, self.shared,
                                       self.plugin)
+        # kubelet sim (in-memory substrate only): device-backed admission,
+        # so bound pods' slices read as USED at actuation time
+        self.kubelet = KubeletSim(api, node_name, self.client, pod_resources)
 
     def start(self) -> None:
         """Startup: cleanup orphaned devices, then first report."""
         self.actuator.startup_cleanup()
+        self.kubelet.bind()
         self.reporter.reconcile()
+
+    def stop(self) -> None:
+        """Detach from the API bus.  A crashed agent's watch dies with
+        its process in production; in-process (tests, sim mains) a
+        replaced agent must unbind or its kubelet sim keeps admitting
+        pods against an abandoned device view."""
+        self.kubelet.unbind()
 
     def tick(self) -> bool:
         """One report+actuate cycle; returns True if devices changed."""
-        # kubelet-phase sim first (no-op against a real substrate, where
-        # the actual kubelet owns the transition): admission precedes
+        # kubelet sweep first (no-op against a real substrate, where the
+        # actual kubelet owns admission/allocation): admission precedes
         # device-usage reporting, as on a real node
-        admit_bound_pods(self.api, self.node_name)
+        self.kubelet.sweep()
         self.reporter.reconcile()
         changed = self.actuator.reconcile()
         if changed:

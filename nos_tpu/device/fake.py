@@ -124,6 +124,9 @@ class FakePodResources(PodResourcesClient):
     def release(self, pod_key: str) -> None:
         self._used.pop(pod_key, None)
 
+    def allocated_pod_keys(self) -> list[str]:
+        return list(self._used)
+
     def used_device_ids(self) -> set[str]:
         out: set[str] = set()
         for ids in self._used.values():

@@ -76,6 +76,15 @@ class APIServer:
         with self._lock:
             return [k for k, s in self._stores.items() if s]
 
+    def locked(self):
+        """The store's reentrant lock, for callers that must order their
+        own lock AFTER it.  Watch callbacks fire with this lock held, so
+        a component locking (own -> APIServer) from another thread would
+        deadlock against (APIServer -> own) in a callback; taking this
+        first (reentrancy keeps nested CRUD calls working) gives both
+        paths the same order.  Used by controllers/kubelet.py."""
+        return self._lock
+
     # -- CRUD -------------------------------------------------------------
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:

@@ -63,14 +63,29 @@ class ClusterSnapshot:
         return self._nodes[name]
 
     def get_candidate_nodes(self) -> list[PartitionableNode]:
-        """Nodes with any free (unrequested) capacity, sorted by name for
-        determinism (reference snapshot.go:119-130)."""
+        """Nodes with any free (unrequested) capacity, best-fit first:
+        fewest free chip-equivalents, then name for determinism.  The
+        reference visits name order (snapshot.go:119-130); carving new
+        demand into the fullest host that still fits keeps empty hosts
+        whole for gangs — with the kubelet sim's used-device accounting,
+        a fragmented host cannot be re-carved under its pods, so where
+        new demand lands now decides real utilization.  Hosts carrying
+        the scheduler's gang-window lease (ANNOT_GANG_LEASE) go last:
+        they are draining toward a stuck multi-host gang and re-carving
+        them for other demand would re-fragment the window."""
+        from nos_tpu.api.constants import ANNOT_GANG_LEASE
+        from nos_tpu.topology.profile import free_chip_equivalents
+
         out = []
         for name in sorted(self._nodes):
             ni = self._nodes[name].node_info()
             if any(v > 0 for v in ni.free().values()):
-                out.append(self._nodes[name])
-        return out
+                leased = bool(ni.node.metadata.annotations.get(
+                    ANNOT_GANG_LEASE))
+                out.append((leased, free_chip_equivalents(ni.free()),
+                            name, self._nodes[name]))
+        out.sort(key=lambda t: (t[0], t[1], t[2]))
+        return [n for _, _, _, n in out]
 
     def get_lacking_slices(self, pod: Pod) -> dict[str, int]:
         """Cluster-wide: (allocatable - requested) - podRequest, negatives

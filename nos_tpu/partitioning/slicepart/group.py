@@ -109,7 +109,19 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                         shape not in gen.multihost_shapes():
                     continue
                 hosts = gen.hosts_for(shape)
-                for window in aligned_windows(members, hosts):
+                # Leased windows first: the scheduler drained these hosts
+                # for exactly this kind of gang (ANNOT_GANG_LEASE), so the
+                # moment one is clean it must become the gang's slice.
+                from nos_tpu.api.constants import ANNOT_GANG_LEASE
+
+                def leased_count(window) -> int:
+                    return sum(
+                        1 for w in window
+                        if w.node_info().node.metadata.annotations.get(
+                            ANNOT_GANG_LEASE))
+
+                for window in sorted(aligned_windows(members, hosts),
+                                     key=lambda w: -leased_count(w)):
                     if remaining[shape] <= 0:
                         break
                     if any(w.has_used_slices() or w.is_multihost_member()

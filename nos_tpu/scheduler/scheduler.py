@@ -15,6 +15,7 @@ import functools
 import logging
 
 from nos_tpu.api.constants import (
+    ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
     LABEL_ACCELERATOR as C_LABEL_ACCELERATOR,
     LABEL_HOST_INDEX as C_LABEL_HOST_INDEX,
     LABEL_POD_GROUP as C_LABEL_POD_GROUP,
@@ -52,12 +53,28 @@ def _window_sizes_of(gen) -> tuple[int, ...]:
     return tuple(sorted({gen.hosts_for(s) for s in gen.multihost_shapes()}))
 
 
+def _free_chip_equiv(ni: NodeInfo) -> float:
+    from nos_tpu.topology.profile import free_chip_equivalents
+
+    return free_chip_equivalents(ni.free())
+
+
 class Scheduler:
     def __init__(self, api: APIServer, framework: Framework,
                  name: str = "nos-tpu-scheduler") -> None:
         self._api = api
         self._framework = framework
         self.name = name
+        # Gang window lease: each cycle, the oldest stuck multi-host gang
+        # reserves its currently most-drained candidate window (re-picked
+        # every cycle — completions are stochastic, so tracking whichever
+        # window is closest to empty beats pinning one; measured on the
+        # v5e-256 trace).  Singles avoid the reserved hosts whenever any
+        # alternative fits, and the lease is published on the nodes so
+        # the partitioner drains the same window.
+        self._lease: tuple[tuple[str, str], frozenset[str]] | None = None
+        self._reserved_hosts: frozenset[str] = frozenset()
+        self._lease_healed = False   # one startup sweep clears stale leases
 
     # -- cluster view -------------------------------------------------------
     def snapshot(self) -> SharedLister:
@@ -123,6 +140,20 @@ class Scheduler:
         ]
         pods.sort(key=lambda p: (-p.spec.priority,
                                  p.metadata.creation_timestamp, p.key))
+        # Release the window lease once its gang is no longer waiting;
+        # a still-stuck gang re-earns (and may move) it this cycle.
+        pending_gangs = {(p.metadata.namespace, gang_name(p))
+                         for p in pods if gang_name(p)}
+        if self._lease is not None and self._lease[0] not in pending_gangs:
+            self._lease = None
+            self._sync_lease_annotations(frozenset())
+        elif not self._lease_healed and self._lease is None:
+            # Startup: a predecessor may have died holding a lease whose
+            # annotations would otherwise skew partitioning forever.
+            self._sync_lease_annotations(frozenset())
+        self._lease_healed = True
+        self._reserved_hosts = (self._lease[1] if self._lease is not None
+                                else frozenset())
         gangs: dict[tuple[str, str], list[Pod]] = {}
         for pod in pods:
             g = gang_name(pod)
@@ -231,6 +262,8 @@ class Scheduler:
             msg = "gang does not fit as a whole"
             if preempted:
                 msg += " (evicted over-quota victims, retrying)"
+            self._reserve_gang_window(
+                (first.metadata.namespace, gang), windows, base)
             for pod in members:
                 self._mark_unschedulable(pod, Status.unschedulable(msg))
             return 0
@@ -395,6 +428,59 @@ class Scheduler:
         return None
 
     # -- internals ----------------------------------------------------------
+    def _reserve_gang_window(self, gang_key: tuple[str, str], windows,
+                             base: SharedLister) -> None:
+        """A stuck multi-host gang leases its most drained candidate
+        window (max free chip-equivalents = least left to wait for),
+        re-evaluated every cycle so the lease follows whichever window is
+        currently closest to empty.  One lease cluster-wide, oldest stuck
+        gang first (processing order).  Advisory: singles shed the
+        reservation whenever any other host fits (_score_key), so it
+        costs nothing when the cluster has room."""
+        if self._lease is not None and self._lease[0] != gang_key:
+            return          # another (older) gang holds this cycle's lease
+        if not windows:
+            return
+        free_by_name = {ni.name: _free_chip_equiv(ni) for ni in base.list()}
+        best: tuple[float, frozenset[str]] | None = None
+        for _, hosts in windows:
+            if not hosts:
+                continue
+            drained = sum(free_by_name.get(h, 0.0) for h in hosts)
+            if best is None or drained > best[0]:
+                best = (drained, frozenset(hosts))
+        if best is not None:
+            self._lease = (gang_key, best[1])
+            self._reserved_hosts = best[1]
+            self._sync_lease_annotations(best[1], gang_key)
+            logger.debug("gang %s leased window %s",
+                         gang_key, sorted(best[1]))
+
+    def _sync_lease_annotations(self, hosts: frozenset[str],
+                                gang_key: tuple[str, str] | None = None
+                                ) -> None:
+        """Publish the lease on the member nodes (ANNOT_GANG_LEASE) so the
+        partitioner drains the SAME window; clear it everywhere else.
+        Scanning all nodes also heals stale leases after a scheduler
+        restart."""
+        value = f"{gang_key[0]}/{gang_key[1]}" if gang_key else ""
+        for node in self._api.list(KIND_NODE):
+            has = node.metadata.annotations.get(C_ANNOT_GANG_LEASE, "")
+            want = value if node.metadata.name in hosts else ""
+            if has == want:
+                continue
+
+            def mutate(n):
+                if want:
+                    n.metadata.annotations[C_ANNOT_GANG_LEASE] = want
+                else:
+                    n.metadata.annotations.pop(C_ANNOT_GANG_LEASE, None)
+            try:
+                self._api.patch(KIND_NODE, node.metadata.name, mutate=mutate)
+            except Exception:  # noqa: BLE001 — advisory; next cycle heals
+                logger.debug("lease annotation patch failed for %s",
+                             node.metadata.name)
+
     def _window_busy_map(self, lister: SharedLister) -> dict:
         """(pod_id, host_index) -> has-pods, for fragmentation-aware
         scoring.  Built once per scoring decision from the cycle's
@@ -459,7 +545,10 @@ class Scheduler:
                     C_LABEL_HOST_INDEX, "0"))
             except ValueError:
                 idx = 0
-            return (headroom, window_penalty(ni), idx, ni.name)
+            # Reserved-window avoidance dominates: a stuck gang's chosen
+            # window must drain, so singles go anywhere else that fits.
+            return (ni.name in self._reserved_hosts, headroom,
+                    window_penalty(ni), idx, ni.name)
 
         return key
 

@@ -84,3 +84,18 @@ def profile_sort_key(profile: str) -> tuple[int, str]:
     if profile.endswith("gb"):
         return (int(profile[:-2]), profile)
     return (10**9, profile)
+
+
+def free_chip_equivalents(resources) -> float:
+    """Capacity in chip-equivalents: slice resources weighted by their
+    shape's chip count, everything else (whole chips, timeshare replicas)
+    at face value; non-positive quantities ignored.  Shared by the
+    scheduler's window-lease scoring and the planner's best-fit candidate
+    ordering so the two planes rank hosts by the SAME metric."""
+    total = 0.0
+    for res, qty in resources.items():
+        if qty <= 0:
+            continue
+        shape = shape_from_resource(res)
+        total += shape.chips * qty if shape is not None else qty
+    return total

@@ -45,29 +45,40 @@ def main() -> None:
         if S % bq or S % bk:
             continue
 
-        def make_fwd(iters, bq=bq, bk=bk):
+        def build(bq, bk):
+            # block sizes stay Python ints via closure (pallas needs them
+            # concrete); the trip count is traced so each config compiles
+            # its fwd/bwd program exactly once
             @jax.jit
-            def run(q, k, v):
+            def run_fwd(q, k, v, iters):
                 def body(i, acc):
                     return flash_attention(acc, k, v, True, bq, bk)
                 return jax.lax.fori_loop(0, iters, body, q)[0, 0, 0, 0]
-            return lambda: float(run(q, k, v))
 
-        def make_bwd(iters, bq=bq, bk=bk):
             def loss(qq, kk, vv):
                 return jnp.sum(
                     flash_attention(qq, kk, vv, True, bq, bk)
                     .astype(jnp.float32) ** 2)
 
             @jax.jit
-            def run(q, k, v):
+            def run_bwd(q, k, v, iters):
                 def body(i, acc):
                     # grads flow to q, k AND v so neither backward
                     # kernel can be dead-code-eliminated
                     gq, gk, gv = jax.grad(loss, (0, 1, 2))(acc, k, v)
                     return gq + gk + gv
                 return jax.lax.fori_loop(0, iters, body, q)[0, 0, 0, 0]
-            return lambda: float(run(q, k, v))
+            return run_fwd, run_bwd
+
+        run_fwd, run_bwd = build(bq, bk)
+
+        def make_fwd(iters):
+            i = jnp.int32(iters)
+            return lambda: float(run_fwd(q, k, v, i))
+
+        def make_bwd(iters):
+            i = jnp.int32(iters)
+            return lambda: float(run_bwd(q, k, v, i))
 
         try:
             t_fwd = _slope(make_fwd)

@@ -97,7 +97,8 @@ class TestKillRestartMidPlan:
 
         # the agent dies before actuating; a FRESH agent (fresh
         # SharedState, same hardware) must pick the plan up purely from
-        # the annotations
+        # the annotations.  The dead process's watches die with it:
+        c.agent.stop()
         c.agent = c.agent2 = c._new_agent()
         c.agent.start()
         c.agent.tick()
@@ -173,6 +174,7 @@ class TestNativeFaultInjection:
     def test_actuator_retries_after_transient_create_failure(self):
         c = Cluster()
         flaky = _FlakyRuntime(c.runtime)
+        c.agent.stop()
         c.agent = SliceAgent(c.api, "host-0", flaky, FakePodResources())
         c.agent.start()
         c.agent.tick()
@@ -200,6 +202,7 @@ class TestNativeFaultInjection:
 
         broken = BrokenList(c.runtime)
         broken.fail = False
+        c.agent.stop()
         c.agent = SliceAgent(c.api, "host-0", broken, FakePodResources())
         c.agent.start()
         c.agent.tick()
@@ -324,3 +327,122 @@ class TestSchedulerScale64Hosts:
         assert worst < 10.0, f"64-host cycle worst {worst:.3f}s"
         bound = sum(1 for p in api.list(KIND_POD) if p.spec.node_name)
         assert bound > 0
+
+
+
+class TestConcurrentChurn:
+    def test_threaded_control_plane_survives_churn(self):
+        """Race hunt at the process-model level: submitter and deleter
+        threads churn pods for a fixed window while the
+        partitioner/scheduler/agent run loops are live.  The live
+        invariant is falsifiable: no host may ever be oversubscribed
+        (bound chips > its 8-chip block).  Demand is capped below
+        cluster capacity so afterwards EVERY surviving pod must converge
+        to bound + Running — a stuck pod fails the test."""
+        import threading
+
+        from nos_tpu.api.config import PartitionerConfig
+        from nos_tpu.cmd.assembly import build_partitioner_main, build_scheduler
+        from nos_tpu.device import default_tpu_runtime
+        from nos_tpu.kube.client import NotFound
+        from nos_tpu.kube.objects import RUNNING
+        from nos_tpu.kube.resources import pod_request
+        from nos_tpu.topology.profile import extract_slice_requests
+
+        def pod_chips(p) -> int:
+            return sum(s.chips * q for s, q in
+                       extract_slice_requests(pod_request(p)).items())
+
+        api = APIServer()
+        state = ClusterState()
+        cfg = PartitionerConfig(batch_timeout_s=0.2, batch_idle_s=0.05,
+                                poll_interval_s=0.01)
+        main, _ = build_partitioner_main(api, state, cfg)
+        for i in range(2):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{i}", pod_id="pod-0", host_index=i))
+            agent = SliceAgent(api, f"host-{i}", default_tpu_runtime(V5E),
+                               FakePodResources())
+            agent.start()
+            main.add_loop(f"agent-{i}", agent.tick, 0.01)
+        main.add_loop("sched", build_scheduler(api).run_cycle, 0.01)
+        main.start()
+
+        stop = threading.Event()
+        errors: list[str] = []
+        DEMAND_CAP = 14        # always below the 16-chip capacity:
+        cap_lock = threading.Lock()   # convergence stays feasible
+
+        def submitter(tid: int) -> None:
+            n = 0
+            while not stop.is_set():
+                # check-then-create under a lock: three submitters racing
+                # past the cap together could strand unbindable pods
+                with cap_lock:
+                    live = sum(pod_chips(p) for p in api.list(KIND_POD))
+                    if live <= DEMAND_CAP - 4:  # worst new pod is 4 chips
+                        n += 1
+                        try:
+                            api.create(KIND_POD, make_slice_pod(
+                                random.choice(["1x1", "1x2", "2x2"]), 1,
+                                name=f"churn-{tid}-{n}"))
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"submit: {e}")
+                time.sleep(0.004)
+
+        def deleter() -> None:
+            while not stop.is_set():
+                for p in api.list(KIND_POD):
+                    if p.spec.node_name and random.random() < 0.3:
+                        try:
+                            api.delete(KIND_POD, p.metadata.name,
+                                       p.metadata.namespace)
+                        except NotFound:
+                            pass
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"delete: {e}")
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(3)] + [threading.Thread(target=deleter)]
+        try:
+            for t in threads:
+                t.start()
+            # Live-churn window: submit/bind/delete races overlap the
+            # scheduler + repartitioner + agents the whole time.
+            churn_until = time.monotonic() + 4.0
+            while time.monotonic() < churn_until:
+                per_node: dict[str, int] = {}
+                for p in api.list(KIND_POD):
+                    if p.spec.node_name:
+                        per_node[p.spec.node_name] = \
+                            per_node.get(p.spec.node_name, 0) + pod_chips(p)
+                for node, chips in per_node.items():
+                    assert chips <= 8, (
+                        f"{node} oversubscribed: {chips} chips bound")
+                time.sleep(0.02)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+            assert not any(t.is_alive() for t in threads)
+            assert not errors, errors[:3]
+
+            # Post-churn: demand was capped below capacity, so EVERY
+            # surviving pod must converge to bound + Running.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                pods = api.list(KIND_POD)
+                if pods and all(p.spec.node_name
+                                and p.status.phase == RUNNING
+                                for p in pods):
+                    break
+                time.sleep(0.05)
+            else:
+                stuck = [(p.metadata.name, p.status.phase)
+                         for p in api.list(KIND_POD)
+                         if not (p.spec.node_name
+                                 and p.status.phase == RUNNING)]
+                pytest.fail(f"pods stuck after churn: {stuck[:5]}")
+        finally:
+            stop.set()
+            main.shutdown()
