@@ -73,6 +73,19 @@ STATUS_ANNOT_RE = re.compile(
     rf"^{re.escape(ANNOT_STATUS_PREFIX)}(?P<index>\d+)-(?P<profile>[0-9a-zx.]+)-(?P<status>free|used)$"
 )
 
+# Observed device placements, reported per unit by the node agent:
+#   nos.tpu/status-tpu-placements-<index> = "<u|f>|<profile>|<o0.o1>|<d0.d1>;..."
+# One record per carved device (status, profile, offset, oriented dims).
+# This is what makes the cluster-scoped planner placement-aware: a geometry
+# that is count-feasible on an empty block can be placement-infeasible
+# around *pinned* used slices (the TPU analog of why NVML creation order
+# matters, reference pkg/gpu/nvml/client.go:286-340) — without these the
+# planner re-commits doomed plans forever.
+ANNOT_PLACEMENTS_PREFIX = f"{GROUP}/status-tpu-placements-"
+PLACEMENT_ANNOT_RE = re.compile(
+    rf"^{re.escape(ANNOT_PLACEMENTS_PREFIX)}(?P<index>\d+)$"
+)
+
 # Plan-id handshake between decision plane and actuation plane
 # (reference annotations.go:21-58, partitioner_controller.go:212-232).
 # Keys are per profile family ("slice" / "timeshare") so the two strategies

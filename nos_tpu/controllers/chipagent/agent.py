@@ -53,7 +53,8 @@ class ChipAgent:
         reference, polled by the run loop here)."""
         # kubelet-phase sim first (no-op against a real substrate, where
         # the actual kubelet owns the transition): admission precedes
-        # device-usage reporting, as on a real node
-        admit_bound_pods(self._api, self._node_name)
+        # device-usage reporting, as on a real node.  Slice pods are left
+        # to the sliceagent's device-backed KubeletSim on hybrid nodes.
+        admit_bound_pods(self._api, self._node_name, skip_slice_pods=True)
         self.plugin.tick()
         self.reporter.reconcile()

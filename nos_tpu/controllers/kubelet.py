@@ -34,16 +34,23 @@ from __future__ import annotations
 import logging
 import threading
 
-from nos_tpu.kube.client import APIServer, KIND_POD
+from nos_tpu.kube.client import APIServer, KIND_POD, NotFound
 from nos_tpu.kube.objects import PENDING, RUNNING
 from nos_tpu.kube.resources import pod_request
+from nos_tpu.topology.profile import extract_slice_requests
 
 logger = logging.getLogger(__name__)
 
 
-def admit_bound_pods(api, node_name: str) -> int:
+def admit_bound_pods(api, node_name: str, *,
+                     skip_slice_pods: bool = False) -> int:
     """Move Pending pods bound to `node_name` to Running; returns how many
-    were admitted.  No-op on non-sim substrates (real kubelet's job)."""
+    were admitted.  No-op on non-sim substrates (real kubelet's job).
+
+    `skip_slice_pods` leaves pods with slice requests to the sliceagent's
+    KubeletSim, which admits only once every slice is matched to a FREE
+    device — on hybrid nodes the ChipAgent must not pre-empt that
+    invariant by admitting them bare."""
     if not isinstance(api, APIServer):
         return 0
     admitted = 0
@@ -51,11 +58,17 @@ def admit_bound_pods(api, node_name: str) -> int:
             KIND_POD,
             filter_fn=lambda p: (p.spec.node_name == node_name
                                  and p.status.phase == PENDING)):
+        if skip_slice_pods and extract_slice_requests(pod_request(pod)):
+            continue
+
         def mutate(p):
             if p.spec.node_name == node_name and p.status.phase == PENDING:
                 p.status.phase = RUNNING
-        api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                  mutate=mutate)
+        try:
+            api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                      mutate=mutate)
+        except NotFound:
+            continue       # deleted between list and patch; nothing to admit
         admitted += 1
     return admitted
 
@@ -141,9 +154,7 @@ class KubeletSim:
     # -- admission ----------------------------------------------------------
     def _try_admit(self, pod) -> int:
         from nos_tpu.topology import FREE
-        from nos_tpu.topology.profile import (
-            extract_slice_requests, slice_resource_name,
-        )
+        from nos_tpu.topology.profile import slice_resource_name
 
         if self._client is not None and self._res is not None:
             requests = extract_slice_requests(pod_request(pod))

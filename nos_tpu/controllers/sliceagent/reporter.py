@@ -14,7 +14,9 @@ from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_NODE
 from nos_tpu.kube.objects import Node
 from nos_tpu.topology import USED
-from nos_tpu.topology.annotations import strip_status_annotations
+from nos_tpu.topology.annotations import (
+    encode_placement_records, strip_status_annotations,
+)
 from nos_tpu.topology.profile import shape_from_resource
 
 from nos_tpu.device.tpuclient import SliceDeviceClient
@@ -34,8 +36,10 @@ class SliceReporter:
 
     def reconcile(self) -> None:
         devices = self._client.get_devices()
+        placements = self._client.runtime.placements()
         annotations: dict[str, str] = {}
         counts: dict[tuple[int, str, str], int] = {}
+        placed: dict[int, list[tuple[str, object]]] = {}
         for d in devices:
             shape = shape_from_resource(d.resource_name)
             if shape is None:
@@ -43,8 +47,16 @@ class SliceReporter:
             status = "used" if d.status == USED else "free"
             key = (d.unit_index, shape.name, status)
             counts[key] = counts.get(key, 0) + 1
+            pl = placements.get(d.device_id)
+            if pl is not None:
+                placed.setdefault(d.unit_index, []).append((status[0], pl))
         for (idx, profile, status), qty in counts.items():
             annotations[f"{C.ANNOT_STATUS_PREFIX}{idx}-{profile}-{status}"] = str(qty)
+        # placement records make the cluster-scoped planner placement-aware
+        # (pins for packing.extend; see api/constants.py ANNOT_PLACEMENTS_PREFIX)
+        for idx, records in placed.items():
+            annotations[f"{C.ANNOT_PLACEMENTS_PREFIX}{idx}"] = \
+                encode_placement_records(records)
 
         plan_id = self._shared.last_parsed_plan_id
 

@@ -17,6 +17,8 @@ from nos_tpu.topology import (
 )
 from nos_tpu.topology.profile import slice_resource_name
 
+from nos_tpu.topology.errors import PlacementInfeasibleError
+
 from .tpuclient import PodResourcesClient, TpuRuntimeClient
 
 
@@ -64,7 +66,7 @@ class FakeTpuRuntime(TpuRuntimeClient):
                 # its per-host share (the real runtime joins the host into
                 # the slice via the Cloud TPU multi-host config).
                 if len(shapes) != 1 or fixed:
-                    raise SliceCreationError(
+                    raise PlacementInfeasibleError(
                         f"multi-host shard {multi[0].name} needs the whole "
                         f"block of unit {unit_index} "
                         f"({len(fixed)} devices present)"
@@ -84,7 +86,7 @@ class FakeTpuRuntime(TpuRuntimeClient):
             placements = extend(self._gen.host_block, fixed, counts)
             if placements is None:
                 # all-or-nothing: nothing was created, nothing to clean up
-                raise SliceCreationError(
+                raise PlacementInfeasibleError(
                     f"cannot place {[s.name for s in shapes]} on unit "
                     f"{unit_index} around {len(fixed)} existing devices"
                 )

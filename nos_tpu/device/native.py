@@ -329,7 +329,8 @@ class NativeTpuRuntime(TpuRuntimeClient):
         rc = self._lib.nos_runtime_create_slices(
             self._h, unit_index, arr, len(shapes), buf, _OUT_CAP)
         if rc == -1:
-            raise NativeSliceError(
+            from nos_tpu.topology.errors import PlacementInfeasibleError
+            raise PlacementInfeasibleError(
                 f"cannot place {[s.name for s in shapes]} on unit "
                 f"{unit_index}")
         if rc < 0:

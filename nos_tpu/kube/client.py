@@ -47,6 +47,8 @@ class APIServer:
         self._admission: dict[str, list[Callable[["APIServer", Any], None]]] = \
             defaultdict(list)
         self._rv = 0
+        self._event_queue: list[tuple[str, str, Any]] = []
+        self._delivering = False
 
     # -- admission (validating webhooks) -----------------------------------
     def register_admission(self, kind: str,
@@ -68,8 +70,36 @@ class APIServer:
         return f"{ns}/{obj.metadata.name}" if ns else obj.metadata.name
 
     def _notify(self, kind: str, event: str, obj: Any) -> None:
-        for fn in list(self._watchers.get(kind, [])):
-            fn(event, copy.deepcopy(obj))
+        """FIFO event delivery.  A watch callback that writes back to the
+        store (e.g. KubeletSim's phase patch) re-enters _notify; delivering
+        the nested event immediately would hand later-registered watchers
+        the *newer* state before the event that caused it, letting a
+        cache-maintaining watcher overwrite new state with the stale outer
+        payload.  Queue instead: the outermost call drains in order, so
+        every watcher sees events in the same store-commit order.  All
+        under self._lock (RLock), so ordering is globally consistent."""
+        self._event_queue.append((kind, event, copy.deepcopy(obj)))
+        if self._delivering:
+            return
+        self._delivering = True
+        # The queue must ALWAYS fully drain before this call returns: a
+        # raising watcher must not strand queued events for delivery during
+        # some unrelated future write.  Keep delivering, remember the first
+        # error, re-raise once the bus is empty.
+        first_exc: BaseException | None = None
+        try:
+            while self._event_queue:
+                k, ev, o = self._event_queue.pop(0)
+                for fn in list(self._watchers.get(k, [])):
+                    try:
+                        fn(ev, copy.deepcopy(o))
+                    except BaseException as e:
+                        if first_exc is None:
+                            first_exc = e
+        finally:
+            self._delivering = False
+        if first_exc is not None:
+            raise first_exc
 
     def kinds(self) -> list[str]:
         """Kinds with at least one stored object (snapshot enumeration)."""

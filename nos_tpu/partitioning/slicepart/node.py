@@ -15,7 +15,9 @@ from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.scheduler.framework import NodeInfo
 from nos_tpu.topology import Shape, SliceUnit, TopologyRegistry, DEFAULT_REGISTRY
-from nos_tpu.topology.annotations import parse_status_annotations
+from nos_tpu.topology.annotations import (
+    parse_placement_annotations, parse_status_annotations,
+)
 from nos_tpu.topology.profile import (
     extract_slice_requests, slice_resource_name,
 )
@@ -38,6 +40,23 @@ def units_from_node(node: Node,
         shape = Shape.parse(a.profile).canonical()
         table = unit.used if a.status == "used" else unit.free
         table[shape] = table.get(shape, 0) + a.quantity
+    # Device placements (reported alongside the counts): used placements
+    # pin the packer, so the planner rejects geometries the device layer
+    # could never actuate (VERDICT r3: the host-12 'cannot place' loop).
+    bdims = gen.host_block.dims
+    for idx, records in parse_placement_annotations(
+            node.metadata.annotations).items():
+        if idx not in units or not records:
+            continue    # placements without counts: stale/corrupt, no unit
+        if any(len(pl.offset) != len(bdims)
+               or any(o + d > b for o, d, b in zip(pl.offset, pl.dims, bdims))
+               for _, pl in records):
+            continue    # out of this generation's block bounds: don't trust
+        unit = units[idx]
+        unit.placed_used = [pl for st, pl in records if st == "u"]
+        unit.placed_free = [pl for st, pl in records if st == "f"]
+        if not unit.has_placement_data():
+            unit._drop_placement_data()     # stale vs counts: don't trust pins
     if not units:
         units[0] = SliceUnit(generation=gen, index=0)
     return [units[i] for i in sorted(units)]

@@ -11,6 +11,7 @@ pkg/api/nos.nebuly.com/v1alpha1/annotations.go:21-58.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Iterable, Mapping
 
@@ -92,6 +93,66 @@ def status_from_units(units: Iterable) -> dict[str, str]:
     return out
 
 
+def encode_placement_records(records: Iterable[tuple[str, "Placement"]]) -> str:
+    """Render (status, placement) pairs as the placements annotation value.
+    `status` is "u" (used) or "f" (free)."""
+    parts = []
+    for status, pl in records:
+        parts.append("|".join((
+            status,
+            pl.shape.name,
+            ".".join(str(v) for v in pl.offset),
+            ".".join(str(v) for v in pl.dims),
+        )))
+    return ";".join(sorted(parts))
+
+
+def parse_placement_annotations(
+    annotations: Mapping[str, str],
+) -> dict[int, list[tuple[str, "Placement"]]]:
+    """unit index -> [(status, Placement)].  Corrupt records are skipped
+    (annotations come from the API server), not raised."""
+    from .packing import Placement
+    from .shape import Shape
+
+    out: dict[int, list[tuple[str, "Placement"]]] = {}
+    for k, v in annotations.items():
+        m = C.PLACEMENT_ANNOT_RE.match(k)
+        if not m:
+            continue
+        idx = int(m.group("index"))
+        records = out.setdefault(idx, [])
+        for part in v.split(";"):
+            if not part:
+                continue
+            try:
+                status, profile, off_s, dims_s = part.split("|")
+                if status not in ("u", "f"):
+                    raise ValueError(status)
+                shape = Shape.parse(profile).canonical()
+                offset = tuple(int(x) for x in off_s.split("."))
+                dims = tuple(int(x) for x in dims_s.split("."))
+                # structural validity: a malformed record fed to the
+                # packer would crash or silently alias cell ids.  A
+                # multi-host shard's record has dims = the host's whole
+                # block (its per-host share), smaller than the slice
+                # shape itself — exempt it from the dims/shape match.
+                multihost = shape.chips > math.prod(dims)
+                if (len(offset) != len(dims)
+                        or any(o < 0 for o in offset)
+                        or any(d < 1 for d in dims)
+                        or (not multihost
+                            and tuple(sorted(d for d in dims if d > 1))
+                            != tuple(d for d in shape.dims if d > 1))
+                        or any(o % d for o, d in zip(offset, dims))):
+                    raise ValueError(part)
+                pl = Placement(shape=shape, offset=offset, dims=dims)
+            except (ValueError, TypeError):
+                continue
+            records.append((status, pl))
+    return out
+
+
 def spec_matches_status(annotations: Mapping[str, str],
                         family: str | None = None) -> bool:
     """Desired == observed, per index+profile (reference
@@ -136,6 +197,9 @@ def strip_status_annotations(annotations: dict[str, str],
         m = C.STATUS_ANNOT_RE.match(k)
         if m and (family is None
                   or _profile_family(m.group("profile")) == family):
+            del annotations[k]
+        elif family in (None, "slice") and C.PLACEMENT_ANNOT_RE.match(k):
+            # placement records describe slice devices only
             del annotations[k]
 
 

@@ -19,6 +19,13 @@ class InvalidGeometryError(TopologyError):
     pass
 
 
+class PlacementInfeasibleError(TopologyError):
+    """A create set that cannot be placed around the pinned used slices.
+    Distinct from transient failures: retrying the same plan is pointless —
+    the planner must re-plan with placement knowledge (the analog of the
+    reference's exhausted NVML permutation search, pkg/gpu/nvml/client.go:286-340)."""
+
+
 class InvalidProfileError(TopologyError):
     pass
 

@@ -88,8 +88,11 @@ def profile_sort_key(profile: str) -> tuple[int, str]:
 
 def free_chip_equivalents(resources) -> float:
     """Capacity in chip-equivalents: slice resources weighted by their
-    shape's chip count, everything else (whole chips, timeshare replicas)
-    at face value; non-positive quantities ignored.  Shared by the
+    shape's chip count, whole chips and timeshare replicas at face value;
+    non-positive quantities ignored.  Only TPU-family resources count —
+    cpu and memory quantities (bytes!) would otherwise dwarf chip counts
+    by orders of magnitude and degenerate the ordering to free-memory
+    order on any substrate where pods request them.  Shared by the
     scheduler's window-lease scoring and the planner's best-fit candidate
     ordering so the two planes rank hosts by the SAME metric."""
     total = 0.0
@@ -97,5 +100,8 @@ def free_chip_equivalents(resources) -> float:
         if qty <= 0:
             continue
         shape = shape_from_resource(res)
-        total += shape.chips * qty if shape is not None else qty
+        if shape is not None:
+            total += shape.chips * qty
+        elif res == C.RESOURCE_TPU or is_timeshare_resource(res):
+            total += qty
     return total

@@ -16,7 +16,7 @@ from typing import Mapping
 from .errors import InvalidGeometryError
 from .geometry import Geometry, named_geometry
 from .known import Generation
-from .packing import enumerate_tilings, feasible
+from .packing import Placement, enumerate_tilings, extend, feasible
 from .shape import Shape
 
 
@@ -26,13 +26,38 @@ class SliceUnit:
     index: int = 0
     used: dict[Shape, int] = field(default_factory=dict)
     free: dict[Shape, int] = field(default_factory=dict)
+    # Observed device placements (from the agent's placements annotation).
+    # Used placements are *pins*: the shim must place new slices around
+    # them (packing.extend), so a count-feasible geometry can still be
+    # placement-infeasible.  Empty lists = no placement data; all checks
+    # degrade to count-level (pre-placement-awareness behavior).
+    placed_used: list[Placement] = field(default_factory=list)
+    placed_free: list[Placement] = field(default_factory=list)
 
     def __deepcopy__(self, memo):
-        # Planner snapshot forks clone every unit (hot path).  Shape keys
-        # and the Generation are frozen — share them; only the two
-        # mutable count tables need copying.
+        # Planner snapshot forks clone every unit (hot path).  Shape keys,
+        # Placements and the Generation are frozen — share them; only the
+        # mutable tables/lists need copying.
         return SliceUnit(generation=self.generation, index=self.index,
-                         used=dict(self.used), free=dict(self.free))
+                         used=dict(self.used), free=dict(self.free),
+                         placed_used=list(self.placed_used),
+                         placed_free=list(self.placed_free))
+
+    # -- placement data ----------------------------------------------------
+    def has_placement_data(self) -> bool:
+        """Pins are trustworthy only when the used placements agree with
+        the used counts (they can drift for one report interval after a
+        bound pod's usage is claimed by the snapshot)."""
+        if not self.placed_used and not any(c > 0 for c in self.used.values()):
+            return False
+        counts: dict[Shape, int] = {}
+        for pl in self.placed_used:
+            counts[pl.shape] = counts.get(pl.shape, 0) + 1
+        return counts == {s: c for s, c in self.used.items() if c > 0}
+
+    def _drop_placement_data(self) -> None:
+        self.placed_used = []
+        self.placed_free = []
 
     # -- derived tables ----------------------------------------------------
     def allowed_geometries(self) -> list[dict[Shape, int]]:
@@ -70,15 +95,27 @@ class SliceUnit:
         return out
 
     def can_apply_geometry(self, geometry: Mapping[Shape, int]) -> bool:
-        """Geometry must be an exact tiling of the host block and must not
-        delete any used slice (reference mig/gpu.go CanApplyGeometry)."""
+        """Geometry must be an exact tiling of the host block, must not
+        delete any used slice (reference mig/gpu.go CanApplyGeometry), and —
+        when device placements are known — the slices beyond the used ones
+        must be placeable *around* the pinned used placements (the actuator
+        deletes and re-creates only free devices; used ones stay where they
+        physically sit, native/tpu_shim.cc occupied-mask semantics)."""
         geometry = self._canon(geometry)
         if not feasible(self.generation.host_block, geometry):
             return False
         total = sum(s.chips * c for s, c in geometry.items())
         if total != self.generation.host_block.chips:
             return False
-        return all(geometry.get(s, 0) >= c for s, c in self.used.items() if c > 0)
+        if not all(geometry.get(s, 0) >= c
+                   for s, c in self.used.items() if c > 0):
+            return False
+        if self.has_placement_data() and self.placed_used:
+            creates = {s: geometry.get(s, 0) - self.used.get(s, 0)
+                       for s in geometry}
+            return extend(self.generation.host_block,
+                          self.placed_used, creates) is not None
+        return True
 
     def apply_geometry(self, geometry: Mapping[Shape, int]) -> None:
         geometry = self._canon(geometry)
@@ -87,11 +124,20 @@ class SliceUnit:
                 f"geometry {named_geometry(dict(geometry))} not applicable to "
                 f"unit {self.index} (used={self.used_names()})"
             )
+        had_data = self.has_placement_data()
         self.free = {
             s: geometry.get(s, 0) - self.used.get(s, 0)
             for s in set(geometry) | set(self.used)
         }
         self.free = {s: c for s, c in self.free.items() if c > 0}
+        if had_data:
+            # mirror what the shim will do: free devices re-placed around
+            # the pinned used ones (non-None guaranteed by can_apply)
+            placed = extend(self.generation.host_block, self.placed_used,
+                            self.free)
+            self.placed_free = list(placed) if placed is not None else []
+            if placed is None:
+                self._drop_placement_data()
 
     def init_geometry(self) -> None:
         """Virgin unit: fewest-slices geometry == one whole-block slice
@@ -111,7 +157,7 @@ class SliceUnit:
         best_geo: dict[Shape, int] | None = None
         best = current
         for geo in self.allowed_geometries():
-            if not all(geo.get(s, 0) >= c for s, c in self.used.items() if c > 0):
+            if not self.can_apply_geometry(geo):
                 continue
             cand_free = {s: geo.get(s, 0) - self.used.get(s, 0) for s in geo}
             sc = score(cand_free)
@@ -139,6 +185,7 @@ class SliceUnit:
                 f"multi-host slice {shape.name}"
             )
         self.free = {shape.canonical(): 1}
+        self._drop_placement_data()
 
     def reset_virgin(self) -> None:
         """Back to the fewest-slices geometry (breaking up a free shard)."""
@@ -147,6 +194,7 @@ class SliceUnit:
                 f"unit {self.index} has used slices; cannot reset")
         self.used = {}
         self.free = {self.generation.host_block.canonical(): 1}
+        self._drop_placement_data()
 
     # -- allocation --------------------------------------------------------
     def allocate(self, shape: Shape) -> bool:
@@ -156,6 +204,7 @@ class SliceUnit:
             return False
         self.free[s] -= 1
         self.used[s] = self.used.get(s, 0) + 1
+        self._move_placement(s, self.placed_free, self.placed_used)
         return True
 
     def release(self, shape: Shape) -> bool:
@@ -164,4 +213,24 @@ class SliceUnit:
             return False
         self.used[s] -= 1
         self.free[s] = self.free.get(s, 0) + 1
+        self._move_placement(s, self.placed_used, self.placed_free)
         return True
+
+    def _move_placement(self, shape: Shape, src: list[Placement],
+                        dst: list[Placement]) -> None:
+        """Keep the placement view in step with an allocate/release: pin an
+        arbitrary placement of that shape (device choice at admission is
+        equally arbitrary); if the data can't follow, drop it and degrade
+        to count-level checks rather than reason from wrong pins.
+
+        Scans from the END so that a release directly after an allocate
+        (the all-or-nothing add_pod rollback) undoes exactly the staged
+        move — popping from the front could swap a REAL pin for the staged
+        one and leave trusted-but-wrong pin positions."""
+        if not src and not dst:
+            return
+        for i in range(len(src) - 1, -1, -1):
+            if src[i].shape == shape:
+                dst.append(src.pop(i))
+                return
+        self._drop_placement_data()

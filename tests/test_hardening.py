@@ -168,7 +168,8 @@ class TestNativeFaultInjection:
         rt = native.NativeTpuRuntime(V5E)
         with pytest.raises(DeviceNotFoundError):
             rt.delete_slice("no-such-device")          # rc != 0
-        with pytest.raises(native.NativeSliceError):
+        from nos_tpu.topology.errors import PlacementInfeasibleError
+        with pytest.raises(PlacementInfeasibleError):
             rt.create_slices(0, [Shape.parse("2x4")] * 2)   # rc=-1
 
     def test_actuator_retries_after_transient_create_failure(self):

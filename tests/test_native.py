@@ -7,7 +7,8 @@ from __future__ import annotations
 import pytest
 
 from nos_tpu.device import native
-from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime, SliceCreationError
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.topology.errors import PlacementInfeasibleError
 from nos_tpu.topology import Shape, V4, V5E
 
 pytestmark = pytest.mark.skipif(
@@ -32,14 +33,14 @@ class TestNativeRuntime:
     def test_exact_fill_and_overfull(self):
         rt = native.NativeTpuRuntime(V5E)   # 2x4 block = 8 chips
         rt.create_slices(0, shapes("2x2", "2x2"))
-        with pytest.raises(native.NativeSliceError):
+        with pytest.raises(PlacementInfeasibleError):
             rt.create_slices(0, shapes("1x1"))
 
     def test_all_or_nothing_on_failure(self):
         rt = native.NativeTpuRuntime(V5E)
         rt.create_slices(0, shapes("2x2"))
         before = len(rt.list_devices())
-        with pytest.raises(native.NativeSliceError):
+        with pytest.raises(PlacementInfeasibleError):
             rt.create_slices(0, shapes("1x1", "2x2"))  # 2nd 2x2 can't fit
         assert len(rt.list_devices()) == before
 
@@ -53,7 +54,7 @@ class TestNativeRuntime:
         rt = native.NativeTpuRuntime(V4)    # 1x2x2 block = 4 chips
         ids = rt.create_slices(0, shapes("1x1x2", "1x1x2"))
         assert len(ids) == 2
-        with pytest.raises(native.NativeSliceError):
+        with pytest.raises(PlacementInfeasibleError):
             rt.create_slices(0, shapes("1x1x1"))
 
     def test_multihost_shard(self):
@@ -61,7 +62,7 @@ class TestNativeRuntime:
         ids = rt.create_slices(0, shapes("4x4"))
         assert len(ids) == 1
         assert rt.list_devices()[0].resource_name == "nos.tpu/slice-4x4"
-        with pytest.raises(native.NativeSliceError):
+        with pytest.raises(PlacementInfeasibleError):
             rt.create_slices(0, shapes("1x1"))
 
     def test_startup_cleanup(self):
@@ -104,9 +105,9 @@ class TestConformanceWithFake:
     ])
     def test_both_reject_overfull(self, reqs):
         fake, nat = FakeTpuRuntime(V5E), native.NativeTpuRuntime(V5E)
-        with pytest.raises(SliceCreationError):
+        with pytest.raises(PlacementInfeasibleError):
             fake.create_slices(0, shapes(*reqs))
-        with pytest.raises(native.NativeSliceError):
+        with pytest.raises(PlacementInfeasibleError):
             nat.create_slices(0, shapes(*reqs))
 
 

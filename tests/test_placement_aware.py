@@ -1,0 +1,227 @@
+"""Placement-aware planning regression tests (VERDICT r3 weak #1).
+
+The BENCH_r03 failure loop: the planner validated geometries as multiset
+tilings of the EMPTY host block, but the device layer must place creates
+around *pinned* used slices — a count-feasible geometry can be
+placement-infeasible given where used slices physically sit, and the
+failed-plan retry reapplied the same doomed plan forever ("cannot place
+['1x2', '2x2'] on unit 0", host-12, repeated).
+
+Three layers of defense, each tested here:
+1. the reporter exports device placements in status annotations;
+2. SliceUnit.can_apply_geometry consults the pins via packing.extend;
+3. the actuator surfaces PlacementInfeasibleError as a distinct outcome
+   that waits for a re-plan instead of retrying.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.kube.client import KIND_NODE, KIND_POD
+from nos_tpu.testing.factory import make_slice_pod
+from nos_tpu.topology import Shape, SliceUnit, V5E
+from nos_tpu.topology.annotations import (
+    encode_placement_records, parse_placement_annotations,
+    parse_spec_annotations,
+)
+from nos_tpu.topology.errors import PlacementInfeasibleError
+from nos_tpu.topology.packing import Placement
+
+from test_e2e_slice import Harness
+
+S11 = Shape.parse("1x1").canonical()
+S12 = Shape.parse("1x2").canonical()
+S22 = Shape.parse("2x2").canonical()
+
+# Two vertical 1x2 slices pinned at columns 1 and 2 of the 2x4 host block:
+# count-feasible geometries containing a 2x2 exist, but no 2x2 placement
+# avoids both pins (aligned offsets are columns 0 and 2 only).
+AWKWARD_PINS = [
+    Placement(S12, (0, 1), (2, 1)),
+    Placement(S12, (0, 2), (2, 1)),
+]
+
+
+class TestPinnedGeometryChecks:
+    def test_count_feasible_but_placement_infeasible(self):
+        bare = SliceUnit(generation=V5E, used={S12: 2})
+        pinned = SliceUnit(generation=V5E, used={S12: 2},
+                           placed_used=list(AWKWARD_PINS))
+        geo = {S12: 2, S22: 1}
+        assert bare.can_apply_geometry(geo)          # the r3 blind spot
+        assert not pinned.can_apply_geometry(geo)    # the fix
+
+    def test_friendly_pins_still_allow_geometry(self):
+        # same counts, pins at columns 0 and 1: a 2x2 fits at column 2
+        pins = [Placement(S12, (0, 0), (2, 1)), Placement(S12, (0, 1), (2, 1))]
+        u = SliceUnit(generation=V5E, used={S12: 2}, placed_used=pins)
+        assert u.can_apply_geometry({S12: 2, S22: 1})
+
+    def test_update_geometry_for_skips_unplaceable_candidates(self):
+        u = SliceUnit(generation=V5E, used={S12: 2},
+                      placed_used=list(AWKWARD_PINS))
+        assert not u.update_geometry_for({S22: 1})
+        # but it can still provide profiles that DO place around the pins
+        assert u.update_geometry_for({S11: 4})
+        assert u.free.get(S11, 0) >= 2
+
+    def test_stale_placement_data_degrades_to_count_checks(self):
+        # pins disagree with used counts (claim window): don't trust them
+        u = SliceUnit(generation=V5E, used={S12: 2},
+                      placed_used=[AWKWARD_PINS[0]])
+        assert not u.has_placement_data()
+        assert u.can_apply_geometry({S12: 2, S22: 1})
+
+    def test_allocate_release_move_pins(self):
+        u = SliceUnit(generation=V5E)
+        u.apply_geometry({S12: 2, S22: 1})
+        u.placed_free = [
+            Placement(S12, (0, 0), (2, 1)),
+            Placement(S12, (0, 1), (2, 1)),
+            Placement(S22, (0, 2), (2, 2)),
+        ]
+        assert u.allocate(S22)
+        assert u.has_placement_data()
+        assert [p.shape for p in u.placed_used] == [S22]
+        assert u.release(S22)
+        assert not u.placed_used
+
+    def test_apply_geometry_recomputes_free_placements(self):
+        pins = [Placement(S22, (0, 0), (2, 2))]
+        u = SliceUnit(generation=V5E, used={S22: 1}, placed_used=pins)
+        u.apply_geometry({S22: 2})
+        assert len(u.placed_free) == 1
+        assert u.placed_free[0].offset == (0, 2)
+
+
+class TestPlacementAnnotationCodec:
+    def test_round_trip(self):
+        records = [("u", AWKWARD_PINS[0]), ("f", Placement(S22, (0, 2), (2, 2)))]
+        encoded = encode_placement_records(records)
+        parsed = parse_placement_annotations(
+            {f"{C.ANNOT_PLACEMENTS_PREFIX}0": encoded})
+        assert sorted(parsed[0]) == sorted(records)
+
+    def test_corrupt_records_skipped(self):
+        parsed = parse_placement_annotations({
+            f"{C.ANNOT_PLACEMENTS_PREFIX}0":
+                "u|1x2|0.1|2.1;garbage;x|1x1|0|1.1;u|bad|a.b|1.1",
+        })
+        assert len(parsed[0]) == 1
+
+    def test_units_from_node_parses_pins(self):
+        from nos_tpu.partitioning.slicepart.node import units_from_node
+        from nos_tpu.testing.factory import make_node
+
+        node = make_node("h", labels={C.LABEL_ACCELERATOR: "tpu-v5e"})
+        node.metadata.annotations.update({
+            f"{C.ANNOT_STATUS_PREFIX}0-1x2-used": "2",
+            f"{C.ANNOT_PLACEMENTS_PREFIX}0": encode_placement_records(
+                [("u", p) for p in AWKWARD_PINS]),
+        })
+        units = units_from_node(node)
+        assert units[0].has_placement_data()
+        assert not units[0].can_apply_geometry({S12: 2, S22: 1})
+
+
+class TestActuatorInfeasibleHandling:
+    """The VERDICT pattern end-to-end at the agent: an infeasible spec is
+    attempted ONCE, remembered, and skipped until a new plan arrives."""
+
+    def _pin_awkward_used(self, h: Harness) -> None:
+        """Carve 4 horizontal 1x2s and bind a pod holding the two at
+        (0,0) and (0,2) — the whole top row — so no aligned 2x2
+        placement (columns 0 or 2) avoids the pins."""
+        from nos_tpu.topology.annotations import strip_spec_annotations
+
+        h.agent.tick()                       # init geometry 2x4
+
+        def carve(node):
+            strip_spec_annotations(node.metadata.annotations, family="slice")
+            node.metadata.annotations.update({
+                f"{C.ANNOT_SPEC_PREFIX}0-1x2": "4",
+                C.spec_plan_annotation("slice"): "pin-setup",
+            })
+        h.api.patch(KIND_NODE, "host-0", mutate=carve)
+        h.agent.tick()                       # deletes 2x4, carves 4x 1x2
+        # bound pod: the kubelet sim allocates the first two device ids,
+        # which the deterministic packer placed at (0,0) and (0,2)
+        h.api.create(KIND_POD, make_slice_pod(
+            "1x2", 2, name="pinner", node_name="host-0"))
+        h.agent.tick()                       # admit + report used/placements
+        pins = {pl.offset for did, pl in h.runtime.placements().items()
+                if did in h.pod_resources.used_device_ids()}
+        assert pins == {(0, 0), (0, 2)}
+
+    def test_infeasible_plan_not_retried(self):
+        from nos_tpu.topology.annotations import strip_spec_annotations
+
+        h = Harness()
+        self._pin_awkward_used(h)
+
+        def mutate(node):
+            strip_spec_annotations(node.metadata.annotations, family="slice")
+            node.metadata.annotations.update({
+                f"{C.ANNOT_SPEC_PREFIX}0-1x2": "2",
+                f"{C.ANNOT_SPEC_PREFIX}0-2x2": "1",
+                C.spec_plan_annotation("slice"): "doomed-plan",
+            })
+        h.api.patch(KIND_NODE, "host-0", mutate=mutate)
+
+        calls_before = h.runtime.create_calls
+        h.agent.tick()                       # attempts once, fails
+        assert h.runtime.create_calls == calls_before + 1
+        h.agent.tick()                       # remembered: no retry
+        h.agent.tick()
+        assert h.runtime.create_calls == calls_before + 1
+
+        # a NEW plan clears the verdict and actuates
+        def replan(node):
+            strip_spec_annotations(node.metadata.annotations, family="slice")
+            node.metadata.annotations.update({
+                f"{C.ANNOT_SPEC_PREFIX}0-1x2": "4",
+                C.spec_plan_annotation("slice"): "good-plan",
+            })
+        h.api.patch(KIND_NODE, "host-0", mutate=replan)
+        h.agent.tick()
+        assert h.runtime.create_calls == calls_before + 2
+        names = sorted(d.resource_name for d in h.runtime.list_devices())
+        assert names == ["nos.tpu/slice-1x2"] * 4
+
+    def test_planner_avoids_doomed_geometry_e2e(self):
+        """The full loop: with placements reported, the planner never
+        writes the infeasible spec in the first place — the pending 2x2
+        pod stays pending with ZERO failed creates (the r3 loop is dead)."""
+        h = Harness()
+        self._pin_awkward_used(h)
+
+        h.api.create(KIND_POD, make_slice_pod("2x2", 1, name="want-2x2"))
+        assert h.scheduler.run_cycle() == 0
+        h.advance(11.0)
+        assert h.partitioner.process_if_ready()
+
+        node = h.get_node()
+        spec = {(a.index, a.profile): a.quantity
+                for a in parse_spec_annotations(node.metadata.annotations)}
+        assert (0, "2x2") not in spec        # planner knew better
+
+        calls_before = h.runtime.create_calls
+        h.agent.tick()
+        h.agent.tick()
+        assert h.runtime.create_calls == calls_before  # no doomed creates
+
+    def test_placement_feasible_request_still_served(self):
+        """Control: with the same pins, profiles that CAN place are carved
+        and the pod schedules."""
+        h = Harness()
+        self._pin_awkward_used(h)
+
+        h.api.create(KIND_POD, make_slice_pod("1x1", 2, name="want-1x1"))
+        assert h.scheduler.run_cycle() == 0
+        h.advance(11.0)
+        assert h.partitioner.process_if_ready()
+        h.agent.tick()
+        assert h.scheduler.run_cycle() == 1
