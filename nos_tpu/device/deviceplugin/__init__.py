@@ -32,6 +32,15 @@ KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
 PLUGINS_DIR = "/var/lib/kubelet/device-plugins"
 API_VERSION = "v1beta1"
 ENV_DEVICE_IDS = "NOS_TPU_SLICE_IDS"
+# Per-resource-suffixed (kubelet merges Allocate envs across plugins —
+# same key would clobber): the granted slices' local chip ids and the
+# host block they index into.  device/workload_env.py unions them into
+# TPU_VISIBLE_CHIPS / TPU_PROCESS_BOUNDS before the first jax import —
+# the TPU analog of MIG device visibility (reference
+# pkg/gpu/nvml/client.go:286-340 creates *hard* per-partition devices;
+# the reachable TPU mechanism is libtpu's chip-visibility env).
+ENV_VISIBLE_CHIPS = "NOS_TPU_VISIBLE_CHIPS"
+ENV_HOST_BOUNDS = "NOS_TPU_HOST_BOUNDS"
 
 
 class SliceDevicePlugin:
@@ -222,12 +231,49 @@ class DevicePluginManager:
     def _current_resources(self) -> set[str]:
         return {d.resource_name for d in self._runtime.list_devices()}
 
+    def _slice_allocate_envs(self, resource: str, ids: list[str]) -> dict:
+        """Device ids plus the granted chips' local ids (visibility
+        grant).  Falls back to ids-only when a device's placement is
+        unknown — never claim visibility we cannot derive."""
+        from nos_tpu.topology.packing import placement_cells
+
+        envs = {ENV_DEVICE_IDS: ",".join(ids)}
+        try:
+            placements = self._runtime.placements()
+            _, block = self._runtime.topology()
+            units = {d.device_id: d.unit_index
+                     for d in self._runtime.list_devices()}
+        except Exception as e:  # noqa: BLE001 — runtime may be restarting
+            logger.warning("allocate %s: no placement data (%s)", resource, e)
+            return envs
+        if len({units.get(did) for did in ids}) > 1:
+            # local chip ids are per partition root: a grant spanning
+            # units cannot be expressed as one visibility set
+            logger.warning("allocate %s: grant spans units; ids-only",
+                           resource)
+            return envs
+        cells: set[int] = set()
+        for did in ids:
+            pl = placements.get(did)
+            if pl is None:
+                logger.warning("allocate %s: device %s has no placement",
+                               resource, did)
+                return envs
+            cells.update(placement_cells(block, pl))
+        suffix = resource.rsplit("/", 1)[-1].replace("-", "_")
+        envs[f"{ENV_VISIBLE_CHIPS}_{suffix}"] = \
+            ",".join(str(c) for c in sorted(cells))
+        envs[ENV_HOST_BOUNDS] = block.name
+        return envs
+
     def _make_plugin(self, resource: str) -> SliceDevicePlugin:
         return SliceDevicePlugin(
             resource,
             lambda r=resource: self._ids_for(r),
             plugins_dir=self._plugins_dir,
-            kubelet_socket=self._kubelet_socket)
+            kubelet_socket=self._kubelet_socket,
+            allocate_envs=lambda ids, r=resource:
+                self._slice_allocate_envs(r, ids))
 
     def sync(self) -> None:
         # A recreated kubelet.sock means the kubelet restarted and forgot

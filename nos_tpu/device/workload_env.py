@@ -13,10 +13,15 @@ actually respects them:
   The chip's HBM size comes from topology discovery (env metadata, no
   jax), so the fraction is right on every generation;
 - a **slice** grant's device ids are surfaced to the workload
-  (TPU_VISIBLE_SLICE_IDS) for job-side tooling and debugging.  Chip-level
-  visibility enforcement (the TPU_VISIBLE_CHIPS analog of MIG device
-  visibility) needs the agent to export the slice's chip coordinates —
-  not wired yet, and not claimed.
+  (TPU_VISIBLE_SLICE_IDS) for job-side tooling and debugging, and the
+  granted chips' local ids (exported per-profile by the device plugin's
+  Allocate response from the carved placements — NOS_TPU_VISIBLE_CHIPS_*)
+  become the libtpu visibility env: TPU_VISIBLE_CHIPS, plus
+  TPU_PROCESS_BOUNDS/TPU_CHIPS_PER_PROCESS_BOUNDS when the granted set
+  is one contiguous sub-mesh.  This is the TPU_VISIBLE_CHIPS analog of
+  MIG device visibility (reference pkg/gpu/nvml/client.go:286-340): a
+  jax process started after apply() sees ONLY the granted chips instead
+  of grabbing every chip on the host.
 
 Analog of what the NVIDIA stack does implicitly through MPS
 active-thread percentage and MIG device visibility; on TPU the runtime
@@ -38,6 +43,60 @@ logger = logging.getLogger(__name__)
 # accepted too.  The workload's cap is the SUM of every grant.
 ENV_TIMESHARE_GB = "NOS_TPU_TIMESHARE_GB"
 ENV_SLICE_IDS = "NOS_TPU_SLICE_IDS"
+ENV_VISIBLE_CHIPS = "NOS_TPU_VISIBLE_CHIPS"
+ENV_HOST_BOUNDS = "NOS_TPU_HOST_BOUNDS"
+
+
+def granted_chip_ids(environ) -> list[int] | None:
+    """Union of every per-profile visibility grant (local chip ids,
+    row-major in the host block — topology.packing.placement_cells).
+    Any corrupt token voids the WHOLE grant (returns None): confining
+    the process to a silently under-sized subset of its grant is worse
+    than not confining it (mirrors the plugin side's 'never claim
+    visibility we cannot derive')."""
+    chips: set[int] = set()
+    for key, value in environ.items():
+        if key == ENV_VISIBLE_CHIPS or key.startswith(
+                ENV_VISIBLE_CHIPS + "_"):
+            for part in str(value).split(","):
+                try:
+                    chips.add(int(part))
+                except ValueError:
+                    logger.warning(
+                        "corrupt visibility grant %s=%r: not confining",
+                        key, value)
+                    return None
+    return sorted(chips)
+
+
+def _chip_bounds(chips: list[int], host_bounds: str) -> tuple[int, ...] | None:
+    """Bounding box of the granted chips in the host block; None unless
+    the chips exactly fill it (only a contiguous sub-mesh can be
+    described to libtpu as process bounds)."""
+    try:
+        bdims = [int(d) for d in host_bounds.split("x")]
+    except ValueError:
+        return None
+    total = 1
+    for d in bdims:
+        total *= d
+    if not bdims or any(d < 1 for d in bdims) \
+            or any(c < 0 or c >= total for c in chips):
+        return None
+    coords = []
+    for c in chips:
+        coord = []
+        for d in reversed(bdims):
+            coord.append(c % d)
+            c //= d
+        coords.append(tuple(reversed(coord)))
+    lo = [min(c[i] for c in coords) for i in range(len(bdims))]
+    hi = [max(c[i] for c in coords) for i in range(len(bdims))]
+    box = tuple(h - l + 1 for l, h in zip(lo, hi))
+    size = 1
+    for d in box:
+        size *= d
+    return box if size == len(chips) else None
 
 
 def granted_timeshare_gb(environ) -> float:
@@ -80,6 +139,22 @@ def apply(environ=os.environ,
         # the carved devices this pod owns (device-plugin Allocate env),
         # surfaced for job-side tooling/debugging — see module docstring
         applied["TPU_VISIBLE_SLICE_IDS"] = slice_ids
+    chips = granted_chip_ids(environ)
+    visibility_keys = ("TPU_VISIBLE_CHIPS", "TPU_PROCESS_BOUNDS",
+                      "TPU_CHIPS_PER_PROCESS_BOUNDS")
+    if chips and not any(k in environ for k in visibility_keys):
+        # chip-visibility enforcement: confine the jax process to the
+        # granted chips (libtpu honors these before backend init).  The
+        # three keys are emitted all-or-none, and never when ANY of them
+        # pre-exists — mixing a grant's bounds with an operator's own
+        # visibility settings would describe a contradictory topology.
+        applied["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+        box = _chip_bounds(chips, environ.get(ENV_HOST_BOUNDS, ""))
+        if box is not None:
+            padded = tuple(box) + (1,) * (3 - len(box))
+            applied["TPU_PROCESS_BOUNDS"] = "1,1,1"
+            applied["TPU_CHIPS_PER_PROCESS_BOUNDS"] = \
+                ",".join(str(d) for d in padded)
     for key, value in applied.items():
         environ.setdefault(key, value)
         logger.info("workload env: %s=%s", key, environ[key])

@@ -66,6 +66,18 @@ def _candidate_placements(block: Shape, shape: Shape) -> tuple[tuple[int, Placem
     return tuple(out)
 
 
+def placement_cells(block: Shape, pl: Placement) -> tuple[int, ...]:
+    """Row-major local chip ids covered by a placement — THE local chip
+    numbering convention shared by the shim, the device plugin's
+    visibility grants and the workload env (TPU_VISIBLE_CHIPS analog):
+    chip id = row-major index of its coordinate in the host block."""
+    return tuple(sorted(
+        _cell_id(cell, block.dims)
+        for cell in itertools.product(
+            *[range(o, o + d) for o, d in zip(pl.offset, pl.dims)])
+    ))
+
+
 def _first_empty_cell(occupied: int, total: int) -> int:
     for i in range(total):
         if not occupied & (1 << i):
@@ -172,12 +184,9 @@ def extend(block: Shape, fixed: Iterable[Placement],
     create path: used devices must keep their placement — the analog of the
     delete-free-then-create plan, reference internal/controllers/migagent/plan/plan.go:31-92)."""
     occ = 0
-    bdims = block.dims
     for pl in fixed:
-        for cell in itertools.product(
-            *[range(o, o + d) for o, d in zip(pl.offset, pl.dims)]
-        ):
-            occ |= 1 << _cell_id(cell, bdims)
+        for cid in placement_cells(block, pl):
+            occ |= 1 << cid
     key = _counts_key(counts)
     native = _try_native(block, key, occ, False)
     if native is not NotImplemented:
