@@ -104,6 +104,11 @@ class OperatorConfig(ManagerConfig):
 
     tpu_memory_gb_per_chip: int = 16
     resync_interval_s: float = 5.0
+    # HTTPS AdmissionReview endpoint (kube/webhook.py): 0 disables; the
+    # chart serves 9443 with certs mounted at webhook_cert_dir
+    # (tls.crt/tls.key).  An empty cert dir serves plain HTTP (tests).
+    webhook_port: int = 0
+    webhook_cert_dir: str = ""
 
     def validate(self) -> None:
         super().validate()
@@ -111,6 +116,8 @@ class OperatorConfig(ManagerConfig):
             raise ConfigError("tpu_memory_gb_per_chip must be positive")
         if self.resync_interval_s <= 0:
             raise ConfigError("resync_interval_s must be positive")
+        if self.webhook_port < 0 or self.webhook_port > 65535:
+            raise ConfigError("webhook_port must be in [0, 65535]")
 
 
 @dataclasses.dataclass

@@ -21,11 +21,46 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.quota import TPUResourceCalculator
 
 
+def _serve_admission_webhook(api, cfg: OperatorConfig):
+    """Start the HTTPS AdmissionReview endpoint (kube/webhook.py) with
+    the SAME validators install_quota_webhooks registered.  On the REST
+    substrate the KubeClient collected them (api.admission); the
+    in-memory substrate enforces in-process already, so serving there is
+    for parity/testing and builds its own handler."""
+    import os
+
+    from nos_tpu.api.elasticquota import (
+        validate_composite_elastic_quota, validate_elastic_quota,
+    )
+    from nos_tpu.kube.client import (
+        KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA,
+    )
+    from nos_tpu.kube.webhook import AdmissionHandler, WebhookServer
+
+    handler = getattr(api, "admission", None)
+    if handler is None:
+        handler = AdmissionHandler(api)
+        handler.register(KIND_ELASTIC_QUOTA, validate_elastic_quota)
+        handler.register(KIND_COMPOSITE_ELASTIC_QUOTA,
+                         validate_composite_elastic_quota)
+    cert = key = None
+    if cfg.webhook_cert_dir:
+        cert = os.path.join(cfg.webhook_cert_dir, "tls.crt")
+        key = os.path.join(cfg.webhook_cert_dir, "tls.key")
+    server = WebhookServer(handler, port=cfg.webhook_port,
+                           cert_file=cert, key_file=key)
+    server.start()
+    return server
+
+
 def build_operator_main(api: APIServer, cfg: OperatorConfig,
                         main: Main | None = None) -> Main:
     main = main or Main("nos-tpu-operator", cfg.health_probe_addr,
                         api=api)
     install_quota_webhooks(api)
+    if cfg.webhook_port > 0:
+        main.webhook = _serve_admission_webhook(api, cfg)
+        main.add_shutdown_hook(main.webhook.stop)
     calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
 
     def bind_reconcilers() -> None:

@@ -24,8 +24,11 @@ Semantics mapping:
   then a streaming thread that re-lists on every (re)connect and diffs
   against what it already delivered, so events raced between list and
   stream — or dropped across a reconnect/410 — are recovered.
-- register_admission    -> no-op warning: in a real cluster admission
-  runs server-side via the validating webhooks the chart installs.
+- register_admission    -> collects the validator for the operator's
+  HTTPS AdmissionReview endpoint (kube/webhook.py WebhookServer): on a
+  real cluster enforcement happens server-side via the chart's
+  ValidatingWebhookConfiguration pointing at that endpoint, with the
+  SAME validator functions the in-memory substrate runs in-process.
 
 Auth: minimal kubeconfig — server, CA (file or data), bearer token or
 client certificate (file or data).  Exotic auth plugins are out of
@@ -137,10 +140,15 @@ class KubeClient:
     """APIServer-surface client over kube-apiserver REST."""
 
     def __init__(self, config: KubeConfig, timeout_s: float = 10.0) -> None:
+        from nos_tpu.kube.webhook import AdmissionHandler
+
         self._cfg = config
         self._timeout = timeout_s
         self._watch_stop = threading.Event()
         self._watch_threads: list[threading.Thread] = []
+        # validators registered via register_admission, served by the
+        # operator's HTTPS AdmissionReview endpoint (kube/webhook.py)
+        self.admission = AdmissionHandler(self)
         if config.server.startswith("https"):
             if config.insecure:
                 self._ssl = ssl._create_unverified_context()
@@ -386,9 +394,7 @@ class KubeClient:
             "Pod", filter_fn=lambda p: p.spec.node_name == node_name)
 
     def register_admission(self, kind: str, fn) -> None:
-        # In a real cluster admission runs server-side through the
-        # validating webhooks the helm chart installs; the in-process
-        # callback only applies to the in-memory substrate.
-        logger.warning(
-            "register_admission(%s) ignored on the REST substrate: "
-            "install the chart's validating webhooks instead", kind)
+        """Collect the validator for the AdmissionReview endpoint the
+        operator serves (kube/webhook.py); a KubeClient cannot intercept
+        writes client-side — the kube-apiserver consults the webhook."""
+        self.admission.register(kind, fn)

@@ -1,0 +1,150 @@
+"""Validating admission webhook server (AdmissionReview v1 over HTTPS).
+
+On the in-memory substrate admission runs in-process (APIServer's
+register_admission hook); on a real cluster the API server must be told
+to consult US — this module is the HTTPS endpoint the chart's
+ValidatingWebhookConfiguration points at.  Analog of the reference's
+controller-runtime webhook server wiring
+(pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:43-97 +
+config/operator/webhook/manifests.yaml): the operator main serves it
+with the same validators `install_quota_webhooks` registers, so the two
+substrates enforce identical rules.
+
+Request flow: kube-apiserver POSTs an AdmissionReview whose
+`request.object` is the raw kind JSON; we decode it with the same codec
+the REST client uses (kube/k8s_codec.from_k8s), run every validator
+registered for the kind, and answer allowed=true/false with the
+validator's message.  Fail-closed on anything malformed: a review we
+cannot parse is denied, not dropped (matching `failurePolicy: Fail` in
+the chart).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from .k8s_codec import from_k8s
+
+logger = logging.getLogger(__name__)
+
+
+def review_response(uid: str, allowed: bool, message: str = "") -> dict:
+    resp: dict = {"uid": uid, "allowed": allowed}
+    if message:
+        resp["status"] = {"message": message, "code": 403}
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": resp}
+
+
+class AdmissionHandler:
+    """Pure request->response admission logic (transport-free, so tests
+    can exercise it without TLS plumbing)."""
+
+    def __init__(self, api) -> None:
+        self._api = api
+        self._validators: dict[str, list[Callable]] = {}
+
+    def register(self, kind: str, fn: Callable) -> None:
+        self._validators.setdefault(kind, []).append(fn)
+
+    @property
+    def kinds(self) -> list[str]:
+        return sorted(self._validators)
+
+    def handle(self, body: bytes) -> dict:
+        uid = ""
+        try:
+            review = json.loads(body)
+            request = review["request"]
+            uid = request.get("uid", "")
+            kind = request["kind"]["kind"]
+            operation = request.get("operation", "CREATE")
+            if operation == "DELETE":
+                return review_response(uid, True)
+            obj = from_k8s(kind, request["object"])
+        except Exception as e:  # noqa: BLE001 — malformed review: deny
+            logger.warning("admission: malformed review rejected (%s)", e)
+            return review_response(uid, False, f"malformed AdmissionReview: {e}")
+        for fn in self._validators.get(kind, []):
+            try:
+                fn(self._api, obj)
+            except Exception as e:  # noqa: BLE001 — validator verdicts + bugs both deny
+                return review_response(uid, False, str(e))
+        return review_response(uid, True)
+
+
+class WebhookServer:
+    """HTTPS AdmissionReview endpoint wrapping an AdmissionHandler.
+
+    `cert_file`/`key_file` hold the serving cert the chart provisions
+    (self-signed generator job; the ValidatingWebhookConfiguration's
+    caBundle carries the matching CA).  Pass neither to serve plain HTTP
+    (tests only — the kube-apiserver requires TLS)."""
+
+    def __init__(self, handler: AdmissionHandler, host: str = "0.0.0.0",
+                 port: int = 9443, cert_file: str | None = None,
+                 key_file: str | None = None) -> None:
+        self._handler = handler
+        self._host, self._port = host, port
+        self._cert, self._key = cert_file, key_file
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        """Bound port (resolves 0 to the kernel's pick after start())."""
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> None:
+        handler = self._handler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 — stdlib naming
+                if not self.path.startswith("/validate"):
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                resp = json.dumps(handler.handle(body)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path in ("/healthz", "/readyz"):
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):  # quiet the stdlib logger
+                logger.debug("webhook: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        if self._cert:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._cert, self._key)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="admission-webhook",
+            daemon=True)
+        self._thread.start()
+        logger.info("admission webhook serving on %s:%d (%s) for %s",
+                    self._host, self.port,
+                    "https" if self._cert else "http", self._handler.kinds)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -45,6 +45,7 @@ Layout convention everywhere in nos_tpu: [batch, seq, heads, head_dim].
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -60,6 +61,32 @@ _LANES = 128
 # Hardware-tuned defaults (v5e sweep at S=2048; see module docstring).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+
+# Backward implementation: "fused" (one 5-matmul kernel + dq partials) or
+# "split" (classic dq/dkv pair, 7 matmuls) — see the backward section.
+_BWD_IMPL = os.environ.get("NOS_TPU_FLASH_BWD", "fused")
+if _BWD_IMPL not in ("fused", "split"):
+    import logging
+    logging.getLogger(__name__).warning(
+        "NOS_TPU_FLASH_BWD=%r is not 'fused'/'split'; using 'fused'",
+        _BWD_IMPL)
+    _BWD_IMPL = "fused"
+
+# The fused backward materialises fp32 dq partials of shape
+# [B*H, Sk/block_k, Sq, D] — quadratic in sequence length.  Above this
+# budget (bytes) fall back to the split kernels, which need no partial
+# buffer (long-context shapes that fit before must keep fitting).
+FUSED_PARTIAL_BUDGET = 1 << 30
+
+
+def set_backward_impl(impl: str) -> str:
+    """Select the flash backward ("fused"/"split"); returns the previous
+    value.  For benchmarking — traced programs pick it up on next trace."""
+    global _BWD_IMPL
+    if impl not in ("fused", "split"):
+        raise ValueError(f"unknown flash backward impl {impl!r}")
+    prev, _BWD_IMPL = _BWD_IMPL, impl
+    return prev
 
 
 def _xla_attention(q, k, v, causal):
@@ -202,6 +229,19 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
 
 
 # -- backward ---------------------------------------------------------------
+#
+# Two implementations, selected by set_backward_impl / NOS_TPU_FLASH_BWD:
+#
+# - "split" (the standard TPU two-kernel split): a dq kernel (grid over
+#   q-blocks, streams K/V) and a dkv kernel (grid over k-blocks, streams
+#   Q/dO).  7 matmuls per (i, j) block pair — s and dp are computed twice.
+# - "fused" (default, measured faster on v5e): ONE kernel with the dkv
+#   grid computes s/p/dp/ds once and produces dk, dv AND dq — 5 matmuls
+#   per pair and half the Q/dO/K/V streaming.  TPU has no atomics and a
+#   pallas grid must write disjoint output blocks, so the cross-j dq
+#   accumulation is done by writing one dq partial per k-block
+#   ([BH, J, Sq, D]) and summing the J partials XLA-side; the extra HBM
+#   round-trip costs less than the two matmuls + second stream it saves.
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_sc, *, scale, causal, block_q, block_k, num_k_blocks):
@@ -270,6 +310,110 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _flush():
         dk_ref[0] = (scale * dk_sc[:, :]).astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:, :].astype(dv_ref.dtype)
+
+
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                      scale, causal, block_q, block_k, num_q_blocks):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:, :] = jnp.zeros(dk_sc.shape, jnp.float32)
+        dv_sc[:, :] = jnp.zeros(dv_sc.shape, jnp.float32)
+
+    diag = _on_or_below_diag(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(diag)
+    def _compute():
+        reps = block_k // _LANES
+        qb, dob = q_ref[0], do_ref[0]
+        kb, vb = k_ref[0], v_ref[0]
+        s = scale * jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + jnp.where(_causal_mask(qi, kj, block_q, block_k),
+                              0.0, _NEG_INF)
+        lse = lse_ref[0]                                  # [bq, 128]
+        delta = delta_ref[0]                              # [bq, 128]
+        p = jnp.exp(s - jnp.tile(lse, (1, reps)))
+        dv_sc[:, :] += jnp.dot(p.astype(dob.dtype).T, dob,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - jnp.tile(delta, (1, reps)))).astype(qb.dtype)
+        dk_sc[:, :] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+        # this k-block's dq contribution; the J partials are summed (and
+        # scaled) XLA-side
+        dq_ref[0, 0] = jnp.dot(ds, kb,
+                               preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(jnp.logical_not(diag))
+        def _zero():
+            # a skipped step still owns its dq partial block
+            dq_ref[0, 0] = jnp.zeros(dq_ref.shape[2:], jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush():
+        dk_ref[0] = (scale * dk_sc[:, :]).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:, :].astype(dv_ref.dtype)
+
+
+def _flash_backward_fused(q, k, v, o, lse, g, causal, block_q, block_k,
+                          interpret):
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    scale = head_dim ** -0.5
+    bh = batch * heads
+    num_q_blocks = seq_q // block_q
+    num_k_blocks = seq_k // block_k
+
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof = _fold(g)
+    delta = jnp.sum(dof.astype(jnp.float32) * _fold(o).astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [BH, Sq, 1]
+    lse_rep = _replicate_rows(lse)
+    delta_rep = _replicate_rows(delta)
+
+    def kv_fixed(b, j, i):
+        return (b, j, 0)
+
+    def q_stream(b, j, i):
+        if causal:
+            lo = (j * block_k) // block_q
+            i = lax.select(_on_or_below_diag(i, j, block_q, block_k), i, lo)
+        return (b, i, 0)
+
+    qspec = pl.BlockSpec((1, block_q, head_dim), q_stream)
+    kspec = pl.BlockSpec((1, block_k, head_dim), kv_fixed)
+    rowspec = pl.BlockSpec((1, block_q, _LANES), q_stream)
+
+    dq_partial, dk, dv = pl.pallas_call(
+        functools.partial(
+            _fused_bwd_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_q_blocks=num_q_blocks),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, num_k_blocks, seq_q, head_dim),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        grid=(bh, num_k_blocks, num_q_blocks),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, j, i: (b, j, i, 0)),
+            kspec, kspec,
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
+                        pltpu.VMEM((block_k, head_dim), jnp.float32)],
+        compiler_params=_grid_params(3),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_rep, delta_rep)
+
+    dq = (scale * jnp.sum(dq_partial, axis=1)).astype(q.dtype)
+    return (_unfold(dq, batch, heads), _unfold(dk, batch, heads),
+            _unfold(dv, batch, heads))
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
@@ -394,7 +538,13 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     if lse is not None:
         plan = _plan(q, k, causal, block_q, block_k)
-        return _flash_backward(q, k, v, o, lse, g, causal, *plan, interpret)
+        batch, seq_q, heads, head_dim = q.shape
+        partial_bytes = (batch * heads * (k.shape[1] // plan[1])
+                         * seq_q * head_dim * 4)
+        use_fused = (_BWD_IMPL == "fused"
+                     and partial_bytes <= FUSED_PARTIAL_BUDGET)
+        impl = _flash_backward_fused if use_fused else _flash_backward
+        return impl(q, k, v, o, lse, g, causal, *plan, interpret)
     _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
     return vjp(g)
 

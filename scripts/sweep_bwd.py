@@ -1,0 +1,100 @@
+"""Sweep the flash BACKWARD implementations/blocks on real hardware.
+
+Compares the classic dq/dkv split against the fused 5-matmul kernel at
+the training shapes over a small (block_q, block_k) grid, with
+bench_compute's chained-iteration slope methodology.
+
+    python scripts/sweep_bwd.py
+
+The winner feeds _BWD_IMPL / DEFAULT_BLOCK_* in nos_tpu/ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from bench_compute import _slope  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops import attention as A
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+
+    B, S, H, D = 8, 2048, 8, 128  # BENCH_350M training shapes
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    fwd_flops = 4 * B * H * S * S * D * 0.5          # causal
+    bwd_flops = 3.5 * fwd_flops   # bench accounting (split's 7 dots)
+
+    def grad_maker(bq, bk):
+        def loss(qq, kk2, vv):
+            return jnp.sum(A.flash_attention(
+                qq, kk2, vv, True, bq, bk).astype(jnp.float32) ** 2)
+
+        def gstep(qx):
+            gq, gk, gv = jax.grad(loss, (0, 1, 2))(qx, k, v)
+            return gq + gk + gv
+
+        @jax.jit
+        def run(q, k, v, iters):
+            return jax.lax.fori_loop(
+                0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
+
+        def make(iters):
+            i = jnp.int32(iters)
+            return lambda: float(run(q, k, v, i))
+        return make
+
+    def fwd_maker(bq, bk):
+        @jax.jit
+        def run(q, k, v, iters):
+            return jax.lax.fori_loop(
+                0, iters,
+                lambda i, acc: A.flash_attention(acc, k, v, True, bq, bk),
+                q)[0, 0, 0, 0]
+
+        def make(iters):
+            i = jnp.int32(iters)
+            return lambda: float(run(q, k, v, i))
+        return make
+
+    results = []
+    for impl, (bq, bk) in itertools.product(
+            ["fused", "split"],
+            [(512, 512), (256, 512), (512, 256), (1024, 512), (512, 1024),
+             (256, 1024), (1024, 256), (2048, 512), (512, 2048)]):
+        if S % bq or S % bk:
+            continue
+        A.set_backward_impl(impl)
+        try:
+            t_fwd = _slope(fwd_maker(bq, bk), n1=40, n2=160)
+            t_grad = _slope(grad_maker(bq, bk))
+            t_bwd = max(t_grad - t_fwd, 1e-9)
+            r = {"impl": impl, "bq": bq, "bk": bk,
+                 "fwd_ms": round(t_fwd * 1e3, 3),
+                 "bwd_ms": round(t_bwd * 1e3, 3),
+                 "bwd_tflops": round(bwd_flops / t_bwd / 1e12, 1)}
+        except Exception as e:  # noqa: BLE001 — sweep must survive one bad config
+            r = {"impl": impl, "bq": bq, "bk": bk, "error": str(e)[:200]}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    A.set_backward_impl("fused")
+    ok = [r for r in results if "bwd_ms" in r]
+    if ok:
+        best = min(ok, key=lambda r: r["bwd_ms"])
+        print(json.dumps({"best": best}))
+
+
+if __name__ == "__main__":
+    main()

@@ -92,9 +92,20 @@ class TestFlashAttention:
         assert jnp.max(jnp.abs(out - dense_attention(q, k, v, True))) < 1e-5
 
     @pytest.mark.parametrize("causal", [True, False])
-    def test_backward_kernels_match_dense(self, causal):
-        """The dedicated dq/dkv pallas kernels vs autodiff of the dense
-        path, for all three inputs and a non-trivial cotangent."""
+    @pytest.mark.parametrize("impl", ["split", "fused"])
+    def test_backward_kernels_match_dense(self, causal, impl):
+        """Both flash backward implementations (classic dq/dkv split and
+        the fused 5-matmul kernel) vs autodiff of the dense path, for all
+        three inputs and a non-trivial cotangent."""
+        from nos_tpu.ops import attention as A
+
+        prev = A.set_backward_impl(impl)
+        try:
+            self._check_backward(causal)
+        finally:
+            A.set_backward_impl(prev)
+
+    def _check_backward(self, causal):
         key = jax.random.PRNGKey(1)
         q, k, v = (jax.random.normal(kk, (2, 256, 2, 128), jnp.float32)
                    for kk in jax.random.split(key, 3))
@@ -111,9 +122,19 @@ class TestFlashAttention:
             scale = float(jnp.max(jnp.abs(want))) + 1e-9
             assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
 
-    def test_backward_rectangular_blocks(self):
-        """block_q != block_k exercises the diagonal bounds in both
-        backward kernels."""
+    @pytest.mark.parametrize("impl", ["split", "fused"])
+    def test_backward_rectangular_blocks(self, impl):
+        """block_q != block_k exercises the diagonal bounds in every
+        backward kernel, for BOTH implementations."""
+        from nos_tpu.ops import attention as A
+
+        prev = A.set_backward_impl(impl)
+        try:
+            self._check_rectangular()
+        finally:
+            A.set_backward_impl(prev)
+
+    def _check_rectangular(self):
         key = jax.random.PRNGKey(2)
         q, k, v = (jax.random.normal(kk, (1, 512, 1, 128), jnp.float32)
                    for kk in jax.random.split(key, 3))

@@ -59,13 +59,20 @@ def _render_expr(expr: str, ctx: dict) -> str:
 def render(text: str, ctx: dict) -> str:
     # strip comment blocks
     text = re.sub(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", "", text, flags=re.S)
-    # if/end blocks (no nesting needed by the chart)
+    # if/end blocks, innermost-first so nesting works (the webhook bits
+    # sit inside the operator.enabled guard)
     def do_if(m):
         cond = _lookup(ctx, m.group(1).lstrip("."))
         return m.group(2) if cond else ""
-    text = re.sub(
-        r"\{\{-?\s*if\s+([.\w]+)\s*-?\}\}\n?(.*?)\{\{-?\s*end\s*-?\}\}\n?",
-        do_if, text, flags=re.S)
+    innermost = re.compile(
+        r"\{\{-?\s*if\s+([.\w]+)\s*-?\}\}\n?"
+        r"((?:(?!\{\{-?\s*(?:if|end)\b).)*?)"
+        r"\{\{-?\s*end\s*-?\}\}\n?",
+        flags=re.S)
+    while True:
+        text, n = innermost.subn(do_if, text)
+        if not n:
+            break
     # expressions
     text = re.sub(r"\{\{-?\s*([^{}]+?)\s*-?\}\}",
                   lambda m: _render_expr(m.group(1), ctx), text)

