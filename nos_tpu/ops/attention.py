@@ -61,6 +61,12 @@ _LANES = 128
 # Hardware-tuned defaults (v5e sweep at S=2048; see module docstring).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+# The backward prefers larger blocks than the forward (fewer grid steps
+# amortize the per-step recompute; scripts/sweep_bwd.py on v5e).  Used by
+# _bwd regardless of the forward's blocks; shrunk by _plan for short
+# sequences.
+DEFAULT_BWD_BLOCK_Q = 512
+DEFAULT_BWD_BLOCK_K = 1024
 
 # Backward implementation: "fused" (one 5-matmul kernel + dq partials) or
 # "split" (classic dq/dkv pair, 7 matmuls) — see the backward section.
@@ -510,14 +516,19 @@ def _plan(q, k, causal, block_q, block_k) -> tuple[int, int] | None:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     interpret: bool = False):
     """Fused attention, [B, S, H, D], K/V already at full head count
     (repeat grouped KV heads first — see repeat_kv).  Falls back to the
-    XLA implementation off-TPU or for unaligned shapes."""
+    XLA implementation off-TPU or for unaligned shapes.
+
+    block_q/block_k None = hardware-tuned defaults, which differ between
+    the forward (DEFAULT_BLOCK_*) and backward (DEFAULT_BWD_BLOCK_*)
+    passes; explicit values are honored verbatim in BOTH passes."""
     on_tpu = jax.default_backend() == "tpu"
-    plan = _plan(q, k, causal, block_q, block_k)
+    plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
+                 block_k or DEFAULT_BLOCK_K)
     if (on_tpu or interpret) and plan is not None:
         out, _ = _flash_forward(q, k, v, causal, *plan, interpret)
         return _unfold(out, q.shape[0], q.shape[2])
@@ -526,7 +537,8 @@ def flash_attention(q, k, v, causal: bool = True,
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     on_tpu = jax.default_backend() == "tpu"
-    plan = _plan(q, k, causal, block_q, block_k)
+    plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
+                 block_k or DEFAULT_BLOCK_K)
     if (on_tpu or interpret) and plan is not None:
         out, lse = _flash_forward(q, k, v, causal, *plan, interpret)
         out = _unfold(out, q.shape[0], q.shape[2])
@@ -537,7 +549,13 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 def _bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     if lse is not None:
-        plan = _plan(q, k, causal, block_q, block_k)
+        # None = the backward's own tuned defaults; explicit blocks are
+        # honored verbatim (sweeps depend on that)
+        plan = _plan(q, k, causal, block_q or DEFAULT_BWD_BLOCK_Q,
+                     block_k or DEFAULT_BWD_BLOCK_K)
+        if plan is None:    # bwd blocks unaligned for these shapes
+            plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
+                         block_k or DEFAULT_BLOCK_K)
         batch, seq_q, heads, head_dim = q.shape
         partial_bytes = (batch * heads * (k.shape[1] // plan[1])
                          * seq_q * head_dim * 4)

@@ -206,10 +206,13 @@ class Scheduler:
         if windows:
             # hosts=None: a sub-host-generation domain — pin the pod id
             # only (gang_slice_windows' per-generation classification).
+            # Ordered so windows that avoid the drain lease are tried
+            # before ones that would refill it (resetting a stuck bigger
+            # gang's drain clock).
             candidate_pins = [
                 {GANG_POD_ID_KEY: pid, GANG_HOST_SET_KEY: hosts}
                 if hosts is not None else {GANG_POD_ID_KEY: pid}
-                for pid, hosts in windows
+                for pid, hosts in self._order_gang_windows(windows)
             ]
         else:
             free_by_pod: dict[str, float] = {}
@@ -285,6 +288,23 @@ class Scheduler:
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
+
+    def _order_gang_windows(self, windows):
+        """Order candidate windows so the FIRST one that fits is also the
+        best citizen: windows overlapping the drain lease come last (a
+        smaller gang binding into the window a stuck larger gang is
+        draining would reset its drain clock), original adjacency order
+        otherwise.  Fragmentation-aware ordering (prefer breaking already
+        -busy super-windows) was measured as well and LOST on the
+        v5e-256 trace (seed-0 utilization -5 points) — see
+        scripts/diag_gang.py for the experiment harness."""
+        def key(item):
+            _, hosts = item
+            if hosts is None:
+                return 0
+            return len(frozenset(hosts) & self._reserved_hosts)
+
+        return sorted(windows, key=key)
 
     def _attempt_gang(self, pins: dict, base: SharedLister,
                       members: list[Pod]):

@@ -1,0 +1,75 @@
+"""Denoised head-to-head of the top backward configs from sweep_bwd.py:
+3 repeats each, min-of-reps slope.  Prints JSON lines + the winner.
+
+    python scripts/confirm_bwd.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from bench_compute import _slope  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops import attention as A
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+
+    B, S, H, D = 8, 2048, 8, 128
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    fwd_flops = 4 * B * H * S * S * D * 0.5
+    bwd_flops = 3.5 * fwd_flops
+
+    def grad_maker(bq, bk):
+        def loss(qq, kk2, vv):
+            return jnp.sum(A.flash_attention(
+                qq, kk2, vv, True, bq, bk).astype(jnp.float32) ** 2)
+
+        def gstep(qx):
+            gq, gk, gv = jax.grad(loss, (0, 1, 2))(qx, k, v)
+            return gq + gk + gv
+
+        @jax.jit
+        def run(q, k, v, iters):
+            return jax.lax.fori_loop(
+                0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
+
+        def make(iters):
+            i = jnp.int32(iters)
+            return lambda: float(run(q, k, v, i))
+        return make
+
+    CONFIGS = [
+        ("split", 1024, 512), ("split", 512, 512),
+        ("fused", 512, 1024), ("fused", 1024, 512), ("fused", 512, 512),
+    ]
+    results = []
+    for impl, bq, bk in CONFIGS:
+        A.set_backward_impl(impl)
+        times = []
+        for _ in range(3):
+            times.append(_slope(grad_maker(bq, bk)))
+        t = min(times)
+        r = {"impl": impl, "bq": bq, "bk": bk,
+             "grad_ms_minrep": round(t * 1e3, 3),
+             "all_ms": [round(x * 1e3, 3) for x in times]}
+        results.append((t, r))
+        print(json.dumps(r), flush=True)
+    A.set_backward_impl("fused")
+    best = min(results)[1]
+    print(json.dumps({"best": best, "note": "grad time = fwd+bwd chained"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Diagnose the gang-4x8 schedule-latency tail (VERDICT r4 task #5).
+
+Runs the utilization sim with per-cycle probes answering: when a 4x8
+gang is waiting, does it hold the window lease (or is another class
+hogging it)?  How drained is the leased window?  Do candidate windows
+even exist?  Prints a JSON summary per seed.
+
+    python scripts/diag_gang.py [seed ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from bench_utilization import Sim, TICK_S, TRACE_S  # noqa: E402
+
+from nos_tpu.kube.client import KIND_POD  # noqa: E402
+
+
+def run(seed: int) -> dict:
+    sim = Sim(seed=seed)
+    sched = sim.scheduler
+    probe = {
+        "cycles": 0,
+        "cycles_with_pending_4x8": 0,
+        "lease_held_by": {},          # class of lease holder while a 4x8 waits
+        "no_lease_while_4x8_waits": 0,
+        "waits": [],                  # per completed wait: cycles waited
+    }
+    waiting: dict[str, int] = {}      # gang name -> cycles waited so far
+
+    probe["lease_moves"] = 0          # window changed under the same gang
+    probe["binds_onto_leased_hosts"] = 0
+    probe["leased_busy_chips_series"] = []
+    last_lease = [None]               # (gang_key, hosts)
+    pre_nodes = [set()]
+
+    orig_cycle = sched.run_cycle
+
+    def instrumented():
+        # what was bound to the leased hosts before this cycle
+        lease_before = sched._lease
+        before = set()
+        if lease_before is not None:
+            before = {p.metadata.name for p in sim.api.list(
+                KIND_POD,
+                filter_fn=lambda p: p.spec.node_name in lease_before[1])}
+        out = orig_cycle()
+        lease_now = sched._lease
+        if lease_now is not None and lease_before is not None \
+                and lease_now[0] == lease_before[0] \
+                and lease_now[1] != lease_before[1]:
+            probe["lease_moves"] += 1
+        if lease_before is not None and lease_now is not None \
+                and lease_now[0] == lease_before[0]:
+            after = {p.metadata.name for p in sim.api.list(
+                KIND_POD,
+                filter_fn=lambda p: p.spec.node_name in lease_before[1])}
+            probe["binds_onto_leased_hosts"] += len(after - before)
+        probe["cycles"] += 1
+        pending_4x8 = {
+            j.name for j in sim.jobs.values()
+            if j.cls == "gang-4x8" and j.bound_at is None
+            # only count gangs whose pods exist and are unbound
+            and any(p.spec.node_name == ""
+                    for p in sim.api.list(
+                        KIND_POD,
+                        filter_fn=lambda p, n=j.name:
+                        p.metadata.name.startswith(n + "-")))
+        }
+        for g in list(waiting):
+            if g not in pending_4x8:
+                probe["waits"].append(waiting.pop(g))
+        for g in pending_4x8:
+            waiting[g] = waiting.get(g, 0) + 1
+        if pending_4x8:
+            probe["cycles_with_pending_4x8"] += 1
+            lease = sched._lease
+            if lease is None:
+                probe["no_lease_while_4x8_waits"] += 1
+            else:
+                (ns, gname), hosts = lease
+                job = sim.jobs.get(gname)
+                cls = job.cls if job else "gone"
+                key = f"{cls}({len(hosts)}h)"
+                probe["lease_held_by"][key] = \
+                    probe["lease_held_by"].get(key, 0) + 1
+        return out
+
+    sched.run_cycle = instrumented
+    result = sim.run()
+    waits = sorted(probe["waits"])
+    return {
+        "seed": seed,
+        "gang_4x8": result["schedule_latency_by_class"].get("gang-4x8"),
+        "gang_4x4": result["schedule_latency_by_class"].get("gang-4x4"),
+        "utilization": result["utilization_pct"],
+        "cycles_with_pending_4x8_pct": round(
+            probe["cycles_with_pending_4x8"] / probe["cycles"], 3),
+        "lease_held_by_while_4x8_waits": probe["lease_held_by"],
+        "no_lease_while_4x8_waits": probe["no_lease_while_4x8_waits"],
+        "wait_cycles_p50": waits[len(waits) // 2] if waits else None,
+        "wait_cycles_p90": waits[int(len(waits) * 0.9)] if waits else None,
+        "lease_moves": probe["lease_moves"],
+        "binds_onto_leased_hosts": probe["binds_onto_leased_hosts"],
+        "ticks_per_second": 1 / TICK_S,
+    }
+
+
+def main() -> None:
+    seeds = [int(s) for s in sys.argv[1:]] or [0, 1]
+    for seed in seeds:
+        print(json.dumps(run(seed)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
