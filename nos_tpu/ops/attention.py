@@ -24,20 +24,24 @@ pipeline rather than as a literal transcription of the CUDA algorithm:
   (the skipped step fetches something useful instead of stalling).
 
 Measured on a real v5e at the training shapes (B8 S2048 H8 D128, causal
-bf16): 94 TFLOP/s forward — above the official pallas TPU kernel
-(jax.experimental.pallas.ops.tpu.flash_attention, 88 TFLOP/s at its best
-block config, same process) and ~52% of the chip's measured 181 TFLOP/s
-matmul roofline.  The naive ports measured along the way: 43 TFLOP/s for
+bf16; BENCH_r03/r04 record the per-round numbers, which move a few
+TFLOP/s run to run through the tunnel): ~89-97 TFLOP/s forward at
+blocks 512/512 — at or above the official pallas TPU kernel
+(jax.experimental.pallas.ops.tpu.flash_attention, 88 TFLOP/s at its
+best block config, same process, r3) — and ~45-50% of the chip's
+bf16 peak.  The naive ports measured along the way: 43 TFLOP/s for
 the in-kernel-loop structure, 70 with "parallel" grid hints, 84 with
 paired q-chains; the streamed + lane-replicated form above beat them all.
 
-The backward is two kernels (the standard TPU split, since TPU has no
-atomics and pallas grids write disjoint output blocks): a dq kernel
-(grid over q-blocks, streams K/V) and a dkv kernel (grid over k-blocks,
-streams Q/dO).  Both recompute p = exp(s - lse) from the saved logsumexp
-(flash-attention-2 style) and use ds = p * (dp - delta) with
-delta = rowsum(dO * O) computed once in XLA.  lse/delta are pre-replicated
-to lane width XLA-side so the per-step subtraction stays lane-aligned.
+The backward recomputes p = exp(s - lse) from the saved logsumexp
+(flash-attention-2 style) and uses ds = p * (dp - delta) with
+delta = rowsum(dO * O) computed once in XLA; lse/delta are
+pre-replicated to lane width XLA-side so the per-step subtraction stays
+lane-aligned.  Two implementations (see the backward section): the
+default FUSED kernel computes dq, dk and dv in one pass (5 matmuls per
+block pair, dq via per-k-block partials summed XLA-side — measured ~30%
+faster on v5e grad time), and the classic SPLIT dq/dkv pair (7 matmuls,
+no partial buffer — the long-context fallback).
 
 Layout convention everywhere in nos_tpu: [batch, seq, heads, head_dim].
 """
