@@ -1,0 +1,82 @@
+"""Diagnose the batch-16 remote-compile rejection (VERDICT r4 task #3).
+
+r3 wrote it off in one line: "the tunnel's remote-compile helper rejects
+the programs, consistently".  This script reproduces it narrowly and
+prints the VERBATIM error for each variant, varying exactly one
+dimension at a time:
+
+    batch 16 x {unrolled, scanned} x {remat mats, full} x {24, 8 layers}
+
+plus a batch-8 control.  If scanned layers compile where unrolled ones
+do not, the rejection is program SIZE, and scan_layers=True at batch 16
+may be a free MFU win.
+
+    python scripts/diag_batch16.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import traceback
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bench_compute import _slope, make_step_chain, model_flops_per_step, \
+    peak_for  # noqa: E402
+from nos_tpu.models.llama import BENCH_350M  # noqa: E402
+from nos_tpu.models.train import ShardedTrainer  # noqa: E402
+from nos_tpu.parallel.mesh import MeshSpec, make_mesh  # noqa: E402
+
+SEQ = 2048
+
+
+def try_variant(batch, scan, remat_policy, layers, peak):
+    cfg = dataclasses.replace(
+        BENCH_350M, attn_impl="flash", remat_policy=remat_policy,
+        scan_layers=scan, num_layers=layers)
+    mesh = make_mesh(MeshSpec.for_device_count(1),
+                     devices=jax.devices()[:1])
+    trainer = ShardedTrainer(cfg, mesh, batch_size=batch, seq_len=SEQ)
+    state = trainer.init_state(0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, SEQ), 0, cfg.vocab_size, jnp.int32)
+    t = _slope(make_step_chain(jax, trainer, state, tokens),
+               n1=2, n2=6, reps=2)
+    flops = model_flops_per_step(cfg, batch, SEQ)
+    return {"step_ms": round(t * 1e3, 2),
+            "mfu": round(flops / t / peak, 4),
+            "tokens_per_s": round(batch * SEQ / t)}
+
+
+def main() -> None:
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return
+    peak = peak_for(jax.devices()[0].device_kind)
+    VARIANTS = [
+        # (batch, scan_layers, remat, n_layers)
+        (8, False, "mats", 24),     # control: the r3 production config
+        (16, True, "mats", 24),     # smaller program: does scan compile?
+        (16, False, "mats", 8),     # smaller model: size or shape?
+        (16, False, "mats", 24),    # the rejected r3 config, verbatim
+        (16, True, "nothing", 24),  # least-memory remat at batch 16
+        (32, True, "mats", 24),     # if 16 works scanned, push on
+    ]
+    for batch, scan, remat, layers in VARIANTS:
+        tag = {"batch": batch, "scan": scan, "remat": remat,
+               "layers": layers}
+        try:
+            tag.update(try_variant(batch, scan, remat, layers, peak))
+        except Exception as e:  # noqa: BLE001 — the error IS the data
+            tag["error"] = f"{type(e).__name__}: {e}"[:800]
+            tag["trace_tail"] = traceback.format_exc()[-400:]
+        print(json.dumps(tag), flush=True)
+
+
+if __name__ == "__main__":
+    main()
