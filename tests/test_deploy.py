@@ -119,6 +119,45 @@ class TestChartRenders:
             (CHART / "templates/partitioner/deployment.yaml").read_text(), c)
         assert all(d is None for d in yaml.safe_load_all(out))
 
+    def test_webhook_disabled_renders_cleanly(self, ctx):
+        """operator.webhook.enabled=false alone must fully disable the
+        webhook: no VWC/certgen manifests, no cert mount or webhook port
+        in the Deployment, and a ConfigMap without webhook_port (so the
+        operator neither serves nor crashloops on missing certs)."""
+        import copy
+
+        c = copy.deepcopy(ctx)
+        c["Values"]["operator"]["webhook"]["enabled"] = False
+        for rel in ("templates/operator/webhook.yaml",
+                    "templates/operator/webhook-certgen.yaml"):
+            out = render((CHART / rel).read_text(), c)
+            assert all(d is None for d in yaml.safe_load_all(out)), rel
+        dep = yaml.safe_load(render(
+            (CHART / "templates/operator/deployment.yaml").read_text(), c))
+        spec = dep["spec"]["template"]["spec"]
+        assert [v["name"] for v in spec["volumes"]] == ["config"]
+        container = spec["containers"][0]
+        assert [p["name"] for p in container["ports"]] == ["health"]
+        cm = yaml.safe_load(render(
+            (CHART / "templates/operator/configmap.yaml").read_text(), c))
+        assert "webhook_port" not in cm["data"]["config.yaml"]
+
+    def test_webhook_enabled_renders_vwc_and_jobs(self, ctx):
+        out = render(
+            (CHART / "templates/operator/webhook.yaml").read_text(), ctx)
+        docs = [d for d in yaml.safe_load_all(out) if d]
+        kinds = sorted(d["kind"] for d in docs)
+        assert kinds == ["Service", "ValidatingWebhookConfiguration"]
+        vwc = next(d for d in docs
+                   if d["kind"] == "ValidatingWebhookConfiguration")
+        rules = [w["rules"][0]["resources"][0] for w in vwc["webhooks"]]
+        assert sorted(rules) == ["compositeelasticquotas", "elasticquotas"]
+        out2 = render(
+            (CHART / "templates/operator/webhook-certgen.yaml").read_text(),
+            ctx)
+        kinds2 = [d["kind"] for d in yaml.safe_load_all(out2) if d]
+        assert kinds2.count("Job") == 2
+
     def test_crds_are_valid_yaml(self):
         names = set()
         for path in sorted(CHART.glob("crds/*.yaml")):

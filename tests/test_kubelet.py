@@ -50,3 +50,48 @@ def test_admit_declines_on_non_sim_substrate():
         pass
 
     assert admit_bound_pods(NotTheSim(), "node-0") == 0
+
+
+def test_admit_skips_slice_pods_when_asked():
+    """Hybrid nodes: the ChipAgent's bare phase transition must leave
+    slice pods to the sliceagent's device-backed admission (ADVICE r3)."""
+    from nos_tpu.testing.factory import make_slice_pod
+
+    api, sched = make_cluster()
+    api.create(KIND_POD, make_pod(name="ts", resources={C.RESOURCE_TPU: 1},
+                                  node_name="node-0"))
+    api.create(KIND_POD, make_slice_pod("2x2", 1, name="sl",
+                                        node_name="node-0"))
+    assert admit_bound_pods(api, "node-0", skip_slice_pods=True) == 1
+    assert api.get(KIND_POD, "ts", "default").status.phase == RUNNING
+    assert api.get(KIND_POD, "sl", "default").status.phase == PENDING
+
+
+def test_watch_events_deliver_in_store_commit_order():
+    """A watch callback that writes back (KubeletSim's phase patch) must
+    not let later-registered watchers see the nested event before the
+    one that caused it — the FIFO bus (ADVICE r3): every watcher
+    observes the same store-commit order."""
+    from nos_tpu.kube.client import APIServer
+
+    api = APIServer()
+
+    def reactor(event, pod):
+        # first watcher: on seeing a bound Pending pod, immediately
+        # patch it Running (a nested write from inside the callback)
+        if event != "DELETED" and pod.status.phase == PENDING \
+                and pod.spec.node_name:
+            def mutate(p):
+                p.status.phase = RUNNING
+            api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
+                      mutate=mutate)
+
+    seen: list[tuple[str, str]] = []
+    api.watch(KIND_POD, reactor)
+    api.watch(KIND_POD, lambda ev, p: seen.append((ev, p.status.phase)))
+
+    api.create(KIND_POD, make_pod(name="w", node_name="node-0"))
+    # the later watcher must see ADDED(Pending) BEFORE MODIFIED(Running):
+    # out-of-order delivery would let a cache overwrite new state with
+    # the stale outer payload
+    assert seen == [("ADDED", PENDING), ("MODIFIED", RUNNING)]
