@@ -82,10 +82,11 @@ if _BWD_IMPL not in ("fused", "split"):
         _BWD_IMPL)
     _BWD_IMPL = "fused"
 
-# The fused backward materialises fp32 dq partials of shape
-# [B*H, Sk/block_k, Sq, D] — quadratic in sequence length.  Above this
-# budget (bytes) fall back to the split kernels, which need no partial
-# buffer (long-context shapes that fit before must keep fitting).
+# The fused backward materialises dq partials of shape
+# [B*H, Sk/block_k, Sq, D] in the array dtype — quadratic in sequence
+# length.  Above this budget (bytes) fall back to the split kernels,
+# which need no partial buffer (long-context shapes that fit before must
+# keep fitting).
 FUSED_PARTIAL_BUDGET = 1 << 30
 
 
@@ -353,15 +354,19 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - jnp.tile(delta, (1, reps)))).astype(qb.dtype)
         dk_sc[:, :] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
         # this k-block's dq contribution; the J partials are summed (and
-        # scaled) XLA-side
-        dq_ref[0, 0] = jnp.dot(ds, kb,
-                               preferred_element_type=jnp.float32)
+        # scaled) XLA-side.  Stored in the array dtype — fp32 partials
+        # double the extra HBM round-trip this design pays, and the
+        # fp32-accumulated sum over J<=Sk/block_k terms keeps the final
+        # dq within bf16 gradient tolerance.
+        dq_ref[0, 0] = jnp.dot(
+            ds, kb, preferred_element_type=jnp.float32
+        ).astype(dq_ref.dtype)
 
     if causal:
         @pl.when(jnp.logical_not(diag))
         def _zero():
             # a skipped step still owns its dq partial block
-            dq_ref[0, 0] = jnp.zeros(dq_ref.shape[2:], jnp.float32)
+            dq_ref[0, 0] = jnp.zeros(dq_ref.shape[2:], dq_ref.dtype)
 
     @pl.when(qi == num_q_blocks - 1)
     def _flush():
@@ -404,7 +409,7 @@ def _flash_backward_fused(q, k, v, o, lse, g, causal, block_q, block_k,
             block_k=block_k, num_q_blocks=num_q_blocks),
         out_shape=[
             jax.ShapeDtypeStruct((bh, num_k_blocks, seq_q, head_dim),
-                                 jnp.float32),
+                                 q.dtype),
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ],
@@ -421,7 +426,8 @@ def _flash_backward_fused(q, k, v, o, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(qf, kf, vf, dof, lse_rep, delta_rep)
 
-    dq = (scale * jnp.sum(dq_partial, axis=1)).astype(q.dtype)
+    dq = (scale * jnp.sum(dq_partial, axis=1,
+                          dtype=jnp.float32)).astype(q.dtype)
     return (_unfold(dq, batch, heads), _unfold(dk, batch, heads),
             _unfold(dv, batch, heads))
 
@@ -562,7 +568,7 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
                          block_k or DEFAULT_BLOCK_K)
         batch, seq_q, heads, head_dim = q.shape
         partial_bytes = (batch * heads * (k.shape[1] // plan[1])
-                         * seq_q * head_dim * 4)
+                         * seq_q * head_dim * q.dtype.itemsize)
         use_fused = (_BWD_IMPL == "fused"
                      and partial_bytes <= FUSED_PARTIAL_BUDGET)
         impl = _flash_backward_fused if use_fused else _flash_backward

@@ -1,15 +1,16 @@
 """Diagnose the batch-16 remote-compile rejection (VERDICT r4 task #3).
 
 r3 wrote it off in one line: "the tunnel's remote-compile helper rejects
-the programs, consistently".  This script reproduces it narrowly and
-prints the VERBATIM error for each variant, varying exactly one
-dimension at a time:
+the programs, consistently".  This script runs a variant matrix and
+prints the VERBATIM error for each failing one.
 
-    batch 16 x {unrolled, scanned} x {remat mats, full} x {24, 8 layers}
-
-plus a batch-8 control.  If scanned layers compile where unrolled ones
-do not, the rejection is program SIZE, and scan_layers=True at batch 16
-may be a free MFU win.
+FINDINGS (round 1 + 2, recorded in PARITY.md): the HTTP 500s are HBM
+OOM in the AOT compiler, not a tunnel limit — "mats" remat saves ~10 GB
+of activations at batch 16 (350M/S2048), which does not fit beside
+params+optimizer on a 16 GB v5e; batch 12 mats, batch 16 mlp and batch
+12 all_mats OOM too.  Every variant that fits loses to batch 8 + mats
+(0.544): batch 16 attn 0.462, batch 16 full remat 0.464.  Batch 8 is
+the memory-feasibility frontier; edit VARIANTS to probe further.
 
     python scripts/diag_batch16.py
 """
@@ -59,13 +60,15 @@ def main() -> None:
         return
     peak = peak_for(jax.devices()[0].device_kind)
     VARIANTS = [
-        # (batch, scan_layers, remat, n_layers)
-        (8, False, "mats", 24),     # control: the r3 production config
-        (16, True, "mats", 24),     # smaller program: does scan compile?
-        (16, False, "mats", 8),     # smaller model: size or shape?
-        (16, False, "mats", 24),    # the rejected r3 config, verbatim
-        (16, True, "nothing", 24),  # least-memory remat at batch 16
-        (32, True, "mats", 24),     # if 16 works scanned, push on
+        # (batch, scan_layers, remat, n_layers).  Round 1 of this matrix
+        # established: the "rejection" is HBM OOM in the AOT compiler
+        # ("mats" saved activations ~10 GB at batch 16 don't fit beside
+        # params+opt); full remat fits but loses (0.464 vs batch-8's
+        # 0.544).  Round 2: the middle ground.
+        (12, False, "mats", 24),    # ~7.5 GB saved: does batch 12 fit?
+        (16, False, "attn", 24),    # save only attn_out (~1.5 GB)
+        (16, False, "mlp", 24),     # save only mlp gate/up
+        (12, False, "all_mats", 24),
     ]
     for batch, scan, remat, layers in VARIANTS:
         tag = {"batch": batch, "scan": scan, "remat": remat,
