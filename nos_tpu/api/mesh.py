@@ -1,0 +1,169 @@
+"""Mesh-aware slice request normalization (SURVEY.md §2.8).
+
+A workload that thinks in chips can request them generically —
+`google.com/tpu: N` plus the `nos.tpu/mesh: AxB[xC]` annotation naming
+the JAX mesh it will build — and admission rewrites the request into the
+matching slice profile (`nos.tpu/slice-AxB: 1`), so the partitioner
+carves an ICI-contiguous sub-mesh of exactly that shape instead of the
+request being unservable on slice-partitioned nodes.  This is the "slice
+shape chooser must know which JAX mesh shapes a workload requests" item
+of SURVEY.md §2.8; the reference has no analog (its MIG profiles are
+explicit in the request).
+
+Two entry points for the two substrates:
+
+- `normalize_mesh_request(pod)` mutates a nos_tpu Pod object in place —
+  registered as an in-process admission hook on the in-memory APIServer
+  (cmd/operator.py).
+- `mesh_patch_ops(raw_pod)` returns RFC 6902 JSON-patch ops computed on
+  the RAW kubernetes pod JSON — served by the operator's mutating
+  webhook endpoint (kube/webhook.py).  Working on the raw object (not
+  the codec's subset model) guarantees unmodeled fields are never
+  touched or stripped.
+
+Rules (both paths identical):
+- the annotation must parse as a shape and its chip product must equal
+  the pod's TOTAL `google.com/tpu` request — a mismatch is left alone
+  (the workload said two different things; admission must not guess);
+- pods already requesting any `nos.tpu/slice-*` resource are left alone
+  (explicit wins);
+- every container's own `google.com/tpu` quantity must itself be the
+  full chip count (multi-container splits are ambiguous — left alone),
+  and a TPU request in an initContainer disqualifies the pod (rewriting
+  only the main containers would leave it requesting BOTH resources and
+  unschedulable); the slice resource replaces it in both limits and
+  requests wherever the original appeared.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api import constants as C
+from nos_tpu.topology.shape import Shape
+
+logger = logging.getLogger(__name__)
+
+
+def _mesh_shape(annotations, total_tpus: float) -> Shape | None:
+    """The shape to carve, or None if the pod is not eligible."""
+    mesh = (annotations or {}).get(C.ANNOT_MESH, "")
+    if not mesh or total_tpus <= 0:
+        return None
+    try:
+        shape = Shape.parse(mesh)
+    except ValueError:
+        logger.warning("ignoring unparseable %s=%r", C.ANNOT_MESH, mesh)
+        return None
+    if shape.chips != int(total_tpus):
+        logger.warning(
+            "%s=%s names %d chips but the pod requests %s %s: not "
+            "normalizing", C.ANNOT_MESH, mesh, shape.chips,
+            C.RESOURCE_TPU, total_tpus)
+        return None
+    return shape
+
+
+# -- nos_tpu object path (in-memory substrate) ------------------------------
+
+def normalize_mesh_request(pod) -> bool:
+    """Rewrite a generic-chip request into the mesh's slice profile;
+    returns True if the pod was changed."""
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.topology.profile import is_slice_resource
+
+    req = pod_request(pod)
+    if any(is_slice_resource(r) for r in req):
+        return False
+    for c in getattr(pod.spec, "init_containers", None) or []:
+        if c.resources.get(C.RESOURCE_TPU, 0):
+            return False    # init-container TPU use: ambiguous, skip
+    shape = _mesh_shape(pod.metadata.annotations,
+                        req.get(C.RESOURCE_TPU, 0))
+    if shape is None:
+        return False
+    total = req.get(C.RESOURCE_TPU, 0)
+    for c in pod.spec.containers:
+        qty = c.resources.get(C.RESOURCE_TPU, 0)
+        if qty and qty != total:
+            return False        # split across containers: ambiguous
+    changed = False
+    from nos_tpu.topology.profile import slice_resource_name
+
+    for c in pod.spec.containers:
+        if c.resources.pop(C.RESOURCE_TPU, None) is not None:
+            c.resources[slice_resource_name(shape)] = 1
+            changed = True
+    if changed:
+        logger.info("mesh normalization: %s/%s -> %s",
+                    pod.metadata.namespace, pod.metadata.name,
+                    slice_resource_name(shape))
+    return changed
+
+
+def install_mesh_normalization(api) -> None:
+    """Register the mutating admission hook (in-memory substrate); on
+    the REST substrate the same rule runs server-side via the operator's
+    mutating webhook (mesh_patch_ops)."""
+    def admit(_api, pod) -> None:
+        normalize_mesh_request(pod)
+
+    api.register_admission("Pod", admit)
+
+
+# -- raw-JSON path (mutating webhook) ---------------------------------------
+
+def _esc(token: str) -> str:
+    """RFC 6901 pointer-token escaping."""
+    return token.replace("~", "~0").replace("/", "~1")
+
+
+def mesh_patch_ops(raw_pod: dict) -> list[dict] | None:
+    """JSON-patch ops normalizing a raw k8s pod, or None for no change.
+    Ops touch ONLY the specific resource keys, never whole stanzas."""
+    meta = raw_pod.get("metadata") or {}
+    spec = raw_pod.get("spec") or {}
+    containers = spec.get("containers") or []
+
+    def qty(v) -> float:
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    for c in spec.get("initContainers") or []:
+        res = c.get("resources") or {}
+        for section in ("limits", "requests"):
+            if C.RESOURCE_TPU in (res.get(section) or {}):
+                return None          # init-container TPU use: ambiguous
+    total = 0.0
+    for c in containers:
+        res = c.get("resources") or {}
+        for section in ("limits", "requests"):
+            for name in (res.get(section) or {}):
+                if C.SLICE_RESOURCE_RE.match(name):
+                    return None          # explicit slice request wins
+        total += qty((res.get("limits") or {}).get(C.RESOURCE_TPU, 0))
+    shape = _mesh_shape(meta.get("annotations"), total)
+    if shape is None:
+        return None
+
+    from nos_tpu.topology.profile import slice_resource_name
+
+    slice_res = slice_resource_name(shape)
+    ops: list[dict] = []
+    for i, c in enumerate(containers):
+        res = c.get("resources") or {}
+        for section in ("limits", "requests"):
+            sec = res.get(section) or {}
+            if C.RESOURCE_TPU not in sec:
+                continue
+            if qty(sec[C.RESOURCE_TPU]) != total:
+                return None              # split across containers
+            base = f"/spec/containers/{i}/resources/{section}"
+            ops.append({"op": "remove",
+                        "path": f"{base}/{_esc(C.RESOURCE_TPU)}"})
+            ops.append({"op": "add",
+                        "path": f"{base}/{_esc(slice_res)}",
+                        "value": "1"})
+    return ops or None

@@ -58,6 +58,15 @@ def build_operator_main(api: APIServer, cfg: OperatorConfig,
     main = main or Main("nos-tpu-operator", cfg.health_probe_addr,
                         api=api)
     install_quota_webhooks(api)
+    # Mesh-aware slice normalization (SURVEY.md §2.8): in-process hook on
+    # the in-memory substrate; raw-JSON mutator for the webhook endpoint
+    # on the REST substrate (the kube-apiserver applies the JSONPatch).
+    from nos_tpu.api.mesh import install_mesh_normalization, mesh_patch_ops
+
+    if hasattr(api, "admission"):       # REST substrate (KubeClient)
+        api.admission.register_mutating("Pod", mesh_patch_ops)
+    else:
+        install_mesh_normalization(api)
     if cfg.webhook_port > 0:
         main.webhook = _serve_admission_webhook(api, cfg)
         main.add_shutdown_hook(main.webhook.stop)

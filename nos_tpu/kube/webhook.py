@@ -33,10 +33,17 @@ from .k8s_codec import from_k8s
 logger = logging.getLogger(__name__)
 
 
-def review_response(uid: str, allowed: bool, message: str = "") -> dict:
+def review_response(uid: str, allowed: bool, message: str = "",
+                    patch_ops: list | None = None) -> dict:
     resp: dict = {"uid": uid, "allowed": allowed}
     if message:
         resp["status"] = {"message": message, "code": 403}
+    if patch_ops:
+        import base64
+
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(
+            json.dumps(patch_ops).encode()).decode()
     return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
             "response": resp}
 
@@ -48,13 +55,20 @@ class AdmissionHandler:
     def __init__(self, api) -> None:
         self._api = api
         self._validators: dict[str, list[Callable]] = {}
+        self._mutators: dict[str, list[Callable]] = {}
 
     def register(self, kind: str, fn: Callable) -> None:
         self._validators.setdefault(kind, []).append(fn)
 
+    def register_mutating(self, kind: str, fn: Callable) -> None:
+        """fn(raw_object_dict) -> RFC 6902 op list | None.  Mutators work
+        on the RAW k8s JSON so unmodeled fields are never touched; their
+        ops are returned as the AdmissionReview JSONPatch."""
+        self._mutators.setdefault(kind, []).append(fn)
+
     @property
     def kinds(self) -> list[str]:
-        return sorted(self._validators)
+        return sorted(set(self._validators) | set(self._mutators))
 
     def handle(self, body: bytes) -> dict:
         uid = ""
@@ -66,16 +80,39 @@ class AdmissionHandler:
             operation = request.get("operation", "CREATE")
             if operation == "DELETE":
                 return review_response(uid, True)
-            obj = from_k8s(kind, request["object"])
+            raw = request["object"]
         except Exception as e:  # noqa: BLE001 — malformed review: deny
             logger.warning("admission: malformed review rejected (%s)", e)
             return review_response(uid, False, f"malformed AdmissionReview: {e}")
-        for fn in self._validators.get(kind, []):
+        validators = self._validators.get(kind, [])
+        if validators:
+            # Validated kinds are fail-closed: an object the codec cannot
+            # decode cannot be validated, so it is denied.  Mutate-only
+            # kinds (cluster-wide pod normalization) never decode — the
+            # mutators consume the raw JSON, and a decode quirk must not
+            # block pod creation.
             try:
-                fn(self._api, obj)
-            except Exception as e:  # noqa: BLE001 — validator verdicts + bugs both deny
-                return review_response(uid, False, str(e))
-        return review_response(uid, True)
+                obj = from_k8s(kind, raw)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("admission: undecodable %s rejected (%s)",
+                               kind, e)
+                return review_response(uid, False,
+                                       f"undecodable {kind}: {e}")
+            for fn in validators:
+                try:
+                    fn(self._api, obj)
+                except Exception as e:  # noqa: BLE001 — verdicts + bugs both deny
+                    return review_response(uid, False, str(e))
+        ops: list = []
+        for fn in self._mutators.get(kind, []):
+            try:
+                ops.extend(fn(raw) or [])
+            except Exception as e:  # noqa: BLE001 — a broken mutator must
+                # not block the write (mutating webhooks ship with
+                # failurePolicy Ignore; same spirit in-process)
+                logger.warning("admission: mutator for %s failed (%s); "
+                               "object passed through unchanged", kind, e)
+        return review_response(uid, True, patch_ops=ops or None)
 
 
 class WebhookServer:
@@ -105,7 +142,8 @@ class WebhookServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self) -> None:  # noqa: N802 — stdlib naming
-                if not self.path.startswith("/validate"):
+                if not (self.path.startswith("/validate")
+                        or self.path.startswith("/mutate")):
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))

@@ -147,7 +147,12 @@ class TestChartRenders:
             (CHART / "templates/operator/webhook.yaml").read_text(), ctx)
         docs = [d for d in yaml.safe_load_all(out) if d]
         kinds = sorted(d["kind"] for d in docs)
-        assert kinds == ["Service", "ValidatingWebhookConfiguration"]
+        assert kinds == ["MutatingWebhookConfiguration", "Service",
+                         "ValidatingWebhookConfiguration"]
+        mwc = next(d for d in docs
+                   if d["kind"] == "MutatingWebhookConfiguration")
+        assert mwc["webhooks"][0]["failurePolicy"] == "Ignore"
+        assert mwc["webhooks"][0]["rules"][0]["resources"] == ["pods"]
         vwc = next(d for d in docs
                    if d["kind"] == "ValidatingWebhookConfiguration")
         rules = [w["rules"][0]["resources"][0] for w in vwc["webhooks"]]
@@ -156,7 +161,7 @@ class TestChartRenders:
             (CHART / "templates/operator/webhook-certgen.yaml").read_text(),
             ctx)
         kinds2 = [d["kind"] for d in yaml.safe_load_all(out2) if d]
-        assert kinds2.count("Job") == 2
+        assert kinds2.count("Job") == 3  # create + 2 patch
 
     def test_crds_are_valid_yaml(self):
         names = set()

@@ -1,0 +1,211 @@
+"""Mesh-aware slice normalization (SURVEY.md §2.8: the slice shape
+chooser must know which JAX mesh shapes a workload requests).
+
+A pod requesting `google.com/tpu: N` with `nos.tpu/mesh: AxB` is
+rewritten at admission into `nos.tpu/slice-AxB: 1`, end-to-end on both
+substrates: the in-memory hook mutates the object, the webhook path
+returns RFC 6902 ops the kube-apiserver applies."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.mesh import (
+    install_mesh_normalization, mesh_patch_ops, normalize_mesh_request,
+)
+from nos_tpu.kube.client import APIServer, KIND_POD
+from nos_tpu.testing.factory import make_pod, make_slice_pod
+
+
+def tpu_pod(n: int, mesh: str | None = None, name: str = "p", **kw):
+    annotations = {C.ANNOT_MESH: mesh} if mesh else {}
+    return make_pod(name=name, resources={C.RESOURCE_TPU: n, "cpu": 1.0},
+                    annotations=annotations, **kw)
+
+
+class TestNormalizeObject:
+    def test_rewrites_matching_mesh(self):
+        pod = tpu_pod(8, mesh="2x4")
+        assert normalize_mesh_request(pod)
+        res = pod.spec.containers[0].resources
+        assert C.RESOURCE_TPU not in res
+        assert res["nos.tpu/slice-2x4"] == 1
+
+    def test_canonicalizes_shape(self):
+        pod = tpu_pod(8, mesh="4x2")
+        assert normalize_mesh_request(pod)
+        assert "nos.tpu/slice-2x4" in pod.spec.containers[0].resources
+
+    @pytest.mark.parametrize("mesh,n", [
+        ("2x4", 4),        # chip-count mismatch
+        ("banana", 8),     # unparseable
+        (None, 8),         # no annotation
+    ])
+    def test_ineligible_left_alone(self, mesh, n):
+        pod = tpu_pod(n, mesh=mesh)
+        assert not normalize_mesh_request(pod)
+        assert pod.spec.containers[0].resources[C.RESOURCE_TPU] == n
+
+    def test_explicit_slice_request_wins(self):
+        pod = make_slice_pod("2x2", 1, name="explicit",
+                             annotations={C.ANNOT_MESH: "2x2"})
+        assert not normalize_mesh_request(pod)
+
+    def test_admission_hook_applies_on_create(self):
+        api = APIServer()
+        install_mesh_normalization(api)
+        api.create(KIND_POD, tpu_pod(8, mesh="2x4"))
+        stored = api.get(KIND_POD, "p", "default")
+        assert stored.spec.containers[0].resources.get(
+            "nos.tpu/slice-2x4") == 1
+
+
+class TestPatchOps:
+    def raw(self, n=8, mesh="2x4", sections=("limits", "requests")):
+        res = {s: {C.RESOURCE_TPU: str(n), "cpu": "1"} for s in sections}
+        return {
+            "metadata": {"name": "p", "namespace": "default",
+                         "annotations": {C.ANNOT_MESH: mesh}},
+            "spec": {"containers": [
+                {"name": "main", "resources": res,
+                 "volumeMounts": [{"name": "x", "mountPath": "/x"}]},
+            ], "nodeSelector": {"pool": "tpu"}},
+        }
+
+    @staticmethod
+    def apply(ops, doc):
+        """Minimal RFC 6902 evaluator for the op shapes we emit."""
+        doc = json.loads(json.dumps(doc))
+        for op in ops:
+            parts = [p.replace("~1", "/").replace("~0", "~")
+                     for p in op["path"].split("/")[1:]]
+            cur = doc
+            for p in parts[:-1]:
+                cur = cur[int(p)] if isinstance(cur, list) else cur[p]
+            if op["op"] == "remove":
+                del cur[parts[-1]]
+            elif op["op"] == "add":
+                cur[parts[-1]] = op["value"]
+        return doc
+
+    def test_ops_rewrite_both_sections_only(self):
+        raw = self.raw()
+        ops = mesh_patch_ops(raw)
+        assert ops and len(ops) == 4     # remove+add x limits+requests
+        out = self.apply(ops, raw)
+        for section in ("limits", "requests"):
+            sec = out["spec"]["containers"][0]["resources"][section]
+            assert C.RESOURCE_TPU not in sec
+            assert sec["nos.tpu/slice-2x4"] == "1"
+            assert sec["cpu"] == "1"     # untouched
+        # unmodeled fields never touched
+        assert out["spec"]["nodeSelector"] == {"pool": "tpu"}
+        assert out["spec"]["containers"][0]["volumeMounts"]
+
+    def test_limits_only_pod(self):
+        raw = self.raw(sections=("limits",))
+        ops = mesh_patch_ops(raw)
+        assert len(ops) == 2
+        out = self.apply(ops, raw)
+        lim = out["spec"]["containers"][0]["resources"]["limits"]
+        assert lim["nos.tpu/slice-2x4"] == "1"
+
+    def test_mismatch_returns_none(self):
+        assert mesh_patch_ops(self.raw(n=4)) is None
+        assert mesh_patch_ops(self.raw(mesh="3x3")) is None
+        raw = self.raw()
+        raw["spec"]["containers"][0]["resources"]["limits"][
+            "nos.tpu/slice-1x1"] = "1"
+        assert mesh_patch_ops(raw) is None   # explicit slice wins
+
+    def test_webhook_returns_jsonpatch(self):
+        import base64
+
+        from nos_tpu.kube.webhook import AdmissionHandler
+
+        h = AdmissionHandler(APIServer())
+        h.register_mutating("Pod", mesh_patch_ops)
+        review = json.dumps({
+            "request": {"uid": "u1", "operation": "CREATE",
+                        "kind": {"kind": "Pod"},
+                        "object": self.raw()},
+        }).encode()
+        resp = h.handle(review)["response"]
+        assert resp["allowed"] is True
+        assert resp["patchType"] == "JSONPatch"
+        ops = json.loads(base64.b64decode(resp["patch"]))
+        assert {o["op"] for o in ops} == {"remove", "add"}
+
+    def test_init_container_tpu_disqualifies(self):
+        raw = self.raw()
+        raw["spec"]["initContainers"] = [
+            {"name": "warm", "resources": {
+                "limits": {C.RESOURCE_TPU: "8"}}}]
+        assert mesh_patch_ops(raw) is None
+
+    def test_undecodable_pod_passes_mutate_only_path(self):
+        """The cluster-wide pod mutating webhook must be fail-open: a
+        pod whose quantities the subset codec cannot parse (e.g. 1Pi
+        memory) is passed through unmutated, never denied.  Kinds with
+        VALIDATORS stay fail-closed."""
+        from nos_tpu.kube.webhook import AdmissionHandler
+
+        raw = self.raw()
+        raw["spec"]["containers"][0]["resources"]["limits"]["memory"] = "1Pi"
+        h = AdmissionHandler(APIServer())
+        h.register_mutating("Pod", mesh_patch_ops)
+        resp = h.handle(json.dumps({
+            "request": {"uid": "u", "kind": {"kind": "Pod"},
+                        "object": raw},
+        }).encode())["response"]
+        assert resp["allowed"] is True
+
+        from nos_tpu.api.elasticquota import validate_elastic_quota
+        h2 = AdmissionHandler(APIServer())
+        h2.register("ElasticQuota", validate_elastic_quota)
+        resp2 = h2.handle(json.dumps({
+            "request": {"uid": "u", "kind": {"kind": "ElasticQuota"},
+                        "object": {"metadata": {"name": "q"},
+                                   "spec": {"min": {"memory": "1Xi"}}}},
+        }).encode())["response"]
+        assert resp2["allowed"] is False
+
+    def test_broken_mutator_does_not_block_the_write(self):
+        from nos_tpu.kube.webhook import AdmissionHandler
+
+        h = AdmissionHandler(APIServer())
+        h.register_mutating("Pod", lambda raw: 1 / 0)
+        resp = h.handle(json.dumps({
+            "request": {"uid": "u", "kind": {"kind": "Pod"},
+                        "object": self.raw()},
+        }).encode())["response"]
+        assert resp["allowed"] is True
+        assert "patch" not in resp
+
+
+class TestEndToEnd:
+    def test_mesh_pod_gets_carved_and_binds(self):
+        """The whole point: a chips+mesh pod on the in-memory substrate
+        is normalized at create, the partitioner carves the shape, and
+        the pod binds to the carved slice."""
+        from test_e2e_slice import Harness
+
+        h = Harness()
+        install_mesh_normalization(h.api)
+        h.agent.tick()
+
+        h.api.create(KIND_POD, tpu_pod(4, mesh="2x2", name="meshy"))
+        stored = h.api.get(KIND_POD, "meshy", "default")
+        assert stored.spec.containers[0].resources.get(
+            "nos.tpu/slice-2x2") == 1
+
+        assert h.scheduler.run_cycle() == 0     # no 2x2 advertised yet
+        h.advance(11.0)
+        assert h.partitioner.process_if_ready()
+        h.agent.tick()
+        assert h.scheduler.run_cycle() == 1
+        bound = h.api.get(KIND_POD, "meshy", "default")
+        assert bound.spec.node_name == "host-0"
