@@ -37,7 +37,8 @@ import sys
 import time
 
 # v5e: 197 bf16 TFLOP/s per chip (public Cloud TPU spec).
-PEAK_TFLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
+PEAK_TFLOPS = {"v6e": 918e12, "trillium": 918e12,
+               "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
                "v5": 197e12}
 DEFAULT_PEAK = 197e12
 

@@ -4,7 +4,7 @@ A ground-up rebuild of the capabilities of Nebuly `nos` (reference:
 /root/reference, a Go Kubernetes operator suite) for Cloud TPU:
 
 - **Dynamic TPU partitioning**: a cluster-scoped planner watches pending pods
-  requesting TPU slices and carves TPU pods (v4/v5e/v5p) into right-sized
+  requesting TPU slices and carves TPU pods (v4/v5e/v5p/v6e) into right-sized
   sub-slices (the analog of dynamic MIG partitioning; reference
   internal/partitioning/), actuated by per-node agents through a native
   C++ device shim (the analog of the NVML CGo boundary,

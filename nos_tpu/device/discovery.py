@@ -30,7 +30,7 @@ import re
 import sys
 from dataclasses import dataclass
 
-from nos_tpu.topology import Generation, Shape, V4, V5E, V5P
+from nos_tpu.topology import Generation, Shape, V4, V5E, V5P, V6E
 
 logger = logging.getLogger(__name__)
 
@@ -70,13 +70,15 @@ class DiscoveredTopology:
 
 # device_kind (PJRT) -> generation.  Public Cloud TPU device-kind strings.
 _KIND_PATTERNS: tuple[tuple[str, Generation], ...] = (
+    (r"v6e|v6\s*lite|trillium", V6E),
     (r"v5\s*lite|v5e", V5E),
     (r"v5p|v5$", V5P),      # v5p clients report "TPU v5p" or plain "TPU v5"
     (r"v4", V4),
 )
 
-# TPU_ACCELERATOR_TYPE prefixes ("v5litepod-4", "v4-8", "v5p-16").
+# TPU_ACCELERATOR_TYPE prefixes ("v5litepod-4", "v4-8", "v5p-16", "v6e-8").
 _ACCEL_PATTERNS: tuple[tuple[str, Generation], ...] = (
+    (r"^v6e", V6E),
     (r"^v5lite", V5E),
     (r"^v5e", V5E),
     (r"^v5p", V5P),

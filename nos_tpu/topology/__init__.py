@@ -1,7 +1,7 @@
 """TPU topology domain model (the analog of reference pkg/gpu/)."""
 
 from .shape import Shape
-from .known import Generation, TopologyRegistry, DEFAULT_REGISTRY, V4, V5E, V5P, GENERATIONS
+from .known import Generation, TopologyRegistry, DEFAULT_REGISTRY, V4, V5E, V5P, V6E, GENERATIONS
 from .geometry import (
     Geometry, geometry_equal, num_slices, fewest_slices_geometry,
     shapes_geometry, named_geometry,
@@ -14,7 +14,7 @@ from . import annotations, profile, errors
 
 __all__ = [
     "Shape", "Generation", "TopologyRegistry", "DEFAULT_REGISTRY",
-    "V4", "V5E", "V5P", "GENERATIONS",
+    "V4", "V5E", "V5P", "V6E", "GENERATIONS",
     "Geometry", "geometry_equal", "num_slices", "fewest_slices_geometry",
     "shapes_geometry", "named_geometry",
     "Placement", "pack", "feasible", "extend", "enumerate_tilings",

@@ -108,7 +108,21 @@ V5P = Generation(
     max_pod=Shape.parse("16x16x24"),
 )
 
-GENERATIONS: dict[str, Generation] = {g.name: g for g in (V5E, V4, V5P)}
+# v6e (Trillium): 2D mesh like v5e, 256-chip pods; multi-host slices use
+# 4-chip hosts (a 2x2 block), 32 GB HBM/chip (public Cloud TPU docs).
+V6E = Generation(
+    name="tpu-v6e",
+    ndims=2,
+    host_block=Shape.parse("2x2"),
+    hbm_gb_per_chip=32,
+    slice_shapes=_shapes(
+        "1x1", "1x2", "2x2",                            # single-host
+        "2x4", "4x4", "4x8", "8x8", "8x16", "16x16",    # multi-host
+    ),
+    max_pod=Shape.parse("16x16"),
+)
+
+GENERATIONS: dict[str, Generation] = {g.name: g for g in (V5E, V4, V5P, V6E)}
 
 
 @dataclass

@@ -82,6 +82,12 @@ class TestEnvDiscovery:
         d = discovery._discover_from_env({"TPU_ACCELERATOR_TYPE": "v5p-16"})
         assert d.generation is V5P
 
+    def test_v6e(self):
+        from nos_tpu.topology import V6E
+
+        d = discovery._discover_from_env({"TPU_ACCELERATOR_TYPE": "v6e-8"})
+        assert d.generation is V6E
+
     def test_unknown_type(self):
         assert discovery._discover_from_env(
             {"TPU_ACCELERATOR_TYPE": "v99-8"}) is None

@@ -58,6 +58,20 @@ class TestGenerations:
         assert {s.name for s in V5E.subhost_shapes()} == {"1x1", "1x2", "2x2", "2x4"}
         assert Shape.parse("4x4") in V5E.multihost_shapes()
 
+    def test_v6e_parameters(self):
+        from nos_tpu.topology import V6E
+
+        assert V6E.chips_per_host == 4
+        assert V6E.hbm_gb_per_chip == 32
+        assert {s.name for s in V6E.subhost_shapes()} == {"1x1", "1x2", "2x2"}
+        assert Shape.parse("2x4") in V6E.multihost_shapes()
+        assert V6E.hosts_for(Shape.parse("2x4")) == 2
+        assert V6E.hosts_for(Shape.parse("16x16")) == 64
+        assert V6E.host_grid(Shape.parse("16x16")).dims == (8, 8)
+        # the derived geometry table exists and is non-trivial
+        unit = SliceUnit(generation=V6E)
+        assert len(unit.allowed_geometries()) >= 3
+
     def test_hosts_for(self):
         assert V5E.hosts_for(Shape.parse("2x2")) == 1
         assert V5E.hosts_for(Shape.parse("4x4")) == 2
