@@ -102,13 +102,17 @@ def chip_equiv(pod) -> float:
 
 class Job:
     def __init__(self, name: str, pods: list, duration: float,
-                 created: float, cls: str = "") -> None:
+                 created: float, cls: str = "", kind: str = "",
+                 arg=None) -> None:
         self.name = name
         self.pods = pods
         self.duration = duration
         self.created = created
         self.cls = cls                      # e.g. "gang-4x8", "slice-1x1"
+        self.kind = kind                    # "slice" | "gang" | "ts"
+        self.arg = arg
         self.bound_at: float | None = None
+        self.evictions = 0
 
 
 class Sim:
@@ -149,8 +153,13 @@ class Sim:
             agent.start()
             self.agents.append(agent)
 
+        # Drain preemption on: after 40 cycles (10 virtual seconds) of a
+        # gang holding the lease, stragglers occupying <= 25% of the
+        # window are evicted and requeue (losing their progress — the
+        # sim's _requeue_evicted models the cost honestly).
         self.scheduler = Scheduler(
-            api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+            api, Framework([NodeResourcesFit(), TopologyFilter(api)]),
+            drain_preempt_after_cycles=40)
 
         self.jobs: dict[str, Job] = {}
         self._job_seq = 0
@@ -160,6 +169,7 @@ class Sim:
         self._util_area = 0.0
         self._util_time = 0.0
         self.completed = 0
+        self.drain_evictions = 0
 
     # -- trace -------------------------------------------------------------
     def _spawn(self) -> None:
@@ -192,7 +202,7 @@ class Sim:
                 pods.append(pod.metadata.name)
                 backlog += chip_equiv(pod)
             self.jobs[name] = Job(name, pods, duration, self.now[0],
-                                  cls=f"{kind}-{arg}")
+                                  cls=f"{kind}-{arg}", kind=kind, arg=arg)
 
     def _complete_finished(self) -> None:
         for job in list(self.jobs.values()):
@@ -210,6 +220,33 @@ class Sim:
                 pass
             del self.jobs[job.name]
             self.completed += 1
+
+    def _requeue_evicted(self) -> None:
+        """Honest eviction semantics: a job whose pods were evicted
+        (drain preemption) loses its progress — missing pods are
+        recreated with the ORIGINAL creation timestamp (its eventual
+        schedule latency includes the wasted run) and the duration
+        restarts at the next full bind."""
+        live = {p.metadata.name for p in self.api.list(KIND_POD)}
+        for job in self.jobs.values():
+            missing = [n for n in job.pods if n not in live]
+            if not missing:
+                continue
+            job.bound_at = None         # re-run from scratch once rebound
+            job.evictions += 1
+            self.drain_evictions += len(missing)
+            for pname in missing:
+                if job.kind == "ts":
+                    pod = make_timeshare_pod(
+                        job.arg, 1, name=pname,
+                        creation_timestamp=job.created)
+                else:
+                    labels = ({C.LABEL_POD_GROUP: job.name}
+                              if job.kind == "gang" else None)
+                    pod = make_slice_pod(
+                        job.arg, 1, name=pname, labels=labels,
+                        creation_timestamp=job.created)
+                self.api.create(KIND_POD, pod)
 
     def _record_binds(self) -> None:
         bound: dict[str, float] = {}
@@ -241,6 +278,7 @@ class Sim:
             t0 = time.perf_counter()
             self.scheduler.run_cycle()
             self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
+            self._requeue_evicted()
             self.slice_ctl.process_if_ready()
             self.ts_ctl.process_if_ready()
             for a in self.agents:
@@ -267,6 +305,7 @@ class Sim:
             "schedule_latency_by_class": by_class,
             "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
             "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
+            "drain_evicted_pods": self.drain_evictions,
         }
 
 
@@ -307,6 +346,7 @@ def run_seeds(seeds=range(5)) -> dict:
         "schedule_latency_by_class": latency_summary(by_class),
         "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
         "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
+        "drain_evicted_pods": sum(s_.drain_evictions for s_ in sims),
     }
 
 

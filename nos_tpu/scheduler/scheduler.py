@@ -17,6 +17,7 @@ import logging
 from nos_tpu.api.constants import (
     ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
     LABEL_ACCELERATOR as C_LABEL_ACCELERATOR,
+    LABEL_CHIP_COUNT as C_LABEL_CHIP_COUNT,
     LABEL_HOST_INDEX as C_LABEL_HOST_INDEX,
     LABEL_POD_GROUP as C_LABEL_POD_GROUP,
     LABEL_POD_ID as C_LABEL_POD_ID,
@@ -61,10 +62,25 @@ def _free_chip_equiv(ni: NodeInfo) -> float:
 
 class Scheduler:
     def __init__(self, api: APIServer, framework: Framework,
-                 name: str = "nos-tpu-scheduler") -> None:
+                 name: str = "nos-tpu-scheduler",
+                 drain_preempt_after_cycles: int | None = None,
+                 drain_preempt_max_busy_fraction: float = 0.25) -> None:
         self._api = api
         self._framework = framework
         self.name = name
+        # Drain preemption (opt-in): once a gang has held the window
+        # lease this many scheduling cycles, the last stragglers on the
+        # window (at most the given fraction of its chip capacity,
+        # PDB-respecting, whole-gang amplified) are EVICTED so the drain
+        # completes instead of waiting out their full durations.  The
+        # honest cost lands on the victims: they requeue and re-run
+        # (workloads checkpointing via cmd/train.py lose little).  None
+        # disables (default — eviction of healthy pods is a policy choice
+        # the operator must make).
+        self._drain_after = drain_preempt_after_cycles
+        self._drain_fraction = drain_preempt_max_busy_fraction
+        self._drain_cycles = 0
+        self._drain_gang: tuple[str, str] | None = None
         # Gang window lease: each cycle, the oldest stuck multi-host gang
         # reserves its currently most-drained candidate window (re-picked
         # every cycle — completions are stochastic, so tracking whichever
@@ -154,6 +170,7 @@ class Scheduler:
         self._lease_healed = True
         self._reserved_hosts = (self._lease[1] if self._lease is not None
                                 else frozenset())
+        self._maybe_drain_preempt()
         gangs: dict[tuple[str, str], list[Pod]] = {}
         for pod in pods:
             g = gang_name(pod)
@@ -288,6 +305,91 @@ class Scheduler:
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
+
+    def _maybe_drain_preempt(self) -> None:
+        """Evict the last stragglers off a long-held drain window (see
+        __init__).  Runs once per lease period: after an eviction the
+        counter goes into cooldown so surviving (PDB-protected) pods are
+        not hammered every cycle."""
+        if self._drain_after is None:
+            return
+        gang = self._lease[0] if self._lease is not None else None
+        if gang != self._drain_gang:
+            self._drain_gang, self._drain_cycles = gang, 0
+            return
+        if gang is None:
+            return
+        self._drain_cycles += 1
+        if self._drain_cycles < self._drain_after:
+            return
+
+        from nos_tpu.scheduler.gang import evict_gang
+        from nos_tpu.topology.profile import free_chip_equivalents
+
+        hosts = self._reserved_hosts
+        stragglers = [
+            p for p in self._api.list(KIND_POD)
+            if p.spec.node_name in hosts
+            and p.status.phase in (PENDING, RUNNING)
+            and (p.metadata.namespace, gang_name(p)) != gang]
+        if not stragglers:
+            return
+        capacity = 0.0
+        for node in self._api.list(KIND_NODE):
+            if node.metadata.name in hosts:
+                try:
+                    capacity += float(node.metadata.labels.get(
+                        C_LABEL_CHIP_COUNT, "0"))
+                except ValueError:
+                    pass
+        busy = sum(free_chip_equivalents(pod_request(p))
+                   for p in stragglers)
+        if capacity <= 0 or busy > self._drain_fraction * capacity:
+            return      # not the final stretch: keep waiting
+
+        # PDB respect: budget-charge each candidate's whole eviction set
+        # (evict_gang amplifies to every gang mate)
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, refresh_pdb_status,
+        )
+
+        pdbs = [refresh_pdb_status(self._api, pdb)
+                for pdb in self._api.list(KIND_POD_DISRUPTION_BUDGET)]
+        allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
+        evicted = 0
+        doomed_keys: set[str] = set()
+        for pod in stragglers:
+            if pod.key in doomed_keys:
+                continue
+            g = gang_name(pod)
+            members = [pod] if not g else self._api.list(
+                KIND_POD, namespace=pod.metadata.namespace,
+                label_selector={C_LABEL_POD_GROUP: g})
+            needed: dict[int, int] = {}
+            for m in members:
+                if m.status.phase != RUNNING or m.key in doomed_keys:
+                    continue
+                for i, pdb in enumerate(pdbs):
+                    if pdb.matches(m):
+                        needed[i] = needed.get(i, 0) + 1
+            if any(allowed[i] < n for i, n in needed.items()):
+                continue        # a budget lacks allowance: reprieve
+            for i, n in needed.items():
+                allowed[i] -= n
+            doomed_keys.update(m.key for m in members)
+            evicted += len(evict_gang(self._api, pod))
+        if evicted:
+            from nos_tpu.exporter.metrics import REGISTRY
+
+            REGISTRY.inc("nos_tpu_drain_preemptions_total",
+                         labels={"gang": f"{gang[0]}/{gang[1]}"},
+                         value=evicted)
+            logger.info(
+                "drain preemption for gang %s/%s: evicted %d straggler "
+                "pod(s) off %s after %d cycles", gang[0], gang[1],
+                evicted, sorted(hosts), self._drain_cycles)
+        # cooldown either way: give survivors/requeues a full period
+        self._drain_cycles = -self._drain_after
 
     def _order_gang_windows(self, windows):
         """Order candidate windows so the FIRST one that fits is also the
@@ -572,6 +674,20 @@ class Scheduler:
 
         return key
 
+    def _patch_pod(self, pod: Pod, mutate) -> None:
+        """A pod can vanish between this cycle's LIST and the patch —
+        deleted by a user, a controller, or this very cycle's drain
+        preemption (whole-gang amplification can doom a pod that is
+        still in the stale pending list).  A gone pod needs no status:
+        swallow NotFound instead of killing the scheduling cycle."""
+        from nos_tpu.kube.client import NotFound
+
+        try:
+            self._api.patch(KIND_POD, pod.metadata.name,
+                            pod.metadata.namespace, mutate=mutate)
+        except NotFound:
+            logger.debug("scheduler: pod %s vanished mid-cycle", pod.key)
+
     def _bind(self, pod: Pod, node_name: str) -> None:
         # Binding only (the /binding subresource against a real substrate).
         # phase=Running is the KUBELET's claim, not the scheduler's — the
@@ -583,18 +699,15 @@ class Scheduler:
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]
-        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                        mutate=mutate)
+        self._patch_pod(pod, mutate)
         logger.debug("scheduler: bound %s -> %s", pod.key, node_name)
 
     def _nominate(self, pod: Pod, node_name: str) -> None:
         def mutate(p: Pod) -> None:
             p.status.nominated_node_name = node_name
-        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                        mutate=mutate)
+        self._patch_pod(pod, mutate)
 
     def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
         def mutate(p: Pod) -> None:
             p.mark_unschedulable(status.message)
-        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                        mutate=mutate)
+        self._patch_pod(pod, mutate)

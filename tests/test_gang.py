@@ -403,3 +403,92 @@ class TestGangPreemption:
             pod = api.get(KIND_POD, f"a-{i}", "ns-a")
             assert pod.spec.node_name
             assert pod.status.phase == RUNNING
+
+
+class TestDrainPreemption:
+    """Opt-in eviction of the last stragglers off a long-held drain
+    window: the lease counts cycles; once past the threshold with the
+    stragglers at or under the busy fraction, they are evicted
+    (whole-gang amplified, PDB-respecting) so the window empties."""
+
+    def _cluster(self, after=3, fraction=0.25):
+        from nos_tpu.scheduler.framework import NodeResourcesFit
+        from nos_tpu.scheduler.gang import TopologyFilter
+
+        api = APIServer()
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api)])
+        # 4 slice hosts in one domain, each advertising one 4x8 share +
+        # a 1x1: a 4-host window for the gang, small slices for noise
+        for h in range(4):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{h}", pod_id="pod-a", host_index=h,
+                status_geometry={"free": {"2x4": 1}}))
+        sched = Scheduler(api, fw, drain_preempt_after_cycles=after,
+                          drain_preempt_max_busy_fraction=fraction)
+        return api, sched
+
+    def _stuck_gang(self, api):
+        create_pod_group(api, "big", min_member=4)
+        for i in range(4):
+            api.create(KIND_POD, make_slice_pod(
+                "4x8", 1, name=f"big-{i}",
+                labels={C.LABEL_POD_GROUP: "big"}))
+
+    def test_straggler_evicted_after_threshold(self):
+        api, sched = self._cluster(after=3)
+        # a straggler single occupying one host's whole 2x4
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="straggler",
+                                            node_name="host-1",
+                                            phase=RUNNING))
+        self._stuck_gang(api)
+        # cycle 1 earns the lease; cycle 2 adopts it into the drain
+        # counter; cycles 3-4 accumulate; cycle 5 crosses the threshold
+        for _ in range(4):
+            sched.run_cycle()
+            assert api.try_get(KIND_POD, "straggler", "default") is not None
+        sched.run_cycle()       # threshold crossed: eviction
+        assert api.try_get(KIND_POD, "straggler", "default") is None
+
+    def test_too_busy_window_not_preempted(self):
+        api, sched = self._cluster(after=2, fraction=0.25)
+        # stragglers hold 16 of 32 chips: 50% > 25% — wait, don't evict
+        for h in (0, 1):
+            api.create(KIND_POD, make_slice_pod(
+                "2x4", 1, name=f"busy-{h}", node_name=f"host-{h}",
+                phase=RUNNING))
+        self._stuck_gang(api)
+        for _ in range(6):
+            sched.run_cycle()
+        assert api.try_get(KIND_POD, "busy-0", "default") is not None
+        assert api.try_get(KIND_POD, "busy-1", "default") is not None
+
+    def test_pdb_protected_straggler_reprieved(self):
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+
+        api, sched = self._cluster(after=2)
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="protected", node_name="host-1", phase=RUNNING,
+            labels={"app": "serving"}))
+        api.create(KIND_POD_DISRUPTION_BUDGET, PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="default"),
+            spec=PodDisruptionBudgetSpec(min_available=1,
+                                         selector={"app": "serving"})))
+        self._stuck_gang(api)
+        for _ in range(6):
+            sched.run_cycle()
+        assert api.try_get(KIND_POD, "protected", "default") is not None
+
+    def test_disabled_by_default(self):
+        api, sched = self._cluster()
+        sched2 = Scheduler(api, Framework([]))
+        assert sched2._drain_after is None
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="s",
+                                            node_name="host-1",
+                                            phase=RUNNING))
+        self._stuck_gang(api)
+        for _ in range(10):
+            sched2.run_cycle()
+        assert api.try_get(KIND_POD, "s", "default") is not None
