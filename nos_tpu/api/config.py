@@ -89,6 +89,11 @@ class SchedulerConfig(ManagerConfig):
 
     tpu_memory_gb_per_chip: int = 16
     cycle_interval_s: float = 0.05
+    # Drain preemption (docs/scheduler.md): 0 disables (default); N > 0
+    # evicts the last stragglers off a gang's drain window after it has
+    # been leased N scheduling cycles.
+    drain_preempt_after_cycles: int = 0
+    drain_preempt_max_busy_fraction: float = 0.25
 
     def validate(self) -> None:
         super().validate()
@@ -96,6 +101,11 @@ class SchedulerConfig(ManagerConfig):
             raise ConfigError("tpu_memory_gb_per_chip must be positive")
         if self.cycle_interval_s <= 0:
             raise ConfigError("cycle_interval_s must be positive")
+        if self.drain_preempt_after_cycles < 0:
+            raise ConfigError("drain_preempt_after_cycles must be >= 0")
+        if not 0 < self.drain_preempt_max_busy_fraction <= 1:
+            raise ConfigError(
+                "drain_preempt_max_busy_fraction must be in (0, 1]")
 
 
 @dataclasses.dataclass

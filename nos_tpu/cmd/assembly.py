@@ -74,7 +74,10 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
 
 
 def build_scheduler(api: APIServer,
-                    tpu_memory_gb_per_chip: int = 16) -> Scheduler:
+                    tpu_memory_gb_per_chip: int = 16,
+                    drain_preempt_after_cycles: int = 0,
+                    drain_preempt_max_busy_fraction: float = 0.25
+                    ) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
     from nos_tpu.quota import TPUResourceCalculator
@@ -83,4 +86,7 @@ def build_scheduler(api: APIServer,
     fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
     plugin.set_framework(fw)
     plugin.attach(api)
-    return Scheduler(api, fw)
+    return Scheduler(
+        api, fw,
+        drain_preempt_after_cycles=drain_preempt_after_cycles or None,
+        drain_preempt_max_busy_fraction=drain_preempt_max_busy_fraction)

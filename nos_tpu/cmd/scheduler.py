@@ -33,7 +33,10 @@ def main(argv=None) -> int:
         print(f'invalid config: {e}', file=sys.stderr)
         return 2
     api = build_api(cfg)
-    scheduler = build_scheduler(api, cfg.tpu_memory_gb_per_chip)
+    scheduler = build_scheduler(
+        api, cfg.tpu_memory_gb_per_chip,
+        drain_preempt_after_cycles=cfg.drain_preempt_after_cycles,
+        drain_preempt_max_busy_fraction=cfg.drain_preempt_max_busy_fraction)
     m = Main("nos-tpu-scheduler", cfg.health_probe_addr, api=api)
     if cfg.leader_election:
         from nos_tpu.kube.leaderelection import LeaderElector
