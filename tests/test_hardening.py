@@ -317,15 +317,18 @@ class TestSchedulerScale64Hosts:
 
         cycles = []
         for _ in range(12):
-            t0 = time.perf_counter()
+            # process CPU time, not wall time: the bound is about the
+            # scheduler's own cost at 64-host scale, and wall time
+            # starves under parallel load (benchmarks, CI neighbors)
+            t0 = time.process_time()
             scheduler.run_cycle()
-            cycles.append(time.perf_counter() - t0)
+            cycles.append(time.process_time() - t0)
         cycles.sort()
-        # median bounds the steady-state cost robustly under CI load;
+        # median bounds the steady-state cost robustly;
         # the max is a gross-regression tripwire only
         p50, worst = cycles[len(cycles) // 2], cycles[-1]
-        assert p50 < 1.0, f"64-host cycle p50 {p50:.3f}s"
-        assert worst < 10.0, f"64-host cycle worst {worst:.3f}s"
+        assert p50 < 1.0, f"64-host cycle p50 {p50:.3f}s CPU"
+        assert worst < 10.0, f"64-host cycle worst {worst:.3f}s CPU"
         bound = sum(1 for p in api.list(KIND_POD) if p.spec.node_name)
         assert bound > 0
 
