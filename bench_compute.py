@@ -14,6 +14,12 @@ Measures, on the real chip (skipped off-TPU):
 - how this host's topology was learned (`topology_source`:
   device/env/configured — nos_tpu/device/discovery.py).
 
+Noise caveat: sub-millisecond KERNEL timings (flash fwd/bwd) vary up to
+2x run to run through the tunnel even with the slope method — judge
+kernels on the best of several runs or on relative comparisons within
+one run.  The step-level metrics (step_time_ms, mfu, tokens_per_s) are
+seconds-long chains and stable to a few tenths of a percent.
+
 Timing methodology: the 'axon' tunneled platform does not block in
 `block_until_ready` (device work completes asynchronously behind the
 tunnel), so each measurement chains N iterations data-dependently inside a
