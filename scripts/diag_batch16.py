@@ -60,15 +60,14 @@ def main() -> None:
         return
     peak = peak_for(jax.devices()[0].device_kind)
     VARIANTS = [
-        # (batch, scan_layers, remat, n_layers).  Round 1 of this matrix
-        # established: the "rejection" is HBM OOM in the AOT compiler
-        # ("mats" saved activations ~10 GB at batch 16 don't fit beside
-        # params+opt); full remat fits but loses (0.464 vs batch-8's
-        # 0.544).  Round 2: the middle ground.
-        (12, False, "mats", 24),    # ~7.5 GB saved: does batch 12 fit?
-        (16, False, "attn", 24),    # save only attn_out (~1.5 GB)
-        (16, False, "mlp", 24),     # save only mlp gate/up
-        (12, False, "all_mats", 24),
+        # (batch, scan_layers, remat, n_layers).  Round 3 of the matrix
+        # re-tested remat policies at batch 8 with the FUSED backward:
+        # all_mats 0.5478 / mats 0.5456 / dots 0.5409 MFU — a plateau
+        # within tunnel noise; the binding constraint is HBM traffic,
+        # not recompute, exactly as r3 concluded with the split kernels.
+        (8, False, "all_mats", 24),
+        (8, False, "dots", 24),
+        (8, False, "mats", 24),     # control
     ]
     for batch, scan, remat, layers in VARIANTS:
         tag = {"batch": batch, "scan": scan, "remat": remat,
