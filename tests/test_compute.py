@@ -171,6 +171,39 @@ class TestFlashAttention:
         assert jnp.array_equal(repeat_kv(x, 1), x)
 
 
+class TestFusedBwdBudgetFallback:
+    def test_budget_exceeded_selects_split(self, monkeypatch):
+        """Long-context shapes whose dq-partial buffer exceeds the budget
+        must take the split kernels (no partial buffer) — validated on
+        real hardware at B16 S8192 (2 GiB partials, r4); here the
+        selection logic is pinned with a shrunken budget."""
+        from nos_tpu.ops import attention as A
+
+        calls = []
+        real_fused, real_split = A._flash_backward_fused, A._flash_backward
+        monkeypatch.setattr(
+            A, "_flash_backward_fused",
+            lambda *a, **k: calls.append("fused") or real_fused(*a, **k))
+        monkeypatch.setattr(
+            A, "_flash_backward",
+            lambda *a, **k: calls.append("split") or real_split(*a, **k))
+
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(kk, (1, 256, 1, 128), jnp.float32)
+                   for kk in jax.random.split(key, 3))
+
+        def loss(q, k, v):
+            return (flash_attention(q, k, v, True, 128, 128, True) ** 2).sum()
+
+        jax.grad(loss, (0, 1, 2))(q, k, v)
+        assert calls == ["fused"]
+
+        calls.clear()
+        monkeypatch.setattr(A, "FUSED_PARTIAL_BUDGET", 1)
+        jax.grad(loss, (0, 1, 2))(q, k, v)
+        assert calls == ["split"]
+
+
 class TestLlama:
     def test_forward_shape_and_finite(self):
         model = Llama(TINY)
