@@ -88,12 +88,24 @@ class SchedulerConfig(ManagerConfig):
     currency conversion, reference pkg/api/scheduler/types.go:23-27)."""
 
     tpu_memory_gb_per_chip: int = 16
+    # Host-shard quota accounting for multi-host slices (see
+    # quota/calculator.py): 0 = charge each unit its full shape (an
+    # N-host gang books the slice N times); the cluster generation's
+    # chips-per-host (8 for v4/v5e/v5p/v6e) charges each member only
+    # the shard it owns.  MUST match the operator's setting — the
+    # preemptor's ledger and the reconciler's over-quota labels speak
+    # the same currency or victim selection goes incoherent.
+    shard_chips_per_host: int = 0
     cycle_interval_s: float = 0.05
     # Drain preemption (docs/scheduler.md): 0 disables (default); N > 0
     # evicts the last stragglers off a gang's drain window after it has
     # been leased N scheduling cycles.
     drain_preempt_after_cycles: int = 0
     drain_preempt_max_busy_fraction: float = 0.25
+    # Stragglers whose reported progress (ANNOT_JOB_PROGRESS) has reached
+    # this fraction are never drain-evicted: they free the window by
+    # finishing, and evicting one wastes its whole run.
+    drain_preempt_spare_progress: float = 0.75
 
     def validate(self) -> None:
         super().validate()
@@ -106,6 +118,11 @@ class SchedulerConfig(ManagerConfig):
         if not 0 < self.drain_preempt_max_busy_fraction <= 1:
             raise ConfigError(
                 "drain_preempt_max_busy_fraction must be in (0, 1]")
+        if not 0 < self.drain_preempt_spare_progress <= 1:
+            raise ConfigError(
+                "drain_preempt_spare_progress must be in (0, 1]")
+        if self.shard_chips_per_host < 0:
+            raise ConfigError("shard_chips_per_host must be >= 0")
 
 
 @dataclasses.dataclass
@@ -113,6 +130,9 @@ class OperatorConfig(ManagerConfig):
     """operator main config (OperatorConfig analog)."""
 
     tpu_memory_gb_per_chip: int = 16
+    # Host-shard quota accounting; MUST match the scheduler's
+    # shard_chips_per_host (see SchedulerConfig).
+    shard_chips_per_host: int = 0
     resync_interval_s: float = 5.0
     # HTTPS AdmissionReview endpoint (kube/webhook.py): 0 disables; the
     # chart serves 9443 with certs mounted at webhook_cert_dir
@@ -128,6 +148,8 @@ class OperatorConfig(ManagerConfig):
             raise ConfigError("resync_interval_s must be positive")
         if self.webhook_port < 0 or self.webhook_port > 65535:
             raise ConfigError("webhook_port must be in [0, 65535]")
+        if self.shard_chips_per_host < 0:
+            raise ConfigError("shard_chips_per_host must be >= 0")
 
 
 @dataclasses.dataclass

@@ -114,6 +114,14 @@ ANNOT_GANG_LEASE = f"{GROUP}/gang-window-lease"
 # shape chooser carve slices with usable ICI topology (SURVEY.md §2.8).
 ANNOT_MESH = f"{GROUP}/mesh"
 
+# Workload-reported progress fraction in [0, 1] (e.g. checkpointed steps /
+# total steps), refreshed by the job on each checkpoint.  Drain preemption
+# (scheduler.py) prefers victims with the LEAST progress — evicting a job
+# seconds from finishing wastes its whole run, while a fresh one loses
+# nothing — and spares near-done stragglers entirely (they drain the window
+# for free by completing).  Absent = 0 (nothing to lose).
+ANNOT_JOB_PROGRESS = f"{GROUP}/job-progress"
+
 # Reported device-plugin generation for timeshare nodes: replaces the
 # reference's blind time.Sleep(devicePluginDelaySeconds)
 # (mps/partitioner.go:99-100) with a generation-stamped readiness handshake.

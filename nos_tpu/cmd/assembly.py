@@ -76,17 +76,22 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
 def build_scheduler(api: APIServer,
                     tpu_memory_gb_per_chip: int = 16,
                     drain_preempt_after_cycles: int = 0,
-                    drain_preempt_max_busy_fraction: float = 0.25
-                    ) -> Scheduler:
+                    drain_preempt_max_busy_fraction: float = 0.25,
+                    drain_preempt_spare_progress: float = 0.75,
+                    drain_preempt_progress_fn=None,
+                    shard_chips_per_host: int = 0) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
     from nos_tpu.quota import TPUResourceCalculator
 
-    plugin = CapacityScheduling(TPUResourceCalculator(tpu_memory_gb_per_chip))
+    plugin = CapacityScheduling(TPUResourceCalculator(
+        tpu_memory_gb_per_chip, shard_chips_per_host))
     fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
     plugin.set_framework(fw)
     plugin.attach(api)
     return Scheduler(
         api, fw,
         drain_preempt_after_cycles=drain_preempt_after_cycles or None,
-        drain_preempt_max_busy_fraction=drain_preempt_max_busy_fraction)
+        drain_preempt_max_busy_fraction=drain_preempt_max_busy_fraction,
+        drain_preempt_spare_progress=drain_preempt_spare_progress,
+        drain_preempt_progress_fn=drain_preempt_progress_fn)

@@ -70,7 +70,8 @@ def build_operator_main(api: APIServer, cfg: OperatorConfig,
     if cfg.webhook_port > 0:
         main.webhook = _serve_admission_webhook(api, cfg)
         main.add_shutdown_hook(main.webhook.stop)
-    calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip)
+    calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip,
+                                 cfg.shard_chips_per_host)
 
     def bind_reconcilers() -> None:
         """The reconcilers write (EQ status, overlap deletion), so with

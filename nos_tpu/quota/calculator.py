@@ -23,10 +23,31 @@ class TPUResourceCalculator:
     `hbm_gb_per_chip` plays the role of the reference's
     `nvidiaGpuResourceMemoryGB` operator config (default 32 GB there;
     16 GB here = v5e chip HBM).
+
+    `chips_per_host` (optional, 0 = off) enables host-shard accounting
+    for multi-host slices: one unit of a multi-host slice resource is
+    one HOST-SHARD of the instance (the partitioner advertises one
+    shard per member host — partitioning/slicepart/group.py — and each
+    gang member binds one), so a member is charged the chips it
+    physically owns, `shape.chips / hosts`, not the whole slice.  With
+    0, every unit is charged its full shape — each member of an
+    N-host gang then books the slice N times, which overstates a
+    gang-heavy namespace's usage N-fold against its quota.  Set it to
+    the cluster generation's chips-per-host (8 for v4/v5e/v5p/v6e
+    host blocks) unless generations are mixed.
     """
 
-    def __init__(self, hbm_gb_per_chip: int = 16) -> None:
+    def __init__(self, hbm_gb_per_chip: int = 16,
+                 chips_per_host: int = 0) -> None:
         self.hbm_gb_per_chip = hbm_gb_per_chip
+        self.chips_per_host = chips_per_host
+
+    def _unit_chips(self, shape) -> int:
+        """Chips charged for ONE unit of a slice resource."""
+        if 0 < self.chips_per_host < shape.chips \
+                and shape.chips % self.chips_per_host == 0:
+            return self.chips_per_host
+        return shape.chips
 
     def compute_pod_request(self, pod) -> ResourceList:
         req = pod_request(pod)
@@ -43,7 +64,8 @@ class TPUResourceCalculator:
                 continue
             shape = shape_from_resource(resource)
             if shape is not None:
-                total += shape.chips * self.hbm_gb_per_chip * int(qty)
+                total += self._unit_chips(shape) * self.hbm_gb_per_chip \
+                    * int(qty)
                 continue
             gb = gb_from_resource(resource)
             if gb is not None:

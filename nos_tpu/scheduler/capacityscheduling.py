@@ -94,6 +94,11 @@ class CapacityScheduling:
         self.elastic_quota_infos = ElasticQuotaInfos()
         self._api: APIServer | None = None
         self._framework: Framework | None = None
+        # Optional observer called as on_preempt(preemptor, victims) just
+        # before each eviction — how the utilization bench audits that
+        # every cross-namespace victim carried the over-quota label
+        # (falsifiable fairness invariant).  None = no observer.
+        self.on_preempt = None
 
     # ------------------------------------------------------------------
     # Wiring
@@ -317,6 +322,8 @@ class CapacityScheduling:
 
         best = min(candidates, key=self._candidate_key)
         node_name, victims, _ = best
+        if self.on_preempt is not None:
+            self.on_preempt(pod, victims)
         self._evict_all(victims)
         from nos_tpu.exporter.metrics import REGISTRY
 

@@ -60,11 +60,31 @@ def _free_chip_equiv(ni: NodeInfo) -> float:
     return free_chip_equivalents(ni.free())
 
 
+def _annotation_progress(pod: Pod) -> float:
+    """Default drain-preemption progress source: the workload-reported
+    ANNOT_JOB_PROGRESS fraction (absent/garbage/non-finite = 0: nothing
+    to lose)."""
+    import math
+
+    from nos_tpu.api.constants import ANNOT_JOB_PROGRESS
+
+    raw = pod.metadata.annotations.get(ANNOT_JOB_PROGRESS, "")
+    try:
+        value = float(raw)
+    except ValueError:
+        return 0.0
+    if not math.isfinite(value):
+        return 0.0
+    return min(1.0, max(0.0, value))
+
+
 class Scheduler:
     def __init__(self, api: APIServer, framework: Framework,
                  name: str = "nos-tpu-scheduler",
                  drain_preempt_after_cycles: int | None = None,
-                 drain_preempt_max_busy_fraction: float = 0.25) -> None:
+                 drain_preempt_max_busy_fraction: float = 0.25,
+                 drain_preempt_spare_progress: float = 0.75,
+                 drain_preempt_progress_fn=None) -> None:
         self._api = api
         self._framework = framework
         self.name = name
@@ -77,8 +97,19 @@ class Scheduler:
         # (workloads checkpointing via cmd/train.py lose little).  None
         # disables (default — eviction of healthy pods is a policy choice
         # the operator must make).
+        #
+        # Victim selection is remaining-work-aware: stragglers are walked
+        # least-progress-first, and any straggler whose reported progress
+        # (ANNOT_JOB_PROGRESS, or `drain_preempt_progress_fn(pod)` when
+        # injected — the simulator passes its job table; production jobs
+        # annotate on checkpoint) has reached `drain_preempt_spare_progress`
+        # is never evicted: a nearly-done job drains the window for free by
+        # finishing, and evicting it wastes its whole run.
         self._drain_after = drain_preempt_after_cycles
         self._drain_fraction = drain_preempt_max_busy_fraction
+        self._drain_spare_progress = drain_preempt_spare_progress
+        self._progress_fn = (drain_preempt_progress_fn
+                             or _annotation_progress)
         self._drain_cycles = 0
         self._drain_gang: tuple[str, str] | None = None
         # Gang window lease: each cycle, the oldest stuck multi-host gang
@@ -358,6 +389,31 @@ class Scheduler:
         allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
         evicted = 0
         doomed_keys: set[str] = set()
+        # Least progress first; near-done stragglers are spared outright
+        # (they free the window by finishing — evicting one wastes its
+        # whole run for seconds of drain time).  Progress is GANG-level
+        # (max over members): eviction is whole-gang amplified, so a
+        # member with an unannotated mate must not sneak its nearly-done
+        # gang past the spare filter.
+        prog_cache: dict[tuple[str, str], float] = {}
+
+        def progress(p: Pod) -> float:
+            g = gang_name(p)
+            if not g:
+                return self._progress_fn(p)
+            key = (p.metadata.namespace, g)
+            if key not in prog_cache:
+                mates = self._api.list(
+                    KIND_POD, namespace=p.metadata.namespace,
+                    label_selector={C_LABEL_POD_GROUP: g})
+                prog_cache[key] = max(
+                    [self._progress_fn(m) for m in mates] or [0.0])
+            return prog_cache[key]
+
+        stragglers = sorted(
+            (p for p in stragglers
+             if progress(p) < self._drain_spare_progress),
+            key=progress)
         for pod in stragglers:
             if pod.key in doomed_keys:
                 continue
@@ -388,8 +444,12 @@ class Scheduler:
                 "drain preemption for gang %s/%s: evicted %d straggler "
                 "pod(s) off %s after %d cycles", gang[0], gang[1],
                 evicted, sorted(hosts), self._drain_cycles)
-        # cooldown either way: give survivors/requeues a full period
-        self._drain_cycles = -self._drain_after
+        # Cooldown either way: the counter restarts, so survivors (spared
+        # or PDB-reprieved) get another full drain_preempt_after_cycles
+        # before the next attempt — attempts fire every N cycles, as the
+        # config documents (the first attempt lands ~2 cycles later than
+        # N: one cycle to adopt the lease, one to arm the counter).
+        self._drain_cycles = 0
 
     def _order_gang_windows(self, windows):
         """Order candidate windows so the FIRST one that fits is also the

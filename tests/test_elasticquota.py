@@ -77,6 +77,20 @@ class TestTPUResourceCalculator:
         })
         assert CALC.compute_pod_request(pod)[TPU_MEM] == 16 + 16 + 4
 
+    def test_multihost_shard_accounting(self):
+        """With chips_per_host set, one unit of a multi-host slice is
+        charged as one host-shard (the chips the member physically owns,
+        quota/calculator.py); sub-host shapes are unaffected, and the
+        default (0) keeps full-shape charging."""
+        from nos_tpu.quota import TPUResourceCalculator
+
+        shard_calc = TPUResourceCalculator(16, chips_per_host=8)
+        gang_member = make_pod(resources={f"{C.RESOURCE_SLICE_PREFIX}4x8": 1})
+        assert shard_calc.compute_pod_request(gang_member)[TPU_MEM] == 8 * 16
+        assert CALC.compute_pod_request(gang_member)[TPU_MEM] == 32 * 16
+        small = make_pod(resources={f"{C.RESOURCE_SLICE_PREFIX}2x2": 1})
+        assert shard_calc.compute_pod_request(small)[TPU_MEM] == 4 * 16
+
 
 # ---------------------------------------------------------------------------
 # Quota ledger arithmetic (reference elasticquotainfo_test.go)

@@ -481,6 +481,71 @@ class TestDrainPreemption:
             sched.run_cycle()
         assert api.try_get(KIND_POD, "protected", "default") is not None
 
+    def test_near_done_straggler_spared(self):
+        """Remaining-work-aware selection: a straggler whose reported
+        progress (ANNOT_JOB_PROGRESS) reached the spare threshold is
+        never drain-evicted — it frees the window by finishing — while
+        a fresh straggler on the same window still is."""
+        api, sched = self._cluster(after=3, fraction=0.5)
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="nearly-done", node_name="host-1",
+            phase=RUNNING,
+            annotations={C.ANNOT_JOB_PROGRESS: "0.9"}))
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="fresh", node_name="host-2", phase=RUNNING,
+            annotations={C.ANNOT_JOB_PROGRESS: "0.1"}))
+        self._stuck_gang(api)
+        for _ in range(6):
+            sched.run_cycle()
+        assert api.try_get(KIND_POD, "nearly-done", "default") is not None
+        assert api.try_get(KIND_POD, "fresh", "default") is None
+
+    def test_gang_straggler_spared_by_mates_progress(self):
+        """Sparing is gang-level: eviction amplifies to the whole gang,
+        so a member with no progress annotation is spared when any
+        gang-mate reports progress past the threshold ('inf' from a
+        buggy mate reads as 0, not an auto-spare)."""
+        api, sched = self._cluster(after=3, fraction=0.8)
+        create_pod_group(api, "straggler-gang", min_member=2)
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="sg-0", node_name="host-1", phase=RUNNING,
+            labels={C.LABEL_POD_GROUP: "straggler-gang"},
+            annotations={C.ANNOT_JOB_PROGRESS: "0.9"}))
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="sg-1", node_name="host-2", phase=RUNNING,
+            labels={C.LABEL_POD_GROUP: "straggler-gang"}))
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="inf-pod", node_name="host-3", phase=RUNNING,
+            annotations={C.ANNOT_JOB_PROGRESS: "inf"}))
+        self._stuck_gang(api)
+        for _ in range(6):
+            sched.run_cycle()
+        assert api.try_get(KIND_POD, "sg-0", "default") is not None
+        assert api.try_get(KIND_POD, "sg-1", "default") is not None
+        assert api.try_get(KIND_POD, "inf-pod", "default") is None
+
+    def test_progress_fn_injection(self):
+        """A simulation's progress table (drain_preempt_progress_fn)
+        replaces the annotation source."""
+        from nos_tpu.scheduler.framework import NodeResourcesFit
+        from nos_tpu.scheduler.gang import TopologyFilter
+
+        api = APIServer()
+        fw = Framework([NodeResourcesFit(), TopologyFilter(api)])
+        for h in range(4):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{h}", pod_id="pod-a", host_index=h,
+                status_geometry={"free": {"2x4": 1}}))
+        sched = Scheduler(
+            api, fw, drain_preempt_after_cycles=3,
+            drain_preempt_progress_fn=lambda p: 0.95)
+        api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name="s", node_name="host-1", phase=RUNNING))
+        self._stuck_gang(api)
+        for _ in range(8):
+            sched.run_cycle()
+        assert api.try_get(KIND_POD, "s", "default") is not None
+
     def test_disabled_by_default(self):
         api, sched = self._cluster()
         sched2 = Scheduler(api, Framework([]))
