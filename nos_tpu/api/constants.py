@@ -25,6 +25,11 @@ GROUP = "nos.tpu"
 # (pkg/gpu/partitioning.go:81-135).
 LABEL_PARTITIONING = f"{GROUP}/tpu-partitioning"
 
+# Hybrid-node family boundary: the slice family's sub-block (a row-major
+# prefix of the host block, e.g. "1x4" on a 2x4 v5e host — slice owns
+# chips 0-3, timeshare owns 4-7).  See nos_tpu/topology/hybrid.py.
+LABEL_SLICE_BLOCK = f"{GROUP}/slice-block"
+
 # Quota standing of a running pod, stamped by the ElasticQuota reconciler.
 # Reference: nos.nebuly.com/capacity (pkg/api/.../labels.go:19-24).
 LABEL_CAPACITY = f"{GROUP}/capacity"

@@ -34,15 +34,22 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
         # would let the partitioner carve nonexistent hardware.
         import dataclasses
 
-        runtime = default_tpu_runtime(None)
-        generation_name, host_block = runtime.topology()
+        discovery_runtime = default_tpu_runtime(None)
+        generation_name, host_block = discovery_runtime.topology()
         generation = dataclasses.replace(
             DEFAULT_REGISTRY.get(generation_name), host_block=host_block)
     else:
+        discovery_runtime = None
         generation = DEFAULT_REGISTRY.get(cfg.generation)
-        runtime = default_tpu_runtime(generation)
+    discovered = generation
     try:
-        api.get(KIND_NODE, cfg.node_name)
+        node = api.get(KIND_NODE, cfg.node_name)
+        # Hybrid node: the slice family carves only its sub-block
+        # (topology/hybrid.py) — the runtime must agree with the planner
+        # on the block or actuation packs onto timeshare-owned chips.
+        from nos_tpu.topology.hybrid import slice_generation_for
+
+        generation = slice_generation_for(node.metadata.labels, generation)
     except NotFound:
         if isinstance(api, APIServer):
             # standalone demo process: self-register the node object (a
@@ -57,6 +64,13 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
             raise ConfigError(
                 f"node {cfg.node_name!r} not found in the cluster "
                 f"(kubelet not registered yet, or --node is wrong)")
+    # Reuse the discovery runtime when the hybrid split left the
+    # generation unchanged (the common case) — constructing a second
+    # native runtime per agent start is waste.
+    if discovery_runtime is not None and generation is discovered:
+        runtime = discovery_runtime
+    else:
+        runtime = default_tpu_runtime(generation)
     main = main or Main(f"nos-tpu-sliceagent-{cfg.node_name}",
                         cfg.health_probe_addr, api=api)
     # Device usage source follows the SAME production switch as the API

@@ -31,7 +31,12 @@ def units_from_node(node: Node,
     """Reconstruct per-unit used/free state from status annotations
     (the agent-reported observed geometry)."""
     accel = node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
-    gen = registry.get(accel)
+    from nos_tpu.topology.hybrid import slice_generation_for
+
+    # Hybrid node: the slice family builds geometry against its OWN
+    # sub-block (topology/hybrid.py) so it never packs onto chips the
+    # timeshare family owns.
+    gen = slice_generation_for(node.metadata.labels, registry.get(accel))
     units: dict[int, SliceUnit] = {}
     for a in parse_status_annotations(node.metadata.annotations):
         if "x" not in a.profile:
@@ -69,9 +74,15 @@ class SliceNode(PartitionableNode):
         self._node_info = node_info
         self._registry = registry
         self.units = units_from_node(node, registry)
-        self.generation = registry.get(
-            node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
-        )
+        from nos_tpu.topology.hybrid import slice_generation_for
+
+        # Must match the units' generation: on a hybrid node the group
+        # pass sizes multi-host windows from THIS generation's
+        # chips_per_host — the full block would over-count the hybrid
+        # member's contribution by the timeshare family's chips.
+        self.generation = slice_generation_for(
+            node.metadata.labels,
+            registry.get(node.metadata.labels.get(C.LABEL_ACCELERATOR, "")))
         self._claim_bound_pod_usage()
         self._sync_allocatable()
 

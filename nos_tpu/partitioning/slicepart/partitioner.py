@@ -59,9 +59,14 @@ class SliceNodeInitializer(NodeInitializer):
         self._registry = registry
 
     def init_node_partitioning(self, node_name: str) -> None:
+        from nos_tpu.topology.hybrid import slice_generation_for
+
         node = self._api.get(KIND_NODE, node_name)
         accel = node.metadata.labels.get(C.LABEL_ACCELERATOR, "")
-        gen = self._registry.get(accel)
+        # Hybrid node: the virgin whole-block slice covers the slice
+        # family's sub-block only (topology/hybrid.py).
+        gen = slice_generation_for(node.metadata.labels,
+                                   self._registry.get(accel))
         geometries = {0: {gen.host_block.canonical().name: 1}}
 
         def mutate(n: Node) -> None:

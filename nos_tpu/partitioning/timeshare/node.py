@@ -28,14 +28,24 @@ from ..core.usage import claim_bound_pod_usage
 def units_from_node(node: Node,
                     registry: TopologyRegistry = DEFAULT_REGISTRY
                     ) -> list[TimeshareUnit]:
+    from nos_tpu.topology.hybrid import timeshare_cells
+
     gen = registry.get(node.metadata.labels.get(C.LABEL_ACCELERATOR, ""))
+    # Hybrid node: only the chips the timeshare family owns become units
+    # (topology/hybrid.py); the slice family's prefix chips never carry
+    # timeshare replicas, so the two strategies cannot oversubscribe the
+    # block.  None = pure timeshare node, all chips.
+    owned = timeshare_cells(node.metadata.labels, gen)
     units = {
         i: TimeshareUnit(hbm_gb=gen.hbm_gb_per_chip, index=i)
         for i in range(gen.chips_per_host)
+        if owned is None or i in owned
     }
     for a in parse_status_annotations(node.metadata.annotations):
         if not a.profile.endswith("gb") or "x" in a.profile:
             continue  # slice annotation on a hybrid node
+        if owned is not None and a.index not in owned:
+            continue  # stale replica report on a slice-family chip
         unit = units.setdefault(
             a.index, TimeshareUnit(hbm_gb=gen.hbm_gb_per_chip, index=a.index))
         gb = int(a.profile[:-2])

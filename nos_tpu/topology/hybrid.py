@@ -1,0 +1,108 @@
+"""Hybrid-node family split: which chips each strategy owns.
+
+The reference's hybrid partitioning assigns each GPU of a node to exactly
+one strategy — MIG-enabled GPUs to the mig strategy, the rest to slicing
+(pkg/gpu/partitioning.go:81-135) — so the strategies never contend for a
+device.  A TPU host has one chip block rather than discrete GPUs, so the
+analog is a static per-node split of the block: the **slice family owns a
+leading row-major prefix** of the host block and the **timeshare family
+owns the remaining chips**.  The prefix constraint is load-bearing: the
+slice sub-block's row-major cell ids then EQUAL the physical chip ids, so
+placements, device grants and TPU_VISIBLE_CHIPS need no re-mapping.
+
+The boundary is configured with the `nos.tpu/slice-block` node label
+(e.g. "1x4" on a 2x4 v5e host: slice owns chips 0-3, timeshare 4-7).
+Absent or invalid, the default halves the first axis of size >= 2.  A
+valid slice block equals the host block on every axis except one, where
+it is strictly smaller, and every axis before the differing one has host
+size 1 (otherwise the region is not a contiguous row-major prefix).
+
+Consumers:
+- slicepart units/agents build geometry against a generation whose
+  host_block is the slice sub-block (`slice_generation_for`);
+- timeshare units exist only for the owned chip ids (`timeshare_cells`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping
+
+from nos_tpu.api import constants as C
+
+from .known import Generation
+from .shape import Shape
+
+logger = logging.getLogger(__name__)
+
+
+def _is_prefix_block(sub: tuple[int, ...], host: tuple[int, ...]) -> bool:
+    """True when `sub` is a contiguous row-major prefix sub-block of
+    `host`: equal everywhere except one axis where it is smaller, with
+    every host axis before that one being 1."""
+    if len(sub) != len(host):
+        return False
+    diff = [i for i, (s, h) in enumerate(zip(sub, host)) if s != h]
+    if len(diff) != 1:
+        return False
+    i = diff[0]
+    return sub[i] < host[i] and all(h == 1 for h in host[:i])
+
+
+def _default_slice_block(host: tuple[int, ...]) -> tuple[int, ...] | None:
+    """Halve the first axis of size >= 2; None when the block has a
+    single chip (nothing to split)."""
+    for i, d in enumerate(host):
+        if d >= 2:
+            out = list(host)
+            out[i] = d // 2
+            return tuple(out)
+    return None
+
+
+def hybrid_slice_block(labels: Mapping[str, str],
+                       gen: Generation) -> Shape | None:
+    """The slice family's sub-block on a hybrid node; None when the node
+    is not hybrid (the slice family owns the whole block, or none of it,
+    by the partitioning label alone)."""
+    if labels.get(C.LABEL_PARTITIONING) != "hybrid":
+        return None
+    host = gen.host_block.dims
+    raw = labels.get(C.LABEL_SLICE_BLOCK, "")
+    if raw:
+        try:
+            sub = Shape.parse(raw).dims
+        except ValueError:
+            sub = ()
+        if _is_prefix_block(sub, host):
+            return Shape(sub)
+        logger.warning(
+            "hybrid node label %s=%r is not a row-major prefix sub-block "
+            "of %s; using the default split",
+            C.LABEL_SLICE_BLOCK, raw, gen.host_block.name)
+    default = _default_slice_block(host)
+    return Shape(default) if default else None
+
+
+def slice_generation_for(labels: Mapping[str, str],
+                         gen: Generation) -> Generation:
+    """The generation the slice family should build geometry against on
+    this node: host_block shrunk to the hybrid sub-block, untouched on
+    non-hybrid nodes."""
+    sub = hybrid_slice_block(labels, gen)
+    if sub is None:
+        return gen
+    return dataclasses.replace(gen, host_block=sub)
+
+
+def timeshare_cells(labels: Mapping[str, str],
+                    gen: Generation) -> frozenset[int] | None:
+    """Chip ids the timeshare family owns on this node; None means ALL
+    chips (a pure timeshare node).  On a hybrid node the slice prefix is
+    excluded; a hybrid block too small to split leaves timeshare empty."""
+    if labels.get(C.LABEL_PARTITIONING) != "hybrid":
+        return None
+    sub = hybrid_slice_block(labels, gen)
+    slice_chips = sub.chips if sub is not None else gen.chips_per_host
+    return frozenset(range(slice_chips, gen.chips_per_host))

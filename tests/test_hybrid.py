@@ -1,0 +1,141 @@
+"""Hybrid-node family split (nos_tpu/topology/hybrid.py): the slice and
+timeshare strategies own disjoint chips of one host block, the analog of
+the reference's per-GPU strategy assignment (pkg/gpu/partitioning.go:81-135).
+"""
+
+from __future__ import annotations
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.testing.factory import (
+    make_slice_pod, make_timeshare_pod, make_tpu_node,
+)
+from nos_tpu.topology import Shape, V4, V5E
+from nos_tpu.topology.hybrid import (
+    hybrid_slice_block, slice_generation_for, timeshare_cells,
+)
+
+
+class TestSplitConvention:
+    def test_non_hybrid_node_not_split(self):
+        labels = {C.LABEL_PARTITIONING: "slice"}
+        assert hybrid_slice_block(labels, V5E) is None
+        assert slice_generation_for(labels, V5E) is V5E
+        assert timeshare_cells(labels, V5E) is None
+
+    def test_default_split_halves_first_wide_axis(self):
+        labels = {C.LABEL_PARTITIONING: "hybrid"}
+        assert hybrid_slice_block(labels, V5E) == Shape.parse("1x4")
+        assert timeshare_cells(labels, V5E) == frozenset({4, 5, 6, 7})
+        # v4 host block is 1x2x2: the first axis of size >= 2 is axis 1
+        assert hybrid_slice_block(labels, V4) == Shape((1, 1, 2))
+        assert timeshare_cells(labels, V4) == frozenset({2, 3})
+
+    def test_labelled_split(self):
+        labels = {C.LABEL_PARTITIONING: "hybrid",
+                  C.LABEL_SLICE_BLOCK: "1x4"}
+        gen = slice_generation_for(labels, V5E)
+        assert gen.host_block == Shape.parse("1x4")
+        assert gen.chips_per_host == 4
+
+    def test_invalid_label_falls_back_to_default(self):
+        for bad in ("2x2",       # not a row-major prefix (axis 1 differs
+                                 # while axis 0 is 2 in the host block)
+                    "2x4",       # equal to the host block (no split)
+                    "3x4",       # exceeds the host block
+                    "banana",    # unparseable
+                    "1x1x1"):    # wrong rank
+            labels = {C.LABEL_PARTITIONING: "hybrid",
+                      C.LABEL_SLICE_BLOCK: bad}
+            assert hybrid_slice_block(labels, V5E) == Shape.parse("1x4"), bad
+
+    def test_units_respect_split(self):
+        from nos_tpu.partitioning.slicepart.node import (
+            units_from_node as slice_units,
+        )
+        from nos_tpu.partitioning.timeshare.node import (
+            units_from_node as ts_units,
+        )
+
+        node = make_tpu_node("h", partitioning="hybrid", status_geometry={
+            "free": {"1x2": 2}})
+        # stale timeshare replica reported on a slice-family chip: dropped
+        node.metadata.annotations[f"{C.ANNOT_STATUS_PREFIX}1-8gb-free"] = "1"
+        node.metadata.annotations[f"{C.ANNOT_STATUS_PREFIX}5-8gb-free"] = "1"
+        su = slice_units(node)
+        assert all(u.generation.host_block == Shape.parse("1x4") for u in su)
+        tu = ts_units(node)
+        assert sorted(u.index for u in tu) == [4, 5, 6, 7]
+        assert tu[1].free == {8: 1}          # chip 5 keeps its replica
+        assert all(not u.free for u in tu if u.index != 5)
+
+
+class TestNoOversubscription:
+    def test_hybrid_host_cannot_exceed_block(self):
+        """Both families under demand pressure on one hybrid host admit
+        at most the block's 8 chips of work (regression: before the
+        split, 12 chip-equivalents were admitted)."""
+        from nos_tpu.cmd.assembly import build_scheduler
+        from nos_tpu.controllers.chipagent import ChipAgent
+        from nos_tpu.controllers.node_controller import NodeController
+        from nos_tpu.controllers.pod_controller import PodController
+        from nos_tpu.controllers.sliceagent.agent import SliceAgent
+        from nos_tpu.device import default_tpu_runtime
+        from nos_tpu.device.fake import FakePodResources
+        from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+        from nos_tpu.partitioning.slicepart.factory import (
+            new_slice_partitioner_controller,
+        )
+        from nos_tpu.partitioning.state import ClusterState
+        from nos_tpu.partitioning.timeshare.factory import (
+            new_timeshare_partitioner_controller,
+        )
+
+        now = [0.0]
+        api = APIServer()
+        state = ClusterState()
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        ctls = [
+            new_slice_partitioner_controller(
+                api, state, batch_timeout_s=1.0, batch_idle_s=0.25,
+                clock=lambda: now[0]),
+            new_timeshare_partitioner_controller(
+                api, state, batch_timeout_s=1.0, batch_idle_s=0.25,
+                clock=lambda: now[0]),
+        ]
+        for c in ctls:
+            c.bind()
+        node = make_tpu_node("hyb-0", partitioning="hybrid", pod_id="",
+                             host_index=0)
+        api.create(KIND_NODE, node)
+        gen = slice_generation_for(node.metadata.labels, V5E)
+        sa = SliceAgent(api, "hyb-0", default_tpu_runtime(gen),
+                        FakePodResources())
+        sa.start()
+        ca = ChipAgent(api, "hyb-0")
+        ca.start()
+        sched = build_scheduler(api)
+        for i in range(3):
+            api.create(KIND_POD, make_slice_pod("1x2", 1, name=f"sl-{i}"))
+        for i in range(5):
+            api.create(KIND_POD, make_timeshare_pod(16, 1, name=f"ts-{i}"))
+        for _ in range(120):
+            now[0] += 0.25
+            sched.run_cycle()
+            for c in ctls:
+                c.process_if_ready()
+            sa.tick()
+            ca.tick()
+        running = [p.metadata.name for p in api.list(KIND_POD)
+                   if p.status.phase == RUNNING]
+        pending = [p.metadata.name for p in api.list(KIND_POD)
+                   if p.status.phase == PENDING]
+        chip_equiv = sum(2 for n in running if n.startswith("sl")) \
+            + sum(1 for n in running if n.startswith("ts"))
+        assert chip_equiv <= 8
+        # both families actually got their halves
+        assert sum(1 for n in running if n.startswith("sl")) == 2
+        assert sum(1 for n in running if n.startswith("ts")) == 4
+        assert len(pending) == 2
