@@ -14,12 +14,28 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import pathlib
 from typing import Any, TypeVar
+
+logger = logging.getLogger(__name__)
 
 SLICE_KIND = "slice"
 TIMESHARE_KIND = "timeshare"
 HYBRID_KIND = "hybrid"
+
+# Versioned config API (the analog of reference pkg/api/scheduler/types.go
+# + pkg/api/scheduler/v1beta3 with generated conversion/defaulting):
+# every config file may carry `apiVersion`.  v1beta1 is the historical
+# flat wire format (files without apiVersion are interpreted as it, with
+# a warning); v1beta2 is canonical — SchedulerConfig's drain knobs move
+# into a nested `drain_preemption:` block there.  Old-version files load
+# through a LOGGED conversion; unknown versions are a hard error, so a
+# config written against a future schema fails fast instead of silently
+# dropping fields.
+CONFIG_V1BETA1 = "nos.tpu/v1beta1"
+CONFIG_V1BETA2 = "nos.tpu/v1beta2"
+SUPPORTED_CONFIG_VERSIONS = (CONFIG_V1BETA1, CONFIG_V1BETA2)
 
 
 class ConfigError(ValueError):
@@ -106,6 +122,10 @@ class SchedulerConfig(ManagerConfig):
     # this fraction are never drain-evicted: they free the window by
     # finishing, and evicting one wastes its whole run.
     drain_preempt_spare_progress: float = 0.75
+    # Max preemption (PostFilter) searches per scheduling cycle: bounds
+    # the victim-search cost when many pods are unschedulable at once;
+    # unserved pods retry next cycle (scheduler.py).
+    preempt_budget_per_cycle: int = 2
 
     def validate(self) -> None:
         super().validate()
@@ -123,6 +143,8 @@ class SchedulerConfig(ManagerConfig):
                 "drain_preempt_spare_progress must be in (0, 1]")
         if self.shard_chips_per_host < 0:
             raise ConfigError("shard_chips_per_host must be >= 0")
+        if self.preempt_budget_per_cycle < 1:
+            raise ConfigError("preempt_budget_per_cycle must be >= 1")
 
 
 @dataclasses.dataclass
@@ -148,6 +170,12 @@ class OperatorConfig(ManagerConfig):
             raise ConfigError("resync_interval_s must be positive")
         if self.webhook_port < 0 or self.webhook_port > 65535:
             raise ConfigError("webhook_port must be in [0, 65535]")
+        if self.webhook_port > 0 and not self.webhook_cert_dir:
+            # The kube-apiserver only talks TLS to webhooks; an empty
+            # cert dir would silently serve admission over cleartext.
+            raise ConfigError(
+                "webhook_port > 0 requires webhook_cert_dir (the chart "
+                "mounts tls.crt/tls.key there)")
         if self.shard_chips_per_host < 0:
             raise ConfigError("shard_chips_per_host must be >= 0")
 
@@ -171,6 +199,79 @@ class AgentConfig(ManagerConfig):
 
 
 T = TypeVar("T")
+
+
+# -- version conversion / canonical decode ----------------------------------
+
+_DRAIN_FLAT_TO_NESTED = (
+    ("drain_preempt_after_cycles", "after_cycles"),
+    ("drain_preempt_max_busy_fraction", "max_busy_fraction"),
+    ("drain_preempt_spare_progress", "spare_progress"),
+)
+
+
+def _scheduler_from_v1beta1(raw: dict) -> dict:
+    """v1beta1 SchedulerConfig (flat drain_preempt_* keys) -> v1beta2
+    (nested drain_preemption block).  Mixing both forms is an error —
+    it means a half-migrated file whose intent is ambiguous."""
+    out = dict(raw)
+    nested: dict = {}
+    for flat, key in _DRAIN_FLAT_TO_NESTED:
+        if flat in out:
+            nested[key] = out.pop(flat)
+    if nested and "drain_preemption" in out:
+        raise ConfigError(
+            "both flat drain_preempt_* keys (v1beta1) and a "
+            "drain_preemption block (v1beta2) present — migrate fully")
+    if nested:
+        out["drain_preemption"] = nested
+    return out
+
+
+def _scheduler_decode(raw: dict) -> dict:
+    """Canonical (v1beta2) SchedulerConfig raw -> dataclass kwargs: the
+    drain_preemption block flattens onto the internal fields.  A v1beta2
+    file that ALSO carries legacy flat drain_preempt_* keys is rejected
+    — same half-migrated ambiguity the v1beta1 converter rejects."""
+    out = dict(raw)
+    block = out.pop("drain_preemption", None)
+    if block is None:
+        return out
+    stale = [flat for flat, _ in _DRAIN_FLAT_TO_NESTED if flat in out]
+    if stale:
+        raise ConfigError(
+            f"both a drain_preemption block and legacy flat key(s) "
+            f"{stale} present — migrate fully")
+    if not isinstance(block, dict):
+        raise ConfigError("drain_preemption must be a mapping")
+    keys = {k: flat for flat, k in _DRAIN_FLAT_TO_NESTED}
+    unknown = set(block) - set(keys)
+    if unknown:
+        raise ConfigError(
+            f"unknown drain_preemption key(s): {sorted(unknown)}")
+    for k, v in block.items():
+        out[keys[k]] = v
+    return out
+
+
+def _convert_config(cls: type, version: str, raw: dict,
+                    source: str) -> dict:
+    """Version pipeline: old version -> canonical raw -> dataclass
+    kwargs, with the conversion logged (the reference's generated
+    conversion functions, hack/generate-scheduler.sh)."""
+    if version not in SUPPORTED_CONFIG_VERSIONS:
+        raise ConfigError(
+            f"unsupported config apiVersion {version!r} for "
+            f"{cls.__name__}; supported: "
+            f"{', '.join(SUPPORTED_CONFIG_VERSIONS)}")
+    if version == CONFIG_V1BETA1:
+        converter = _V1BETA1_CONVERTERS.get(cls)
+        if converter is not None:
+            raw = converter(raw)
+        logger.info("config %s: converted %s from %s to %s",
+                    source, cls.__name__, version, CONFIG_V1BETA2)
+    decoder = _CANONICAL_DECODERS.get(cls)
+    return decoder(raw) if decoder is not None else raw
 
 
 _FIELD_TYPES = {
@@ -239,7 +340,28 @@ def load_config(path: str | pathlib.Path | None, cls: type[T], *,
         if not isinstance(raw, dict):
             raise ConfigError(f"config root must be a mapping, "
                               f"got {type(raw).__name__}")
+        # Only apiVersion is recognized as schema metadata — these files
+        # are component configs, not k8s objects, and PartitionerConfig
+        # has a real `kind` field (the partitioning kind).
+        version = raw.pop("apiVersion", None)
+        if version is None:
+            version = CONFIG_V1BETA1
+            logger.warning(
+                "config %s has no apiVersion; interpreting as %s "
+                "(write 'apiVersion: %s' to pin the schema)",
+                path, CONFIG_V1BETA1, CONFIG_V1BETA2)
+        elif not isinstance(version, str):
+            raise ConfigError("apiVersion must be a string")
+        raw = _convert_config(cls, version, raw, str(path))
         cfg = _coerce(cls, raw)
     if validate:
         cfg.validate()
     return cfg
+
+
+_V1BETA1_CONVERTERS: dict[type, Any] = {
+    SchedulerConfig: _scheduler_from_v1beta1,
+}
+_CANONICAL_DECODERS: dict[type, Any] = {
+    SchedulerConfig: _scheduler_decode,
+}

@@ -79,7 +79,8 @@ def build_scheduler(api: APIServer,
                     drain_preempt_max_busy_fraction: float = 0.25,
                     drain_preempt_spare_progress: float = 0.75,
                     drain_preempt_progress_fn=None,
-                    shard_chips_per_host: int = 0) -> Scheduler:
+                    shard_chips_per_host: int = 0,
+                    preempt_budget_per_cycle: int = 2) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
     from nos_tpu.quota import TPUResourceCalculator
@@ -94,4 +95,5 @@ def build_scheduler(api: APIServer,
         drain_preempt_after_cycles=drain_preempt_after_cycles or None,
         drain_preempt_max_busy_fraction=drain_preempt_max_busy_fraction,
         drain_preempt_spare_progress=drain_preempt_spare_progress,
-        drain_preempt_progress_fn=drain_preempt_progress_fn)
+        drain_preempt_progress_fn=drain_preempt_progress_fn,
+        preempt_budget_per_cycle=preempt_budget_per_cycle)

@@ -20,6 +20,8 @@ from nos_tpu.controllers.elasticquota import (
 from nos_tpu.kube.client import APIServer
 from nos_tpu.quota import TPUResourceCalculator
 
+logger = logging.getLogger(__name__)
+
 
 def _serve_admission_webhook(api, cfg: OperatorConfig):
     """Start the HTTPS AdmissionReview endpoint (kube/webhook.py) with
@@ -47,8 +49,12 @@ def _serve_admission_webhook(api, cfg: OperatorConfig):
     if cfg.webhook_cert_dir:
         cert = os.path.join(cfg.webhook_cert_dir, "tls.crt")
         key = os.path.join(cfg.webhook_cert_dir, "tls.key")
+    # OperatorConfig.validate rejects webhook_port>0 without a cert dir,
+    # so the insecure path is only reachable from tests driving this
+    # helper directly with an ephemeral port.
     server = WebhookServer(handler, port=cfg.webhook_port,
-                           cert_file=cert, key_file=key)
+                           cert_file=cert, key_file=key,
+                           allow_insecure=not cfg.webhook_cert_dir)
     server.start()
     return server
 
@@ -70,6 +76,19 @@ def build_operator_main(api: APIServer, cfg: OperatorConfig,
     if cfg.webhook_port > 0:
         main.webhook = _serve_admission_webhook(api, cfg)
         main.add_shutdown_hook(main.webhook.stop)
+    elif hasattr(api, "admission"):
+        # REST substrate with webhook_port=0: the quota validators were
+        # collected but NOTHING serves them — the kube-apiserver cannot
+        # consult us, so EQ/CEQ admission rules are NOT enforced on this
+        # deployment.  Loud, because a silent gap here means duplicate
+        # or overlapping quotas go in unchecked.
+        logger.warning(
+            "admission validators registered for %s but webhook_port=0: "
+            "no AdmissionReview endpoint is serving them — quota "
+            "admission is UNENFORCED on the REST substrate (set "
+            "webhook_port and webhook_cert_dir, and install the chart's "
+            "ValidatingWebhookConfiguration)",
+            api.admission.kinds)
     calc = TPUResourceCalculator(cfg.tpu_memory_gb_per_chip,
                                  cfg.shard_chips_per_host)
 

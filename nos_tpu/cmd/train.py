@@ -213,10 +213,18 @@ def main(argv=None) -> int:
         print(f"invalid config: {e}", file=sys.stderr)
         return 2
     # honor timeshare/slice grants BEFORE the first jax import
-    from nos_tpu.device.workload_env import apply as apply_workload_env
+    from nos_tpu.device.workload_env import (
+        apply as apply_workload_env, validate_confinement,
+    )
 
     apply_workload_env()
     maybe_init_distributed()
+    # ... and after the backend is up, PROVE the confinement took: the
+    # chip-numbering convention is asserted, not assumed
+    # (workload_env.py module docstring CAVEAT).  Raises before any
+    # training step can run on another slice's chips.
+    if validate_confinement():
+        logger.info("chip-visibility grant verified against jax.devices()")
     health = None
     if cfg.health_probe_addr:
         from nos_tpu.cmd._runtime import Main

@@ -23,6 +23,17 @@ actually respects them:
   jax process started after apply() sees ONLY the granted chips instead
   of grabbing every chip on the host.
 
+  CAVEAT — numbering convention: the grant's chip ids are row-major
+  placement cells in the host block (topology.packing.placement_cells),
+  and libtpu is ASSUMED to number local chips the same way.  That holds
+  for the documented Cloud TPU host layouts, but it is not provable on
+  this repo's single-chip CI host, so confinement is belt-and-braces:
+  call `validate_confinement()` after the first jax import — it checks
+  the visible device COUNT and (where PJRT exposes coords) that the
+  visible devices' local coordinates are exactly the granted cells, and
+  raises before any work runs on wrongly-shared chips if the host's
+  enumeration disagrees.
+
 Analog of what the NVIDIA stack does implicitly through MPS
 active-thread percentage and MIG device visibility; on TPU the runtime
 has no such enforcement layer, so the framework provides the cooperative
@@ -159,3 +170,99 @@ def apply(environ=os.environ,
         environ.setdefault(key, value)
         logger.info("workload env: %s=%s", key, environ[key])
     return applied
+
+
+class ConfinementError(RuntimeError):
+    """The jax process does NOT match its visibility grant — running on
+    would share chips with another slice's workload."""
+
+
+def _local_coords(cells: list[int], bounds: str) -> set[tuple[int, ...]] | None:
+    """Row-major cell ids -> host-local coordinates; None on bad input."""
+    try:
+        bdims = [int(d) for d in bounds.split("x")]
+    except ValueError:
+        return None
+    total = 1
+    for d in bdims:
+        total *= d
+    if not bdims or any(d < 1 for d in bdims) \
+            or any(c < 0 or c >= total for c in cells):
+        return None
+    out = set()
+    for c in cells:
+        coord = []
+        for d in reversed(bdims):
+            coord.append(c % d)
+            c //= d
+        out.add(tuple(reversed(coord)))
+    return out
+
+
+def check_confinement(granted: list[int], devices,
+                      host_bounds: str) -> None:
+    """Pure core of validate_confinement (tested without a TPU).
+    `devices` is the jax.devices() list of the CONFINED process; each
+    device's `.coords` (PJRT, global pod coordinates) is compared — after
+    rebasing to the host-local origin — against the granted cells'
+    coordinates in the host block.  Raises ConfinementError on count or
+    coordinate mismatch; silently returns when the runtime exposes no
+    coords (count is then the only check available)."""
+    if len(devices) != len(granted):
+        raise ConfinementError(
+            f"visibility grant promised {len(granted)} chip(s) "
+            f"{granted} but jax sees {len(devices)} — libtpu did not "
+            f"honor TPU_VISIBLE_CHIPS, or the grant was clobbered")
+    want = _local_coords(granted, host_bounds)
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return              # runtime exposes no coords: count-only
+        coords.append(tuple(c))
+    if want is None or not coords:
+        return
+    ndim = len(next(iter(want)))
+    if any(len(c) < ndim for c in coords) \
+            or len({len(c) for c in coords}) != 1:
+        raise ConfinementError(
+            f"visible device coords {coords} have rank below the host "
+            f"bounds {host_bounds!r} rank — cannot verify confinement; "
+            f"refusing to run on an unverifiable chip set")
+    origin = tuple(min(c[i] for c in coords) for i in range(len(coords[0])))
+    got = {tuple(c[i] - origin[i] for i in range(ndim))
+           for c in coords}
+    # rebase the granted cells to their own origin too: the grant may be
+    # an interior sub-block (e.g. cells {2,3}) while the visible devices
+    # are renumbered from the host origin
+    want_origin = tuple(min(c[i] for c in want) for i in range(ndim))
+    want_rebased = {tuple(c[i] - want_origin[i] for i in range(ndim))
+                    for c in want}
+    if got != want_rebased:
+        raise ConfinementError(
+            f"visible device coords {sorted(got)} != granted cells "
+            f"{sorted(want_rebased)} (host bounds {host_bounds!r}): "
+            f"libtpu's local chip numbering disagrees with the row-major "
+            f"placement convention on this host — STOP, the process may "
+            f"be confined to another slice's chips")
+
+
+def validate_confinement(environ=os.environ) -> bool:
+    """Post-jax-init check that the process really is confined to its
+    grant (module docstring CAVEAT).  Returns True when a grant was
+    present and verified, False when there was nothing to check; raises
+    ConfinementError on mismatch."""
+    granted = granted_chip_ids(environ)
+    if not granted:
+        return False
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False    # visibility envs only bind libtpu; nothing to check
+    # local_devices, NOT devices: the grant is per-host, and after
+    # jax.distributed.initialize a multi-host gang's global device list
+    # spans every member — a correctly-confined member would fail the
+    # count check against it.
+    check_confinement(granted, jax.local_devices(),
+                      environ.get(ENV_HOST_BOUNDS, ""))
+    return True

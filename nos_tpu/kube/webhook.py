@@ -70,7 +70,14 @@ class AdmissionHandler:
     def kinds(self) -> list[str]:
         return sorted(set(self._validators) | set(self._mutators))
 
-    def handle(self, body: bytes) -> dict:
+    def handle(self, body: bytes, path: str = "") -> dict:
+        """`path` routes the review like the chart wires it: a
+        /validate-* URL runs only validators, /mutate-* only mutators;
+        "" (in-process use, tests) runs both.  Kind alone must not pick
+        the behavior — the apiserver POSTs the SAME kind to both
+        endpoints and expects a patch only from the mutating one."""
+        run_validators = not path or path.startswith("/validate")
+        run_mutators = not path or path.startswith("/mutate")
         uid = ""
         try:
             review = json.loads(body)
@@ -84,7 +91,8 @@ class AdmissionHandler:
         except Exception as e:  # noqa: BLE001 — malformed review: deny
             logger.warning("admission: malformed review rejected (%s)", e)
             return review_response(uid, False, f"malformed AdmissionReview: {e}")
-        validators = self._validators.get(kind, [])
+        validators = (self._validators.get(kind, [])
+                      if run_validators else [])
         if validators:
             # Validated kinds are fail-closed: an object the codec cannot
             # decode cannot be validated, so it is denied.  Mutate-only
@@ -104,7 +112,7 @@ class AdmissionHandler:
                 except Exception as e:  # noqa: BLE001 — verdicts + bugs both deny
                     return review_response(uid, False, str(e))
         ops: list = []
-        for fn in self._mutators.get(kind, []):
+        for fn in (self._mutators.get(kind, []) if run_mutators else []):
             try:
                 ops.extend(fn(raw) or [])
             except Exception as e:  # noqa: BLE001 — a broken mutator must
@@ -120,15 +128,19 @@ class WebhookServer:
 
     `cert_file`/`key_file` hold the serving cert the chart provisions
     (self-signed generator job; the ValidatingWebhookConfiguration's
-    caBundle carries the matching CA).  Pass neither to serve plain HTTP
-    (tests only — the kube-apiserver requires TLS)."""
+    caBundle carries the matching CA).  Serving WITHOUT a cert requires
+    an explicit `allow_insecure=True` (tests only): the kube-apiserver
+    requires TLS, so a production misconfig with an empty cert dir must
+    fail fast instead of silently serving admission over cleartext."""
 
     def __init__(self, handler: AdmissionHandler, host: str = "0.0.0.0",
                  port: int = 9443, cert_file: str | None = None,
-                 key_file: str | None = None) -> None:
+                 key_file: str | None = None,
+                 allow_insecure: bool = False) -> None:
         self._handler = handler
         self._host, self._port = host, port
         self._cert, self._key = cert_file, key_file
+        self._allow_insecure = allow_insecure
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -138,6 +150,11 @@ class WebhookServer:
         return self._httpd.server_address[1] if self._httpd else self._port
 
     def start(self) -> None:
+        if not self._cert and not self._allow_insecure:
+            raise ValueError(
+                "WebhookServer without a serving cert: the kube-apiserver "
+                "requires TLS — set webhook_cert_dir (the chart mounts "
+                "tls.crt/tls.key) or pass allow_insecure=True in tests")
         handler = self._handler
 
         class Handler(BaseHTTPRequestHandler):
@@ -148,7 +165,7 @@ class WebhookServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                resp = json.dumps(handler.handle(body)).encode()
+                resp = json.dumps(handler.handle(body, self.path)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(resp)))

@@ -383,6 +383,30 @@ class CapacityScheduling:
         base_snapshot: ElasticQuotaInfos = state[ELASTIC_QUOTA_SNAPSHOT_KEY]
         pfs: PreFilterState = state[PRE_FILTER_STATE_KEY]
 
+        # Cheap screen before the what-if clones: every victim the walk
+        # below can select is (a) quota-less lower-priority for a
+        # quota-less preemptor, (b) same-namespace lower-priority, or
+        # (c) cross-namespace carrying the over-quota label.  A node
+        # hosting none of those can never yield victims — skip it without
+        # paying the snapshot/NodeInfo clone (the preemption storm at
+        # v5e-256 scale is dominated by victim-less nodes).
+        pod_ns = pod.metadata.namespace
+        preemptor_governed = base_snapshot.get(pod_ns) is not None
+
+        def _maybe_victim(pv: Pod) -> bool:
+            governed = base_snapshot.get(pv.metadata.namespace) is not None
+            if not preemptor_governed:
+                return not governed \
+                    and pv.spec.priority < pod.spec.priority
+            if not governed:
+                return False
+            if pv.metadata.namespace == pod_ns:
+                return pv.spec.priority < pod.spec.priority
+            return is_over_quota(pv)
+
+        if not any(_maybe_victim(pv) for pv in node_info.pods):
+            return [], 0, Status.unschedulable("no victims found")
+
         # Candidate-local what-if copies.
         snapshot = base_snapshot.clone()
         ni = node_info.clone()

@@ -84,7 +84,8 @@ class Scheduler:
                  drain_preempt_after_cycles: int | None = None,
                  drain_preempt_max_busy_fraction: float = 0.25,
                  drain_preempt_spare_progress: float = 0.75,
-                 drain_preempt_progress_fn=None) -> None:
+                 drain_preempt_progress_fn=None,
+                 preempt_budget_per_cycle: int = 2) -> None:
         self._api = api
         self._framework = framework
         self.name = name
@@ -112,6 +113,16 @@ class Scheduler:
                              or _annotation_progress)
         self._drain_cycles = 0
         self._drain_gang: tuple[str, str] | None = None
+        # Preemption budget: at most this many PostFilter (preemption)
+        # searches per scheduling cycle.  kube-scheduler pops one pod per
+        # cycle, so it never runs more than one preemption between state
+        # refreshes; this loop schedules EVERY pending pod per cycle, and
+        # running a full victim search for each unschedulable pod both
+        # multiplies the cycle cost ~10x at v5e-256 scale and lets
+        # same-cycle preemptors fight over the same space.  Unserved pods
+        # simply retry next cycle (one tick later).
+        self._preempt_budget_per_cycle = preempt_budget_per_cycle
+        self._preempt_budget = self._preempt_budget_per_cycle
         # Gang window lease: each cycle, the oldest stuck multi-host gang
         # reserves its currently most-drained candidate window (re-picked
         # every cycle — completions are stochastic, so tracking whichever
@@ -146,9 +157,8 @@ class Scheduler:
             # resolved by evicting over-quota borrowers (reference
             # capacity_scheduling.go:323-341).
             if status.code == UNSCHEDULABLE:
-                nominated, post = self._framework.run_post_filter_plugins(
-                    state, pod, lister
-                )
+                nominated, post = self._post_filter_budgeted(
+                    state, pod, lister)
                 if post.is_success and nominated:
                     self._nominate(pod, nominated)
                     return None
@@ -159,9 +169,7 @@ class Scheduler:
             if self._framework.run_filter_plugins(state, pod, ni).is_success:
                 feasible.append(ni)
         if not feasible:
-            nominated, post = self._framework.run_post_filter_plugins(
-                state, pod, lister
-            )
+            nominated, post = self._post_filter_budgeted(state, pod, lister)
             if post.is_success and nominated:
                 self._nominate(pod, nominated)
             else:
@@ -181,6 +189,7 @@ class Scheduler:
         returns number of pods bound.  Pods sharing a `nos.tpu/pod-group`
         label are admitted all-or-nothing (gang scheduling)."""
         bound = 0
+        self._preempt_budget = self._preempt_budget_per_cycle
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
@@ -295,12 +304,16 @@ class Scheduler:
             # helps.  Victims are evicted whole-gang (evict_gang); the
             # gang binds on a later cycle once the space exists.
             preempted = False
-            feasible_pins = self._gang_feasible_after_evictions(
-                members, candidate_pins, base)
+            if self._preempt_budget > 0:
+                feasible_pins = self._gang_feasible_after_evictions(
+                    members, candidate_pins, base)
+            else:
+                feasible_pins = None        # budget spent: retry next cycle
             if feasible_pins is not None:
                 _, st, domain, stuck = self._attempt_gang(
                     feasible_pins, base, members)
                 if stuck is not None:
+                    self._preempt_budget -= 1
                     nominated, post = \
                         self._framework.run_post_filter_plugins(
                             st, stuck, SharedLister(domain))
@@ -336,6 +349,16 @@ class Scheduler:
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
+
+    def _post_filter_budgeted(self, state: CycleState, pod: Pod,
+                              lister: SharedLister) -> tuple[str, Status]:
+        """PostFilter under the per-cycle preemption budget (__init__):
+        once spent, further unschedulable pods just wait for next cycle."""
+        if self._preempt_budget <= 0:
+            return "", Status.unschedulable(
+                "preemption budget for this cycle spent")
+        self._preempt_budget -= 1
+        return self._framework.run_post_filter_plugins(state, pod, lister)
 
     def _maybe_drain_preempt(self) -> None:
         """Evict the last stragglers off a long-held drain window (see

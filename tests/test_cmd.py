@@ -40,6 +40,84 @@ class TestConfig:
         p.write_text(json.dumps({"tpu_memory_gb_per_chip": 32}))
         assert load_config(p, SchedulerConfig).tpu_memory_gb_per_chip == 32
 
+
+class TestConfigVersioning:
+    """Versioned config API (api/config.py): apiVersion routing, logged
+    v1beta1 -> v1beta2 conversion, defaulting, hard error on unknown
+    versions — the pkg/api/scheduler/v1beta3 analog."""
+
+    def test_v1beta2_nested_drain_block(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(
+            "apiVersion: nos.tpu/v1beta2\n"
+            "drain_preemption:\n"
+            "  after_cycles: 40\n"
+            "  max_busy_fraction: 0.3\n"
+            "  spare_progress: 0.6\n")
+        cfg = load_config(p, SchedulerConfig)
+        assert cfg.drain_preempt_after_cycles == 40
+        assert cfg.drain_preempt_max_busy_fraction == 0.3
+        assert cfg.drain_preempt_spare_progress == 0.6
+
+    def test_v1beta1_flat_keys_convert_with_log(self, tmp_path, caplog):
+        import logging
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text(
+            "apiVersion: nos.tpu/v1beta1\n"
+            "drain_preempt_after_cycles: 25\n"
+            "drain_preempt_max_busy_fraction: 0.4\n")
+        with caplog.at_level(logging.INFO, logger="nos_tpu.api.config"):
+            cfg = load_config(p, SchedulerConfig)
+        assert cfg.drain_preempt_after_cycles == 25
+        assert cfg.drain_preempt_max_busy_fraction == 0.4
+        # defaulting pass: fields the old version never had
+        assert cfg.drain_preempt_spare_progress == 0.75
+        assert any("converted" in r.message for r in caplog.records)
+
+    def test_unversioned_file_warns_and_loads_as_v1beta1(
+            self, tmp_path, caplog):
+        import logging
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text("drain_preempt_after_cycles: 10\n")
+        with caplog.at_level(logging.WARNING,
+                             logger="nos_tpu.api.config"):
+            cfg = load_config(p, SchedulerConfig)
+        assert cfg.drain_preempt_after_cycles == 10
+        assert any("no apiVersion" in r.message for r in caplog.records)
+
+    def test_unknown_version_is_hard_error(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("apiVersion: nos.tpu/v9\n")
+        with pytest.raises(ConfigError, match="unsupported config"):
+            load_config(p, SchedulerConfig)
+
+    def test_mixed_forms_rejected(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(
+            "apiVersion: nos.tpu/v1beta1\n"
+            "drain_preempt_after_cycles: 10\n"
+            "drain_preemption:\n"
+            "  after_cycles: 20\n")
+        with pytest.raises(ConfigError, match="migrate fully"):
+            load_config(p, SchedulerConfig)
+
+    def test_unknown_nested_key_rejected(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(
+            "apiVersion: nos.tpu/v1beta2\n"
+            "drain_preemption:\n"
+            "  banana: 1\n")
+        with pytest.raises(ConfigError, match="unknown drain_preemption"):
+            load_config(p, SchedulerConfig)
+
+    def test_version_accepted_on_all_kinds(self, tmp_path):
+        for cls in (PartitionerConfig, OperatorConfig):
+            p = tmp_path / "cfg.yaml"
+            p.write_text("apiVersion: nos.tpu/v1beta2\n")
+            load_config(p, cls)
+
     @pytest.mark.parametrize("body,err", [
         ("kind: banana", "slice|timeshare|hybrid"),
         ("batch_idle_s: 10\nbatch_timeout_s: 2", "must not exceed"),

@@ -109,6 +109,51 @@ class TestWorkloadEnvVisibility:
         assert applied["TPU_VISIBLE_CHIPS"] == "1,2"
         assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in applied
 
+
+class _Dev:
+    def __init__(self, coords=None):
+        if coords is not None:
+            self.coords = coords
+
+
+class TestConfinementCheck:
+    """check_confinement: the chip-numbering convention is asserted after
+    jax init, not assumed (ADVICE r4 medium — a host whose libtpu
+    enumeration disagrees with row-major placement cells must fail loudly
+    before work runs on another slice's chips)."""
+
+    def test_count_mismatch_raises(self):
+        import pytest
+
+        with pytest.raises(workload_env.ConfinementError,
+                           match="promised 2"):
+            workload_env.check_confinement(
+                [0, 1], [_Dev((0, 0, 0))], "2x4")
+
+    def test_matching_coords_pass(self):
+        # granted cells 0,1 of a 2x4 block = local coords (0,0),(0,1);
+        # PJRT reports global coords with an arbitrary host origin
+        workload_env.check_confinement(
+            [0, 1], [_Dev((4, 2, 0)), _Dev((4, 3, 0))], "2x4")
+
+    def test_interior_subblock_passes(self):
+        # cells 2,3 (row 0, cols 2-3): devices renumbered from their own
+        # origin still match after rebasing both sides
+        workload_env.check_confinement(
+            [2, 3], [_Dev((0, 0)), _Dev((0, 1))], "2x4")
+
+    def test_wrong_shape_raises(self):
+        import pytest
+
+        # granted a 1x2 row pair but the visible devices form a column
+        with pytest.raises(workload_env.ConfinementError,
+                           match="numbering disagrees"):
+            workload_env.check_confinement(
+                [0, 1], [_Dev((0, 0)), _Dev((1, 0))], "2x4")
+
+    def test_no_coords_degrades_to_count(self):
+        workload_env.check_confinement([0, 1], [_Dev(), _Dev()], "2x4")
+
     def test_one_corrupt_token_voids_the_whole_grant(self):
         # confining to a silently under-sized subset is worse than not
         # confining at all
