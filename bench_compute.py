@@ -15,10 +15,13 @@ Measures, on the real chip (skipped off-TPU):
   device/env/configured — nos_tpu/device/discovery.py).
 
 Noise caveat: sub-millisecond KERNEL timings (flash fwd/bwd) vary up to
-2x run to run through the tunnel even with the slope method — judge
-kernels on the best of several runs or on relative comparisons within
-one run.  The step-level metrics (step_time_ms, mfu, tokens_per_s) are
-seconds-long chains and stable to a few tenths of a percent.
+2x run to run through the tunnel even with the slope method.  Every
+tunnel-noisy metric therefore carries a *_band_ms / mfu_band field from
+full independent repeats in this run: kernels record min-of-3 (tunnel
+noise is strictly additive), the train step records median-of-3 (the
+chain is seconds long and stable); the band's spread is the recorded
+evidence of measurement quality, so a regression can be told from a
+noisy repeat inside the artifact itself.
 
 Timing methodology: the 'axon' tunneled platform does not block in
 `block_until_ready` (device work completes asynchronously behind the
@@ -103,6 +106,25 @@ def _slope(fn_maker, n1=20, n2=80, reps=5):
         tsa.append(_t(fa))
         tsb.append(_t(fb))
     return (min(tsb) - min(tsa)) / (n2 - n1)
+
+
+def _band(ts: list[float]) -> dict:
+    """{min, median, max} in ms from sorted seconds."""
+    return {"min": round(ts[0] * 1e3, 4),
+            "median": round(ts[len(ts) // 2] * 1e3, 4),
+            "max": round(ts[-1] * 1e3, 4)}
+
+
+def _slope_band(fn_maker, repeats=3, **kw):
+    """`repeats` independent _slope measurements of ONE compiled program
+    (compile caching makes re-measurement nearly free): returns
+    (sorted_times, band_ms).  Tunnel jitter on sub-ms kernels reaches
+    +-30% run to run, so a single number cannot distinguish a regression
+    from noise — the band makes the artifact self-evidencing: judge the
+    MIN (noise through the tunnel is strictly additive), read the spread
+    as measurement quality."""
+    ts = sorted(_slope(fn_maker, **kw) for _ in range(repeats))
+    return ts, _band(ts)
 
 
 def model_flops_per_step(cfg, batch, seq) -> float:
@@ -195,17 +217,29 @@ def bench_attention(jax, jnp, flash_attention, dense_attention, peak):
     flash = lambda q, k, v: flash_attention(q, k, v, True)   # noqa: E731
     dense = lambda q, k, v: dense_attention(q, k, v, True)   # noqa: E731
 
-    t_flash = _slope(fwd_maker(flash), n1=40, n2=160)
-    t_dense = _slope(fwd_maker(dense), n1=20, n2=80)
-    t_grad = _slope(grad_maker(flash))
-    t_bwd = max(t_grad - t_flash, 1e-9)
+    # min-of-3 full repeats per kernel (compile shared): the recorded
+    # number is the band's MIN, so one noisy repeat cannot masquerade as
+    # a kernel regression (r3->r4 flash_fwd "regressed" 0.77->1.06 ms on
+    # a single-run artifact; the band kills that ambiguity).
+    ts_flash, flash_band = _slope_band(fwd_maker(flash), n1=40, n2=160)
+    ts_dense, dense_band = _slope_band(fwd_maker(dense), n1=20, n2=80)
+    ts_grad, _ = _slope_band(grad_maker(flash))
+    t_flash, t_dense = ts_flash[0], ts_dense[0]
+    # pair rank-to-rank (min-min, med-med, max-max): tunnel noise is
+    # additive, so same-rank differences are the honest bwd estimates
+    bwd_ts = sorted(max(g - f, 1e-9) for g, f in zip(ts_grad, ts_flash))
+    t_bwd = bwd_ts[0]
+    bwd_band = _band(bwd_ts)
     return {
         "flash_fwd_ms": round(t_flash * 1e3, 4),
+        "flash_fwd_band_ms": flash_band,
         "dense_fwd_ms": round(t_dense * 1e3, 4),
+        "dense_fwd_band_ms": dense_band,
         "flash_speedup": round(t_dense / t_flash, 2),
         "flash_tflops": round(fwd_flops / t_flash / 1e12, 1),
         "flash_pct_peak": round(fwd_flops / t_flash / peak * 100, 1),
         "flash_bwd_ms": round(t_bwd * 1e3, 4),
+        "flash_bwd_band_ms": bwd_band,
         "flash_bwd_impl": "fused" if fused else "split",
         "flash_bwd_flop_ratio": bwd_ratio,
         "flash_bwd_tflops": round(bwd_flops / t_bwd / 1e12, 1),
@@ -283,7 +317,11 @@ def bench_train_step(jax, jnp, peak):
             g, jnp.float32(0))
         return loss + gsum * 1e-30
 
-    t_step = _slope(make_step, n1=4, n2=16, reps=4)  # headline: must run
+    # Headline: must run.  median-of-3 full repeats — the step chain is
+    # seconds long so the median is stable to tenths of a percent; the
+    # band proves it in the artifact.
+    step_ts, step_band = _slope_band(make_step, n1=4, n2=16, reps=4)
+    t_step = step_ts[len(step_ts) // 2]
     t_fwd = retry_transient(
         lambda: _slope(chain(fwd_loss), n1=4, n2=16, reps=4),
         "breakdown/forward", attempts=2, reraise=False)
@@ -303,11 +341,17 @@ def bench_train_step(jax, jnp, peak):
 
     flops = model_flops_per_step(cfg, BATCH, SEQ)
     device_kind = jax.devices()[0].device_kind.lower()
+    mfu_band = {k: round(flops / (v / 1e3) / peak, 4)
+                for k, v in (("max", step_band["min"]),
+                             ("median", step_band["median"]),
+                             ("min", step_band["max"]))}
     return {
         "step_time_ms": round(t_step * 1e3, 2),
+        "step_time_band_ms": step_band,
         "tokens_per_s": round(BATCH * SEQ / t_step),
         "model_tflops_per_step": round(flops / 1e12, 2),
         "mfu": round(flops / t_step / peak, 4),
+        "mfu_band": mfu_band,
         "step_breakdown_ms": breakdown,
         "train_config": {"remat_policy": cfg.remat_policy,
                          "scan_layers": cfg.scan_layers,

@@ -1,23 +1,54 @@
-"""Utilization benchmark: v5e-256 mixed trace (the BASELINE north star).
+"""Utilization benchmark: v5e-256 mixed trace with ENFORCED elastic quotas,
+TPU-VM preemption (node loss) and hybrid hosts — BASELINE configs #1 + #5.
 
-Simulates 32 hosts x 8 chips = 256 chips (two slice ICI domains of 16 and
-12 hosts plus 4 timeshare hosts) under a churning mixed workload — small
-slice jobs (1x1 / 2x2 / full-host 2x4), multi-host gangs (4x4 over 2
-hosts, 4x8 over 4 hosts), and fractional timeshare jobs (4/8 GB HBM
-profiles) — driven through the REAL control plane: scheduler cycles with
-gang admission + topology pinning, both partitioner controllers
-(batcher -> planner -> packer -> annotation protocol), and per-host agents
-actuating geometry against fake runtimes.
+Cluster: 32 hosts x 8 chips = 256 chips — two slice ICI domains (16 and 12
+hosts), 2 pure timeshare hosts, and 2 HYBRID hosts (slice sub-block 1x4 +
+timeshare chips 4-7, topology/hybrid.py).  Everything runs through the REAL
+control plane: the scheduler is built by cmd/assembly.build_scheduler — the
+same wiring as the production cmd/scheduler main — so `CapacityScheduling`
+(quota PreFilter + over-quota preemption, scheduler/capacityscheduling.py)
+sits in the framework for every decision, with the EQ/CEQ reconcilers
+(controllers/elasticquota) relabelling pods in-quota/over-quota on a
+per-tick resync.
 
-Time is virtual (the batcher clock is injected), so a multi-minute trace
-runs in seconds of wall clock while preserving every control-loop
-interaction: batch windows, plan handshakes, repartition latency all play
-out in simulated seconds exactly as they would in real ones.
+Quota layout (currency nos.tpu/tpu-memory GB, host-shard accounting
+chips_per_host=8 so one gang member books the chips it physically owns):
 
-Metrics: time-weighted mean chip utilization after warmup (target >= 0.85,
-BASELINE.md), p50/p90 pod schedule latency (creation -> bind, virtual
-seconds), and p50/p99 wall-clock scheduler cycle time (the gang-search
-cost at v5e-256 scale).
+    team       object              min GB (chips)   max GB
+    train-a    ElasticQuota        1536  (96)       3072
+    train-b    ElasticQuota        1024  (64)       2560
+    serve      ElasticQuota         768  (48)       1536
+    res-a+b    CompositeEQ          768  (48)       2048
+    total                          4096 (256) == cluster HBM capacity
+
+Demand PHASES shift per-namespace pressure so the quota machinery actually
+fires: phase 1 starves serve/research while train-a over-drives (train-a
+BORROWS unused min); phase 2 reverses — serve/research reclaim their
+guaranteed min, and since the cluster is full their pods preempt train-a's
+over-quota borrowers (capacity_scheduling.go:468-675 semantics).  Jobs are
+heterogeneous: long train gangs (45-110 s) vs short serve bursts (8-20 s).
+
+TPU-VM preemption: at t=150 s two hosts (one per slice domain) are killed —
+agents stop, their pods die, the nodes vanish — and at t=210 s replacement
+hosts join at the same host-index.  Affected jobs requeue with their
+original creation timestamps; time-to-recover (all affected jobs rebound)
+is reported.  Utilization is measured against LIVE capacity (dead chips
+are not schedulable), with the lost chip-seconds reported alongside.
+
+Falsifiable invariants, checked EVERY tick (violations reported, 0 means
+the machinery is provably coherent under churn):
+  - ledger coherence: each quota's in-ledger `used` equals a recount over
+    assigned pods in its namespaces;
+  - per-EQ used <= max; aggregate used <= aggregate min;
+  - every cross-namespace preemption victim carried the over-quota label
+    (or was gang-amplified from one), and no quota preemption fires while
+    no quota is over its min (nothing borrowed => nothing to reclaim);
+  - hybrid admission ownership: every running slice-family pod on a hybrid
+    host was admitted by the sliceagent's device-backed KubeletSim (has a
+    recorded device allocation), never bare-admitted by the chipagent.
+
+Time is virtual (the batcher clock is injected) so the 360 s trace runs in
+seconds of wall clock while preserving every control-loop interaction.
 """
 
 from __future__ import annotations
@@ -27,52 +58,124 @@ import random
 import time
 
 from nos_tpu.api import constants as C
+from nos_tpu.api.elasticquota import (
+    CompositeElasticQuota, CompositeElasticQuotaSpec, ElasticQuota,
+    ElasticQuotaSpec, install_quota_webhooks,
+)
 from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.cmd.assembly import build_scheduler
 from nos_tpu.controllers.chipagent import ChipAgent
+from nos_tpu.controllers.elasticquota.controller import (
+    CompositeElasticQuotaReconciler, ElasticQuotaReconciler,
+)
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
 from nos_tpu.controllers.sliceagent.agent import SliceAgent
 from nos_tpu.device import default_tpu_runtime
 from nos_tpu.device.fake import FakePodResources
 from nos_tpu.kube.client import (
-    APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP, NotFound,
+    APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_NODE,
+    KIND_POD, KIND_POD_GROUP, NotFound,
 )
-from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.partitioning.slicepart import SliceNodeInitializer
 from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
 from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
-from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
-from nos_tpu.scheduler.gang import TopologyFilter
-from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.quota import TPUResourceCalculator
+from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+from nos_tpu.scheduler.gang import gang_name
 from nos_tpu.testing.factory import make_slice_pod, make_timeshare_pod, make_tpu_node
 from nos_tpu.topology import V5E
+from nos_tpu.topology.hybrid import slice_generation_for
 from nos_tpu.topology.profile import extract_slice_requests, extract_timeshare_requests
+from nos_tpu.utils.pod_util import is_over_quota
 
 SLICE_DOMAINS = {"pod-0": 16, "pod-1": 12}
-TS_HOSTS = 4
+TS_HOSTS = 2
+HYBRID_HOSTS = 2
 CHIPS_PER_HOST = V5E.chips_per_host          # 8
 HBM_GB = 16                                  # v5e chip HBM
-TOTAL_CHIPS = (sum(SLICE_DOMAINS.values()) + TS_HOSTS) * CHIPS_PER_HOST
+TOTAL_CHIPS = (sum(SLICE_DOMAINS.values()) + TS_HOSTS + HYBRID_HOSTS) \
+    * CHIPS_PER_HOST
 
 TICK_S = 0.25
 WARMUP_S = 60.0
 TRACE_S = 360.0
 BATCH_IDLE_S = 0.5
 BATCH_TIMEOUT_S = 2.0
-TARGET_BACKLOG_CHIPS = 64.0                  # keep demand ~25% over capacity
 UTILIZATION_TARGET = 0.85
 
-# (kind, arg, members, weight): chip-equivalents are derived from requests.
-JOB_MIX = [
-    ("slice", "1x1", 1, 3.0),
-    ("slice", "2x2", 1, 4.0),
-    ("slice", "2x4", 1, 4.0),
-    ("gang", "4x4", 2, 2.0),
-    ("gang", "4x8", 4, 1.0),
-    ("ts", 8, 1, 2.0),
-    ("ts", 4, 1, 2.0),
+# TPU-VM preemption (spot reclamation): one host per slice domain dies
+# mid-trace; replacements join at the same host-index 60 s later.
+NODE_KILL_T = 150.0
+NODE_RESTORE_T = 210.0
+KILL_NODES = ("host-3", "host-21")            # pod-0 idx 3, pod-1 idx 5
+
+# Control-experiment toggle: False runs the identical trace without any
+# ElasticQuota objects (plugin no-ops, no preemption) to price quota
+# enforcement itself.  The published bench always runs True.
+CREATE_QUOTAS = True
+
+# Quota layout: mins sum to the cluster's HBM capacity (4096 GB), so the
+# aggregate-min gate (PreFilter) equals physical capacity and borrowing
+# redistributes real headroom.
+QUOTAS = {
+    "train-a": {"min": 1536.0, "max": 3072.0},
+    "train-b": {"min": 1024.0, "max": 2560.0},
+    "serve": {"min": 768.0, "max": 1536.0},
+}
+COMPOSITE_QUOTA = {"name": "research", "namespaces": ["res-a", "res-b"],
+                   "min": 768.0, "max": 2048.0}
+NAMESPACES = [*QUOTAS, *COMPOSITE_QUOTA["namespaces"]]
+
+# Per-namespace job mixes (kind, arg, members, weight) and durations:
+# long pinned train gangs vs short serve bursts vs medium research jobs —
+# the heterogeneous regime where window fragmentation, borrowing and
+# preemption actually interact.  Timeshare demand is spawned against its
+# OWN backlog target (TS_MIX): ts pods bind within a tick, so in a shared
+# backlog the slow-binding slice pods would saturate the target and
+# starve timeshare arrivals, idling the ts hosts (measured: 83% idle).
+JOB_MIX = {
+    "train-a": [("gang", "4x8", 4, 2.0), ("gang", "4x4", 2, 2.0),
+                ("slice", "2x4", 1, 2.0)],
+    "train-b": [("gang", "4x4", 2, 3.0), ("slice", "2x4", 1, 3.0),
+                ("slice", "2x2", 1, 1.0)],
+    "serve": [("slice", "1x1", 1, 4.0), ("slice", "2x2", 1, 2.0),
+              ("slice", "1x2", 1, 2.0)],
+    "res-a": [("slice", "2x2", 1, 3.0), ("slice", "2x4", 1, 2.0)],
+    "res-b": [("slice", "2x2", 1, 2.0), ("gang", "4x4", 2, 1.0)],
+}
+# Inference/sharing replicas: longer-lived than serve's slice bursts
+# (a model replica serves for minutes), fractional-to-whole-chip HBM.
+TS_MIX = {
+    "serve": [("ts", 4, 1, 2.0), ("ts", 8, 1, 3.0), ("ts", 16, 1, 1.0)],
+    "res-a": [("ts", 8, 1, 1.0), ("ts", 16, 1, 1.0)],
+    "res-b": [("ts", 8, 1, 1.0), ("ts", 16, 1, 1.0)],
+}
+DURATION_S = {
+    "train-a": (60.0, 110.0), "train-b": (45.0, 90.0),
+    "serve": (8.0, 20.0), "res-a": (25.0, 50.0), "res-b": (25.0, 50.0),
+}
+TS_DURATION_S = {
+    "serve": (30.0, 90.0), "res-a": (25.0, 60.0), "res-b": (25.0, 60.0),
+}
+
+# Per-namespace pending-backlog targets (chip-equivalents) by phase,
+# split {slice-and-gang target, timeshare target}: phase 1 lets train-a
+# borrow, phase 2 makes serve/research reclaim (the preemption regime),
+# phase 3 is balanced churn.
+PHASES = [
+    (0.0, {"train-a": (30.0, 0.0), "train-b": (12.0, 0.0),
+           "serve": (6.0, 4.0), "res-a": (5.0, 2.0),
+           "res-b": (5.0, 2.0)}),
+    (120.0, {"train-a": (6.0, 0.0), "train-b": (10.0, 0.0),
+             "serve": (20.0, 6.0), "res-a": (10.0, 3.0),
+             "res-b": (10.0, 3.0)}),
+    (240.0, {"train-a": (14.0, 0.0), "train-b": (12.0, 0.0),
+             "serve": (12.0, 5.0), "res-a": (9.0, 3.0),
+             "res-b": (9.0, 3.0)}),
 ]
 
 
@@ -94,17 +197,22 @@ def latency_summary(by_class: dict[str, list[float]]) -> dict:
 
 
 def chip_equiv(pod) -> float:
+    """Physical chips a pod occupies: one unit of a multi-host slice is
+    one host-shard (the member's own chips), matching the quota
+    calculator's chips_per_host=8 accounting."""
     req = pod_request(pod)
-    chips = sum(s.chips * q for s, q in extract_slice_requests(req).items())
+    chips = sum(min(s.chips, CHIPS_PER_HOST) * q
+                for s, q in extract_slice_requests(req).items())
     gb = sum(g * q for g, q in extract_timeshare_requests(req).items())
     return chips + gb / HBM_GB
 
 
 class Job:
-    def __init__(self, name: str, pods: list, duration: float,
-                 created: float, cls: str = "", kind: str = "",
-                 arg=None) -> None:
+    def __init__(self, name: str, namespace: str, pods: list,
+                 duration: float, created: float, cls: str = "",
+                 kind: str = "", arg=None) -> None:
         self.name = name
+        self.namespace = namespace
         self.pods = pods
         self.duration = duration
         self.created = created
@@ -122,6 +230,7 @@ class Sim:
         clock = lambda: self.now[0]  # noqa: E731
         api = self.api = APIServer()
         state = ClusterState()
+        install_quota_webhooks(api)
         NodeController(api, state, SliceNodeInitializer(api)).bind()
         PodController(api, state).bind()
         self.slice_ctl = new_slice_partitioner_controller(
@@ -133,17 +242,39 @@ class Sim:
             batch_idle_s=BATCH_IDLE_S, clock=clock)
         self.ts_ctl.bind()
 
-        self.agents = []
+        # Quotas FIRST (through the admission-validated create path) so
+        # the scheduler's ledger is live before any pod exists.
+        # CREATE_QUOTAS=False runs the identical trace quota-free — the
+        # control experiment that prices enforcement itself.
+        self.calculator = TPUResourceCalculator(
+            HBM_GB, chips_per_host=CHIPS_PER_HOST)
+        if CREATE_QUOTAS:
+            for ns, q in QUOTAS.items():
+                api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+                    metadata=ObjectMeta(name=ns, namespace=ns),
+                    spec=ElasticQuotaSpec(
+                        min={C.RESOURCE_TPU_MEMORY: q["min"]},
+                        max={C.RESOURCE_TPU_MEMORY: q["max"]})))
+            api.create(KIND_COMPOSITE_ELASTIC_QUOTA, CompositeElasticQuota(
+                metadata=ObjectMeta(name=COMPOSITE_QUOTA["name"],
+                                    namespace="default"),
+                spec=CompositeElasticQuotaSpec(
+                    namespaces=list(COMPOSITE_QUOTA["namespaces"]),
+                    min={C.RESOURCE_TPU_MEMORY: COMPOSITE_QUOTA["min"]},
+                    max={C.RESOURCE_TPU_MEMORY: COMPOSITE_QUOTA["max"]})))
+        # The operator's reconcilers maintain the in/over-quota labels the
+        # preemptor keys on; they run on a per-tick resync (the reference
+        # operator's periodic reconcile) instead of per-event watches.
+        self.eq_reconciler = ElasticQuotaReconciler(api, self.calculator)
+        self.ceq_reconciler = CompositeElasticQuotaReconciler(
+            api, self.calculator)
+
+        self.agents: dict[str, object] = {}
+        self.slice_pod_resources: dict[str, FakePodResources] = {}
         idx = 0
         for pod_id, n in SLICE_DOMAINS.items():
             for h in range(n):
-                name = f"host-{idx}"
-                api.create(KIND_NODE, make_tpu_node(
-                    name, pod_id=pod_id, host_index=h))
-                agent = SliceAgent(api, name, default_tpu_runtime(V5E),
-                                   FakePodResources())
-                agent.start()
-                self.agents.append(agent)
+                self._add_slice_host(f"host-{idx}", pod_id, h)
                 idx += 1
         for t in range(TS_HOSTS):
             name = f"ts-{t}"
@@ -151,18 +282,39 @@ class Sim:
                 name, partitioning="timeshare", pod_id="", host_index=t))
             agent = ChipAgent(api, name)
             agent.start()
-            self.agents.append(agent)
+            self.agents[name] = agent
+        self.hybrid_agents: dict[str, tuple] = {}
+        for t in range(HYBRID_HOSTS):
+            name = f"hyb-{t}"
+            node = make_tpu_node(
+                name, partitioning="hybrid", pod_id="", host_index=t)
+            api.create(KIND_NODE, node)
+            gen = slice_generation_for(node.metadata.labels, V5E)
+            res = FakePodResources()
+            sa = SliceAgent(api, name, default_tpu_runtime(gen), res)
+            sa.start()
+            ca = ChipAgent(api, name)
+            ca.start()
+            self.agents[f"{name}/slice"] = sa
+            self.agents[f"{name}/ts"] = ca
+            self.slice_pod_resources[name] = res
+            self.hybrid_agents[name] = (sa, ca)
 
-        # Drain preemption on: after 40 cycles (10 virtual seconds) of a
-        # gang holding the lease, stragglers occupying <= 25% of the
-        # window are evicted and requeue (losing their progress — the
-        # sim's _requeue_evicted models the cost honestly).
-        self.scheduler = Scheduler(
-            api, Framework([NodeResourcesFit(), TopologyFilter(api)]),
-            drain_preempt_after_cycles=40)
+        # The production scheduler assembly: CapacityScheduling enforced,
+        # drain preemption with remaining-work-aware victims (progress
+        # from the sim's job table), host-shard quota accounting.
+        self.scheduler = build_scheduler(
+            api, HBM_GB, drain_preempt_after_cycles=40,
+            drain_preempt_progress_fn=self._pod_progress,
+            shard_chips_per_host=CHIPS_PER_HOST)
+        self.capacity: CapacityScheduling = next(
+            p for p in self.scheduler._framework.plugins
+            if isinstance(p, CapacityScheduling))
+        self.capacity.on_preempt = self._on_preempt
 
         self.jobs: dict[str, Job] = {}
         self._job_seq = 0
+        self._pod_job: dict[str, Job] = {}
         self.latencies: list[float] = []
         self.latency_by_class: dict[str, list[float]] = {}
         self.cycle_wall_ms: list[float] = []
@@ -170,39 +322,219 @@ class Sim:
         self._util_time = 0.0
         self.completed = 0
         self.drain_evictions = 0
+        # quota machinery observability
+        self.borrowed_chip_seconds = 0.0
+        self.quota_preemptions = 0
+        self.over_quota_evictions = 0
+        self._preempt_victim_names: set[str] = set()
+        self.invariant_violations: dict[str, int] = {
+            "ledger_incoherent": 0, "eq_used_over_max": 0,
+            "aggregate_over_min": 0, "victim_not_over_quota": 0,
+            "preempt_without_borrow": 0, "hybrid_bare_admission": 0,
+        }
+        # node loss bookkeeping
+        self._killed = False
+        self._restored = False
+        self._kill_affected: set[str] = set()
+        self._killed_pod_names: set[str] = set()
+        self.node_loss_recover_s: float | None = None
+        self.lost_chip_seconds = 0.0
+        self.live_chips = float(TOTAL_CHIPS)
+
+    def _add_slice_host(self, name: str, pod_id: str, host_index: int):
+        res = FakePodResources()
+        self.api.create(KIND_NODE, make_tpu_node(
+            name, pod_id=pod_id, host_index=host_index))
+        agent = SliceAgent(self.api, name, default_tpu_runtime(V5E), res)
+        agent.start()
+        self.agents[name] = agent
+        self.slice_pod_resources[name] = res
+
+    # -- quota observability -----------------------------------------------
+    def _ledger_infos(self):
+        seen: dict[int, object] = {}
+        for info in self.capacity.elastic_quota_infos.values():
+            seen[id(info)] = info
+        return list(seen.values())
+
+    def _on_preempt(self, preemptor, victims) -> None:
+        """CapacityScheduling observer: count + audit victim fairness."""
+        self.quota_preemptions += 1
+        self.over_quota_evictions += len(victims)
+        self._preempt_victim_names.update(v.metadata.name for v in victims)
+        over_gangs = {
+            (v.metadata.namespace, gang_name(v))
+            for v in victims if is_over_quota(v) and gang_name(v)}
+        for v in victims:
+            if v.metadata.namespace == preemptor.metadata.namespace:
+                continue        # same-ns priority branch (not audited here)
+            if is_over_quota(v):
+                continue
+            if gang_name(v) and (v.metadata.namespace,
+                                 gang_name(v)) in over_gangs:
+                continue        # gang-amplified from an over-quota victim
+            self.invariant_violations["victim_not_over_quota"] += 1
+        if not any(info.used_over_min() for info in self._ledger_infos()):
+            self.invariant_violations["preempt_without_borrow"] += 1
+
+    def _check_invariants(self) -> None:
+        """Falsifiable per-tick checks (module docstring)."""
+        mem = C.RESOURCE_TPU_MEMORY
+        infos = self._ledger_infos()
+        agg_used = agg_min = 0.0
+        for info in infos:
+            actual = 0.0
+            for ns in info.namespaces:
+                for p in self.api.list(KIND_POD, namespace=ns):
+                    if p.spec.node_name \
+                            and p.status.phase in (PENDING, RUNNING):
+                        actual += self.calculator.compute_pod_request(
+                            p).get(mem, 0.0)
+            ledger = info.used.get(mem, 0.0)
+            if abs(ledger - actual) > 1e-6:
+                self.invariant_violations["ledger_incoherent"] += 1
+            if info.max_enforced and ledger > info.max.get(mem, 0.0) + 1e-6:
+                self.invariant_violations["eq_used_over_max"] += 1
+            agg_used += ledger
+            agg_min += info.min.get(mem, 0.0)
+            self.borrowed_chip_seconds += max(
+                0.0, ledger - info.min.get(mem, 0.0)) / HBM_GB * TICK_S
+        if agg_used > agg_min + 1e-6:
+            self.invariant_violations["aggregate_over_min"] += 1
+        # hybrid admission ownership: running slice pods on hybrid hosts
+        # must hold a device allocation from the sliceagent's KubeletSim
+        for name in self.hybrid_agents:
+            res = self.slice_pod_resources.get(name)
+            if res is None:
+                continue
+            allocated = set(res.allocated_pod_keys())
+            for p in self.api.list(KIND_POD):
+                if p.spec.node_name == name \
+                        and p.status.phase == RUNNING \
+                        and extract_slice_requests(pod_request(p)) \
+                        and p.key not in allocated:
+                    self.invariant_violations["hybrid_bare_admission"] += 1
+
+    # -- node loss ----------------------------------------------------------
+    def _maybe_kill_restore(self) -> None:
+        if not self._killed and self.now[0] >= NODE_KILL_T:
+            self._killed = True
+            for name in KILL_NODES:
+                agent = self.agents.pop(name, None)
+                if agent is not None and hasattr(agent, "stop"):
+                    agent.stop()
+                self.slice_pod_resources.pop(name, None)
+                for p in self.api.list(KIND_POD):
+                    if p.spec.node_name == name:
+                        job = self._pod_job.get(p.metadata.name)
+                        if job is not None:
+                            self._kill_affected.add(job.name)
+                        self._killed_pod_names.add(p.metadata.name)
+                        try:
+                            self.api.delete(KIND_POD, p.metadata.name,
+                                            p.metadata.namespace)
+                        except NotFound:
+                            pass
+                try:
+                    self.api.delete(KIND_NODE, name)
+                except NotFound:
+                    pass
+            self.live_chips = float(
+                TOTAL_CHIPS - len(KILL_NODES) * CHIPS_PER_HOST)
+        if not self._restored and self.now[0] >= NODE_RESTORE_T:
+            self._restored = True
+            # replacements join at the SAME host-index: the plan handshake
+            # re-initializes them, gang windows become whole again
+            self._add_slice_host("host-3r", "pod-0", 3)
+            self._add_slice_host("host-21r", "pod-1", 5)
+            self.live_chips = float(TOTAL_CHIPS)
+    def _check_recovered(self) -> None:
+        """Runs at END of tick (after _requeue_evicted has voided the
+        affected jobs' bound_at and _record_binds has re-set it): the
+        cluster has recovered once every job that lost a pod to the node
+        kill is FULLY bound again."""
+        if not self._killed or self.node_loss_recover_s is not None \
+                or not self._kill_affected:
+            return
+        affected = [self.jobs[j] for j in self._kill_affected
+                    if j in self.jobs]
+        if affected and all(j.bound_at is not None for j in affected):
+            self.node_loss_recover_s = round(self.now[0] - NODE_KILL_T, 2)
 
     # -- trace -------------------------------------------------------------
+    def _phase_targets(self) -> dict[str, float]:
+        current = PHASES[0][1]
+        for start, targets in PHASES:
+            if self.now[0] >= start:
+                current = targets
+        return current
+
     def _spawn(self) -> None:
-        kinds, weights = zip(*[(m[:3], m[3]) for m in JOB_MIX])
-        backlog = sum(
-            chip_equiv(p) for p in self.api.list(KIND_POD)
-            if not p.spec.node_name)
-        while backlog < TARGET_BACKLOG_CHIPS:
-            kind, arg, members = self.rng.choices(kinds, weights)[0]
-            self._job_seq += 1
-            name = f"job-{self._job_seq}"
-            duration = self.rng.uniform(25.0, 50.0)
-            pods = []
-            if kind == "gang":
-                self.api.create(KIND_POD_GROUP, PodGroup(
-                    metadata=ObjectMeta(name=name, namespace="default"),
-                    spec=PodGroupSpec(min_member=members)))
-            for i in range(members):
-                if kind == "ts":
-                    pod = make_timeshare_pod(
-                        arg, 1, name=f"{name}-{i}",
-                        creation_timestamp=self.now[0])
-                else:
-                    labels = ({C.LABEL_POD_GROUP: name}
-                              if kind == "gang" else None)
-                    pod = make_slice_pod(
-                        arg, 1, name=f"{name}-{i}", labels=labels,
-                        creation_timestamp=self.now[0])
-                self.api.create(KIND_POD, pod)
-                pods.append(pod.metadata.name)
-                backlog += chip_equiv(pod)
-            self.jobs[name] = Job(name, pods, duration, self.now[0],
-                                  cls=f"{kind}-{arg}", kind=kind, arg=arg)
+        targets = self._phase_targets()
+        # Backlog split by kind (module comment on TS_MIX): pending
+        # timeshare demand is tracked apart from slice/gang demand.
+        backlog = {ns: 0.0 for ns in NAMESPACES}
+        ts_backlog = {ns: 0.0 for ns in NAMESPACES}
+        for p in self.api.list(KIND_POD):
+            if not p.spec.node_name and p.metadata.namespace in backlog:
+                job = self._pod_job.get(p.metadata.name)
+                table = ts_backlog if (job is not None
+                                       and job.kind == "ts") else backlog
+                table[p.metadata.namespace] += chip_equiv(p)
+        for ns, (target, ts_target) in targets.items():
+            lo, hi = DURATION_S[ns]
+            while backlog[ns] < target:
+                backlog[ns] += self._spawn_job(ns, JOB_MIX[ns], lo, hi)
+            if ts_target <= 0:
+                continue
+            ts_lo, ts_hi = TS_DURATION_S[ns]
+            while ts_backlog[ns] < ts_target:
+                ts_backlog[ns] += self._spawn_job(
+                    ns, TS_MIX[ns], ts_lo, ts_hi)
+
+    def _spawn_job(self, ns: str, mix, lo: float, hi: float) -> float:
+        kinds = [m[:3] for m in mix]
+        weights = [m[3] for m in mix]
+        kind, arg, members = self.rng.choices(kinds, weights)[0]
+        self._job_seq += 1
+        name = f"job-{self._job_seq}"
+        duration = self.rng.uniform(lo, hi)
+        pods = []
+        job = Job(name, ns, pods, duration, self.now[0],
+                  cls=f"{kind}-{arg}", kind=kind, arg=arg)
+        spawned = 0.0
+        if kind == "gang":
+            self.api.create(KIND_POD_GROUP, PodGroup(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                spec=PodGroupSpec(min_member=members)))
+        for i in range(members):
+            pod = self._make_job_pod(job, f"{name}-{i}", job.created)
+            self.api.create(KIND_POD, pod)
+            pods.append(pod.metadata.name)
+            self._pod_job[pod.metadata.name] = job
+            spawned += chip_equiv(pod)
+        self.jobs[name] = job
+        return spawned
+
+    def _make_job_pod(self, job: Job, pod_name: str, created: float):
+        if job.kind == "ts":
+            return make_timeshare_pod(
+                job.arg, 1, name=pod_name, namespace=job.namespace,
+                creation_timestamp=created)
+        labels = ({C.LABEL_POD_GROUP: job.name}
+                  if job.kind == "gang" else None)
+        return make_slice_pod(
+            job.arg, 1, name=pod_name, namespace=job.namespace,
+            labels=labels, creation_timestamp=created)
+
+    def _pod_progress(self, pod) -> float:
+        """Drain-preemption progress source: the sim's job table (the
+        production analog is the nos.tpu/job-progress annotation)."""
+        job = self._pod_job.get(pod.metadata.name)
+        if job is None or job.bound_at is None or job.duration <= 0:
+            return 0.0
+        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+                            / job.duration))
 
     def _complete_finished(self) -> None:
         for job in list(self.jobs.values()):
@@ -211,22 +543,24 @@ class Sim:
                 continue
             for pname in job.pods:
                 try:
-                    self.api.delete(KIND_POD, pname, "default")
+                    self.api.delete(KIND_POD, pname, job.namespace)
                 except NotFound:
                     pass
+                self._pod_job.pop(pname, None)
             try:
-                self.api.delete(KIND_POD_GROUP, job.name, "default")
+                self.api.delete(KIND_POD_GROUP, job.name, job.namespace)
             except NotFound:
                 pass
             del self.jobs[job.name]
+            self._kill_affected.discard(job.name)
             self.completed += 1
 
     def _requeue_evicted(self) -> None:
         """Honest eviction semantics: a job whose pods were evicted
-        (drain preemption) loses its progress — missing pods are
-        recreated with the ORIGINAL creation timestamp (its eventual
-        schedule latency includes the wasted run) and the duration
-        restarts at the next full bind."""
+        (drain preemption, quota preemption, or node loss) loses its
+        progress — missing pods are recreated with the ORIGINAL creation
+        timestamp (its eventual schedule latency includes the wasted run)
+        and the duration restarts at the next full bind."""
         live = {p.metadata.name for p in self.api.list(KIND_POD)}
         for job in self.jobs.values():
             missing = [n for n in job.pods if n not in live]
@@ -234,19 +568,16 @@ class Sim:
                 continue
             job.bound_at = None         # re-run from scratch once rebound
             job.evictions += 1
-            self.drain_evictions += len(missing)
             for pname in missing:
-                if job.kind == "ts":
-                    pod = make_timeshare_pod(
-                        job.arg, 1, name=pname,
-                        creation_timestamp=job.created)
+                if pname in self._preempt_victim_names:
+                    self._preempt_victim_names.discard(pname)
+                elif pname in self._killed_pod_names:
+                    self._killed_pod_names.discard(pname)
                 else:
-                    labels = ({C.LABEL_POD_GROUP: job.name}
-                              if job.kind == "gang" else None)
-                    pod = make_slice_pod(
-                        job.arg, 1, name=pname, labels=labels,
-                        creation_timestamp=job.created)
+                    self.drain_evictions += 1
+                pod = self._make_job_pod(job, pname, job.created)
                 self.api.create(KIND_POD, pod)
+                self._pod_job[pname] = job
 
     def _record_binds(self) -> None:
         bound: dict[str, float] = {}
@@ -261,18 +592,22 @@ class Sim:
                 self.latency_by_class.setdefault(job.cls, []).append(lat)
 
     def _sample_utilization(self) -> None:
+        lost = TOTAL_CHIPS - self.live_chips
+        if lost > 0:
+            self.lost_chip_seconds += lost * TICK_S
         if self.now[0] < WARMUP_S:
             return
         used = sum(
             chip_equiv(p) for p in self.api.list(KIND_POD)
             if p.spec.node_name and p.status.phase == RUNNING)
-        self._util_area += min(1.0, used / TOTAL_CHIPS) * TICK_S
+        self._util_area += min(1.0, used / self.live_chips) * TICK_S
         self._util_time += TICK_S
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> dict:
         while self.now[0] < TRACE_S:
             self.now[0] += TICK_S
+            self._maybe_kill_restore()
             self._complete_finished()
             self._spawn()
             t0 = time.perf_counter()
@@ -281,10 +616,14 @@ class Sim:
             self._requeue_evicted()
             self.slice_ctl.process_if_ready()
             self.ts_ctl.process_if_ready()
-            for a in self.agents:
+            for a in list(self.agents.values()):
                 a.tick()
+            self.eq_reconciler.reconcile_all()
+            self.ceq_reconciler.reconcile_all()
             self._record_binds()
+            self._check_recovered()
             self._sample_utilization()
+            self._check_invariants()
 
         lat = self.latencies
         cyc = self.cycle_wall_ms
@@ -299,13 +638,25 @@ class Sim:
             "jobs_bound": len(self.latencies),
             "p50_schedule_latency_s": pct(lat, 0.50, 3),
             "p90_schedule_latency_s": pct(lat, 0.90, 3),
-            # p90 attribution: which job class pays the tail (gangs wait
-            # through batch windows + repartition; singles bind off free
-            # geometry immediately)
             "schedule_latency_by_class": by_class,
             "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
             "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
             "drain_evicted_pods": self.drain_evictions,
+            "quota": {
+                "borrowed_chip_seconds": round(
+                    self.borrowed_chip_seconds, 1),
+                "preemptions": self.quota_preemptions,
+                "over_quota_evicted_pods": self.over_quota_evictions,
+                "invariant_violations": dict(self.invariant_violations),
+            },
+            "node_loss": {
+                "killed": list(KILL_NODES),
+                "kill_t_s": NODE_KILL_T,
+                "restore_t_s": NODE_RESTORE_T,
+                "affected_jobs": len(self._kill_affected),
+                "recover_s": self.node_loss_recover_s,
+                "lost_chip_seconds": round(self.lost_chip_seconds, 1),
+            },
         }
 
 
@@ -332,6 +683,12 @@ def run_seeds(seeds=range(5)) -> dict:
     for sim in sims:
         for cls, ls in sim.latency_by_class.items():
             by_class.setdefault(cls, []).extend(ls)
+    violations: dict[str, int] = {}
+    for r in runs.values():
+        for k, v in r["quota"]["invariant_violations"].items():
+            violations[k] = violations.get(k, 0) + v
+    recover = [r["node_loss"]["recover_s"] for r in runs.values()
+               if r["node_loss"]["recover_s"] is not None]
     return {
         "utilization_pct": round(sum(utils) / len(utils), 4),
         "utilization_min": round(min(utils), 4),
@@ -347,6 +704,28 @@ def run_seeds(seeds=range(5)) -> dict:
         "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
         "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
         "drain_evicted_pods": sum(s_.drain_evictions for s_ in sims),
+        "quota": {
+            "enforced": True,
+            "borrowed_chip_seconds": round(sum(
+                r["quota"]["borrowed_chip_seconds"]
+                for r in runs.values()), 1),
+            "preemptions": sum(r["quota"]["preemptions"]
+                               for r in runs.values()),
+            "over_quota_evicted_pods": sum(
+                r["quota"]["over_quota_evicted_pods"]
+                for r in runs.values()),
+            "invariant_violations": violations,
+        },
+        "node_loss": {
+            "killed_per_seed": list(KILL_NODES),
+            "recover_s_per_seed": {
+                str(s): r["node_loss"]["recover_s"]
+                for s, r in runs.items()},
+            "recover_s_max": max(recover) if recover else None,
+            "lost_chip_seconds": round(sum(
+                r["node_loss"]["lost_chip_seconds"]
+                for r in runs.values()), 1),
+        },
     }
 
 
