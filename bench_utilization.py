@@ -31,9 +31,19 @@ heterogeneous: long train gangs (45-110 s) vs short serve bursts (8-20 s).
 TPU-VM preemption: at t=150 s two hosts (one per slice domain) are killed —
 agents stop, their pods die, the nodes vanish — and at t=210 s replacement
 hosts join at the same host-index.  Affected jobs requeue with their
-original creation timestamps; time-to-recover (all affected jobs rebound)
-is reported.  Utilization is measured against LIVE capacity (dead chips
-are not schedulable), with the lost chip-seconds reported alongside.
+original creation timestamps; recovery is reported on two clocks: the
+per-affected-job rebind distribution (p50/max + never-rebound count —
+fair-share queueing of a borrower team's singles is visible, not hidden
+behind a single latch) and replacement_ready_s (plan handshake re-issued
+and actuated on the new hosts).  Utilization is measured against LIVE
+capacity (dead chips are not schedulable), with lost chip-seconds
+reported alongside.
+
+Workload priorities: train gangs run at PriorityClass 10 vs 0 for
+singles — a pinned multi-host job holds first claim on its team's quota
+headroom (the scheduler's quota head-of-line rule) and may preempt its
+own team's over-min singles, exercising BOTH victim-selection branches
+of the preemptor.
 
 Falsifiable invariants, checked EVERY tick (violations reported, 0 means
 the machinery is provably coherent under churn):
@@ -111,7 +121,9 @@ UTILIZATION_TARGET = 0.85
 # mid-trace; replacements join at the same host-index 60 s later.
 NODE_KILL_T = 150.0
 NODE_RESTORE_T = 210.0
-KILL_NODES = ("host-3", "host-21")            # pod-0 idx 3, pod-1 idx 5
+# pod-0 idx 3, pod-1 idx 5; replacements join at the same host-index
+KILL_NODES = {"host-3": ("pod-0", 3), "host-21": ("pod-1", 5)}
+REPLACEMENT_NODES = {f"{n}r": spec for n, spec in KILL_NODES.items()}
 
 # Control-experiment toggle: False runs the identical trace without any
 # ElasticQuota objects (plugin no-ops, no preemption) to price quota
@@ -159,24 +171,34 @@ DURATION_S = {
     "serve": (8.0, 20.0), "res-a": (25.0, 50.0), "res-b": (25.0, 50.0),
 }
 TS_DURATION_S = {
-    "serve": (30.0, 90.0), "res-a": (25.0, 60.0), "res-b": (25.0, 60.0),
+    "serve": (25.0, 70.0), "res-a": (20.0, 50.0), "res-b": (20.0, 50.0),
 }
 
 # Per-namespace pending-backlog targets (chip-equivalents) by phase,
 # split {slice-and-gang target, timeshare target}: phase 1 lets train-a
 # borrow, phase 2 makes serve/research reclaim (the preemption regime),
-# phase 3 is balanced churn.
+# phase 3 is balanced churn.  train-a's phase-2 target deliberately
+# keeps the team slightly OVER its min: a team sitting exactly at min
+# leaves its high-priority gang nothing to preempt (same-namespace
+# victims require used > min) and the gang must wait out its own
+# singles' full durations — measured p50 108 s vs 15 s with headroom.
 PHASES = [
-    (0.0, {"train-a": (30.0, 0.0), "train-b": (12.0, 0.0),
+    (0.0, {"train-a": (34.0, 0.0), "train-b": (12.0, 0.0),
            "serve": (6.0, 4.0), "res-a": (5.0, 2.0),
            "res-b": (5.0, 2.0)}),
-    (120.0, {"train-a": (6.0, 0.0), "train-b": (10.0, 0.0),
-             "serve": (20.0, 6.0), "res-a": (10.0, 3.0),
+    (120.0, {"train-a": (12.0, 0.0), "train-b": (10.0, 0.0),
+             "serve": (16.0, 5.0), "res-a": (10.0, 3.0),
              "res-b": (10.0, 3.0)}),
-    (240.0, {"train-a": (14.0, 0.0), "train-b": (12.0, 0.0),
-             "serve": (12.0, 5.0), "res-a": (9.0, 3.0),
+    (240.0, {"train-a": (16.0, 0.0), "train-b": (12.0, 0.0),
+             "serve": (12.0, 4.0), "res-a": (9.0, 3.0),
              "res-b": (9.0, 3.0)}),
 ]
+
+# Train gangs run at a PriorityClass above their team's singles: a
+# pinned multi-host job holds first claim on the team's quota headroom
+# (the scheduler's quota head-of-line rule keys on it) and may preempt
+# the team's own over-min singles.
+GANG_PRIORITY = 10
 
 
 def percentile(xs, q: float, digits: int):
@@ -337,7 +359,9 @@ class Sim:
         self._restored = False
         self._kill_affected: set[str] = set()
         self._killed_pod_names: set[str] = set()
-        self.node_loss_recover_s: float | None = None
+        self._rebind_latencies: list[float] = []
+        self._affected_total = 0
+        self.replacement_ready_s: float | None = None
         self.lost_chip_seconds = 0.0
         self.live_chips = float(TOTAL_CHIPS)
 
@@ -439,27 +463,51 @@ class Sim:
                     self.api.delete(KIND_NODE, name)
                 except NotFound:
                     pass
+            self._affected_total = len(self._kill_affected)
             self.live_chips = float(
                 TOTAL_CHIPS - len(KILL_NODES) * CHIPS_PER_HOST)
         if not self._restored and self.now[0] >= NODE_RESTORE_T:
             self._restored = True
             # replacements join at the SAME host-index: the plan handshake
             # re-initializes them, gang windows become whole again
-            self._add_slice_host("host-3r", "pod-0", 3)
-            self._add_slice_host("host-21r", "pod-1", 5)
+            for name, (pod_id, idx) in REPLACEMENT_NODES.items():
+                self._add_slice_host(name, pod_id, idx)
             self.live_chips = float(TOTAL_CHIPS)
     def _check_recovered(self) -> None:
         """Runs at END of tick (after _requeue_evicted has voided the
-        affected jobs' bound_at and _record_binds has re-set it): the
-        cluster has recovered once every job that lost a pod to the node
-        kill is FULLY bound again."""
-        if not self._killed or self.node_loss_recover_s is not None \
-                or not self._kill_affected:
+        affected jobs' bound_at and _record_binds has re-set it).  Two
+        recovery clocks, reported separately:
+
+        - workload: per-affected-job FIRST rebind since the kill (the
+          distribution matters — quota head-of-line can legitimately
+          queue a borrower team's small jobs behind its gang claimant,
+          so a single latch would conflate fair-share queueing with
+          recovery failure);
+        - control plane: replacement nodes carrying agent-reported
+          status annotations (the plan handshake re-issued and actuated
+          on the new hosts)."""
+        if not self._killed:
             return
-        affected = [self.jobs[j] for j in self._kill_affected
-                    if j in self.jobs]
-        if affected and all(j.bound_at is not None for j in affected):
-            self.node_loss_recover_s = round(self.now[0] - NODE_KILL_T, 2)
+        for name in list(self._kill_affected):
+            job = self.jobs.get(name)
+            if job is None:
+                # vanished without rebinding (future give-up paths):
+                # stays in never_rebound, records no latency
+                self._kill_affected.discard(name)
+            elif job.bound_at is not None:
+                self._kill_affected.discard(name)
+                self._rebind_latencies.append(self.now[0] - NODE_KILL_T)
+        if self._restored and self.replacement_ready_s is None:
+            ready = 0
+            for name in REPLACEMENT_NODES:
+                node = self.api.try_get(KIND_NODE, name)
+                if node is not None and any(
+                        "status-tpu" in k
+                        for k in node.metadata.annotations):
+                    ready += 1
+            if ready == len(REPLACEMENT_NODES):
+                self.replacement_ready_s = round(
+                    self.now[0] - NODE_RESTORE_T, 2)
 
     # -- trace -------------------------------------------------------------
     def _phase_targets(self) -> dict[str, float]:
@@ -525,7 +573,8 @@ class Sim:
                   if job.kind == "gang" else None)
         return make_slice_pod(
             job.arg, 1, name=pod_name, namespace=job.namespace,
-            labels=labels, creation_timestamp=created)
+            labels=labels, creation_timestamp=created,
+            priority=GANG_PRIORITY if job.kind == "gang" else 0)
 
     def _pod_progress(self, pod) -> float:
         """Drain-preemption progress source: the sim's job table (the
@@ -653,8 +702,14 @@ class Sim:
                 "killed": list(KILL_NODES),
                 "kill_t_s": NODE_KILL_T,
                 "restore_t_s": NODE_RESTORE_T,
-                "affected_jobs": len(self._kill_affected),
-                "recover_s": self.node_loss_recover_s,
+                "affected_jobs": self._affected_total,
+                "rebound_jobs": len(self._rebind_latencies),
+                "never_rebound": self._affected_total
+                - len(self._rebind_latencies),
+                "rebind_p50_s": pct(self._rebind_latencies, 0.50, 2),
+                "rebind_max_s": (round(max(self._rebind_latencies), 2)
+                                 if self._rebind_latencies else None),
+                "replacement_ready_s": self.replacement_ready_s,
                 "lost_chip_seconds": round(self.lost_chip_seconds, 1),
             },
         }
@@ -687,8 +742,9 @@ def run_seeds(seeds=range(5)) -> dict:
     for r in runs.values():
         for k, v in r["quota"]["invariant_violations"].items():
             violations[k] = violations.get(k, 0) + v
-    recover = [r["node_loss"]["recover_s"] for r in runs.values()
-               if r["node_loss"]["recover_s"] is not None]
+    rebinds = [x for sim in sims for x in sim._rebind_latencies]
+    ready = [r["node_loss"]["replacement_ready_s"] for r in runs.values()
+             if r["node_loss"]["replacement_ready_s"] is not None]
     return {
         "utilization_pct": round(sum(utils) / len(utils), 4),
         "utilization_min": round(min(utils), 4),
@@ -718,10 +774,17 @@ def run_seeds(seeds=range(5)) -> dict:
         },
         "node_loss": {
             "killed_per_seed": list(KILL_NODES),
-            "recover_s_per_seed": {
-                str(s): r["node_loss"]["recover_s"]
-                for s, r in runs.items()},
-            "recover_s_max": max(recover) if recover else None,
+            "affected_jobs": sum(r["node_loss"]["affected_jobs"]
+                                 for r in runs.values()),
+            "rebound_jobs": sum(r["node_loss"]["rebound_jobs"]
+                                for r in runs.values()),
+            "never_rebound": sum(r["node_loss"]["never_rebound"]
+                                 for r in runs.values()),
+            "rebind_p50_s": pct(rebinds, 0.50, 2),
+            "rebind_p90_s": pct(rebinds, 0.90, 2),
+            "rebind_max_s": (round(max(rebinds), 2) if rebinds
+                             else None),
+            "replacement_ready_s_max": max(ready) if ready else None,
             "lost_chip_seconds": round(sum(
                 r["node_loss"]["lost_chip_seconds"]
                 for r in runs.values()), 1),

@@ -80,7 +80,9 @@ def build_scheduler(api: APIServer,
                     drain_preempt_spare_progress: float = 0.75,
                     drain_preempt_progress_fn=None,
                     shard_chips_per_host: int = 0,
-                    preempt_budget_per_cycle: int = 2) -> Scheduler:
+                    preempt_budget_per_cycle: int = 2,
+                    backfill_remaining_fn=None,
+                    backfill_duration_fn=None) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
     from nos_tpu.quota import TPUResourceCalculator
@@ -96,4 +98,6 @@ def build_scheduler(api: APIServer,
         drain_preempt_max_busy_fraction=drain_preempt_max_busy_fraction,
         drain_preempt_spare_progress=drain_preempt_spare_progress,
         drain_preempt_progress_fn=drain_preempt_progress_fn,
-        preempt_budget_per_cycle=preempt_budget_per_cycle)
+        preempt_budget_per_cycle=preempt_budget_per_cycle,
+        backfill_remaining_fn=backfill_remaining_fn,
+        backfill_duration_fn=backfill_duration_fn)

@@ -122,18 +122,26 @@ class Pod(FastCopy):
 
     def is_unschedulable(self) -> bool:
         """Pod marked unschedulable by the scheduler (condition
-        PodScheduled=False/Unschedulable).  Reference pkg/util/pod/pod.go:31-39."""
+        PodScheduled=False/Unschedulable, optionally refined as
+        Unschedulable/<class>).  Reference pkg/util/pod/pod.go:31-39."""
         return any(
-            c.type == "PodScheduled" and c.status == "False" and c.reason == "Unschedulable"
+            c.type == "PodScheduled" and c.status == "False"
+            and c.reason.split("/", 1)[0] == "Unschedulable"
             for c in self.status.conditions
         )
 
-    def mark_unschedulable(self, message: str = "") -> None:
+    def mark_unschedulable(self, message: str = "",
+                           reason: str = "") -> None:
+        """`reason` refines the standard Unschedulable condition reason
+        with a machine-readable class (e.g. "Unschedulable/quota-hol")
+        so controllers can filter without parsing messages."""
         self.status.conditions = [
             c for c in self.status.conditions if c.type != "PodScheduled"
         ]
+        cond_reason = f"Unschedulable/{reason}" if reason \
+            else "Unschedulable"
         self.status.conditions.append(
-            PodCondition("PodScheduled", "False", "Unschedulable", message)
+            PodCondition("PodScheduled", "False", cond_reason, message)
         )
 
 

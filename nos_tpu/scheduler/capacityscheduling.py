@@ -229,10 +229,11 @@ class CapacityScheduling:
         if eq.used_over_max_with(pfs.nominated_in_eq_with_req):
             return Status.unschedulable(
                 f"quota {eq.resource_namespace}/{eq.resource_name} "
-                f"used more than max"
+                f"used more than max", reason="quota"
             )
         if snapshot.aggregated_used_over_min_with(pfs.nominated_with_req):
-            return Status.unschedulable("total quota used is more than min")
+            return Status.unschedulable("total quota used is more than min",
+                                       reason="quota")
         return Status.ok()
 
     def _nominated_pods(self) -> list[Pod]:

@@ -34,6 +34,10 @@ ERROR = "Error"
 class Status:
     code: str = SUCCESS
     message: str = ""
+    # Machine-readable rejection class (e.g. "quota" from
+    # CapacityScheduling) — lets the scheduler react to WHY a pod is
+    # unschedulable without parsing messages.  "" = unclassified.
+    reason: str = ""
 
     @property
     def is_success(self) -> bool:
@@ -44,8 +48,8 @@ class Status:
         return Status(SUCCESS)
 
     @staticmethod
-    def unschedulable(msg: str) -> "Status":
-        return Status(UNSCHEDULABLE, msg)
+    def unschedulable(msg: str, reason: str = "") -> "Status":
+        return Status(UNSCHEDULABLE, msg, reason)
 
     @staticmethod
     def error(msg: str) -> "Status":

@@ -85,7 +85,9 @@ class Scheduler:
                  drain_preempt_max_busy_fraction: float = 0.25,
                  drain_preempt_spare_progress: float = 0.75,
                  drain_preempt_progress_fn=None,
-                 preempt_budget_per_cycle: int = 2) -> None:
+                 preempt_budget_per_cycle: int = 2,
+                 backfill_remaining_fn=None,
+                 backfill_duration_fn=None) -> None:
         self._api = api
         self._framework = framework
         self.name = name
@@ -123,6 +125,23 @@ class Scheduler:
         # simply retry next cycle (one tick later).
         self._preempt_budget_per_cycle = preempt_budget_per_cycle
         self._preempt_budget = self._preempt_budget_per_cycle
+        # Duration-aware backfill on the drain window (opt-in, both fns
+        # required): a single may bind onto a reserved host ONLY if its
+        # expected duration fits inside the window's drain ETA (max
+        # remaining time of the stragglers already there) — short jobs
+        # keep the draining window busy for free, anything longer would
+        # push the stuck gang's bind out and is excluded outright.
+        # `backfill_remaining_fn(pod)` estimates a RUNNING pod's
+        # remaining seconds (None = unknown); `backfill_duration_fn(pod)`
+        # a PENDING pod's total expected seconds (None = unknown, which
+        # excludes it — don't gamble the window on an unbounded job).
+        # Production sources these from duration/deadline annotations;
+        # the simulator injects its job table.  Without the fns, the
+        # score-key's soft avoidance (reserved hosts last) is unchanged.
+        self._backfill_remaining_fn = backfill_remaining_fn
+        self._backfill_duration_fn = backfill_duration_fn
+        self._window_eta: float | None = None
+        self._quota_hol: dict[str, int] = {}
         # Gang window lease: each cycle, the oldest stuck multi-host gang
         # reserves its currently most-drained candidate window (re-picked
         # every cycle — completions are stochastic, so tracking whichever
@@ -152,6 +171,8 @@ class Scheduler:
         state = CycleState()
         status = self._framework.run_pre_filter_plugins(state, pod, lister)
         if not status.is_success:
+            if status.reason == "quota":
+                self._record_quota_hol(pod)
             # An unschedulable PreFilter verdict still gets a preemption
             # attempt, exactly like kube-scheduler: quota rejections are
             # resolved by evicting over-quota borrowers (reference
@@ -166,6 +187,8 @@ class Scheduler:
             return None
         feasible: list[NodeInfo] = []
         for ni in lister.list():
+            if not self._backfill_allows(pod, ni):
+                continue
             if self._framework.run_filter_plugins(state, pod, ni).is_success:
                 feasible.append(ni)
         if not feasible:
@@ -190,6 +213,8 @@ class Scheduler:
         label are admitted all-or-nothing (gang scheduling)."""
         bound = 0
         self._preempt_budget = self._preempt_budget_per_cycle
+        self._window_eta = None     # re-estimated per cycle
+        self._quota_hol: dict[str, int] = {}
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
@@ -210,6 +235,7 @@ class Scheduler:
         self._lease_healed = True
         self._reserved_hosts = (self._lease[1] if self._lease is not None
                                 else frozenset())
+        self._window_eta = None     # follows _reserved_hosts, always
         self._maybe_drain_preempt()
         gangs: dict[tuple[str, str], list[Pod]] = {}
         for pod in pods:
@@ -218,6 +244,8 @@ class Scheduler:
                 gangs.setdefault((pod.metadata.namespace, g), []).append(pod)
         seen_gangs: set[tuple[str, str]] = set()
         for pod in pods:
+            if self._quota_hol_defers(pod):
+                continue
             g = gang_name(pod)
             if not g:
                 if self.schedule_one(pod) is not None:
@@ -228,6 +256,51 @@ class Scheduler:
                 seen_gangs.add(key)
                 bound += self.schedule_gang(gangs[key])
         return bound
+
+    # -- quota head-of-line -------------------------------------------------
+    # A quota-rejected pod is waiting for LEDGER headroom in its
+    # namespace's share; once it is rejected this cycle, lower-priority
+    # pods of the same namespace must not bind into the headroom that
+    # frees up (first-come ledger allocation would starve a big gang
+    # forever: every chunk of freed quota is eaten by a small single
+    # before the gang's full requirement accumulates — the ledger-level
+    # twin of the physical window-lease problem).  Scope is one cycle;
+    # the rejection re-records each cycle while the claimant waits.
+
+    def _record_quota_hol(self, pod: Pod,
+                          total_request=None) -> None:
+        ns = pod.metadata.namespace
+        # Unsatisfiability guard: a claimant whose request ALONE exceeds
+        # the namespace max can never schedule — no eviction set frees
+        # enough — so letting it hold the head-of-line would starve the
+        # whole namespace until someone deletes it.  Such a claimant
+        # records nothing.
+        cap = next((p for p in self._framework.plugins
+                    if hasattr(p, "elastic_quota_infos")), None)
+        if cap is not None:
+            info = cap.elastic_quota_infos.get(ns)
+            if info is not None and info.max_enforced:
+                req = total_request if total_request is not None \
+                    else cap.calculator.compute_pod_request(pod)
+                if any(req.get(r, 0.0) > limit
+                       for r, limit in info.max.items()):
+                    logger.warning(
+                        "quota HOL: claimant %s requests more than "
+                        "namespace %s max on its own — never "
+                        "schedulable, not blocking the namespace",
+                        pod.key, ns)
+                    return
+        self._quota_hol[ns] = max(self._quota_hol.get(ns, 0),
+                                  pod.spec.priority)
+
+    def _quota_hol_defers(self, pod: Pod) -> bool:
+        blocker = self._quota_hol.get(pod.metadata.namespace)
+        if blocker is None or pod.spec.priority >= blocker:
+            return False
+        self._mark_unschedulable(pod, Status.unschedulable(
+            f"waiting behind a higher-priority quota claim in namespace "
+            f"{pod.metadata.namespace}", reason="quota-hol"))
+        return True
 
     def schedule_gang(self, members: list[Pod]) -> int:
         """All-or-nothing placement of a pod group: simulate every member
@@ -349,6 +422,37 @@ class Scheduler:
         logger.info("gang %s: bound %d pods",
                     gang_name(first), len(placements))
         return len(placements)
+
+    def _backfill_allows(self, pod: Pod, ni: NodeInfo) -> bool:
+        """Duration-aware drain-window backfill (__init__); True outside
+        the reserved window or when the feature is off."""
+        if ni.name not in self._reserved_hosts \
+                or self._backfill_duration_fn is None \
+                or self._backfill_remaining_fn is None:
+            return True
+        duration = self._backfill_duration_fn(pod)
+        if duration is None:
+            return False        # unbounded job: never gamble the window
+        return duration <= self._window_drain_eta()
+
+    def _window_drain_eta(self) -> float:
+        """Max estimated remaining seconds among pods running on the
+        reserved window (cached per cycle).  Unknown remaining => +inf:
+        the window will not drain on its own soon anyway (drain
+        preemption is the lever there), so backfill costs nothing."""
+        if self._window_eta is not None:
+            return self._window_eta
+        eta = 0.0
+        for p in self._api.list(KIND_POD):
+            if p.spec.node_name in self._reserved_hosts \
+                    and p.status.phase in (PENDING, RUNNING):
+                rem = self._backfill_remaining_fn(p)
+                if rem is None:
+                    eta = float("inf")
+                    break
+                eta = max(eta, rem)
+        self._window_eta = eta
+        return eta
 
     def _post_filter_budgeted(self, state: CycleState, pod: Pod,
                               lister: SharedLister) -> tuple[str, Status]:
@@ -515,6 +619,13 @@ class Scheduler:
                         state, pod, ni).is_success
                 ]
             if not feasible:
+                if status.reason == "quota":
+                    # the gang is waiting on LEDGER headroom: lower-
+                    # priority same-namespace pods defer (quota HOL).
+                    # The unsatisfiability guard judges the WHOLE
+                    # gang's request, not one member's.
+                    total = self._gang_total_request(members)
+                    self._record_quota_hol(pod, total_request=total)
                 return [], state, domain, pod
             chosen = min(feasible, key=self._score_key(pod))
             chosen.add_pod(pod)  # next member sees reduced capacity
@@ -522,6 +633,21 @@ class Scheduler:
                 state, pod, pod, chosen)  # book quota usage for mates
             placements.append((pod, chosen))
         return placements, state, domain, None
+
+    def _gang_total_request(self, members: list[Pod]):
+        """Aggregate quota request of a gang, in the capacity plugin's
+        currency; None when no capacity plugin is registered."""
+        cap = next((p for p in self._framework.plugins
+                    if hasattr(p, "elastic_quota_infos")), None)
+        if cap is None:
+            return None
+        from nos_tpu.kube.resources import sum_resources
+
+        total: dict = {}
+        for m in members:
+            total = sum_resources(
+                total, cap.calculator.compute_pod_request(m))
+        return total
 
     @staticmethod
     def _pins_match(ni: NodeInfo, pins: dict) -> bool:
@@ -657,6 +783,7 @@ class Scheduler:
         if best is not None:
             self._lease = (gang_key, best[1])
             self._reserved_hosts = best[1]
+            self._window_eta = None     # new window: stale ETA must die
             self._sync_lease_annotations(best[1], gang_key)
             logger.debug("gang %s leased window %s",
                          gang_key, sorted(best[1]))
@@ -792,5 +919,5 @@ class Scheduler:
 
     def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
         def mutate(p: Pod) -> None:
-            p.mark_unschedulable(status.message)
+            p.mark_unschedulable(status.message, status.reason)
         self._patch_pod(pod, mutate)

@@ -405,6 +405,109 @@ class TestGangPreemption:
             assert pod.status.phase == RUNNING
 
 
+class TestQuotaHeadOfLine:
+    """A quota-rejected high-priority claimant blocks lower-priority
+    same-namespace pods from eating the freed ledger headroom
+    (scheduler.py quota HOL): without it, every chunk of quota that
+    frees is taken by a small single before a big gang's requirement
+    accumulates, starving the gang forever."""
+
+    def test_lower_priority_single_defers_behind_quota_claim(self):
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        from nos_tpu.cmd.assembly import build_scheduler
+        from nos_tpu.kube.client import KIND_ELASTIC_QUOTA
+
+        api = APIServer()
+        # plenty of physical room; quota max is the binding constraint
+        for h in range(2):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{h}", pod_id="pod-a", host_index=h,
+                status_geometry={"free": {"2x2": 2}}))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="team"),
+            spec=ElasticQuotaSpec(
+                min={C.RESOURCE_TPU_MEMORY: 32.0},
+                max={C.RESOURCE_TPU_MEMORY: 128.0})))
+        sched = build_scheduler(api)
+        # occupant holds 64 GB; big claimant (128 GB) is SATISFIABLE
+        # (fits max alone) but blocked while the occupant lives
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="occ", namespace="team", node_name="host-0",
+            phase=RUNNING))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 2, name="big", namespace="team", priority=10))
+        # small: 64 GB, fits max — but must defer behind the claimant
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="small", namespace="team", priority=0,
+            creation_timestamp=1.0))
+        sched.run_cycle()
+        small = api.get(KIND_POD, "small", "team")
+        assert not small.spec.node_name
+        msgs = " ".join(c.message or "" for c in small.status.conditions)
+        assert "higher-priority quota claim" in msgs
+        assert any(c.reason == "Unschedulable/quota-hol"
+                   for c in small.status.conditions)
+        # other namespaces are unaffected by team's HOL
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="other", namespace="free-ns",
+            creation_timestamp=1.0))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "other", "free-ns").spec.node_name
+
+    def test_unsatisfiable_claimant_does_not_block_namespace(self):
+        """A claimant whose request ALONE exceeds the namespace max can
+        never schedule; it must not hold the head-of-line (permanent
+        namespace starvation)."""
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        from nos_tpu.cmd.assembly import build_scheduler
+        from nos_tpu.kube.client import KIND_ELASTIC_QUOTA
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "host-0", pod_id="pod-a", host_index=0,
+            status_geometry={"free": {"2x2": 2}}))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="team"),
+            spec=ElasticQuotaSpec(
+                min={C.RESOURCE_TPU_MEMORY: 64.0},
+                max={C.RESOURCE_TPU_MEMORY: 64.0})))
+        sched = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 2, name="impossible", namespace="team", priority=10))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="small", namespace="team", priority=0,
+            creation_timestamp=1.0))
+        sched.run_cycle()
+        # the impossible claimant never binds; small proceeds anyway
+        assert not api.get(KIND_POD, "impossible",
+                           "team").spec.node_name
+        assert api.get(KIND_POD, "small", "team").spec.node_name
+
+    def test_equal_priority_not_deferred(self):
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        from nos_tpu.cmd.assembly import build_scheduler
+        from nos_tpu.kube.client import KIND_ELASTIC_QUOTA
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "host-0", pod_id="pod-a", host_index=0,
+            status_geometry={"free": {"2x2": 2}}))
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="team"),
+            spec=ElasticQuotaSpec(
+                min={C.RESOURCE_TPU_MEMORY: 64.0},
+                max={C.RESOURCE_TPU_MEMORY: 64.0})))
+        sched = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 2, name="big", namespace="team", priority=0))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="peer", namespace="team", priority=0,
+            creation_timestamp=1.0))
+        sched.run_cycle()
+        # first-come at equal priority: the peer binds
+        assert api.get(KIND_POD, "peer", "team").spec.node_name
+
+
 class TestDrainPreemption:
     """Opt-in eviction of the last stragglers off a long-held drain
     window: the lease counts cycles; once past the threshold with the
@@ -545,6 +648,43 @@ class TestDrainPreemption:
         for _ in range(8):
             sched.run_cycle()
         assert api.try_get(KIND_POD, "s", "default") is not None
+
+    def test_duration_aware_backfill(self):
+        """Opt-in backfill: a single whose expected duration fits inside
+        the reserved window's drain ETA may bind there; a longer one is
+        excluded outright (it would outlive the drain); unknown duration
+        never backfills."""
+        from nos_tpu.scheduler.framework import NodeResourcesFit
+        from nos_tpu.scheduler.gang import TopologyFilter
+
+        api = APIServer()
+        for h in range(4):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{h}", pod_id="pod-a", host_index=h,
+                status_geometry={"free": {"1x2": 4}}))
+        durations = {"straggler": 20.0, "short": 5.0, "long": 60.0}
+        sched = Scheduler(
+            api, Framework([NodeResourcesFit(), TopologyFilter(api)]),
+            backfill_remaining_fn=lambda p: durations.get(
+                p.metadata.name),
+            backfill_duration_fn=lambda p: durations.get(
+                p.metadata.name))
+        # a straggler with 20 s left occupies the window the stuck gang
+        # is draining
+        api.create(KIND_POD, make_slice_pod(
+            "1x2", 1, name="straggler", node_name="host-1",
+            phase=RUNNING))
+        self._stuck_gang(api)
+        sched.run_cycle()       # gang earns the lease on hosts 0-3
+        assert sched._reserved_hosts
+        api.create(KIND_POD, make_slice_pod("1x2", 1, name="short"))
+        api.create(KIND_POD, make_slice_pod("1x2", 1, name="long"))
+        api.create(KIND_POD, make_slice_pod("1x2", 1, name="unknown"))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "short", "default").spec.node_name
+        assert not api.get(KIND_POD, "long", "default").spec.node_name
+        assert not api.get(KIND_POD, "unknown",
+                           "default").spec.node_name
 
     def test_disabled_by_default(self):
         api, sched = self._cluster()
