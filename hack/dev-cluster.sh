@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Dev-cluster on-ramp (the reference ships hack/kind as the contributor
+# entry point; this is the nos-tpu analog).  Two modes:
+#
+#   ./hack/dev-cluster.sh up       create a 3-node kind cluster
+#                                  (hack/kind/cluster.yaml), install the
+#                                  CRDs and the rendered chart, wait for
+#                                  the control plane.  Needs kind+kubectl.
+#   ./hack/dev-cluster.sh render   render-and-validate only: produce the
+#                                  manifests `up` would apply and check
+#                                  every ConfigMap through the typed
+#                                  config loaders.  Needs only python3 —
+#                                  works in this repo's CI image, which
+#                                  has no cluster binaries.
+#   ./hack/dev-cluster.sh down     delete the kind cluster.
+#
+# `render` is the CI-enforced half: it runs in environments without
+# kind, so the manifests stay valid even where `up` cannot execute.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER=nos-tpu-dev
+OUT="${OUT:-/tmp/nos-tpu-rendered}"
+
+render() {
+    python3 hack/render-chart.py --out "$OUT"
+}
+
+case "${1:-render}" in
+  render)
+    render
+    ;;
+  up)
+    command -v kind >/dev/null || {
+        echo "kind not found — run './hack/dev-cluster.sh render' for the \
+no-binaries mode" >&2; exit 1; }
+    command -v kubectl >/dev/null || { echo "kubectl not found" >&2; exit 1; }
+    render
+    kind create cluster --name "$CLUSTER" --config hack/kind/cluster.yaml
+    kubectl apply -f deploy/helm/nos-tpu/crds/
+    kubectl apply -f "$OUT/nos-tpu.yaml"
+    kubectl -n nos-tpu-system wait --for=condition=Available deployment \
+        --all --timeout=300s
+    echo "nos-tpu dev cluster '$CLUSTER' is up; try:"
+    echo "  kubectl -n nos-tpu-system get pods"
+    echo "  kubectl apply -f docs/quotas.md examples"
+    ;;
+  down)
+    kind delete cluster --name "$CLUSTER"
+    ;;
+  *)
+    echo "usage: $0 {up|render|down}" >&2
+    exit 2
+    ;;
+esac

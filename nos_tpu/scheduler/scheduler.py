@@ -25,7 +25,7 @@ from nos_tpu.api.constants import (
 )
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
-from nos_tpu.kube.resources import pod_request
+from nos_tpu.kube.resources import pod_request, sum_resources
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status, UNSCHEDULABLE,
 )
@@ -142,6 +142,11 @@ class Scheduler:
         self._backfill_duration_fn = backfill_duration_fn
         self._window_eta: float | None = None
         self._quota_hol: dict[str, int] = {}
+        # The capacity plugin, if registered (fixed at construction):
+        # quota HOL and gang evictability consult its ledger/calculator.
+        self._capacity = next(
+            (p for p in framework.plugins
+             if hasattr(p, "elastic_quota_infos")), None)
         # Gang window lease: each cycle, the oldest stuck multi-host gang
         # reserves its currently most-drained candidate window (re-picked
         # every cycle — completions are stochastic, so tracking whichever
@@ -270,26 +275,30 @@ class Scheduler:
     def _record_quota_hol(self, pod: Pod,
                           total_request=None) -> None:
         ns = pod.metadata.namespace
-        # Unsatisfiability guard: a claimant whose request ALONE exceeds
-        # the namespace max can never schedule — no eviction set frees
-        # enough — so letting it hold the head-of-line would starve the
-        # whole namespace until someone deletes it.  Such a claimant
-        # records nothing.
-        cap = next((p for p in self._framework.plugins
-                    if hasattr(p, "elastic_quota_infos")), None)
+        # Unsatisfiability guard: a claimant whose request ALONE can
+        # never pass the quota gates — it exceeds its namespace max, or
+        # the cluster's aggregated min (rejected even at zero usage) —
+        # will never schedule no matter what is evicted, so letting it
+        # hold the head-of-line would starve the whole namespace until
+        # someone deletes it.  Such a claimant records nothing.
+        cap = self._capacity
         if cap is not None:
+            req = total_request if total_request is not None \
+                else cap.calculator.compute_pod_request(pod)
             info = cap.elastic_quota_infos.get(ns)
-            if info is not None and info.max_enforced:
-                req = total_request if total_request is not None \
-                    else cap.calculator.compute_pod_request(pod)
-                if any(req.get(r, 0.0) > limit
-                       for r, limit in info.max.items()):
-                    logger.warning(
-                        "quota HOL: claimant %s requests more than "
-                        "namespace %s max on its own — never "
-                        "schedulable, not blocking the namespace",
-                        pod.key, ns)
-                    return
+            over_own_max = (info is not None and info.max_enforced
+                            and any(req.get(r, 0.0) > limit
+                                    for r, limit in info.max.items()))
+            agg_min = cap.elastic_quota_infos.aggregated_min()
+            over_agg_min = any(req.get(r, 0.0) > limit
+                               for r, limit in agg_min.items())
+            if over_own_max or over_agg_min:
+                logger.warning(
+                    "quota HOL: claimant %s requests more than %s can "
+                    "ever grant (namespace max or aggregated min) — "
+                    "never schedulable, not blocking the namespace",
+                    pod.key, ns)
+                return
         self._quota_hol[ns] = max(self._quota_hol.get(ns, 0),
                                   pod.spec.priority)
 
@@ -637,16 +646,12 @@ class Scheduler:
     def _gang_total_request(self, members: list[Pod]):
         """Aggregate quota request of a gang, in the capacity plugin's
         currency; None when no capacity plugin is registered."""
-        cap = next((p for p in self._framework.plugins
-                    if hasattr(p, "elastic_quota_infos")), None)
-        if cap is None:
+        if self._capacity is None:
             return None
-        from nos_tpu.kube.resources import sum_resources
-
         total: dict = {}
         for m in members:
             total = sum_resources(
-                total, cap.calculator.compute_pod_request(m))
+                total, self._capacity.calculator.compute_pod_request(m))
         return total
 
     @staticmethod
@@ -683,8 +688,7 @@ class Scheduler:
                    for p in self._framework.plugins):
             return None  # nothing could perform an eviction anyway
         first = members[0]
-        cap = next((p for p in self._framework.plugins
-                    if hasattr(p, "elastic_quota_infos")), None)
+        cap = self._capacity
         infos = cap.elastic_quota_infos if cap is not None else None
         preemptor_info = (infos.get(first.metadata.namespace)
                           if infos is not None else None)
@@ -694,7 +698,6 @@ class Scheduler:
             # mates booked into the quota snapshot, so its over-min test
             # effectively sees the whole gang's claim — a single member's
             # request would misclassify same-namespace victims.
-            from nos_tpu.kube.resources import sum_resources
 
             total_req: dict = {}
             for m in members:

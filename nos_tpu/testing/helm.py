@@ -1,0 +1,104 @@
+"""Minimal helm-template renderer for the nos-tpu chart.
+
+Implements exactly the template subset the chart commits to
+(deploy/helm/nos-tpu/_helpers.tpl documents it): `.Values/.Release/
+.Chart` lookups, `| default X`, `{{- if <path> }} ... {{- end }}` (with
+nesting), and the two named helpers.  Straying outside the subset raises
+— the chart stays mechanically renderable without helm in the image, by
+CI (tests/test_deploy.py) and by the dev-cluster harness
+(hack/dev-cluster.sh), the analog of the reference's hack/kind
+contributor on-ramp.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+
+def _lookup(ctx: dict, path: str):
+    cur: object = ctx
+    for part in path.split("."):
+        if not part:
+            continue
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(f"template references unknown value .{path}")
+        cur = cur[part]
+    return cur
+
+
+def _render_expr(expr: str, ctx: dict) -> str:
+    expr = expr.strip()
+    if expr.startswith("include "):
+        name = expr.split('"')[1]
+        return ctx["__helpers__"][name]
+    parts = [p.strip() for p in expr.split("|")]
+    val = _lookup(ctx, parts[0].lstrip("."))
+    for f in parts[1:]:
+        if f.startswith("default "):
+            arg = f[len("default "):].strip()
+            if val in ("", None):
+                val = _lookup(ctx, arg.lstrip("."))
+        else:
+            raise AssertionError(f"unsupported template function: {f}")
+    if isinstance(val, bool):
+        return "true" if val else "false"
+    return str(val)
+
+
+def render(text: str, ctx: dict) -> str:
+    """Render one template file against the context."""
+    # strip comment blocks
+    text = re.sub(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", "", text, flags=re.S)
+
+    # if/end blocks, innermost-first so nesting works (the webhook bits
+    # sit inside the operator.enabled guard)
+    def do_if(m):
+        cond = _lookup(ctx, m.group(1).lstrip("."))
+        return m.group(2) if cond else ""
+    innermost = re.compile(
+        r"\{\{-?\s*if\s+([.\w]+)\s*-?\}\}\n?"
+        r"((?:(?!\{\{-?\s*(?:if|end)\b).)*?)"
+        r"\{\{-?\s*end\s*-?\}\}\n?",
+        flags=re.S)
+    while True:
+        text, n = innermost.subn(do_if, text)
+        if not n:
+            break
+    # expressions
+    text = re.sub(r"\{\{-?\s*([^{}]+?)\s*-?\}\}",
+                  lambda m: _render_expr(m.group(1), ctx), text)
+    return text
+
+
+def default_context(chart_dir: pathlib.Path,
+                    app_version: str = "0.3.0") -> dict:
+    """The context `helm template` would build from values.yaml."""
+    import yaml
+
+    values = yaml.safe_load((chart_dir / "values.yaml").read_text())
+    return {
+        "Values": values,
+        "Chart": {"AppVersion": app_version, "Name": "nos-tpu"},
+        "Release": {"Name": "nos-tpu", "Namespace": "nos-tpu-system"},
+        "__helpers__": {
+            "nos-tpu.tag": app_version,
+            "nos-tpu.labels": ("app.kubernetes.io/part-of: nos-tpu\n"
+                               "app.kubernetes.io/managed-by: Helm"),
+        },
+    }
+
+
+def render_chart(chart_dir: pathlib.Path,
+                 ctx: dict | None = None) -> list[dict]:
+    """Every template in the chart rendered to parsed manifests."""
+    import yaml
+
+    ctx = ctx or default_context(chart_dir)
+    docs: list[dict] = []
+    for path in sorted(chart_dir.glob("templates/**/*.yaml")):
+        out = render(path.read_text(), ctx)
+        for doc in yaml.safe_load_all(out):
+            if doc is not None:
+                docs.append(doc)
+    return docs

@@ -2,11 +2,11 @@
 ConfigMaps must satisfy the typed config loaders (`helm template`-level
 validation without helm in the image).
 
-The renderer implements exactly the template subset the chart commits to
-(_helpers.tpl documents it): `.Values/.Release/.Chart` lookups,
-`| default X`, `{{- if <path> }} ... {{- end }}`, and the two named
-helpers.  Straying outside the subset fails the test, which is the
-point — the chart stays mechanically verifiable in CI.
+The renderer (nos_tpu/testing/helm.py, shared with the dev-cluster
+harness) implements exactly the template subset the chart commits to
+(_helpers.tpl documents it).  Straying outside the subset fails the
+test, which is the point — the chart stays mechanically verifiable in
+CI.
 """
 
 from __future__ import annotations
@@ -21,81 +21,36 @@ from nos_tpu.api.config import (
     AgentConfig, OperatorConfig, PartitionerConfig, SchedulerConfig,
     load_config,
 )
+from nos_tpu.testing.helm import default_context, render
 
 CHART = pathlib.Path(__file__).resolve().parent.parent / "deploy/helm/nos-tpu"
 BUILD = CHART.parent.parent.parent / "build"
 
 
-def _lookup(ctx: dict, path: str):
-    cur: object = ctx
-    for part in path.split("."):
-        if not part:
-            continue
-        if not isinstance(cur, dict) or part not in cur:
-            raise KeyError(f"template references unknown value .{path}")
-        cur = cur[part]
-    return cur
-
-
-def _render_expr(expr: str, ctx: dict) -> str:
-    expr = expr.strip()
-    if expr.startswith("include "):
-        name = expr.split('"')[1]
-        return ctx["__helpers__"][name]
-    parts = [p.strip() for p in expr.split("|")]
-    val = _lookup(ctx, parts[0].lstrip("."))
-    for f in parts[1:]:
-        if f.startswith("default "):
-            arg = f[len("default "):].strip()
-            if val in ("", None):
-                val = _lookup(ctx, arg.lstrip("."))
-        else:
-            raise AssertionError(f"unsupported template function: {f}")
-    if isinstance(val, bool):
-        return "true" if val else "false"
-    return str(val)
-
-
-def render(text: str, ctx: dict) -> str:
-    # strip comment blocks
-    text = re.sub(r"\{\{-?\s*/\*.*?\*/\s*-?\}\}", "", text, flags=re.S)
-    # if/end blocks, innermost-first so nesting works (the webhook bits
-    # sit inside the operator.enabled guard)
-    def do_if(m):
-        cond = _lookup(ctx, m.group(1).lstrip("."))
-        return m.group(2) if cond else ""
-    innermost = re.compile(
-        r"\{\{-?\s*if\s+([.\w]+)\s*-?\}\}\n?"
-        r"((?:(?!\{\{-?\s*(?:if|end)\b).)*?)"
-        r"\{\{-?\s*end\s*-?\}\}\n?",
-        flags=re.S)
-    while True:
-        text, n = innermost.subn(do_if, text)
-        if not n:
-            break
-    # expressions
-    text = re.sub(r"\{\{-?\s*([^{}]+?)\s*-?\}\}",
-                  lambda m: _render_expr(m.group(1), ctx), text)
-    return text
-
-
 @pytest.fixture(scope="module")
 def ctx():
-    values = yaml.safe_load((CHART / "values.yaml").read_text())
-    return {
-        "Values": values,
-        "Chart": {"AppVersion": "0.3.0", "Name": "nos-tpu"},
-        "Release": {"Name": "nos-tpu", "Namespace": "nos-tpu-system"},
-        "__helpers__": {
-            "nos-tpu.tag": "0.3.0",
-            "nos-tpu.labels": ("app.kubernetes.io/part-of: nos-tpu\n"
-                               "app.kubernetes.io/managed-by: Helm"),
-        },
-    }
+    return default_context(CHART)
 
 
 def _templates():
     return sorted(p for p in CHART.glob("templates/**/*.yaml"))
+
+
+class TestDevClusterHarness:
+    def test_render_mode_runs_clean(self):
+        """hack/dev-cluster.sh's CI-enforced half: render-and-validate
+        must work with no cluster binaries in the image (the kind `up`
+        path applies exactly these manifests)."""
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, str(CHART.parent.parent.parent
+                                 / "hack/render-chart.py")],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "validated 5 ConfigMaps" in proc.stdout
+        assert "3 CRDs" in proc.stdout
 
 
 class TestChartRenders:

@@ -428,9 +428,17 @@ class TestQuotaHeadOfLine:
             spec=ElasticQuotaSpec(
                 min={C.RESOURCE_TPU_MEMORY: 32.0},
                 max={C.RESOURCE_TPU_MEMORY: 128.0})))
+        # idle lender: aggregate min 128, so the 128 GB claimant is
+        # satisfiable (borrowing) — the unsatisfiability guard must NOT
+        # trip
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="lender", namespace="lender"),
+            spec=ElasticQuotaSpec(
+                min={C.RESOURCE_TPU_MEMORY: 96.0})))
         sched = build_scheduler(api)
         # occupant holds 64 GB; big claimant (128 GB) is SATISFIABLE
-        # (fits max alone) but blocked while the occupant lives
+        # (fits max + aggregate alone) but blocked while the occupant
+        # lives
         api.create(KIND_POD, make_slice_pod(
             "2x2", 1, name="occ", namespace="team", node_name="host-0",
             phase=RUNNING))
