@@ -35,15 +35,35 @@ case "${1:-render}" in
         echo "kind not found — run './hack/dev-cluster.sh render' for the \
 no-binaries mode" >&2; exit 1; }
     command -v kubectl >/dev/null || { echo "kubectl not found" >&2; exit 1; }
+    command -v docker >/dev/null || { echo "docker not found" >&2; exit 1; }
     render
+    # Build the component images under the chart's default names and
+    # side-load them into kind (nothing is published at the default
+    # registry; imagePullPolicy IfNotPresent then uses the loaded
+    # copies).  SKIP_BUILD=1 reuses images from a previous run.
+    REGISTRY=ghcr.io/nos-tpu
+    TAG=0.3.0
+    COMPONENTS="operator partitioner scheduler sliceagent chipagent \
+metricsexporter"
+    if [ -z "${SKIP_BUILD:-}" ]; then
+        docker build -f build/Dockerfile.base -t nos-tpu-base:latest .
+        for c in $COMPONENTS; do
+            docker build -f "build/$c/Dockerfile" \
+                -t "$REGISTRY/nos-tpu-$c:$TAG" \
+                --build-arg BASE_IMAGE=nos-tpu-base:latest .
+        done
+    fi
     kind create cluster --name "$CLUSTER" --config hack/kind/cluster.yaml
+    for c in $COMPONENTS; do
+        kind load docker-image --name "$CLUSTER" "$REGISTRY/nos-tpu-$c:$TAG"
+    done
     kubectl apply -f deploy/helm/nos-tpu/crds/
     kubectl apply -f "$OUT/nos-tpu.yaml"
     kubectl -n nos-tpu-system wait --for=condition=Available deployment \
         --all --timeout=300s
     echo "nos-tpu dev cluster '$CLUSTER' is up; try:"
     echo "  kubectl -n nos-tpu-system get pods"
-    echo "  kubectl apply -f docs/quotas.md examples"
+    echo "  # then create the example ElasticQuotas from docs/quotas.md"
     ;;
   down)
     kind delete cluster --name "$CLUSTER"

@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import pathlib
 import sys
-import tempfile
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
@@ -25,21 +24,11 @@ sys.path.insert(0, str(ROOT))
 CHART = ROOT / "deploy/helm/nos-tpu"
 CRD_DIR = CHART / "crds"
 
-CONFIG_KINDS = {
-    "nos-tpu-scheduler-config": "SchedulerConfig",
-    "nos-tpu-operator-config": "OperatorConfig",
-    "nos-tpu-partitioner-config": "PartitionerConfig",
-    "nos-tpu-sliceagent-config": "AgentConfig",
-    "nos-tpu-chipagent-config": "AgentConfig",
-}
-
 
 def main() -> int:
     import yaml
 
-    from nos_tpu.api import config as cfg_mod
-    from nos_tpu.api.config import load_config
-    from nos_tpu.testing.helm import render_chart
+    from nos_tpu.testing.helm import render_chart, validate_configmaps
 
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out", default=None,
@@ -49,21 +38,7 @@ def main() -> int:
     docs = render_chart(CHART)
     crds = [yaml.safe_load(p.read_text())
             for p in sorted(CRD_DIR.glob("*.yaml"))]
-    configs_checked = 0
-    for doc in docs:
-        if doc.get("kind") != "ConfigMap":
-            continue
-        name = doc["metadata"]["name"]
-        cls_name = CONFIG_KINDS.get(name)
-        if cls_name is None or "config.yaml" not in doc.get("data", {}):
-            continue
-        cls = getattr(cfg_mod, cls_name)
-        with tempfile.NamedTemporaryFile("w", suffix=".yaml") as f:
-            f.write(doc["data"]["config.yaml"])
-            f.flush()
-            # agent configs validate node_name at runtime (--node)
-            load_config(f.name, cls, validate=cls_name != "AgentConfig")
-        configs_checked += 1
+    configs_checked = validate_configmaps(docs)
 
     if args.out:
         out = pathlib.Path(args.out)

@@ -89,6 +89,51 @@ def default_context(chart_dir: pathlib.Path,
     }
 
 
+# Rendered ConfigMap name -> typed loader class name (api/config.py).
+# Shared by the deploy tests and hack/render-chart.py, so a new
+# component's config cannot be half-wired: a rendered ConfigMap with a
+# config.yaml key that is NOT in this table is an ERROR at render time,
+# never a silent skip.
+CONFIG_KINDS = {
+    "nos-tpu-scheduler-config": "SchedulerConfig",
+    "nos-tpu-operator-config": "OperatorConfig",
+    "nos-tpu-partitioner-config": "PartitionerConfig",
+    "nos-tpu-sliceagent-config": "AgentConfig",
+    "nos-tpu-chipagent-config": "AgentConfig",
+}
+
+
+def validate_configmaps(docs: list[dict]) -> int:
+    """Round-trip every rendered config.yaml ConfigMap through its typed
+    loader; returns the number validated.  Unknown config ConfigMaps and
+    loader rejections raise."""
+    import tempfile
+
+    from nos_tpu.api import config as cfg_mod
+    from nos_tpu.api.config import load_config
+
+    checked = 0
+    for doc in docs:
+        if doc.get("kind") != "ConfigMap" \
+                or "config.yaml" not in doc.get("data", {}):
+            continue
+        name = doc["metadata"]["name"]
+        cls_name = CONFIG_KINDS.get(name)
+        if cls_name is None:
+            raise AssertionError(
+                f"rendered ConfigMap {name!r} carries a config.yaml but "
+                f"is not in testing.helm.CONFIG_KINDS — wire its typed "
+                f"loader so the render stays validated")
+        cls = getattr(cfg_mod, cls_name)
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml") as f:
+            f.write(doc["data"]["config.yaml"])
+            f.flush()
+            # agent configs validate node_name at runtime (--node)
+            load_config(f.name, cls, validate=cls_name != "AgentConfig")
+        checked += 1
+    return checked
+
+
 def render_chart(chart_dir: pathlib.Path,
                  ctx: dict | None = None) -> list[dict]:
     """Every template in the chart rendered to parsed manifests."""

@@ -132,6 +132,15 @@ class TestRenderedConfigsLoad:
     """The chart's ConfigMaps must round-trip through the typed config
     loaders — chart and code cannot drift apart silently."""
 
+    def test_every_config_configmap_is_wired(self, ctx):
+        """The shared CONFIG_KINDS table (testing/helm.py) must cover
+        every rendered config.yaml ConfigMap — validate_configmaps
+        raises on an unknown one, so a seventh component cannot ship a
+        config that nothing validates."""
+        from nos_tpu.testing.helm import render_chart, validate_configmaps
+
+        assert validate_configmaps(render_chart(CHART, ctx)) == 5
+
     @pytest.mark.parametrize("component,cls", [
         ("partitioner", PartitionerConfig),
         ("operator", OperatorConfig),
