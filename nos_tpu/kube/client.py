@@ -190,7 +190,19 @@ class APIServer:
             if key not in store:
                 raise NotFound(f"{kind} {key}")
             obj = store.pop(key)
+            # deletions are mutations too: rv-memoized views (nominated
+            # pods, cycle snapshots) must invalidate on them
+            self._rv += 1
             self._notify(kind, "DELETED", obj)
+
+    @property
+    def resource_version(self) -> int:
+        """Global mutation counter (bumped on every create/put/patch/
+        delete): lets read-mostly consumers memoize derived views and
+        invalidate EXACTLY when anything changed (the scheduler's cycle
+        snapshot, the capacity plugin's nominated-pods list)."""
+        with self._lock:
+            return self._rv
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None,

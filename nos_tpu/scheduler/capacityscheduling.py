@@ -99,6 +99,8 @@ class CapacityScheduling:
         # every cross-namespace victim carried the over-quota label
         # (falsifiable fairness invariant).  None = no observer.
         self.on_preempt = None
+        self._nominated_rv: int | None = None
+        self._nominated_cache: list[Pod] = []
 
     # ------------------------------------------------------------------
     # Wiring
@@ -239,12 +241,24 @@ class CapacityScheduling:
     def _nominated_pods(self) -> list[Pod]:
         if self._api is None:
             return []
-        return self._api.list(
+        # rv-memoized: PreFilter runs for every pod of every cycle and
+        # nominated pods are rare — re-listing (and deep-copying) the
+        # whole pod store each time dominated the cycle cost at v5e-256
+        # scale.  The global mutation counter invalidates exactly when
+        # anything changed; substrates without it (REST) list every time.
+        rv = getattr(self._api, "resource_version", None)
+        if rv is not None and rv == self._nominated_rv:
+            return self._nominated_cache
+        pods = self._api.list(
             KIND_POD,
             filter_fn=lambda p: (p.status.nominated_node_name
                                  and not p.spec.node_name
                                  and p.status.phase == PENDING),
         )
+        if rv is not None:
+            self._nominated_rv = rv
+            self._nominated_cache = pods
+        return pods
 
     # ------------------------------------------------------------------
     # PreFilter extensions (preemption what-if coherence)

@@ -11,6 +11,7 @@ mark the pod unschedulable so the partitioner notices it
 
 from __future__ import annotations
 
+import copy
 import functools
 import logging
 
@@ -157,6 +158,13 @@ class Scheduler:
         self._lease: tuple[tuple[str, str], frozenset[str]] | None = None
         self._reserved_hosts: frozenset[str] = frozenset()
         self._lease_healed = False   # one startup sweep clears stale leases
+        # Per-cycle snapshot + assume cache (kube-scheduler's snapshot
+        # model): the cluster view is built once per cycle, pods bound
+        # THIS cycle are assumed into it in place, and it is invalidated
+        # after any eviction (preemption) so freed capacity is seen.
+        # Rebuilding it for every pending pod dominated the cycle cost
+        # at v5e-256 scale (one full deepcopy of the store per pod).
+        self._cycle_lister_cache: SharedLister | None = None
 
     # -- cluster view -------------------------------------------------------
     def snapshot(self) -> SharedLister:
@@ -170,9 +178,14 @@ class Scheduler:
         return SharedLister(infos.values())
 
     # -- one scheduling cycle ----------------------------------------------
+    def _cycle_lister(self) -> SharedLister:
+        if self._cycle_lister_cache is None:
+            self._cycle_lister_cache = self.snapshot()
+        return self._cycle_lister_cache
+
     def schedule_one(self, pod: Pod) -> str | None:
         """Try to place one pod; returns the node name or None."""
-        lister = self.snapshot()
+        lister = self._cycle_lister()
         state = CycleState()
         status = self._framework.run_pre_filter_plugins(state, pod, lister)
         if not status.is_success:
@@ -210,7 +223,20 @@ class Scheduler:
             self._mark_unschedulable(pod, status)
             return None
         self._bind(pod, chosen.name)
+        self._assume_bound(pod, chosen.name)
         return chosen.name
+
+    def _assume_bound(self, pod: Pod, node_name: str) -> None:
+        """Book a just-bound pod into the cycle snapshot so later pods
+        this cycle see its capacity consumed (the assume cache)."""
+        lister = self._cycle_lister_cache
+        if lister is None:
+            return
+        ni = lister.get(node_name)
+        if ni is not None:
+            assumed = copy.deepcopy(pod)
+            assumed.spec.node_name = node_name
+            ni.add_pod(assumed)
 
     def run_cycle(self) -> int:
         """Schedule all pending, not-yet-bound pods for this scheduler;
@@ -220,6 +246,7 @@ class Scheduler:
         self._preempt_budget = self._preempt_budget_per_cycle
         self._window_eta = None     # re-estimated per cycle
         self._quota_hol: dict[str, int] = {}
+        self._cycle_lister_cache = None     # fresh snapshot per cycle
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
@@ -260,6 +287,10 @@ class Scheduler:
             if key not in seen_gangs:
                 seen_gangs.add(key)
                 bound += self.schedule_gang(gangs[key])
+        # drop the cycle snapshot on exit: schedule_one/schedule_gang are
+        # public entry points and must see fresh state when driven
+        # outside run_cycle (they rebuild lazily)
+        self._cycle_lister_cache = None
         return bound
 
     # -- quota head-of-line -------------------------------------------------
@@ -341,7 +372,7 @@ class Scheduler:
         # that still might hold the gang — keeps large pods free for large
         # gangs).  "" = hosts with no pod-id label.
         windows = gang_slice_windows(self._api, members)
-        base = self.snapshot()
+        base = self._cycle_lister()
         if windows:
             # hosts=None: a sub-host-generation domain — pin the pod id
             # only (gang_slice_windows' per-generation classification).
@@ -395,10 +426,8 @@ class Scheduler:
                 _, st, domain, stuck = self._attempt_gang(
                     feasible_pins, base, members)
                 if stuck is not None:
-                    self._preempt_budget -= 1
-                    nominated, post = \
-                        self._framework.run_post_filter_plugins(
-                            st, stuck, SharedLister(domain))
+                    nominated, post = self._post_filter_budgeted(
+                        st, stuck, SharedLister(domain))
                     # Deliberately NOT nominating: a nominated pod stops
                     # matching extra_resources_could_help_scheduling,
                     # which would hide this member from the partitioner
@@ -424,6 +453,7 @@ class Scheduler:
                 return 0
         for pod, ni in placements:
             self._bind(pod, ni.name)
+            self._assume_bound(pod, ni.name)
         if pg is not None:
             # `alive` counts running mates plus the members just bound —
             # the true scheduled size, not just this cycle's batch
@@ -471,7 +501,12 @@ class Scheduler:
             return "", Status.unschedulable(
                 "preemption budget for this cycle spent")
         self._preempt_budget -= 1
-        return self._framework.run_post_filter_plugins(state, pod, lister)
+        nominated, status = self._framework.run_post_filter_plugins(
+            state, pod, lister)
+        if status.is_success:
+            # victims were evicted: the cycle snapshot is stale
+            self._cycle_lister_cache = None
+        return nominated, status
 
     def _maybe_drain_preempt(self) -> None:
         """Evict the last stragglers off a long-held drain window (see
