@@ -42,6 +42,9 @@ BATCH_IDLE_S = 0.5     # tightened vs the reference's 10 s idle window
 BATCH_TIMEOUT_S = 2.0  # vs the reference's 60 s
 POLL_S = 0.02
 BASELINE_S = 30.0
+# the banded compute bench (3 full repeats per metric) measures ~16 min on
+# a good tunnel day; leave headroom for transient-retry sleeps
+COMPUTE_BENCH_TIMEOUT_S = 2200
 
 
 def build_cluster():
@@ -121,7 +124,8 @@ def run_compute_bench(attempts: int = 2) -> dict:
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_compute.py")],
-                capture_output=True, text=True, timeout=1500)
+                capture_output=True, text=True,
+                timeout=COMPUTE_BENCH_TIMEOUT_S)
             lines = proc.stdout.strip().splitlines()
             if lines:
                 return json.loads(lines[-1])
@@ -131,7 +135,8 @@ def run_compute_bench(attempts: int = 2) -> dict:
         except subprocess.TimeoutExpired:
             # A full-timeout run is a hang, not the fast transient
             # HTTP-500 the retry exists for — don't double the bound.
-            return {"error": "compute bench timed out (1500s)"}
+            return {"error": f"compute bench timed out "
+                    f"({COMPUTE_BENCH_TIMEOUT_S}s)"}
         except Exception as e:  # noqa: BLE001 — bench must print its line
             err = {"error": f"compute bench failed: {e}"}
     return err

@@ -70,6 +70,19 @@ class TestContract:
         assert got.spec.node_name == "host-3"
         assert got.status.phase == RUNNING
 
+    def test_delete_bumps_resource_version(self, api):
+        """Deletions are mutations: rv-memoized views (the scheduler's
+        cycle snapshot, the capacity plugin's nominated-pods cache) must
+        invalidate on them.  Only the in-memory substrate exposes the
+        counter; the REST substrate has no equivalent (callers fall back
+        to listing)."""
+        if not hasattr(api, "resource_version"):
+            pytest.skip("REST substrate exposes no global counter")
+        api.create("Pod", make_slice_pod("1x1", 1, name="rv-pod"))
+        before = api.resource_version
+        api.delete("Pod", "rv-pod", "default")
+        assert api.resource_version > before
+
     def test_delete_then_not_found(self, api):
         api.create("Pod", make_slice_pod("1x1", 1, name="p2"))
         api.delete("Pod", "p2", "default")
