@@ -125,10 +125,21 @@ NODE_RESTORE_T = 210.0
 KILL_NODES = {"host-3": ("pod-0", 3), "host-21": ("pod-1", 5)}
 REPLACEMENT_NODES = {f"{n}r": spec for n, spec in KILL_NODES.items()}
 
-# Control-experiment toggle: False runs the identical trace without any
-# ElasticQuota objects (plugin no-ops, no preemption) to price quota
-# enforcement itself.  The published bench always runs True.
+# Control-experiment toggles (scripts/diag_quota_trace.py sets these;
+# the published bench always runs the defaults):
+# - CREATE_QUOTAS=False runs the identical trace without any
+#   ElasticQuota objects (plugin no-ops, no preemption) to price quota
+#   enforcement itself.
+# - BACKLOG_STALE_S=<seconds> stops jobs pending longer than that from
+#   counting against the spawn targets (teams keep submitting past a
+#   stuck gang).  Measured a DEAD END: +1 util point on the weakest
+#   seed, but gang-4x4 p90 37.5 -> 73.5 s — stays None.
+# - SCHEDULER_EXTRA_KWARGS_FN, if set, is called with the Sim and
+#   returns extra build_scheduler kwargs (e.g. the backfill estimator
+#   fns) so variants reuse the ONE production assembly call.
 CREATE_QUOTAS = True
+BACKLOG_STALE_S: float | None = None
+SCHEDULER_EXTRA_KWARGS_FN = None
 
 # Quota layout: mins sum to the cluster's HBM capacity (4096 GB), so the
 # aggregate-min gate (PreFilter) equals physical capacity and borrowing
@@ -325,10 +336,12 @@ class Sim:
         # The production scheduler assembly: CapacityScheduling enforced,
         # drain preemption with remaining-work-aware victims (progress
         # from the sim's job table), host-shard quota accounting.
+        extra = (SCHEDULER_EXTRA_KWARGS_FN(self)
+                 if SCHEDULER_EXTRA_KWARGS_FN else {})
         self.scheduler = build_scheduler(
             api, HBM_GB, drain_preempt_after_cycles=40,
             drain_preempt_progress_fn=self._pod_progress,
-            shard_chips_per_host=CHIPS_PER_HOST)
+            shard_chips_per_host=CHIPS_PER_HOST, **extra)
         self.capacity: CapacityScheduling = next(
             p for p in self.scheduler._framework.plugins
             if isinstance(p, CapacityScheduling))
@@ -526,6 +539,9 @@ class Sim:
         for p in self.api.list(KIND_POD):
             if not p.spec.node_name and p.metadata.namespace in backlog:
                 job = self._pod_job.get(p.metadata.name)
+                if BACKLOG_STALE_S is not None and job is not None \
+                        and self.now[0] - job.created > BACKLOG_STALE_S:
+                    continue    # diag variant: team keeps submitting
                 table = ts_backlog if (job is not None
                                        and job.kind == "ts") else backlog
                 table[p.metadata.namespace] += chip_equiv(p)

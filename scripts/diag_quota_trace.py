@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Experiment harness behind the round-5 quota-trace decisions: re-run
+any variant of bench_utilization on chosen seeds and print the metrics
+the tuning judged by.  Every PARITY.md round-5 claim about a measured
+win or dead end is reproducible from here.
+
+    python3 scripts/diag_quota_trace.py baseline 0 1
+    python3 scripts/diag_quota_trace.py backfill 0       # dead end
+    python3 scripts/diag_quota_trace.py stale45 4        # dead end
+    python3 scripts/diag_quota_trace.py noquota 0        # control
+    python3 scripts/diag_quota_trace.py nokill 0         # control
+
+Variants (implemented through bench_utilization's own toggles —
+CREATE_QUOTAS / BACKLOG_STALE_S / SCHEDULER_EXTRA_KWARGS_FN — so the
+variants can never drift from the bench's spawn/scheduler logic):
+- baseline: the published configuration (quota enforced, gang priority,
+  node loss, hybrid hosts).
+- nokill:   no node-loss injection.
+- noquota:  nokill WITHOUT any ElasticQuota objects.  Its comparator is
+  `nokill`, NOT baseline — both controls disable the node kill so the
+  delta isolates quota enforcement alone (the r5 pair measured 0.9176
+  vs 0.9180 on seed 0: enforcement costs ~nothing).
+- backfill: duration-aware drain-window backfill ON (measured: -1.4
+  util points on seed 0 — why it ships opt-in-off).
+- stale45:  jobs pending >45 s stop counting against the spawn target
+  (teams keep submitting past a stuck gang).  Measured: +1 util point
+  on the weakest seed but gang-4x4 p90 37.5 -> 73.5 s — rejected.
+
+One variant+seed list per process run: bench_utilization's module
+constants are patched in place.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import bench_utilization as B  # noqa: E402
+
+VARIANTS = ("baseline", "noquota", "nokill", "backfill", "stale45")
+
+
+def _backfill_kwargs(sim: "B.Sim") -> dict:
+    """Estimator fns over the sim's job table (the production analog is
+    duration/deadline annotations)."""
+    def remaining(pod):
+        job = sim._pod_job.get(pod.metadata.name)
+        if job is None:
+            return None
+        if job.bound_at is None:
+            return job.duration
+        return max(0.0, job.bound_at + job.duration - sim.now[0])
+
+    def duration(pod):
+        job = sim._pod_job.get(pod.metadata.name)
+        return None if job is None else job.duration
+
+    return {"backfill_remaining_fn": remaining,
+            "backfill_duration_fn": duration}
+
+
+def apply_variant(variant: str) -> None:
+    if variant == "noquota":
+        B.CREATE_QUOTAS = False
+        B.NODE_KILL_T = B.NODE_RESTORE_T = 1e18
+    elif variant == "nokill":
+        B.NODE_KILL_T = B.NODE_RESTORE_T = 1e18
+    elif variant == "backfill":
+        B.SCHEDULER_EXTRA_KWARGS_FN = _backfill_kwargs
+    elif variant == "stale45":
+        B.BACKLOG_STALE_S = 45.0
+
+
+def main() -> int:
+    if len(sys.argv) < 2 or sys.argv[1] not in VARIANTS:
+        print(f"usage: {sys.argv[0]} {{{'|'.join(VARIANTS)}}} "
+              f"[seed ...]", file=sys.stderr)
+        return 2
+    variant = sys.argv[1]
+    seeds = [int(s) for s in sys.argv[2:]] or [0]
+    apply_variant(variant)
+    for seed in seeds:
+        sim = B.Sim(seed=seed)
+        out = sim.run()
+        cls = out["schedule_latency_by_class"]
+        print(json.dumps({
+            "variant": variant, "seed": seed,
+            "util": out["utilization_pct"],
+            "p90": out["p90_schedule_latency_s"],
+            "gang4x8": cls.get("gang-4x8"),
+            "gang4x4": cls.get("gang-4x4"),
+            "slice2x2": cls.get("slice-2x2"),
+            "preemptions": out["quota"]["preemptions"],
+            "invariant_violations": sum(
+                out["quota"]["invariant_violations"].values()),
+            "node_loss": out["node_loss"],
+            "cycle_p50_ms": out["scheduler_cycle_wall_ms_p50"],
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
