@@ -74,6 +74,9 @@ class PartitionerConfig(ManagerConfig):
     batch_timeout_s: float = 2.0
     batch_idle_s: float = 0.5
     poll_interval_s: float = 0.05
+    # Per-plan handshake deadline before a silent node is quarantined
+    # (docs/protocol.md).  0 = default (3x batch_timeout_s).
+    plan_deadline_s: float = 0.0
     # Geometry-override file (SetKnownGeometries analog, reference
     # known_configs.go:144-150 wired at cmd/gpupartitioner/:370-380).
     known_geometries_file: str = ""
@@ -91,6 +94,12 @@ class PartitionerConfig(ManagerConfig):
             raise ConfigError("batch_idle_s must not exceed batch_timeout_s")
         if self.poll_interval_s <= 0:
             raise ConfigError("poll_interval_s must be positive")
+        if self.plan_deadline_s < 0:
+            raise ConfigError("plan_deadline_s must be >= 0")
+        if self.plan_deadline_s and self.plan_deadline_s < self.batch_timeout_s:
+            raise ConfigError(
+                "plan_deadline_s below batch_timeout_s would quarantine "
+                "nodes still inside a normal batch window")
         if self.known_geometries_file and \
                 not pathlib.Path(self.known_geometries_file).is_file():
             raise ConfigError(

@@ -50,10 +50,13 @@ class RunLoop(threading.Thread):
                 logger.exception("run loop %s: tick failed", self.name)
                 REGISTRY.inc("nos_tpu_runloop_errors_total",
                              labels={"loop": self.name})
-            REGISTRY.observe("nos_tpu_runloop_tick_seconds",
-                             time.perf_counter() - t0,
+            tick = time.perf_counter() - t0
+            REGISTRY.observe("nos_tpu_runloop_tick_seconds", tick,
                              labels={"loop": self.name})
-            self._halt.wait(self._interval)
+            # fixed-period scheduling: the tick's own duration counts
+            # against the interval, so a slow tick doesn't stretch the
+            # effective reconcile period to interval + tick
+            self._halt.wait(max(0.0, self._interval - tick))
 
 
 class _HealthHandler(http.server.BaseHTTPRequestHandler):
@@ -90,11 +93,17 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
     def _respond(self, code: int, body: str,
                  content_type: str = "text/plain") -> None:
         data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            # scraper hung up mid-response (curl timeout, Prometheus
+            # reload): not a server error, and the health thread must
+            # not dump a traceback for it
+            logger.debug("health endpoint: client disconnected mid-write")
 
     def log_message(self, *args) -> None:  # quiet
         pass

@@ -43,10 +43,12 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
         a standby replica must not reconcile."""
         NodeController(api, state, SliceNodeInitializer(api)).bind()
         PodController(api, state).bind()
+        plan_deadline = cfg.plan_deadline_s or None
         if cfg.kind in (SLICE_KIND, HYBRID_KIND):
             ctl = new_slice_partitioner_controller(
                 api, state, batch_timeout_s=cfg.batch_timeout_s,
-                batch_idle_s=cfg.batch_idle_s)
+                batch_idle_s=cfg.batch_idle_s,
+                plan_deadline_s=plan_deadline)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-slice", ctl.process_if_ready,
@@ -56,7 +58,8 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
                 api, state, batch_timeout_s=cfg.batch_timeout_s,
                 batch_idle_s=cfg.batch_idle_s,
                 cm_name=cfg.device_plugin_cm_name,
-                cm_namespace=cfg.device_plugin_cm_namespace)
+                cm_namespace=cfg.device_plugin_cm_namespace,
+                plan_deadline_s=plan_deadline)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-timeshare", ctl.process_if_ready,

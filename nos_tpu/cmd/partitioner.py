@@ -19,6 +19,7 @@ import logging
 import sys
 
 from nos_tpu.api.config import ConfigError, PartitionerConfig, load_config
+from nos_tpu.cmd._runtime import build_api
 from nos_tpu.cmd.assembly import build_partitioner_main, build_scheduler
 from nos_tpu.kube.client import APIServer
 from nos_tpu.partitioning.state import ClusterState

@@ -24,6 +24,7 @@ from nos_tpu.kube.objects import Node, RUNNING
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.topology.annotations import strip_status_annotations
 from nos_tpu.topology.profile import extract_timeshare_requests
+from nos_tpu.utils.retry import retry_on_conflict
 
 from nos_tpu.device.timeshare_plugin import TimeshareDevicePlugin
 from nos_tpu.partitioning.timeshare.partitioner import plan_id_from_key
@@ -78,5 +79,6 @@ class ChipReporter:
             if plan_id:
                 n.metadata.annotations[C.status_plan_annotation("timeshare")] = plan_id
 
-        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, self._node_name, mutate,
+                          component="chipagent-reporter")
         logger.debug("chipagent reporter: node %s reported", self._node_name)

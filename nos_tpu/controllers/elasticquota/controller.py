@@ -24,6 +24,7 @@ from nos_tpu.kube.client import (
 from nos_tpu.kube.objects import RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
 from nos_tpu.quota import TPUResourceCalculator
+from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
 
@@ -117,10 +118,11 @@ class _PodsReconciler:
         if pod.metadata.labels.get(C.LABEL_CAPACITY) == desired:
             return
         try:
-            self._api.patch(
-                KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                mutate=lambda p: p.metadata.labels.__setitem__(
+            retry_on_conflict(
+                self._api, KIND_POD, pod.metadata.name,
+                lambda p: p.metadata.labels.__setitem__(
                     C.LABEL_CAPACITY, desired),
+                pod.metadata.namespace, component="elasticquota",
             )
         except NotFound:
             pass
@@ -155,9 +157,10 @@ class ElasticQuotaReconciler:
     def _update_status(self, eq: ElasticQuota, used: ResourceList) -> None:
         if eq.status.used == used:
             return
-        self._api.patch(
-            KIND_ELASTIC_QUOTA, eq.metadata.name, eq.metadata.namespace,
-            mutate=lambda o: setattr(o.status, "used", dict(used)),
+        retry_on_conflict(
+            self._api, KIND_ELASTIC_QUOTA, eq.metadata.name,
+            lambda o: setattr(o.status, "used", dict(used)),
+            eq.metadata.namespace, component="elasticquota",
         )
 
     def reconcile_all(self) -> None:
@@ -209,9 +212,10 @@ class CompositeElasticQuotaReconciler:
         used = self._pods.patch_pods_and_compute_used(
             pods, ceq.spec.min, ceq.spec.max)
         if ceq.status.used != used:
-            self._api.patch(
-                KIND_COMPOSITE_ELASTIC_QUOTA, name, namespace,
-                mutate=lambda o: setattr(o.status, "used", dict(used)),
+            retry_on_conflict(
+                self._api, KIND_COMPOSITE_ELASTIC_QUOTA, name,
+                lambda o: setattr(o.status, "used", dict(used)),
+                namespace, component="elasticquota",
             )
 
     def _delete_overlapping_elastic_quotas(self,

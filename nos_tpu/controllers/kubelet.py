@@ -38,6 +38,7 @@ from nos_tpu.kube.client import APIServer, KIND_POD, NotFound
 from nos_tpu.kube.objects import PENDING, RUNNING
 from nos_tpu.kube.resources import pod_request
 from nos_tpu.topology.profile import extract_slice_requests
+from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
 
@@ -65,8 +66,8 @@ def admit_bound_pods(api, node_name: str, *,
             if p.spec.node_name == node_name and p.status.phase == PENDING:
                 p.status.phase = RUNNING
         try:
-            api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                      mutate=mutate)
+            retry_on_conflict(api, KIND_POD, pod.metadata.name, mutate,
+                              pod.metadata.namespace, component="kubelet")
         except NotFound:
             continue       # deleted between list and patch; nothing to admit
         admitted += 1
@@ -183,6 +184,6 @@ class KubeletSim:
         def mutate(p):
             if p.spec.node_name == node and p.status.phase == PENDING:
                 p.status.phase = RUNNING
-        self._api.patch(KIND_POD, pod.metadata.name, pod.metadata.namespace,
-                        mutate=mutate)
+        retry_on_conflict(self._api, KIND_POD, pod.metadata.name, mutate,
+                          pod.metadata.namespace, component="kubelet-sim")
         return 1

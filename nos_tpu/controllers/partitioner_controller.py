@@ -10,16 +10,28 @@ timeshare — exactly as the reference instantiates it for MIG and MPS):
 - when the batch is ready AND every node has reported the previous plan
   (spec vs status plan-id handshake, :212-232), fetch ALL pending pods,
   snapshot cluster state, Plan, and Apply.
+
+The handshake wait is per-failure-domain: a node that never reports a
+written plan within `plan_deadline_s` (default 3x the batch timeout) is
+quarantined — dropped from the wait and from the next snapshot — so one
+dead agent degrades one node, not every future plan cluster-wide.  The
+node auto-unquarantines the moment its report catches up (see
+docs/protocol.md, "Plan deadline and quarantine").
 """
 
 from __future__ import annotations
 
 import logging
+import time
+from typing import Callable
 
 from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer
 from nos_tpu.kube.objects import PENDING, Pod
-from nos_tpu.partitioning.core import Actuator, Planner, SnapshotTaker
+from nos_tpu.partitioning.core import (
+    Actuator, Planner, QuarantineList, REASON_ACTUATION,
+    REASON_PLAN_DEADLINE, SnapshotTaker,
+)
 from nos_tpu.partitioning.state import ClusterState
 from nos_tpu.utils.batcher import Batcher
 from nos_tpu.utils.pod_util import extra_resources_could_help_scheduling
@@ -27,12 +39,21 @@ from nos_tpu.topology.annotations import spec_plan_id, status_plan_id
 
 logger = logging.getLogger(__name__)
 
+# Default plan deadline as a multiple of the batch timeout: a healthy
+# agent reports within one report interval, so 3 full batch windows of
+# silence after a spec write is a wedged/dead agent, not a slow one.
+PLAN_DEADLINE_FACTOR = 3.0
+
 
 class PartitionerController:
     def __init__(self, api: APIServer, cluster_state: ClusterState,
                  kind: str, planner: Planner, actuator: Actuator,
                  snapshot_taker: SnapshotTaker,
-                 batcher: Batcher[Pod]) -> None:
+                 batcher: Batcher[Pod],
+                 quarantine: QuarantineList | None = None,
+                 plan_deadline_s: float | None = None,
+                 rescan_interval_s: float | None = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self._api = api
         self._state = cluster_state
         self._kind = kind
@@ -40,6 +61,22 @@ class PartitionerController:
         self._actuator = actuator
         self._snapshot_taker = snapshot_taker
         self._batcher = batcher
+        self._quarantine = quarantine or QuarantineList(kind=kind,
+                                                        clock=clock)
+        self._plan_deadline_s = (
+            plan_deadline_s if plan_deadline_s is not None
+            else PLAN_DEADLINE_FACTOR * batcher.timeout_s)
+        self._rescan_interval_s = (
+            rescan_interval_s if rescan_interval_s is not None
+            else batcher.timeout_s)
+        self._clock = clock
+        self._last_scan = clock()
+        # node -> (unreported spec plan id, first seen lagging at)
+        self._lag_since: dict[str, tuple[str, float]] = {}
+
+    @property
+    def quarantine(self) -> QuarantineList:
+        return self._quarantine
 
     # -- event path ---------------------------------------------------------
     def reconcile_pod(self, pod: Pod) -> None:
@@ -58,27 +95,54 @@ class PartitionerController:
     # -- batch path ---------------------------------------------------------
     def process_if_ready(self) -> bool:
         """Poll from the run loop; returns True if a plan cycle ran."""
+        self._reconcile_quarantine()
+        rescan_pods = None
         if not self._batcher.ready():
-            return False
+            # An accumulating batch already carries a live trigger and
+            # its idle/timeout windows govern — the rescan backstop is
+            # only for demand whose trigger was consumed (or never
+            # delivered), i.e. an EMPTY batcher with pods still pending.
+            if len(self._batcher):
+                return False
+            rescan_pods = self._rescan_due()
+            if rescan_pods is None:
+                return False
         if self._waiting_for_nodes_to_report_plan():
-            # defer new plans until all nodes report the previous one
+            # defer new plans until all live nodes report the previous one
             # (reference :118-124 requeues after 10 s)
             logger.debug("partitioner[%s]: waiting for plan reports", self._kind)
             return False
-        self._batcher.drain()
-        self.process_pending_pods()
+        # Drain BEFORE planning: watch events landing while the (slow)
+        # plan runs must accumulate into the NEXT batch, not be thrown
+        # away with this one — against a real apiserver a no-op re-mark
+        # produces no event, so a dropped trigger is dropped forever.
+        items = self._batcher.drain()
+        self._last_scan = self._clock()
+        if not self.process_pending_pods(pods=rescan_pods):
+            # nothing plannable right now (e.g. every node of this kind
+            # is quarantined): restore the trigger, so the pending
+            # demand is replanned as soon as a node recovers — without
+            # this the pods would strand until fresh pod churn
+            for pod in items:
+                self._batcher.add(pod.key, pod)
+            return False
         return True
 
-    def process_pending_pods(self) -> None:
+    def process_pending_pods(self, pods: list[Pod] | None = None) -> bool:
+        """Returns False when no snapshot node was available to plan on
+        (the caller keeps its trigger); True once a plan cycle ran.
+        `pods` lets a rescan-triggered cycle reuse its own listing."""
         from nos_tpu.exporter.metrics import REGISTRY
 
-        pods = [
-            p for p in self._api.pods_by_phase(PENDING)
-            if extra_resources_could_help_scheduling(p)
-        ]
-        snapshot = self._snapshot_taker.take_snapshot(self._state)
+        if pods is None:
+            pods = [
+                p for p in self._api.pods_by_phase(PENDING)
+                if extra_resources_could_help_scheduling(p)
+            ]
+        snapshot = self._snapshot_taker.take_snapshot(
+            self._state, exclude=self._quarantine.names())
         if not snapshot.nodes():
-            return
+            return False
         with REGISTRY.time("nos_tpu_plan_seconds",
                            labels={"kind": self._kind}):
             desired = self._planner.plan(snapshot.clone(), pods)
@@ -86,16 +150,107 @@ class PartitionerController:
         REGISTRY.inc("nos_tpu_plans_total", labels={"kind": self._kind})
         REGISTRY.set("nos_tpu_plan_pending_pods",
                      float(len(pods)), labels={"kind": self._kind})
+        return True
+
+    def _rescan_due(self) -> list[Pod] | None:
+        """Level-triggered backstop for the event-triggered batch path
+        (the reference requeues every 10 s regardless of events,
+        partitioner_controller.go:118-124).  Against a real apiserver a
+        pod's repeated unschedulable re-mark is a NO-OP write that emits
+        no watch event, so demand whose only trigger was consumed by a
+        plan that could not satisfy it would otherwise wait forever; the
+        in-memory substrate masks this by bumping rv on every patch.  At
+        most one pending-pods listing per rescan interval (default: the
+        batch timeout); the listing is returned (None = no rescan) so
+        the triggered plan cycle does not list again."""
+        if self._clock() - self._last_scan < self._rescan_interval_s:
+            return None
+        # the listing IS the scan: stamp before it so a blocked (or
+        # empty) outcome still waits a full interval before the next one
+        self._last_scan = self._clock()
+        if not self._state.is_partitioning_enabled(self._kind):
+            return None
+        pods = [p for p in self._api.pods_by_phase(PENDING)
+                if extra_resources_could_help_scheduling(p)]
+        return pods or None
+
+    # -- failure-domain bookkeeping -----------------------------------------
+    def _my_kind(self, node) -> bool:
+        return node.metadata.labels.get(C.LABEL_PARTITIONING, "") in (
+            self._kind, "hybrid")
+
+    def _node_reported(self, node) -> bool:
+        annots = node.metadata.annotations
+        spec_id = spec_plan_id(annots, family=self._kind)
+        return not spec_id or status_plan_id(annots, family=self._kind) == spec_id
+
+    def _reconcile_quarantine(self) -> None:
+        """Cheap per-poll sweep over the quarantine set only, releasing:
+        - any node that left the cluster (or this kind);
+        - deadline-quarantined nodes the moment their report catches up;
+        - actuation-quarantined nodes after one deadline of cool-down
+          (half-open breaker: their spec==status trivially because the
+          spec write failed, so only a fresh apply attempt can prove
+          them healed)."""
+        items = self._quarantine.items()
+        if not items:
+            return
+        now = self._clock()
+        nodes = self._state.nodes()
+        for name, (reason, since) in items.items():
+            node = nodes.get(name)
+            if node is None or not self._my_kind(node):
+                self._lag_since.pop(name, None)
+                self._quarantine.unquarantine(name)
+            elif reason == REASON_ACTUATION:
+                if now - since >= self._plan_deadline_s:
+                    # half-open: one failed apply within the probe
+                    # window re-opens the breaker
+                    self._quarantine.release_for_probe(
+                        name, self._plan_deadline_s)
+            elif self._node_reported(node):
+                self._lag_since.pop(name, None)
+                self._quarantine.unquarantine(name)
 
     def _waiting_for_nodes_to_report_plan(self) -> bool:
         """spec-partitioning-plan vs status-partitioning-plan per node
-        (reference :212-232)."""
+        (reference :212-232), with a per-plan deadline: a node lagging
+        longer than `plan_deadline_s` on the SAME plan id is quarantined
+        and stops blocking the handshake."""
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        now = self._clock()
+        waiting = False
+        live = set()
         for node in self._state.nodes().values():
-            kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
-            if kind not in (self._kind, "hybrid"):
+            if not self._my_kind(node):
                 continue
-            annots = node.metadata.annotations
-            spec_id = spec_plan_id(annots, family=self._kind)
-            if spec_id and status_plan_id(annots, family=self._kind) != spec_id:
-                return True
-        return False
+            name = node.metadata.name
+            live.add(name)
+            if self._node_reported(node):
+                self._lag_since.pop(name, None)
+                continue
+            if self._quarantine.is_quarantined(name):
+                continue
+            spec_id = spec_plan_id(node.metadata.annotations,
+                                   family=self._kind)
+            entry = self._lag_since.get(name)
+            if entry is None or entry[0] != spec_id:
+                # first sight of this plan lagging: arm the deadline
+                self._lag_since[name] = (spec_id, now)
+                waiting = True
+            elif now - entry[1] >= self._plan_deadline_s:
+                del self._lag_since[name]
+                REGISTRY.inc("nos_tpu_plan_deadline_exceeded_total",
+                             labels={"kind": self._kind})
+                self._quarantine.quarantine(name, REASON_PLAN_DEADLINE)
+                logger.warning(
+                    "partitioner[%s]: node %s missed plan %s deadline "
+                    "(%.1fs) — quarantined, replanning without it",
+                    self._kind, name, spec_id, self._plan_deadline_s)
+            else:
+                waiting = True
+        # nodes that left the cluster must not pin a stale deadline
+        for name in [n for n in self._lag_since if n not in live]:
+            del self._lag_since[name]
+        return waiting

@@ -18,6 +18,7 @@ from nos_tpu.topology.annotations import (
     encode_placement_records, strip_status_annotations,
 )
 from nos_tpu.topology.profile import shape_from_resource
+from nos_tpu.utils.retry import retry_on_conflict
 
 from nos_tpu.device.tpuclient import SliceDeviceClient
 
@@ -66,7 +67,8 @@ class SliceReporter:
             if plan_id:
                 node.metadata.annotations[C.status_plan_annotation("slice")] = plan_id
 
-        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, self._node_name, mutate,
+                          component="sliceagent-reporter")
         self._shared.on_report_done()
         logger.debug("sliceagent reporter: node %s reported %d devices",
                      self._node_name, len(devices))

@@ -14,6 +14,7 @@ from __future__ import annotations
 from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_NODE
 from nos_tpu.kube.objects import Node
+from nos_tpu.utils.retry import retry_on_conflict
 
 from .tpuclient import TpuRuntimeClient
 
@@ -55,5 +56,6 @@ class DevicePluginClient:
             ) + 1
             node.metadata.annotations[C.ANNOT_PLUGIN_GENERATION] = str(new_gen)
 
-        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, self._node_name, mutate,
+                          component="device-plugin")
         return new_gen

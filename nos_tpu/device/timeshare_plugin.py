@@ -17,6 +17,7 @@ from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE
 from nos_tpu.kube.objects import Node
 from nos_tpu.topology.profile import is_timeshare_resource, timeshare_resource_name
+from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +74,7 @@ class TimeshareDevicePlugin:
             n.metadata.annotations[C.ANNOT_PLUGIN_GENERATION] = str(gen + 1)
             n.metadata.annotations[C.ANNOT_PLUGIN_APPLIED_CONFIG] = key
 
-        self._api.patch(KIND_NODE, self._node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, self._node_name, mutate,
+                          component="timeshare-plugin")
         logger.info("timeshare plugin: node %s applied %s", self._node_name, key)
         return True

@@ -32,6 +32,13 @@ class Conflict(Exception):
     pass
 
 
+class TransientAPIError(RuntimeError):
+    """A server-side failure worth retrying: 5xx / 429 from a real
+    apiserver (kube/rest.py), or an injected transient from the chaos
+    substrate.  Distinct from plain RuntimeError so permanent request
+    errors (400s, validation) are NOT blindly retried."""
+
+
 class APIServer:
     """Typed object store: kind -> key -> object.
 
@@ -253,7 +260,7 @@ KIND_COMPOSITE_ELASTIC_QUOTA = "CompositeElasticQuota"
 KIND_POD_GROUP = "PodGroup"
 
 __all__ = [
-    "APIServer", "NotFound", "Conflict",
+    "APIServer", "NotFound", "Conflict", "TransientAPIError",
     "KIND_POD", "KIND_NODE", "KIND_CONFIGMAP",
     "KIND_ELASTIC_QUOTA", "KIND_COMPOSITE_ELASTIC_QUOTA", "KIND_POD_GROUP",
     "Node", "Pod", "ConfigMap",

@@ -45,7 +45,9 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable
 
-from nos_tpu.kube.client import Conflict, NotFound, WatchFn
+from nos_tpu.kube.client import (
+    Conflict, NotFound, TransientAPIError, WatchFn,
+)
 from nos_tpu.kube.k8s_codec import KIND_REST, from_k8s, rest_path, to_k8s
 from nos_tpu.kube.objects import Pod
 
@@ -187,6 +189,11 @@ class KubeClient:
             if e.code == 409:
                 raise Conflict(path) from None
             detail = e.read().decode(errors="replace")[:500]
+            if e.code >= 500 or e.code == 429:
+                # server-side / overload failures are retryable
+                # (utils/retry.py); 4xx request errors are not
+                raise TransientAPIError(
+                    f"{method} {path} -> HTTP {e.code}: {detail}") from None
             raise RuntimeError(
                 f"{method} {path} -> HTTP {e.code}: {detail}") from None
 
@@ -344,6 +351,13 @@ class KubeClient:
         rv = sync()  # synchronous initial replay (informer sync)
 
         def pump() -> None:
+            from nos_tpu.utils.retry import Backoff
+
+            # Capped jittered backoff between reconnect attempts: a down
+            # or overloaded apiserver must not be hammered on a tight
+            # 1 s loop by every watcher of every kind.  Reset only after
+            # a successful (re)connect + sync.
+            backoff = Backoff(base_s=0.5, cap_s=30.0)
             last_rv = rv
             while not stop.is_set() and not self._watch_stop.is_set():
                 try:
@@ -357,6 +371,7 @@ class KubeClient:
                         # list and this registration (deliver() dedups
                         # by resourceVersion).
                         last_rv = sync()
+                        backoff.reset()
                         for line in resp:
                             if stop.is_set():
                                 return
@@ -371,8 +386,10 @@ class KubeClient:
                         RuntimeError) as e:
                     if stop.is_set() or self._watch_stop.is_set():
                         return
-                    logger.debug("watch %s reconnect: %s", kind, e)
-                    stop.wait(1.0)
+                    delay = backoff.next_delay()
+                    logger.debug("watch %s reconnect in %.1fs: %s",
+                                 kind, delay, e)
+                    stop.wait(delay)
 
         t = threading.Thread(target=pump, name=f"watch-{kind}", daemon=True)
         t.start()

@@ -10,6 +10,9 @@ from .tracker import SliceTracker
 from .sorter import ProfileAwareSorter
 from .planner import GeometryPlanner
 from .actuator import GeometryActuator, new_plan_id
+from .quarantine import (
+    QuarantineList, REASON_ACTUATION, REASON_PLAN_DEADLINE,
+)
 
 __all__ = [
     "Actuator", "NodeInitializer", "PartitionableNode", "PartitionCalculator",
@@ -17,4 +20,5 @@ __all__ = [
     "SliceFilter", "SnapshotTaker", "Sorter",
     "ClusterSnapshot", "SnapshotError", "SliceTracker", "ProfileAwareSorter",
     "GeometryPlanner", "GeometryActuator", "new_plan_id",
+    "QuarantineList", "REASON_ACTUATION", "REASON_PLAN_DEADLINE",
 ]

@@ -3,6 +3,14 @@
 Analog of reference internal/partitioning/core/actuator.go:39-66: skip if the
 desired state is empty or equals the current state; otherwise call the
 strategy Partitioner per changed node under a fresh plan id.
+
+Actuation is per-failure-domain: one node's `apply_partitioning` raising
+(apiserver write rejected, agent-side precondition) must not abort the
+rest of the plan — the other nodes' spec writes land, their agents
+actuate, and only the failing node is left behind for the next cycle.
+Consecutive failures on the same node open a circuit breaker
+(QuarantineList) so a persistently failing node drops out of planning
+instead of burning every cycle on it.
 """
 
 from __future__ import annotations
@@ -10,8 +18,11 @@ from __future__ import annotations
 import logging
 import uuid
 
+from nos_tpu.exporter.metrics import REGISTRY
+
 from ..state import PartitioningState
 from .interfaces import Actuator, PartitionCalculator, Partitioner
+from .quarantine import QuarantineList
 from .snapshot import ClusterSnapshot
 
 logger = logging.getLogger(__name__)
@@ -35,16 +46,22 @@ def compute_partitioning_state(
 
 class GeometryActuator(Actuator):
     def __init__(self, partitioner: Partitioner,
-                 partition_calculator: PartitionCalculator) -> None:
+                 partition_calculator: PartitionCalculator,
+                 quarantine: QuarantineList | None = None,
+                 kind: str = "") -> None:
         self._partitioner = partitioner
         self._partition_calculator = partition_calculator
+        self._quarantine = quarantine
+        self._kind = kind or (quarantine.kind if quarantine else "")
 
     def current_state(self, snapshot: ClusterSnapshot) -> PartitioningState:
         return compute_partitioning_state(snapshot, self._partition_calculator)
 
     def apply(self, snapshot: ClusterSnapshot,
               desired: PartitioningState) -> bool:
-        """Returns True if anything was actuated."""
+        """Returns True if anything was actuated.  Per-node failures are
+        isolated: the remaining nodes of the plan are still applied, the
+        failing node feeds the quarantine circuit breaker."""
         if desired.empty:
             logger.debug("actuator: desired state empty, skipping")
             return False
@@ -54,11 +71,28 @@ class GeometryActuator(Actuator):
             return False
         plan_id = new_plan_id()
         changed = False
+        failed: list[str] = []
         for node_name, node_partitioning in desired.items():
             if node_name in current and current[node_name] == node_partitioning:
                 continue
-            self._partitioner.apply_partitioning(
-                node_name, plan_id, node_partitioning
-            )
+            try:
+                self._partitioner.apply_partitioning(
+                    node_name, plan_id, node_partitioning
+                )
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                failed.append(node_name)
+                REGISTRY.inc("nos_tpu_actuation_failures_total",
+                             labels={"kind": self._kind})
+                streak = (self._quarantine.record_failure(node_name)
+                          if self._quarantine else 0)
+                logger.warning(
+                    "actuator: node %s apply failed (streak %d): %s",
+                    node_name, streak, e)
+                continue
             changed = True
+            if self._quarantine is not None:
+                self._quarantine.record_success(node_name)
+        if failed:
+            logger.warning("actuator: plan %s applied with %d node "
+                           "failure(s): %s", plan_id, len(failed), failed)
         return changed

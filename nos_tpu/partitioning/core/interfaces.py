@@ -9,7 +9,7 @@ strategies plug in the same way mig/mps do in the reference.
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import TYPE_CHECKING, Mapping
+from typing import TYPE_CHECKING, Collection, Mapping
 
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.resources import ResourceList
@@ -96,10 +96,14 @@ class NodeInitializer(ABC):
 
 class SnapshotTaker(ABC):
     """Build a strategy-specific snapshot from cluster state
-    (reference mig/snapshot_taker.go:31-53)."""
+    (reference mig/snapshot_taker.go:31-53).  `exclude` names nodes the
+    controller has quarantined — they must not appear in the snapshot,
+    so the planner cannot commit new geometry to a failure domain that
+    is not answering."""
 
     @abstractmethod
-    def take_snapshot(self, cluster_state) -> "ClusterSnapshot": ...
+    def take_snapshot(self, cluster_state,
+                      exclude: Collection[str] = ()) -> "ClusterSnapshot": ...
 
 
 class Sorter(ABC):

@@ -1,0 +1,167 @@
+"""Per-node quarantine: the decision plane's failure-domain boundary.
+
+One dead or wedged node agent must degrade THAT node, not the cluster:
+without quarantine, the plan handshake (one plan in flight per family,
+partitioner_controller.py) waits forever on a node whose
+`status-partitioning-plan` never catches up, and every future plan for
+every other node is blocked behind it.
+
+Two paths put a node here, both reversible:
+
+- **plan-deadline** — the node failed to report a written plan within
+  the controller's deadline (default 3x the batch timeout);
+- **actuation-failures** — `apply_partitioning` failed on the node N
+  consecutive times (circuit breaker, GeometryActuator).
+
+A quarantined node is skipped by the handshake wait and excluded from
+the next snapshot, so planning continues for the healthy failure
+domains.  It leaves the moment it proves liveness: the controller
+unquarantines on a caught-up report, the actuator on a successful
+apply (`record_success`).  An actuation-quarantined node
+cannot prove itself by report (its spec write failed, so spec==status
+trivially), so the controller re-probes it after a cool-down instead —
+a half-open breaker.  The set is in-memory only —
+deliberately: a restarted controller re-derives laggards from the same
+annotations, so persisting quarantine would only risk stale verdicts.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+REASON_PLAN_DEADLINE = "plan-deadline"
+REASON_ACTUATION = "actuation-failures"
+
+DEFAULT_FAILURE_THRESHOLD = 3
+
+REGISTRY.describe("nos_tpu_quarantined_nodes",
+                  "Nodes currently quarantined from planning, per kind")
+REGISTRY.describe("nos_tpu_plan_deadline_exceeded_total",
+                  "Plans whose node missed the report deadline")
+REGISTRY.describe("nos_tpu_actuation_failures_total",
+                  "Per-node apply_partitioning failures (isolated)")
+REGISTRY.describe("nos_tpu_actuation_breaker_open_total",
+                  "Actuation circuit-breaker openings (failure streaks)")
+
+
+class QuarantineList:
+    """Thread-safe quarantine set + per-node failure streaks, shared by
+    the partitioner controller (deadline path) and the actuator (circuit
+    breaker path) of one partitioning kind."""
+
+    def __init__(self, kind: str = "",
+                 failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.kind = kind
+        self.failure_threshold = failure_threshold
+        self._clock = clock
+        self._lock = threading.Lock()
+        # node -> (reason, quarantined-at)
+        self._quarantined: dict[str, tuple[str, float]] = {}
+        self._streaks: dict[str, int] = {}       # node -> consecutive failures
+        self._probe_until: dict[str, float] = {}  # half-open probe windows
+
+    # -- membership ---------------------------------------------------------
+    def quarantine(self, node: str, reason: str) -> bool:
+        """Returns True if the node was newly quarantined."""
+        with self._lock:
+            if node in self._quarantined:
+                return False
+            self._quarantined[node] = (reason, self._clock())
+            self._set_gauge_locked()
+        logger.warning("quarantine[%s]: node %s quarantined (%s)",
+                       self.kind, node, reason)
+        return True
+
+    def unquarantine(self, node: str) -> bool:
+        with self._lock:
+            entry = self._quarantined.pop(node, None)
+            if entry is None:
+                return False
+            self._streaks.pop(node, None)
+            self._probe_until.pop(node, None)
+            self._set_gauge_locked()
+        logger.info("quarantine[%s]: node %s released (was: %s)",
+                    self.kind, node, entry[0])
+        return True
+
+    def release_for_probe(self, node: str, window_s: float) -> bool:
+        """Half-open release after the actuation cool-down: the node
+        re-enters planning, and ONE failed apply within `window_s`
+        re-opens the breaker immediately — without this, a permanently
+        failing node would get threshold-many doomed plan cycles after
+        every cool-down.  The window is time-bounded: if no apply
+        happens inside it (no demand touched the node), a much later
+        isolated failure counts as a fresh streak of one, preserving
+        the N-CONSECUTIVE-failures contract.  A successful apply clears
+        everything (record_success)."""
+        with self._lock:
+            entry = self._quarantined.pop(node, None)
+            if entry is None:
+                return False
+            self._streaks.pop(node, None)
+            self._probe_until[node] = self._clock() + window_s
+            self._set_gauge_locked()
+        logger.info("quarantine[%s]: node %s released for half-open "
+                    "probe (was: %s)", self.kind, node, entry[0])
+        return True
+
+    def is_quarantined(self, node: str) -> bool:
+        with self._lock:
+            return node in self._quarantined
+
+    def reason(self, node: str) -> str:
+        with self._lock:
+            entry = self._quarantined.get(node)
+            return entry[0] if entry else ""
+
+    def names(self) -> frozenset[str]:
+        with self._lock:
+            return frozenset(self._quarantined)
+
+    def items(self) -> dict[str, tuple[str, float]]:
+        """node -> (reason, quarantined-at), a copy."""
+        with self._lock:
+            return dict(self._quarantined)
+
+    # -- liveness signals ---------------------------------------------------
+    def record_failure(self, node: str) -> int:
+        """One failed actuation; at `failure_threshold` consecutive
+        failures — or one failure inside an open half-open probe
+        window — the breaker opens (node quarantined).  Returns the
+        streak length."""
+        with self._lock:
+            probe_until = self._probe_until.pop(node, None)
+            if probe_until is not None and self._clock() <= probe_until:
+                streak = self.failure_threshold    # failed probe
+            else:
+                streak = self._streaks.get(node, 0) + 1
+            self._streaks[node] = streak
+        if streak >= self.failure_threshold:
+            if self.quarantine(node, REASON_ACTUATION):
+                REGISTRY.inc("nos_tpu_actuation_breaker_open_total",
+                             labels={"kind": self.kind})
+        return streak
+
+    def record_success(self, node: str) -> None:
+        with self._lock:
+            self._streaks.pop(node, None)
+            self._probe_until.pop(node, None)
+            entry = self._quarantined.get(node)
+            if entry is None or entry[0] != REASON_ACTUATION:
+                return
+        # an actuation-quarantined node healed by a successful apply;
+        # deadline quarantine waits for the *report* instead
+        self.unquarantine(node)
+
+    def _set_gauge_locked(self) -> None:
+        REGISTRY.set("nos_tpu_quarantined_nodes",
+                     float(len(self._quarantined)),
+                     labels={"kind": self.kind})

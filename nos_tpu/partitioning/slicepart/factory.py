@@ -9,7 +9,7 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import GeometryActuator
+from ..core import GeometryActuator, QuarantineList
 from ..state import ClusterState
 from .calculators import SlicePartitionCalculator, SliceProfileCalculator
 from .group import MultiHostGeometryPlanner
@@ -21,6 +21,7 @@ def new_slice_partitioner_controller(
     api: APIServer, cluster_state: ClusterState,
     framework: Framework | None = None,
     batch_timeout_s: float = 60.0, batch_idle_s: float = 10.0,
+    plan_deadline_s: float | None = None,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
@@ -31,15 +32,21 @@ def new_slice_partitioner_controller(
         calculator=SliceProfileCalculator(),
         partition_calculator=partition_calculator,
     )
-    actuator = GeometryActuator(SlicePartitioner(api), partition_calculator)
     kwargs = {}
     if clock is not None:
         kwargs["clock"] = clock
+    # one quarantine list shared by actuator (circuit breaker) and
+    # controller (plan deadline): a node is one failure domain, however
+    # it failed
+    quarantine = QuarantineList(kind=SLICE_KIND, **kwargs)
+    actuator = GeometryActuator(SlicePartitioner(api), partition_calculator,
+                                quarantine=quarantine)
     batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
     return PartitionerController(
         api=api, cluster_state=cluster_state, kind=SLICE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=SliceSnapshotTaker(), batcher=batcher,
+        quarantine=quarantine, plan_deadline_s=plan_deadline_s, **kwargs,
     )
 
 

@@ -17,6 +17,7 @@ from nos_tpu.topology.annotations import (
     spec_from_geometries, strip_spec_annotations,
 )
 from nos_tpu.topology.profile import shape_from_resource
+from nos_tpu.utils.retry import retry_on_conflict
 
 from ..core.actuator import new_plan_id
 from ..core.interfaces import NodeInitializer, Partitioner
@@ -45,7 +46,8 @@ class SlicePartitioner(Partitioner):
             node.metadata.annotations.update(spec_from_geometries(geometries))
             node.metadata.annotations[C.spec_plan_annotation("slice")] = plan_id
 
-        self._api.patch(KIND_NODE, node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, node_name, mutate,
+                          component="slicepart")
         logger.info("slicepart: node %s spec updated (plan %s)", node_name, plan_id)
 
 
@@ -74,7 +76,8 @@ class SliceNodeInitializer(NodeInitializer):
             n.metadata.annotations.update(spec_from_geometries(geometries))
             n.metadata.annotations[C.spec_plan_annotation("slice")] = new_plan_id()
 
-        self._api.patch(KIND_NODE, node_name, mutate=mutate)
+        retry_on_conflict(self._api, KIND_NODE, node_name, mutate,
+                          component="slicepart-init")
         logger.info("slicepart: initialized virgin node %s", node_name)
 
 

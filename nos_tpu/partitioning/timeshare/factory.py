@@ -9,7 +9,7 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import GeometryActuator, GeometryPlanner
+from ..core import GeometryActuator, GeometryPlanner, QuarantineList
 from ..state import ClusterState
 from .calculators import TimesharePartitionCalculator, TimeshareProfileCalculator
 from .partitioner import (
@@ -24,6 +24,7 @@ def new_timeshare_partitioner_controller(
     batch_timeout_s: float = 60.0, batch_idle_s: float = 10.0,
     cm_name: str = DEVICE_PLUGIN_CM_NAME,
     cm_namespace: str = DEVICE_PLUGIN_CM_NAMESPACE,
+    plan_deadline_s: float | None = None,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
@@ -34,14 +35,17 @@ def new_timeshare_partitioner_controller(
         calculator=TimeshareProfileCalculator(),
         partition_calculator=partition_calculator,
     )
-    actuator = GeometryActuator(
-        TimesharePartitioner(api, cm_name, cm_namespace), partition_calculator)
     kwargs = {}
     if clock is not None:
         kwargs["clock"] = clock
+    quarantine = QuarantineList(kind=TIMESHARE_KIND, **kwargs)
+    actuator = GeometryActuator(
+        TimesharePartitioner(api, cm_name, cm_namespace),
+        partition_calculator, quarantine=quarantine)
     batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
     return PartitionerController(
         api=api, cluster_state=cluster_state, kind=TIMESHARE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=TimeshareSnapshotTaker(), batcher=batcher,
+        quarantine=quarantine, plan_deadline_s=plan_deadline_s, **kwargs,
     )

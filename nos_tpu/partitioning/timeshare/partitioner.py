@@ -19,6 +19,7 @@ from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE, NotFound
 from nos_tpu.kube.objects import ConfigMap, Node, ObjectMeta
 from nos_tpu.topology.profile import gb_from_resource
+from nos_tpu.utils.retry import retry_on_conflict
 
 from ..core.interfaces import Partitioner
 from ..state import NodePartitioning
@@ -79,8 +80,9 @@ class TimesharePartitioner(Partitioner):
             cm.data[key] = payload
 
         try:
-            self._api.patch(KIND_CONFIGMAP, self._cm_name,
-                            self._cm_namespace, mutate=mutate_cm)
+            retry_on_conflict(self._api, KIND_CONFIGMAP, self._cm_name,
+                              mutate_cm, self._cm_namespace,
+                              component="timeshare")
         except NotFound:
             self._api.create(KIND_CONFIGMAP, ConfigMap(
                 metadata=ObjectMeta(name=self._cm_name,
@@ -95,5 +97,6 @@ class TimesharePartitioner(Partitioner):
             node.metadata.labels[C.LABEL_DEVICE_PLUGIN_CONFIG] = plan_id
             node.metadata.annotations[C.spec_plan_annotation("timeshare")] = plan_id
 
-        self._api.patch(KIND_NODE, node_name, mutate=mutate_node)
+        retry_on_conflict(self._api, KIND_NODE, node_name, mutate_node,
+                          component="timeshare")
         logger.info("timeshare: node %s config %s published", node_name, key)

@@ -6,6 +6,8 @@ labeled for timeshare (or hybrid) partitioning as TimeshareNodes.
 
 from __future__ import annotations
 
+from typing import Collection
+
 from nos_tpu.api import constants as C
 from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
 
@@ -21,10 +23,13 @@ class TimeshareSnapshotTaker(SnapshotTaker):
     def __init__(self, registry: TopologyRegistry = DEFAULT_REGISTRY) -> None:
         self._registry = registry
 
-    def take_snapshot(self, cluster_state: ClusterState) -> ClusterSnapshot:
+    def take_snapshot(self, cluster_state: ClusterState,
+                      exclude: Collection[str] = ()) -> ClusterSnapshot:
         infos = cluster_state.node_infos()
         nodes = {}
         for name, node in cluster_state.nodes().items():
+            if name in exclude:        # quarantined failure domain
+                continue
             kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
             if kind not in (TIMESHARE_KIND, HYBRID_KIND):
                 continue

@@ -190,14 +190,38 @@ class SharedLister:
 # ---------------------------------------------------------------------------
 
 
+def _slice_chips(resources: ResourceList) -> int:
+    """Total chip-equivalents across the slice profile resources."""
+    from nos_tpu.topology.profile import extract_slice_requests
+
+    return sum(shape.chips * qty
+               for shape, qty in extract_slice_requests(resources).items())
+
+
 class NodeResourcesFit:
-    """The in-tree fit plugin: pod request must fit node free capacity."""
+    """The in-tree fit plugin: pod request must fit node free capacity.
+
+    For slice resources the per-profile check alone is unsound while a
+    repartition is in flight: a bound pod whose profile was re-carved
+    away no longer subtracts from ANY advertised profile, so per-profile
+    free looks positive while the node's chips are spoken for.  The
+    aggregate chip-equivalent guard closes that window — a node can
+    never be bound past its carved chip capacity, whatever the current
+    geometry says per profile."""
 
     name = "NodeResourcesFit"
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         req = pod_request(pod)
         if fits(req, node_info.free()):
+            pod_chips = _slice_chips(req)
+            if pod_chips:
+                cap = _slice_chips(node_info.allocatable)
+                used = _slice_chips(node_info.requested)
+                if used + pod_chips > cap:
+                    return Status.unschedulable(
+                        f"insufficient slice chips ({used}+{pod_chips} "
+                        f"over {cap}; geometry in flux)")
             return Status.ok()
         missing = [
             k for k, v in req.items()

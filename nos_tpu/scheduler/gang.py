@@ -53,8 +53,10 @@ def set_pod_group_status(api: APIServer, pg, phase: str,
         o.status.scheduled = scheduled
 
     try:
-        api.patch(KIND_POD_GROUP, pg.metadata.name,
-                  pg.metadata.namespace, mutate=mutate)
+        from nos_tpu.utils.retry import retry_on_conflict
+
+        retry_on_conflict(api, KIND_POD_GROUP, pg.metadata.name, mutate,
+                          pg.metadata.namespace, component="gang")
     except NotFound:
         pass
 

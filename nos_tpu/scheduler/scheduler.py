@@ -929,10 +929,12 @@ class Scheduler:
         still in the stale pending list).  A gone pod needs no status:
         swallow NotFound instead of killing the scheduling cycle."""
         from nos_tpu.kube.client import NotFound
+        from nos_tpu.utils.retry import retry_on_conflict
 
         try:
-            self._api.patch(KIND_POD, pod.metadata.name,
-                            pod.metadata.namespace, mutate=mutate)
+            retry_on_conflict(self._api, KIND_POD, pod.metadata.name,
+                              mutate, pod.metadata.namespace,
+                              component="scheduler")
         except NotFound:
             logger.debug("scheduler: pod %s vanished mid-cycle", pod.key)
 

@@ -1,0 +1,161 @@
+"""Seeded chaos substrate: deterministic fault injection over APIServer.
+
+The decision plane's robustness claims (retry-on-conflict at every write
+site, quarantine instead of cluster-wide stalls, watch recovery) are
+exercised by soak runs against this substrate instead of being asserted
+by hand.  Every fault draw comes from one `random.Random(seed)`, so a
+failing soak reproduces with its seed alone (scripts/diag_chaos.py).
+
+Injected faults, mirroring what a real kube-apiserver does under load:
+
+- **Conflict** on update/patch — the optimistic-concurrency 409 every
+  annotation writer must retry (utils/retry.py);
+- **transient write errors** (ConnectionError) on update/patch — the
+  LB reset / timeout class of failure, same retry path;
+- **watch-event drops** — an event is withheld from one watcher, then
+  the object's CURRENT state is replayed a few operations later: the
+  drop-then-informer-resync cycle the KubeClient pump performs on every
+  reconnect (kube/rest.py sync()), compressed into the in-memory bus.
+  Level-triggered watchers must converge through it;
+- **injected latency** — a seeded sleep before an operation commits
+  (off by default; soak tests keep it 0 for speed).
+
+Faults fire on update/patch only: creates/deletes are test-harness
+setup traffic, and the production failure modes above are
+read-modify-write races.  Reads (`get`/`list`) stay exact so the test's
+own assertions observe true state.
+
+A subclass (not a delegating wrapper) on purpose: the kubelet sim and
+the cmd mains gate their in-memory-only behavior on
+`isinstance(api, APIServer)`, and the chaos substrate must walk through
+those gates like the real thing.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Collection
+
+from nos_tpu.kube.client import APIServer, Conflict, WatchFn
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosAPIServer(APIServer):
+    """APIServer injecting seed-deterministic faults on the write path.
+
+    Single-writer determinism: with one thread driving the control
+    plane (the soak harness ticks components explicitly), the same seed
+    yields the same fault sequence.
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 conflict_rate: float = 0.0,
+                 transient_rate: float = 0.0,
+                 drop_watch_rate: float = 0.0,
+                 max_latency_s: float = 0.0,
+                 replay_after_ops: int = 8,
+                 fault_kinds: Collection[str] | None = None) -> None:
+        super().__init__()
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._conflict_rate = conflict_rate
+        self._transient_rate = transient_rate
+        self._drop_watch_rate = drop_watch_rate
+        self._max_latency_s = max_latency_s
+        self._replay_after_ops = max(1, replay_after_ops)
+        self._fault_kinds = frozenset(fault_kinds) if fault_kinds else None
+        self._chaos_lock = threading.RLock()
+        self._ops = 0
+        # (watcher fn, kind, name, namespace, event obj as delivered)
+        self._dropped: list[tuple[WatchFn, str, str, str, Any]] = []
+        self.stats = {"conflicts": 0, "transients": 0, "drops": 0,
+                      "replays": 0}
+
+    # -- fault machinery ----------------------------------------------------
+    def _faultable(self, kind: str) -> bool:
+        return self._fault_kinds is None or kind in self._fault_kinds
+
+    def _pre_write(self, kind: str, op: str) -> None:
+        if not self._faultable(kind):
+            return
+        with self._chaos_lock:
+            if self._max_latency_s:
+                delay = self._rng.random() * self._max_latency_s
+                if delay:
+                    time.sleep(delay)
+            roll = self._rng.random()
+            if roll < self._conflict_rate:
+                self.stats["conflicts"] += 1
+                raise Conflict(
+                    f"chaos(seed={self.seed}): injected conflict on "
+                    f"{op} {kind}")
+            if roll < self._conflict_rate + self._transient_rate:
+                self.stats["transients"] += 1
+                raise ConnectionError(
+                    f"chaos(seed={self.seed}): injected transient error "
+                    f"on {op} {kind}")
+
+    def _tick_ops(self) -> None:
+        with self._chaos_lock:
+            self._ops += 1
+            due = self._ops % self._replay_after_ops == 0
+        if due:
+            self.replay_dropped()
+
+    def replay_dropped(self) -> None:
+        """The 'reconnect': every withheld event's object is re-read and
+        delivered at its CURRENT state (MODIFIED), or as the original
+        DELETED if it is gone — exactly what the informer resync in
+        kube/rest.py produces after a dropped stream."""
+        with self._chaos_lock:
+            pending, self._dropped = self._dropped, []
+        for fn, kind, name, namespace, obj in pending:
+            cur = self.try_get(kind, name, namespace)
+            self.stats["replays"] += 1
+            if cur is not None:
+                fn("MODIFIED", cur)
+            else:
+                fn("DELETED", obj)
+
+    # -- APIServer surface overrides ----------------------------------------
+    def update(self, kind: str, obj: Any) -> Any:
+        self._pre_write(kind, "update")
+        out = super().update(kind, obj)
+        self._tick_ops()
+        return out
+
+    def patch(self, kind: str, name: str, namespace: str = "", *,
+              mutate: Callable[[Any], None]) -> Any:
+        self._pre_write(kind, "patch")
+        out = super().patch(kind, name, namespace, mutate=mutate)
+        self._tick_ops()
+        return out
+
+    def create(self, kind: str, obj: Any) -> Any:
+        out = super().create(kind, obj)
+        self._tick_ops()
+        return out
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        super().delete(kind, name, namespace)
+        self._tick_ops()
+
+    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+        def chaotic(event: str, obj: Any) -> None:
+            if self._faultable(kind):
+                with self._chaos_lock:
+                    drop = self._rng.random() < self._drop_watch_rate
+                    if drop:
+                        self.stats["drops"] += 1
+                        self._dropped.append((
+                            fn, kind, obj.metadata.name,
+                            getattr(obj.metadata, "namespace", ""), obj))
+                if drop:
+                    return
+            fn(event, obj)
+
+        return super().watch(kind, chaotic)

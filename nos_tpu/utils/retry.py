@@ -1,0 +1,124 @@
+"""Retry-on-conflict substrate for the decision plane's writes.
+
+Every annotation/status write in the control plane is a read-modify-write
+patch; against a real apiserver (or the chaos substrate,
+nos_tpu/testing/chaos.py) any of them can fail with `Conflict` (409) or a
+transient transport error.  The reference leans on controller-runtime's
+`retry.RetryOnConflict` (k8s.io/client-go/util/retry) at its patch sites;
+this module is that helper for the APIServer surface, plus the capped
+jittered backoff the KubeClient watch-reconnect loop uses.
+
+`mutate` re-reads the object on every attempt (api.patch re-fetches before
+calling it), so a retried patch is computed against the winner's state —
+never a blind replay of a stale diff.
+
+Transient transport errors (OSError) are retried too — an explicit
+widening over client-go's Conflict-only helper, because a dropped LB
+connection must not wedge the handshake.  The cost: a response lost
+AFTER the server committed gets the mutate applied twice.  Every mutate
+passed here must therefore be IDEMPOTENT against current state
+(set-annotation / set-label / set-status writes are; a read-modify-write
+counter bump is only if double-increment is harmless, as the plugin
+generation's staleness ordering is).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Any, Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import Conflict, TransientAPIError
+
+logger = logging.getLogger(__name__)
+
+# Test seam: soak tests replace this with a no-op so hundreds of injected
+# conflicts retry instantly (the backoff *schedule* is still computed and
+# asserted on; only the actual blocking is skipped).
+sleep: Callable[[float], None] = time.sleep
+
+DEFAULT_ATTEMPTS = 8
+DEFAULT_BASE_DELAY_S = 0.02
+DEFAULT_MAX_DELAY_S = 1.0
+
+REGISTRY.describe("nos_tpu_retry_total",
+                  "Write attempts retried after Conflict/transient errors")
+REGISTRY.describe("nos_tpu_retry_exhausted_total",
+                  "Writes abandoned after exhausting retry attempts")
+
+# Exceptions worth retrying: optimistic-concurrency losses, transport
+# blips (ConnectionError, URLError, timeouts — all OSError), and
+# server-side 5xx/429 (TransientAPIError from kube/rest.py).  NotFound is
+# deliberately NOT here: a vanished object is a state change, not a blip,
+# and every call site has its own NotFound policy.
+RETRYABLE = (Conflict, OSError, TransientAPIError)
+
+
+class Backoff:
+    """Capped exponential backoff with full jitter.
+
+    `next_delay()` grows base * factor^n up to `cap_s`, jittered over
+    [cap*(1-jitter), cap] so a fleet of reconnecting watchers does not
+    thundering-herd the apiserver; `reset()` on success.
+    """
+
+    def __init__(self, base_s: float = 0.2, cap_s: float = 30.0,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 rng: random.Random | None = None) -> None:
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.factor = factor
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+        self._n = 0
+
+    def next_delay(self) -> float:
+        raw = min(self.cap_s, self.base_s * (self.factor ** self._n))
+        self._n += 1
+        if not self.jitter:
+            return raw
+        return raw * (1.0 - self.jitter * self._rng.random())
+
+    def reset(self) -> None:
+        self._n = 0
+
+
+def retry_on_conflict(api, kind: str, name: str,
+                      mutate: Callable[[Any], None],
+                      namespace: str = "", *,
+                      component: str = "",
+                      attempts: int = DEFAULT_ATTEMPTS,
+                      base_delay_s: float = DEFAULT_BASE_DELAY_S,
+                      max_delay_s: float = DEFAULT_MAX_DELAY_S) -> Any:
+    """api.patch(kind, name, namespace, mutate=mutate) with jittered
+    exponential backoff on Conflict/transient errors.
+
+    Emits `nos_tpu_retry_total` per retried attempt and
+    `nos_tpu_retry_exhausted_total` (then re-raises) when `attempts`
+    are burned — a climbing exhausted counter is a contended object or
+    a down apiserver, not normal operation (docs/troubleshooting.md).
+    """
+    labels = {"component": component or kind}
+    backoff = Backoff(base_s=base_delay_s, cap_s=max_delay_s)
+    last: Exception | None = None
+    for attempt in range(attempts):
+        try:
+            return api.patch(kind, name, namespace, mutate=mutate)
+        except RETRYABLE as e:  # noqa: PERF203 — retry loop
+            last = e
+            REGISTRY.inc("nos_tpu_retry_total", labels=labels)
+            if attempt == attempts - 1:
+                break
+            delay = backoff.next_delay()
+            logger.debug("retry %s %s/%s (%s, attempt %d/%d, %.3fs): %s",
+                         kind, namespace, name, labels["component"],
+                         attempt + 1, attempts, delay, e)
+            sleep(delay)
+    REGISTRY.inc("nos_tpu_retry_exhausted_total", labels=labels)
+    logger.warning("retry exhausted after %d attempts: %s %s/%s (%s): %s",
+                   attempts, kind, namespace, name, labels["component"],
+                   last)
+    assert last is not None
+    raise last

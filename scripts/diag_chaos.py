@@ -1,0 +1,81 @@
+"""Chaos soak runner: seeded fault-injection sweeps with one-command repro.
+
+Drives the same slice e2e soak the test suite runs (tests/test_chaos.py
+run_slice_soak) over a seed range, with tunable fault rates and cluster
+size, and prints a JSON line per failure naming the seed — so a CI or
+overnight soak failure reproduces with:
+
+    python scripts/diag_chaos.py --seed <N>
+
+Sweeps:
+
+    python scripts/diag_chaos.py                      # seeds 0..99
+    python scripts/diag_chaos.py --seeds 1000 --hosts 4 --pods 7
+    python scripts/diag_chaos.py --conflict-rate 0.4 --drop-rate 0.3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+from nos_tpu.utils import retry as retry_mod  # noqa: E402
+
+# The soak harness lives with the tests so the acceptance gate and this
+# runner can never drift apart.
+sys.path.insert(0, str(_REPO / "tests"))
+from test_chaos import run_slice_soak  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run exactly one seed (repro mode, verbose)")
+    ap.add_argument("--seeds", type=int, default=100,
+                    help="sweep seeds 0..N-1 (default 100)")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--pods", type=int, default=3)
+    ap.add_argument("--conflict-rate", type=float, default=0.15)
+    ap.add_argument("--transient-rate", type=float, default=0.10)
+    ap.add_argument("--drop-rate", type=float, default=0.10)
+    ap.add_argument("--real-backoff", action="store_true",
+                    help="keep real retry sleeps (slower, timing-true)")
+    args = ap.parse_args(argv)
+
+    if not args.real_backoff:
+        retry_mod.sleep = lambda s: None
+
+    seeds = [args.seed] if args.seed is not None else range(args.seeds)
+    failures = 0
+    t0 = time.monotonic()
+    for seed in seeds:
+        r = run_slice_soak(seed, hosts=args.hosts, pods=args.pods,
+                           conflict_rate=args.conflict_rate,
+                           transient_rate=args.transient_rate,
+                           drop_watch_rate=args.drop_rate)
+        ok = r.converged and not r.errors
+        if not ok or args.seed is not None:
+            print(json.dumps({
+                "seed": seed, "ok": ok, "rounds": r.rounds,
+                "stats": r.api.stats, "errors": r.errors[:5],
+                "quarantined": sorted(r.quarantined),
+                "repro": f"python scripts/diag_chaos.py --seed {seed}",
+            }))
+        if not ok:
+            failures += 1
+    n = len(list(seeds))
+    print(json.dumps({
+        "seeds": n, "failures": failures,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

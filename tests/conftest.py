@@ -12,6 +12,16 @@ late; `jax.config.update` works any time before first backend use.
 
 import os
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 "
+        "(`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers", "chaos: deep chaos soak (seeded fault-injection runs "
+        "beyond the small tier-1 depth); select with `-m chaos`")
+
+
 if not os.environ.get("NOS_TPU_TEST_REAL"):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,443 @@
+"""Chaos soak + failure-domain tests (the robustness acceptance gate).
+
+Seeded `ChaosAPIServer` runs drive the full slice e2e path — node init,
+repartition plan, agent actuation, scheduling, kubelet admission — while
+conflicts, transient write errors, and watch-event drops are injected on
+every update/patch.  Each run must converge to spec==status on every
+node with all pods Running and ZERO exceptions escaping the (simulated)
+run loops; a failure prints the seed for one-command repro via
+`python scripts/diag_chaos.py --seed N`.
+
+Also here: the plan-deadline quarantine state machine (kill an agent
+mid-plan, assert the controller quarantines it and still replans the
+surviving nodes — docs/protocol.md, "Plan deadline and quarantine")
+and retry exhaustion.
+"""
+
+from __future__ import annotations
+
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import Conflict, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import RUNNING
+from nos_tpu.partitioning.core import REASON_PLAN_DEADLINE
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import (
+    new_slice_partitioner_controller,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.gang import TopologyFilter
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.chaos import ChaosAPIServer
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+from nos_tpu.topology.annotations import (
+    spec_matches_status, spec_plan_id, status_plan_id,
+)
+from nos_tpu.utils import retry as retry_mod
+
+
+@pytest.fixture(autouse=True)
+def fast_retry(monkeypatch):
+    """Injected faults retry instantly — the soak exercises the retry
+    *logic* hundreds of times; real backoff sleeps belong in prod."""
+    monkeypatch.setattr(retry_mod, "sleep", lambda s: None)
+
+
+# The acceptance gate: 25+ seeded runs, all faults on, all converge.
+TIER1_SEEDS = range(25)
+DEEP_SEEDS = range(25, 125)
+
+BATCH_TIMEOUT_S = 60.0
+
+
+def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
+                   max_rounds: int = 80,
+                   conflict_rate: float = 0.15,
+                   transient_rate: float = 0.10,
+                   drop_watch_rate: float = 0.10) -> SimpleNamespace:
+    """One seeded chaos run over the full slice e2e path.  Single
+    thread, injected clock: deterministic per seed."""
+    api = ChaosAPIServer(seed, conflict_rate=conflict_rate,
+                         transient_rate=transient_rate,
+                         drop_watch_rate=drop_watch_rate,
+                         replay_after_ops=5)
+    state = ClusterState()
+    clock = [0.0]
+    errors: list[str] = []
+
+    def tick(name, fn):
+        """RunLoop analog: a raising tick is THE failure being hunted."""
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — recorded, then asserted on
+            errors.append(f"seed={seed} round={round_no} {name}: {e!r}")
+
+    NodeController(api, state, SliceNodeInitializer(api)).bind()
+    PodController(api, state).bind()
+    partitioner = new_slice_partitioner_controller(
+        api, state, batch_timeout_s=BATCH_TIMEOUT_S, batch_idle_s=10.0,
+        clock=lambda: clock[0])
+    partitioner.bind()
+    agents = []
+    round_no = -1  # node creation fires watch callbacks through tick-less paths
+    for i in range(hosts):
+        api.create(KIND_NODE, make_tpu_node(
+            f"host-{i}", pod_id="pod-0", host_index=i))
+        agent = SliceAgent(api, f"host-{i}", FakeTpuRuntime(V5E),
+                           FakePodResources())
+        agent.start()
+        agents.append(agent)
+    scheduler = Scheduler(
+        api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+
+    # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
+    # is always feasible
+    assert pods <= hosts * 2
+    for i in range(pods):
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"soak-{i}"))
+
+    def converged() -> bool:
+        for p in api.list(KIND_POD):
+            if not p.spec.node_name or p.status.phase != RUNNING:
+                return False
+        return all(
+            spec_matches_status(n.metadata.annotations)
+            for n in api.list(KIND_NODE))
+
+    done = False
+    for round_no in range(max_rounds):
+        clock[0] += BATCH_TIMEOUT_S + 1.0
+        tick("scheduler", scheduler.run_cycle)
+        tick("partitioner", partitioner.process_if_ready)
+        for i, agent in enumerate(agents):
+            tick(f"agent-{i}", agent.tick)
+        api.replay_dropped()        # the round's watch "reconnect"
+        if converged():
+            done = True
+            break
+    return SimpleNamespace(api=api, errors=errors, converged=done,
+                           rounds=round_no + 1, seed=seed,
+                           quarantined=partitioner.quarantine.names())
+
+
+def _assert_soak_ok(result) -> None:
+    repro = f"repro: python scripts/diag_chaos.py --seed {result.seed}"
+    assert not result.errors, (result.errors[:3], repro)
+    assert result.converged, (
+        f"seed {result.seed} did not converge in {result.rounds} rounds "
+        f"(stats {result.api.stats}, quarantined {result.quarantined}); "
+        + repro)
+
+
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", TIER1_SEEDS)
+    def test_soak_converges(self, seed):
+        result = run_slice_soak(seed)
+        _assert_soak_ok(result)
+        # the run must actually have been chaotic, not a lucky no-fault
+        # walk — otherwise the gate asserts nothing
+        stats = result.api.stats
+        assert stats["conflicts"] + stats["transients"] + stats["drops"] > 0
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", DEEP_SEEDS)
+    def test_soak_deep(self, seed):
+        _assert_soak_ok(run_slice_soak(seed, hosts=3, pods=5,
+                                       drop_watch_rate=0.2))
+
+    def test_same_seed_same_fault_sequence(self):
+        a = run_slice_soak(7)
+        b = run_slice_soak(7)
+        assert a.api.stats == b.api.stats
+        assert a.rounds == b.rounds
+
+
+class _Cluster:
+    """Fault-free control plane over N hosts with individually killable
+    agents (chaos rates zero: these tests inject failure by *silence*)."""
+
+    def __init__(self, hosts=2):
+        self.api = ChaosAPIServer(0)
+        self.state = ClusterState()
+        self.clock = [0.0]
+        NodeController(self.api, self.state,
+                       SliceNodeInitializer(self.api)).bind()
+        PodController(self.api, self.state).bind()
+        self.partitioner = new_slice_partitioner_controller(
+            self.api, self.state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=10.0, clock=lambda: self.clock[0])
+        self.partitioner.bind()
+        self.agents = {}
+        for i in range(hosts):
+            name = f"host-{i}"
+            self.api.create(KIND_NODE, make_tpu_node(
+                name, pod_id="pod-0", host_index=i))
+            self.agents[name] = SliceAgent(
+                self.api, name, FakeTpuRuntime(V5E), FakePodResources())
+            self.agents[name].start()
+            self.agents[name].tick()
+        self.scheduler = Scheduler(self.api, Framework())
+
+    def demand(self, shape, qty, name):
+        self.api.create(KIND_POD, make_slice_pod(shape, qty, name=name))
+        self.scheduler.run_cycle()
+
+    def plan_cycle(self):
+        self.clock[0] += BATCH_TIMEOUT_S + 1.0
+        return self.partitioner.process_if_ready()
+
+    def node(self, name):
+        return self.api.get(KIND_NODE, name)
+
+    def planned_nodes(self):
+        """Nodes with an open handshake (spec plan != status plan)."""
+        out = []
+        for n in self.api.list(KIND_NODE):
+            annots = n.metadata.annotations
+            if spec_plan_id(annots) and \
+                    status_plan_id(annots) != spec_plan_id(annots):
+                out.append(n.metadata.name)
+        return out
+
+
+class TestHandshakeDeadline:
+    def test_dead_agent_is_quarantined_and_survivors_replan(self):
+        c = _Cluster(hosts=2)
+        quarantine = c.partitioner.quarantine
+
+        # a plan lands on one host; its agent dies before actuating
+        c.demand("2x2", 1, "want-a")
+        assert c.plan_cycle()
+        dead = c.planned_nodes()
+        assert len(dead) == 1
+        dead = dead[0]
+        alive = next(n for n in c.agents if n != dead)
+        dead_plan = spec_plan_id(c.node(dead).metadata.annotations)
+        alive_plan = spec_plan_id(c.node(alive).metadata.annotations)
+
+        # new demand: the handshake is open, so the first ready batch
+        # only arms the deadline...
+        c.demand("2x2", 1, "want-b")
+        assert not c.plan_cycle()
+        assert not quarantine.is_quarantined(dead)
+
+        # ...and once the deadline passes, the laggard is quarantined
+        # and the SAME call replans the surviving node
+        c.clock[0] += 3 * BATCH_TIMEOUT_S + 1.0
+        assert c.plan_cycle()
+        assert quarantine.is_quarantined(dead)
+        assert quarantine.reason(dead) == REASON_PLAN_DEADLINE
+        # survivor got a fresh plan; the dead node's spec is untouched
+        new_alive_plan = spec_plan_id(c.node(alive).metadata.annotations)
+        assert new_alive_plan and new_alive_plan != alive_plan
+        assert alive in c.planned_nodes()
+        assert spec_plan_id(c.node(dead).metadata.annotations) == dead_plan
+        snap = REGISTRY.snapshot()
+        assert snap["nos_tpu_plan_deadline_exceeded_total"]["kind=slice"] >= 1
+
+        # the quarantined node is OUT of the snapshot until it reports
+        assert dead in c.partitioner.quarantine.names()
+
+        # the agent comes back and reports: auto-unquarantine on the
+        # next poll, node rejoins planning
+        c.agents[dead].tick()
+        assert spec_matches_status(c.node(dead).metadata.annotations)
+        c.partitioner.process_if_ready()
+        assert not quarantine.is_quarantined(dead)
+        assert snap_gauge("nos_tpu_quarantined_nodes", "kind=slice") == 0.0
+
+    def test_demand_survives_total_quarantine(self):
+        """Regression: quarantining the LAST node of a kind used to
+        drain the batch into an empty snapshot, stranding the pending
+        pods until unrelated pod churn re-fed the batcher."""
+        c = _Cluster(hosts=1)
+        c.demand("2x2", 1, "a")
+        assert c.plan_cycle()           # plan lands; agent never ticks
+        c.demand("1x1", 1, "b")
+        assert not c.plan_cycle()       # handshake open: arms deadline
+        c.clock[0] += 3 * BATCH_TIMEOUT_S + 1.0
+        # quarantined -> snapshot empty -> batch must be KEPT
+        assert not c.partitioner.process_if_ready()
+        assert c.partitioner.quarantine.is_quarantined("host-0")
+
+        # the agent recovers and reports; with NO new pod events the
+        # restored batch must replan the recovered node (its window
+        # restarted at the restore, so advance past it again)
+        old_plan = spec_plan_id(c.node("host-0").metadata.annotations)
+        c.agents["host-0"].tick()
+        assert c.plan_cycle()
+        assert not c.partitioner.quarantine.is_quarantined("host-0")
+        assert spec_plan_id(
+            c.node("host-0").metadata.annotations) != old_plan
+
+    def test_deadline_rearms_per_plan(self):
+        """A node lagging on plan A, then reporting, then lagging on
+        plan B gets a FRESH deadline for B — the timer is per-plan, not
+        cumulative."""
+        c = _Cluster(hosts=1)
+        c.demand("2x2", 1, "a")
+        assert c.plan_cycle()
+        assert c.planned_nodes() == ["host-0"]
+        # lag half a deadline, then report
+        c.demand("1x1", 1, "b")
+        assert not c.plan_cycle()       # arms deadline for plan A
+        c.clock[0] += 1.5 * BATCH_TIMEOUT_S
+        c.agents["host-0"].tick()       # reports plan A
+        # plan B lands; half a deadline later the node must NOT be
+        # quarantined (fresh timer), a full deadline later it must be
+        assert c.plan_cycle()
+        c.demand("1x2", 1, "c")
+        assert not c.plan_cycle()       # arms deadline for plan B
+        c.clock[0] += 1.5 * BATCH_TIMEOUT_S
+        c.partitioner.process_if_ready()
+        assert not c.partitioner.quarantine.is_quarantined("host-0")
+        c.clock[0] += 2.0 * BATCH_TIMEOUT_S
+        c.partitioner.process_if_ready()
+        assert c.partitioner.quarantine.is_quarantined("host-0")
+
+
+class TestRescanBackstop:
+    def test_lost_trigger_is_replanned_by_rescan(self):
+        """Against a real apiserver a pod's repeated unschedulable
+        re-mark is a no-op write emitting NO watch event: if the batch
+        carrying the pod's only event is consumed by a plan that could
+        not help it, only the level-triggered rescan can save it."""
+        c = _Cluster(hosts=1)
+        before = spec_plan_id(c.node("host-0").metadata.annotations)
+        c.demand("2x2", 1, "a")
+        # simulate the trigger loss: the batch vanishes unconsummated
+        c.partitioner._batcher.drain()
+        assert not c.partitioner._batcher.ready()
+        c.clock[0] += BATCH_TIMEOUT_S + 1.0
+        assert c.partitioner.process_if_ready()   # rescan plans anyway
+        after = spec_plan_id(c.node("host-0").metadata.annotations)
+        assert after and after != before
+
+    def test_rescan_is_idle_without_pending_demand(self):
+        c = _Cluster(hosts=1)
+        c.clock[0] += 10 * BATCH_TIMEOUT_S
+        assert not c.partitioner.process_if_ready()
+
+    def test_rescan_defers_to_an_accumulating_batch(self):
+        """A fresh not-yet-ready batch already carries a live trigger:
+        the rescan must not preempt its idle/timeout accumulation
+        windows and plan with half a demand wave."""
+        c = _Cluster(hosts=1)
+        c.clock[0] += 10 * BATCH_TIMEOUT_S      # rescan long overdue
+        c.demand("2x2", 1, "a")                 # batch starts filling
+        c.clock[0] += 1.0                       # inside idle window
+        assert not c.partitioner.process_if_ready()
+        c.clock[0] += 11.0                      # idle window elapses
+        assert c.partitioner.process_if_ready()
+
+
+def snap_gauge(name: str, series: str) -> float:
+    return REGISTRY.snapshot().get(name, {}).get(series, 0.0)
+
+
+class TestRetrySubstrate:
+    def test_retry_recovers_from_conflicts(self):
+        api = ChaosAPIServer(3, conflict_rate=0.5, transient_rate=0.2)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        retry_mod.retry_on_conflict(
+            api, KIND_NODE, "n1",
+            lambda n: n.metadata.annotations.__setitem__("x", "1"),
+            component="test", attempts=100)
+        assert api.get(KIND_NODE, "n1").metadata.annotations["x"] == "1"
+
+    def test_retry_exhausted_raises_and_counts(self):
+        api = ChaosAPIServer(4, conflict_rate=1.0)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        before = snap_gauge("nos_tpu_retry_exhausted_total",
+                            "component=exhaust-test")
+        with pytest.raises(Conflict):
+            retry_mod.retry_on_conflict(
+                api, KIND_NODE, "n1",
+                lambda n: n.metadata.annotations.__setitem__("x", "1"),
+                component="exhaust-test", attempts=4)
+        assert "x" not in api.get(KIND_NODE, "n1").metadata.annotations
+        assert snap_gauge("nos_tpu_retry_exhausted_total",
+                          "component=exhaust-test") == before + 1
+        assert snap_gauge("nos_tpu_retry_total",
+                          "component=exhaust-test") >= 4
+
+    def test_transient_api_errors_are_retried(self):
+        """5xx/429 from a real apiserver arrive as TransientAPIError
+        (kube/rest.py) and must ride the same retry path as Conflict."""
+        from nos_tpu.kube.client import TransientAPIError
+
+        calls = []
+
+        class _FlakyApi:
+            def patch(self, kind, name, namespace="", *, mutate):
+                calls.append(name)
+                if len(calls) < 3:
+                    raise TransientAPIError("HTTP 503: apiserver rolling")
+                return "ok"
+
+        assert retry_mod.retry_on_conflict(
+            _FlakyApi(), KIND_NODE, "n1", lambda n: None,
+            component="t503") == "ok"
+        assert len(calls) == 3
+
+    def test_backoff_caps_and_resets(self):
+        b = retry_mod.Backoff(base_s=0.1, cap_s=1.0, jitter=0.0)
+        delays = [b.next_delay() for _ in range(8)]
+        assert delays[0] == pytest.approx(0.1)
+        assert delays[-1] == 1.0 and max(delays) == 1.0
+        b.reset()
+        assert b.next_delay() == pytest.approx(0.1)
+
+    def test_jitter_stays_below_raw_delay(self):
+        b = retry_mod.Backoff(base_s=1.0, cap_s=1.0, jitter=0.5,
+                              rng=random.Random(1))
+        for _ in range(50):
+            assert 0.5 <= b.next_delay() <= 1.0
+
+
+class TestChaosMechanics:
+    def test_dropped_watch_event_is_replayed_at_current_state(self):
+        api = ChaosAPIServer(1, drop_watch_rate=1.0, replay_after_ops=1000)
+        seen = []
+        api.watch(KIND_NODE, lambda ev, o: seen.append(
+            (ev, o.metadata.name, dict(o.metadata.annotations))))
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.patch(KIND_NODE, "n1",
+                  mutate=lambda n: n.metadata.annotations.__setitem__(
+                      "k", "v2"))
+        assert seen == []               # everything withheld
+        api.replay_dropped()
+        # replay delivers the CURRENT state once per drop, not the
+        # stale intermediates
+        assert all(ann.get("k") == "v2" for _, _, ann in seen)
+
+    def test_dropped_delete_replays_as_deleted(self):
+        api = ChaosAPIServer(1, drop_watch_rate=1.0, replay_after_ops=1000)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        seen = []
+        api.watch(KIND_NODE, lambda ev, o: seen.append((ev, o.metadata.name)))
+        api.delete(KIND_NODE, "n1")
+        api.replay_dropped()
+        assert ("DELETED", "n1") in seen
+
+    def test_faults_are_scoped_to_fault_kinds(self):
+        api = ChaosAPIServer(2, conflict_rate=1.0, fault_kinds={"Pod"})
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.patch(KIND_NODE, "n1",
+                  mutate=lambda n: n.metadata.labels.__setitem__("a", "b"))
+        pod = make_slice_pod("2x2", 1, name="p")
+        api.create(KIND_POD, pod)
+        with pytest.raises(Conflict):
+            api.patch(KIND_POD, "p", pod.metadata.namespace,
+                      mutate=lambda p: None)

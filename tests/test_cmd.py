@@ -224,6 +224,48 @@ class TestRunLoops:
         time.sleep(0.05)
         assert len(calls) == n  # actually stopped
 
+    def test_slow_tick_does_not_stretch_the_period(self):
+        """Regression: the loop used to sleep the FULL interval after
+        every tick, so a tick taking ~interval doubled the effective
+        reconcile period.  Asserted on the WAIT the loop requests (not
+        on wall-clock tick counts, which flake on loaded CI): a ~0.03 s
+        tick against a 0.05 s interval must wait ~0.02 s, never the
+        full interval."""
+        import threading
+
+        from nos_tpu.cmd._runtime import RunLoop
+
+        waits: list[float] = []
+
+        class _Stop(threading.Event):
+            def wait(self, timeout=None):
+                waits.append(timeout)
+                return len(waits) >= 3
+
+            def is_set(self):
+                return len(waits) >= 3
+
+        loop = RunLoop("t", lambda: time.sleep(0.03), 0.05, _Stop())
+        loop.run()          # synchronous: 3 ticks, then the stub stops it
+        # tick duration only GROWS under load, so the requested wait
+        # only shrinks — this bound holds on any machine
+        assert len(waits) == 3
+        assert all(w < 0.045 for w in waits), waits
+
+    def test_health_respond_swallows_client_disconnect(self):
+        from nos_tpu.cmd._runtime import _HealthHandler
+
+        h = _HealthHandler.__new__(_HealthHandler)
+        h.request_version = "HTTP/1.1"
+        h.requestline = "GET /metrics HTTP/1.1"
+
+        class _BrokenPipe:
+            def write(self, data):
+                raise BrokenPipeError("client went away")
+
+        h.wfile = _BrokenPipe()
+        h._respond(200, "payload")      # must not raise off the thread
+
     def test_health_endpoints(self):
         main = Main("t", health_addr="127.0.0.1:0")
         main.add_loop("noop", lambda: None, 0.05)

@@ -11,8 +11,8 @@ import pytest
 from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_NODE
 from nos_tpu.partitioning.core import (
-    ClusterSnapshot, GeometryActuator, GeometryPlanner, SliceTracker,
-    SnapshotError,
+    ClusterSnapshot, GeometryActuator, GeometryPlanner, QuarantineList,
+    REASON_ACTUATION, SliceTracker, SnapshotError,
 )
 from nos_tpu.partitioning.slicepart import (
     SliceNodeInitializer, SlicePartitionCalculator, SlicePartitioner,
@@ -251,6 +251,107 @@ class TestActuatorAndPartitioner:
     def test_apply_skips_empty(self):
         snap, _ = snapshot_for([self.node])
         assert not self.actuator.apply(snap, PartitioningState())
+
+
+class _FailingForNode:
+    """Partitioner stub failing every apply for one node."""
+
+    def __init__(self, inner, bad_node):
+        self.inner = inner
+        self.bad_node = bad_node
+        self.failures = 0
+
+    def apply_partitioning(self, node_name, plan_id, partitioning):
+        if node_name == self.bad_node:
+            self.failures += 1
+            raise RuntimeError("injected: apply rejected")
+        self.inner.apply_partitioning(node_name, plan_id, partitioning)
+
+
+class TestActuatorFailureIsolation:
+    """Regression: one node's apply_partitioning raising used to abort
+    the remaining nodes of the plan."""
+
+    def _desired(self, names):
+        return PartitioningState({
+            n: NodePartitioning(units=[
+                UnitPartitioning(0, {"nos.tpu/slice-2x2": 2})
+            ]) for n in names
+        })
+
+    def test_one_failing_node_does_not_abort_the_rest(self):
+        api = APIServer()
+        nodes = [virgin_v5e("bad"), virgin_v5e("good")]
+        for n in nodes:
+            api.create(KIND_NODE, n)
+        quarantine = QuarantineList(kind="slice")
+        actuator = GeometryActuator(
+            _FailingForNode(SlicePartitioner(api), "bad"),
+            SlicePartitionCalculator(), quarantine=quarantine)
+        snap, _ = snapshot_for(nodes)
+
+        assert actuator.apply(snap, self._desired(["bad", "good"]))
+        good = api.get(KIND_NODE, "good")
+        parsed = parse_spec_annotations(good.metadata.annotations)
+        assert [(a.profile, a.quantity) for a in parsed] == [("2x2", 2)]
+        bad = api.get(KIND_NODE, "bad")
+        assert not parse_spec_annotations(bad.metadata.annotations)
+        assert not quarantine.is_quarantined("bad")  # streak 1 of 3
+
+    def test_failure_streak_opens_the_breaker(self):
+        api = APIServer()
+        nodes = [virgin_v5e("bad"), virgin_v5e("good")]
+        for n in nodes:
+            api.create(KIND_NODE, n)
+        quarantine = QuarantineList(kind="slice", failure_threshold=3)
+        failing = _FailingForNode(SlicePartitioner(api), "bad")
+        actuator = GeometryActuator(
+            failing, SlicePartitionCalculator(), quarantine=quarantine)
+        for _ in range(3):
+            snap, _ = snapshot_for(nodes)
+            actuator.apply(snap, self._desired(["bad"]))
+        assert failing.failures == 3
+        assert quarantine.is_quarantined("bad")
+        assert quarantine.reason("bad") == REASON_ACTUATION
+
+        # a later success (after the controller's half-open probe put
+        # the node back in the snapshot) closes the breaker
+        failing.bad_node = "nobody"
+        snap, _ = snapshot_for(nodes)
+        assert actuator.apply(snap, self._desired(["bad"]))
+        assert not quarantine.is_quarantined("bad")
+
+    def test_half_open_probe_reopens_on_first_failure(self):
+        """A failure inside the probe window re-opens the breaker at
+        once: a permanently failing node gets ONE doomed plan cycle per
+        cool-down, not threshold-many.  Outside the window the
+        N-consecutive contract is back in force."""
+        now = [0.0]
+        quarantine = QuarantineList(kind="slice", failure_threshold=3,
+                                    clock=lambda: now[0])
+        for _ in range(3):
+            quarantine.record_failure("bad")
+        assert quarantine.is_quarantined("bad")
+        assert quarantine.release_for_probe("bad", window_s=10.0)
+        assert not quarantine.is_quarantined("bad")
+        now[0] += 5.0
+        quarantine.record_failure("bad")        # failed probe, in window
+        assert quarantine.is_quarantined("bad")
+
+        # a success during the probe clears everything
+        assert quarantine.release_for_probe("bad", window_s=10.0)
+        quarantine.record_success("bad")
+        assert quarantine.record_failure("bad") == 1
+
+        # an EXPIRED probe window must not turn one isolated failure
+        # weeks later into an instant quarantine
+        quarantine.record_failure("bad")
+        quarantine.record_failure("bad")
+        assert quarantine.is_quarantined("bad")
+        assert quarantine.release_for_probe("bad", window_s=10.0)
+        now[0] += 100.0
+        assert quarantine.record_failure("bad") == 1
+        assert not quarantine.is_quarantined("bad")
 
 
 class TestInitializer:

@@ -424,7 +424,11 @@ class TestFullStackQuotaFlow:
             main.add_loop("eq-reconciler", eq_rec.reconcile_all, 0.05)
             main.start()
             try:
-                def wait(pred, what, timeout=45.0):
+                # 90 s envelope: standalone convergence is ~3 s, but
+                # the whole control plane, the TLS webhook, AND the
+                # apiserver stub share this process's GIL, so a loaded
+                # CI box stretches it substantially
+                def wait(pred, what, timeout=90.0):
                     deadline = time.monotonic() + timeout
                     while time.monotonic() < deadline:
                         if pred():
