@@ -36,6 +36,9 @@ def _install_native() -> None:
         from nos_tpu.device.native import install_native_packer
 
         install_native_packer(build=False)
+    # Best-effort native-packer hook: importing nos_tpu must never
+    # fail because an optional compiler is missing.
+    # noslint: N005 — intentional swallow; every caller falls back to pure Python
     except Exception:  # noqa: BLE001 — import must never fail on this
         pass
 

@@ -1,0 +1,18 @@
+"""noslint: project-native static checks for the nos-tpu tree.
+
+`python -m nos_tpu.analysis` runs rules N001–N006 over ``nos_tpu/`` and
+exits non-zero on any unsuppressed violation; ``tests/test_analysis.py``
+runs the same sweep in tier-1, so a rule violation is a test failure.
+See docs/static-analysis.md for the rule catalog and pragma grammar,
+and nos_tpu/testing/lockcheck.py for the dynamic lock-order half.
+"""
+
+from .core import (
+    FRAMEWORK_RULE, ModuleSource, Report, Rule, Violation, lint_source, run,
+)
+from .rules import default_rules
+
+__all__ = [
+    "FRAMEWORK_RULE", "ModuleSource", "Report", "Rule", "Violation",
+    "default_rules", "lint_source", "run",
+]

@@ -1,0 +1,65 @@
+"""CLI driver: ``python -m nos_tpu.analysis [paths ...]``.
+
+Exit status 0 = clean (the CI/tier-1 contract), 1 = violations.
+``--format json`` emits machine-readable findings for tooling;
+``--list-rules`` prints the catalog; ``--show-suppressed`` audits what
+the pragmas are hiding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import run
+from .rules import default_rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nos_tpu.analysis",
+        description="noslint: project-native invariant checks (N001-N006)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the nos_tpu "
+                        "package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print pragma-suppressed findings")
+    args = parser.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(os.path.dirname(pkg_dir))
+    paths = args.paths or [os.path.dirname(pkg_dir)]
+    report = run(rules, paths, root=repo_root)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": report.files,
+            "violations": [vars(v) for v in report.violations],
+            "suppressed": [vars(v) for v in report.suppressed],
+        }, indent=2))
+    else:
+        for v in report.violations:
+            print(v.render())
+        if args.show_suppressed:
+            for v in report.suppressed:
+                print(f"[suppressed] {v.render()}")
+        print(f"noslint: {report.files} file(s), "
+              f"{len(report.violations)} violation(s), "
+              f"{len(report.suppressed)} suppressed")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

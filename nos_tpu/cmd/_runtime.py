@@ -20,6 +20,11 @@ from nos_tpu.exporter.metrics import REGISTRY
 
 logger = logging.getLogger(__name__)
 
+REGISTRY.describe("nos_tpu_runloop_errors_total",
+                  "Reconcile ticks that raised (survived, logged)")
+REGISTRY.describe("nos_tpu_runloop_tick_seconds",
+                  "Run-loop tick duration (count/sum/max per loop)")
+
 
 class RunLoop(threading.Thread):
     """Periodic loop: fn() every interval until stop.  One crashing tick

@@ -15,7 +15,6 @@ import sys
 from nos_tpu.api.config import ConfigError, SchedulerConfig, load_config
 from nos_tpu.cmd._runtime import Main, build_api
 from nos_tpu.cmd.assembly import build_scheduler
-from nos_tpu.kube.client import APIServer
 
 
 def main(argv=None) -> int:

@@ -25,8 +25,12 @@ import sys
 import time
 
 from nos_tpu.api.config import ConfigError, ManagerConfig, load_config
+from nos_tpu.exporter.metrics import REGISTRY
 
 logger = logging.getLogger("nos_tpu.cmd.train")
+
+REGISTRY.describe("nos_tpu_train_loss", "Last training step loss")
+REGISTRY.describe("nos_tpu_train_step", "Last completed training step")
 
 
 @dataclasses.dataclass
@@ -160,7 +164,6 @@ def build(cfg: TrainConfig):
 def train(cfg: TrainConfig) -> float | None:
     """Run the loop; returns the final loss, or None when the checkpoint
     already covers every requested step (nothing to do)."""
-    from nos_tpu.exporter.metrics import REGISTRY
 
     trainer, loader, checkpointer, state, start_step = build(cfg)
     if start_step >= cfg.steps:

@@ -26,6 +26,7 @@ import time
 from typing import Callable
 
 from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.client import APIServer
 from nos_tpu.kube.objects import PENDING, Pod
 from nos_tpu.partitioning.core import (
@@ -38,6 +39,12 @@ from nos_tpu.utils.pod_util import extra_resources_could_help_scheduling
 from nos_tpu.topology.annotations import spec_plan_id, status_plan_id
 
 logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_plan_seconds",
+                  "Partitioning plan computation time")
+REGISTRY.describe("nos_tpu_plans_total", "Partitioning plans computed")
+REGISTRY.describe("nos_tpu_plan_pending_pods",
+                  "Pending pods the last plan tried to place")
 
 # Default plan deadline as a multiple of the batch timeout: a healthy
 # agent reports within one report interval, so 3 full batch windows of
@@ -132,7 +139,6 @@ class PartitionerController:
         """Returns False when no snapshot node was available to plan on
         (the caller keeps its trigger); True once a plan cycle ran.
         `pods` lets a rescan-triggered cycle reuse its own listing."""
-        from nos_tpu.exporter.metrics import REGISTRY
 
         if pods is None:
             pods = [
@@ -217,7 +223,6 @@ class PartitionerController:
         (reference :212-232), with a per-plan deadline: a node lagging
         longer than `plan_deadline_s` on the SAME plan id is quarantined
         and stops blocking the handshake."""
-        from nos_tpu.exporter.metrics import REGISTRY
 
         now = self._clock()
         waiting = False

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 
-from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.client import APIServer, KIND_NODE
 from nos_tpu.topology.annotations import (
     parse_spec_annotations, spec_matches_status, spec_plan_id,
@@ -26,6 +26,10 @@ from .plan import ConfigPlan, SliceState, new_config_plan
 from .shared import SharedState
 
 logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_placement_infeasible_total",
+                  "Plans skipped: create set cannot be placed around "
+                  "pinned used slices (awaits a re-plan)")
 
 
 @dataclass
@@ -115,7 +119,6 @@ class SliceActuator:
             # it so the retry path waits for a re-plan instead of looping
             # (VERDICT r3 weak #1).  The reporter's placement annotations
             # give the planner what it needs to plan differently.
-            from nos_tpu.exporter.metrics import REGISTRY
             REGISTRY.inc("nos_tpu_placement_infeasible_total",
                          labels={"node": self._node_name})
             if all(s.error is None for s in result.statuses

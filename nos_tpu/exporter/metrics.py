@@ -28,7 +28,19 @@ class Registry:
         return name, tuple(sorted((labels or {}).items()))
 
     def describe(self, name: str, help_text: str) -> None:
+        """Register a metric's HELP text.  Idempotent for the same text
+        (module re-import, double build_api) but a CONFLICTING
+        re-registration raises: two call sites claiming one series name
+        with different meanings is the double-registration bug class
+        noslint N003 bans statically — this guard catches the dynamic
+        remainder (name built at runtime, plugin registering late)."""
         with self._lock:
+            existing = self._help.get(name)
+            if existing is not None and existing != help_text:
+                raise ValueError(
+                    f"metric {name!r} already registered with different "
+                    f"help text ({existing!r} != {help_text!r}); one "
+                    "describe per metric — see docs/static-analysis.md")
             self._help[name] = help_text
 
     def inc(self, name: str, value: float = 1.0,

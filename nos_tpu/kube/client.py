@@ -17,7 +17,7 @@ from __future__ import annotations
 import copy
 import threading
 from collections import defaultdict
-from typing import Any, Callable, Iterable
+from typing import Any, Callable
 
 from .objects import ConfigMap, Node, Pod
 

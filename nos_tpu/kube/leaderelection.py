@@ -112,6 +112,8 @@ class LeaderElector:
             # would have no such guard on the REST substrate.
             anns[ANN_HOLDER] = self.identity
             anns[ANN_DEADLINE] = str(deadline)
+            # CAS is the election: a Conflict means another candidate won.
+            # noslint: N001 — retrying the lost CAS would steal the winner's lease
             self._api.update(KIND_CONFIGMAP, cm)
             if holder != self.identity:
                 logger.info("leader election %s: %s took over from %s",
@@ -171,6 +173,7 @@ class LeaderElector:
                 if anns.get(ANN_HOLDER) == self.identity:
                     anns[ANN_DEADLINE] = "0"
 
+            # noslint: N001 — best-effort lease drop on exit; must not retry against a successor
             self._api.patch(KIND_CONFIGMAP, self._name, self._ns,
                             mutate=mutate)
         except (Conflict, NotFound, OSError):

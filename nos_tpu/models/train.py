@@ -12,8 +12,7 @@ ring collectives for sp.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable
+from typing import Callable
 
 import flax.linen as nn
 import jax

@@ -18,7 +18,6 @@ let the compiler do the rest).
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import jax

@@ -9,7 +9,7 @@ strategies plug in the same way mig/mps do in the reference.
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import TYPE_CHECKING, Collection, Mapping
+from typing import TYPE_CHECKING, Collection
 
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.resources import ResourceList
@@ -124,4 +124,4 @@ class Actuator(ABC):
 
 
 # Re-exported here to keep the interface module self-contained for readers.
-from ..state import NodePartitioning  # noqa: E402  (cycle-free: state has no core imports)
+from ..state import NodePartitioning  # noqa: E402  # noslint: N006 — re-export: interface readers get the full strategy vocabulary here

@@ -24,7 +24,7 @@ from .actuator import compute_partitioning_state
 from .interfaces import (
     PartitionCalculator, Planner, SliceCalculator, Sorter,
 )
-from .snapshot import ClusterSnapshot
+from .snapshot import ClusterSnapshot, SnapshotError
 from .sorter import ProfileAwareSorter
 from .tracker import SliceTracker
 
@@ -88,7 +88,9 @@ class GeometryPlanner(Planner):
             return False
         try:
             snapshot.add_pod(node_name, pod)
-        except Exception:
+        except SnapshotError:
+            # the only failure add_pod defines: hypothetical bind does
+            # not fit — a real bug class must not hide behind it (N005)
             return False
         return True
 

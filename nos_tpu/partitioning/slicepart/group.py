@@ -30,7 +30,6 @@ import logging
 from collections import defaultdict
 
 from nos_tpu.kube.objects import Pod
-from nos_tpu.scheduler.framework import CycleState, SharedLister
 from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
 from nos_tpu.topology.shape import Shape
 

@@ -26,9 +26,9 @@ from __future__ import annotations
 import logging
 
 from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.client import (
     APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_POD,
-    NotFound,
 )
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
@@ -39,6 +39,11 @@ from nos_tpu.scheduler.framework import (
 from nos_tpu.utils.pod_util import is_over_quota
 
 logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_preemptions_total",
+                  "Over-quota preemption decisions executed")
+REGISTRY.describe("nos_tpu_preemption_victims_total",
+                  "Pods evicted by over-quota preemption")
 
 PRE_FILTER_STATE_KEY = "PreFilterCapacityScheduling"
 ELASTIC_QUOTA_SNAPSHOT_KEY = "ElasticQuotaSnapshot"
@@ -340,7 +345,6 @@ class CapacityScheduling:
         if self.on_preempt is not None:
             self.on_preempt(pod, victims)
         self._evict_all(victims)
-        from nos_tpu.exporter.metrics import REGISTRY
 
         REGISTRY.inc("nos_tpu_preemptions_total")
         REGISTRY.inc("nos_tpu_preemption_victims_total", len(victims))

@@ -24,7 +24,7 @@ from nos_tpu.api.constants import (
     LABEL_POD_ID as C_LABEL_POD_ID,
     RESOURCE_TPU,
 )
-from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import pod_request, sum_resources
 from nos_tpu.scheduler.framework import (
@@ -35,8 +35,13 @@ from nos_tpu.scheduler.gang import (
     get_pod_group, set_pod_group_status,
 )
 from nos_tpu.topology import DEFAULT_REGISTRY
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_drain_preemptions_total",
+                  "Straggler pods evicted to complete a window drain")
 
 
 def _gen_window_sizes(accel: str) -> tuple[int, ...]:
@@ -606,7 +611,6 @@ class Scheduler:
             doomed_keys.update(m.key for m in members)
             evicted += len(evict_gang(self._api, pod))
         if evicted:
-            from nos_tpu.exporter.metrics import REGISTRY
 
             REGISTRY.inc("nos_tpu_drain_preemptions_total",
                          labels={"gang": f"{gang[0]}/{gang[1]}"},
@@ -846,8 +850,12 @@ class Scheduler:
                 else:
                     n.metadata.annotations.pop(C_ANNOT_GANG_LEASE, None)
             try:
-                self._api.patch(KIND_NODE, node.metadata.name, mutate=mutate)
-            except Exception:  # noqa: BLE001 — advisory; next cycle heals
+                retry_on_conflict(self._api, KIND_NODE, node.metadata.name,
+                                  mutate, component="scheduler-gang-lease")
+            except Exception:  # noqa: BLE001 — advisory; next cycle's
+                # full-node scan heals a half-synced lease, so nothing on
+                # this path (exhausted retries, vanished node, a raising
+                # watcher re-thrown through the write) may abort the cycle
                 logger.debug("lease annotation patch failed for %s",
                              node.metadata.name)
 
@@ -928,9 +936,6 @@ class Scheduler:
         preemption (whole-gang amplification can doom a pod that is
         still in the stale pending list).  A gone pod needs no status:
         swallow NotFound instead of killing the scheduling cycle."""
-        from nos_tpu.kube.client import NotFound
-        from nos_tpu.utils.retry import retry_on_conflict
-
         try:
             retry_on_conflict(self._api, KIND_POD, pod.metadata.name,
                               mutate, pod.metadata.namespace,

@@ -82,11 +82,17 @@ class ChaosAPIServer(APIServer):
     def _pre_write(self, kind: str, op: str) -> None:
         if not self._faultable(kind):
             return
+        # Draw the injected latency under the lock (seed determinism),
+        # sleep after release: blocking inside the chaos lock would
+        # convoy every concurrent writer behind one injected delay
+        # (noslint N004).
+        delay = 0.0
         with self._chaos_lock:
             if self._max_latency_s:
                 delay = self._rng.random() * self._max_latency_s
-                if delay:
-                    time.sleep(delay)
+        if delay:
+            time.sleep(delay)
+        with self._chaos_lock:
             roll = self._rng.random()
             if roll < self._conflict_rate:
                 self.stats["conflicts"] += 1
@@ -111,15 +117,43 @@ class ChaosAPIServer(APIServer):
         delivered at its CURRENT state (MODIFIED), or as the original
         DELETED if it is gone — exactly what the informer resync in
         kube/rest.py produces after a dropped stream."""
-        with self._chaos_lock:
-            pending, self._dropped = self._dropped, []
-        for fn, kind, name, namespace, obj in pending:
-            cur = self.try_get(kind, name, namespace)
-            self.stats["replays"] += 1
-            if cur is not None:
-                fn("MODIFIED", cur)
-            else:
-                fn("DELETED", obj)
+        # Deliver under the store lock, exactly like the live bus
+        # (_notify): watchers are entitled to "callbacks fire with the
+        # APIServer lock held" (client.py locked()), and replaying
+        # without it inverts every component's (api -> own) lock order
+        # into (own -> api) — an AB/BA deadlock the instrumented soak
+        # caught on its first run (tests/test_chaos.py lock_graph).
+        with self._lock:
+            if self._delivering:
+                # Mid-drain (a nested chaos write's _tick_ops landed on
+                # the replay boundary): delivering NOW would hand the
+                # dropped watcher the object's newer state before the
+                # older events still queued in the outer drain — the
+                # stale-overwrite hazard _notify's FIFO exists to
+                # prevent.  Stay withheld; the next boundary (or the
+                # harness's explicit replay call) delivers after the
+                # drain unwinds.
+                return
+            with self._chaos_lock:
+                pending, self._dropped = self._dropped, []
+            # Drain fully even if a callback raises (same contract as
+            # _notify): a raising watcher must not strand the remaining
+            # withheld events — deliver everything, re-raise the first
+            # error once the backlog is empty.
+            first_exc: BaseException | None = None
+            for fn, kind, name, namespace, obj in pending:
+                cur = self.try_get(kind, name, namespace)
+                self.stats["replays"] += 1
+                try:
+                    if cur is not None:
+                        fn("MODIFIED", cur)
+                    else:
+                        fn("DELETED", obj)
+                except BaseException as e:
+                    if first_exc is None:
+                        first_exc = e
+            if first_exc is not None:
+                raise first_exc
 
     # -- APIServer surface overrides ----------------------------------------
     def update(self, kind: str, obj: Any) -> Any:

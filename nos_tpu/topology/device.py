@@ -9,7 +9,6 @@ the kubelet pod-resources view.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable
 
 USED = "used"
 FREE = "free"

@@ -25,6 +25,11 @@ from typing import Callable, Iterable, Mapping
 
 from .shape import Shape
 
+from nos_tpu.exporter.metrics import REGISTRY
+
+REGISTRY.describe("nos_tpu_pack_seconds",
+                  "Slice-packing search time (impl=native|python)")
+
 # A placement: offset and oriented dims, both padded to the block's rank.
 @dataclass(frozen=True)
 class Placement:
@@ -159,7 +164,6 @@ def pack(block: Shape, counts: Mapping[Shape, int],
     exact tiling (used when deriving geometry tables)."""
     from time import perf_counter
 
-    from nos_tpu.exporter.metrics import REGISTRY
 
     key = _counts_key(counts)
     t0 = perf_counter()

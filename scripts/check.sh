@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Single-command correctness gate: noslint + mypy + tier-1 pytest.
+#
+#   ./scripts/check.sh            # everything
+#   ./scripts/check.sh --fast     # noslint + mypy only (no pytest)
+#
+# Exit non-zero if any stage fails.  CI runs this verbatim; run it
+# before pushing.  docs/static-analysis.md describes the rules.
+
+set -u -o pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+rc=0
+
+echo "==> noslint (python -m nos_tpu.analysis)"
+if ! python -m nos_tpu.analysis; then
+    rc=1
+fi
+
+echo "==> mypy (strict: topology/, partitioning/core/, utils/)"
+if python -c "import mypy" 2>/dev/null; then
+    # mypy.ini pins the per-package strictness tiers
+    if ! python -m mypy --config-file mypy.ini \
+            nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils; then
+        rc=1
+    fi
+else
+    # The hermetic test image does not bake mypy in; the gate degrades
+    # loudly instead of failing silently or pip-installing.
+    echo "    mypy not installed — skipping (install mypy to enable)"
+fi
+
+if [ "$FAST" -eq 0 ]; then
+    echo "==> tier-1 pytest (-m 'not slow')"
+    if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+            --continue-on-collection-errors -p no:cacheprovider; then
+        rc=1
+    fi
+fi
+
+if [ "$rc" -eq 0 ]; then
+    echo "==> check.sh: ALL GREEN"
+else
+    echo "==> check.sh: FAILED (see above)" >&2
+fi
+exit "$rc"

@@ -59,11 +59,14 @@ def main(argv=None) -> int:
                            conflict_rate=args.conflict_rate,
                            transient_rate=args.transient_rate,
                            drop_watch_rate=args.drop_rate)
-        ok = r.converged and not r.errors
+        lock_problems = ([i.render() for i in r.lock_graph.inversions]
+                         + r.lock_graph.unguarded_writes)
+        ok = r.converged and not r.errors and not lock_problems
         if not ok or args.seed is not None:
             print(json.dumps({
                 "seed": seed, "ok": ok, "rounds": r.rounds,
                 "stats": r.api.stats, "errors": r.errors[:5],
+                "lock_violations": lock_problems[:5],
                 "quarantined": sorted(r.quarantined),
                 "repro": f"python scripts/diag_chaos.py --seed {seed}",
             }))

@@ -12,6 +12,8 @@ late; `jax.config.update` works any time before first backend use.
 
 import os
 
+import pytest
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -20,6 +22,28 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deep chaos soak (seeded fault-injection runs "
         "beyond the small tier-1 depth); select with `-m chaos`")
+    config.addinivalue_line(
+        "markers", "analysis: noslint static checks + lockcheck over the "
+        "tree (tests/test_analysis.py); select with `-m analysis`")
+
+
+@pytest.fixture
+def lock_discipline():
+    """Lockdep-instrumented test: every threading.Lock/RLock constructed
+    while the test runs is checked (nos_tpu/testing/lockcheck.py), and a
+    lock-order inversion or unguarded write observed anywhere fails the
+    test at teardown.  Opt in per-module with
+    ``pytestmark = pytest.mark.usefixtures("lock_discipline")``."""
+    from nos_tpu.testing.lockcheck import LockGraph, unguard_all
+
+    graph = LockGraph(name="lock-discipline")
+    with graph.install():
+        yield graph
+    try:
+        graph.assert_clean()
+    finally:
+        graph.close()   # threads leaked past teardown record nothing
+        unguard_all()   # restore any guard_state class patches
 
 
 if not os.environ.get("NOS_TPU_TEST_REAL"):

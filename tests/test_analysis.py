@@ -1,0 +1,535 @@
+"""noslint + lockcheck acceptance (docs/static-analysis.md).
+
+Two halves:
+
+- the **gate**: rules N001–N006 run over the whole ``nos_tpu/`` tree and
+  any unsuppressed violation fails tier-1 — the analyzer ships with the
+  tree clean, so a regression in any invariant is a test failure with
+  the file:line in the message;
+- **per-rule fixtures**: for each rule a violating snippet, a clean
+  snippet, and a pragma-suppressed snippet run through ``lint_source``,
+  so rule semantics are pinned independently of the tree's current
+  state.  Plus unit tests for the dynamic lock-order checker (a real
+  A→B/B→A inversion, reentrancy, Condition compatibility, guarded
+  shared-state writes).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from nos_tpu.analysis import default_rules, lint_source, run
+from nos_tpu.analysis.__main__ import main as noslint_main
+from nos_tpu.analysis.rules import (
+    InjectableClock, MetricDiscipline, NameHygiene, NoBlockingUnderLock,
+    NoSwallowedExceptions, RetryWrappedWrites,
+)
+from nos_tpu.testing.lockcheck import LockGraph, guard_state
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "nos_tpu")
+
+
+def rules_of(v):
+    return [x.rule for x in v]
+
+
+# ---------------------------------------------------------------------------
+# The gate: the tree is clean.
+# ---------------------------------------------------------------------------
+
+class TestTreeIsClean:
+    def test_noslint_zero_violations_on_nos_tpu(self):
+        report = run(default_rules(), [PACKAGE], root=REPO_ROOT)
+        assert report.files > 100      # the sweep actually saw the tree
+        rendered = "\n".join(v.render() for v in report.violations)
+        assert report.ok, f"noslint violations:\n{rendered}"
+
+    def test_cli_exits_zero_and_lists_rules(self, capsys):
+        assert noslint_main([PACKAGE]) == 0
+        assert noslint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ("N001", "N002", "N003", "N004", "N005", "N006"):
+            assert rule_id in out
+
+    def test_every_suppression_carries_a_reason(self):
+        report = run(default_rules(), [PACKAGE], root=REPO_ROOT)
+        # N000 findings are pragmas without reasons; the gate above
+        # already fails on them — this pins the contract explicitly
+        assert not [v for v in report.violations if v.rule == "N000"]
+
+
+# ---------------------------------------------------------------------------
+# N001: retry-wrapped writes
+# ---------------------------------------------------------------------------
+
+class TestN001:
+    def test_flags_raw_patch_and_update(self):
+        src = (
+            "def f(api, cm):\n"
+            "    api.patch('Node', 'n', mutate=lambda o: None)\n"
+            "    api.update(KIND_CONFIGMAP, cm)\n"
+        )
+        v = lint_source(src, [RetryWrappedWrites()])
+        assert rules_of(v) == ["N001", "N001"]
+
+    def test_wrapped_and_dict_update_are_clean(self):
+        src = (
+            "from nos_tpu.utils.retry import retry_on_conflict\n"
+            "def f(api, d):\n"
+            "    retry_on_conflict(api, 'Node', 'n', lambda o: None)\n"
+            "    d.update({'a': 1})\n"          # dict.update: not an API write
+            "    obj.metadata.annotations.update(extra)\n"
+        )
+        assert lint_source(src, [RetryWrappedWrites()]) == []
+
+    def test_substrate_and_super_calls_exempt(self):
+        src = (
+            "class Chaos:\n"
+            "    def patch(self, kind, name, ns='', *, mutate=None):\n"
+            "        return super().patch(kind, name, ns, mutate=mutate)\n"
+        )
+        assert lint_source(src, [RetryWrappedWrites()]) == []
+        raw = "api.patch('Node', 'n', mutate=m)\n"
+        assert lint_source(
+            raw, [RetryWrappedWrites()],
+            relpath="nos_tpu/kube/rest.py") == []    # substrate file
+
+    def test_pragma_suppresses_with_reason(self):
+        src = (
+            "def f(api, cm):\n"
+            "    # noslint: N001 — CAS loss is semantically a lost election\n"
+            "    api.update(KIND_CONFIGMAP, cm)\n"
+        )
+        assert lint_source(src, [RetryWrappedWrites()]) == []
+
+    def test_pragma_without_reason_is_flagged(self):
+        src = (
+            "def f(api, cm):\n"
+            "    api.update(KIND_CONFIGMAP, cm)  # noslint: N001\n"
+        )
+        v = lint_source(src, [RetryWrappedWrites()])
+        # N001 suppressed, but the naked pragma itself is an N000
+        assert rules_of(v) == ["N000"]
+
+
+# ---------------------------------------------------------------------------
+# N002: injectable clock
+# ---------------------------------------------------------------------------
+
+class TestN002:
+    REL = "nos_tpu/controllers/foo.py"
+
+    def test_flags_raw_time_calls(self):
+        src = (
+            "import time\n"
+            "from time import sleep\n"
+            "def tick():\n"
+            "    t = time.time()\n"
+            "    sleep(1)\n"
+            "    time.monotonic()\n"
+        )
+        v = lint_source(src, [InjectableClock()], relpath=self.REL)
+        assert rules_of(v) == ["N002", "N002", "N002"]
+
+    def test_injectable_default_reference_is_clean(self):
+        src = (
+            "import time\n"
+            "from typing import Callable\n"
+            "class C:\n"
+            "    def __init__(self, clock: Callable[[], float]"
+            " = time.monotonic):\n"
+            "        self._clock = clock\n"
+            "    def now(self):\n"
+            "        return self._clock()\n"
+        )
+        assert lint_source(src, [InjectableClock()], relpath=self.REL) == []
+
+    def test_out_of_scope_paths_unflagged(self):
+        src = "import time\nt = time.time()\n"
+        assert lint_source(src, [InjectableClock()],
+                           relpath="nos_tpu/exporter/__init__.py") == []
+
+    def test_pragma_suppressed(self):
+        src = (
+            "import time\n"
+            "# noslint: N002 — wall-clock timestamp for a log payload only\n"
+            "t = time.time()\n"
+        )
+        assert lint_source(src, [InjectableClock()], relpath=self.REL) == []
+
+
+# ---------------------------------------------------------------------------
+# N003: metric discipline
+# ---------------------------------------------------------------------------
+
+class TestN003:
+    def test_unregistered_and_bad_name_flagged(self):
+        src = (
+            "REGISTRY.inc('nos_tpu_good_total')\n"
+            "REGISTRY.inc('bad_prefix_total')\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        msgs = [x.message for x in v]
+        assert any("never registered" in m for m in msgs)
+        assert any("nos_tpu_[a-z0-9_]+" in m for m in msgs)
+
+    def test_double_describe_flagged(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_total', 'a')\n"
+            "REGISTRY.describe('nos_tpu_x_total', 'b')\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("more than once" in x.message for x in v)
+
+    def test_inconsistent_label_keys_flagged(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_total', 'help')\n"
+            "REGISTRY.inc('nos_tpu_x_total', labels={'kind': 'a'})\n"
+            "REGISTRY.inc('nos_tpu_x_total', labels={'node': 'b'})\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("label keys" in x.message for x in v)
+
+    def test_consistent_usage_clean(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_total', 'help')\n"
+            "REGISTRY.inc('nos_tpu_x_total', labels={'kind': 'a'})\n"
+            "REGISTRY.inc('nos_tpu_x_total', 2.0, labels={'kind': 'b'})\n"
+        )
+        assert lint_source(src, [MetricDiscipline()]) == []
+
+    def test_non_literal_name_flagged(self):
+        src = "REGISTRY.inc(name_var)\n"
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("string literal" in x.message for x in v)
+
+
+# ---------------------------------------------------------------------------
+# N004: no blocking under lock
+# ---------------------------------------------------------------------------
+
+class TestN004:
+    def test_sleep_network_result_log_flagged(self):
+        src = (
+            "def f(self):\n"
+            "    with self._lock:\n"
+            "        time.sleep(0.1)\n"
+            "        fut.result()\n"
+            "        logger.warning('x')\n"
+            "        subprocess.run(['ls'])\n"
+        )
+        v = lint_source(src, [NoBlockingUnderLock()])
+        assert rules_of(v) == ["N004"] * 4
+
+    def test_debug_log_and_nested_def_clean(self):
+        src = (
+            "def f(self):\n"
+            "    with self._lock:\n"
+            "        logger.debug('cheap when disabled')\n"
+            "        x = compute()\n"
+            "        def later():\n"
+            "            time.sleep(1)\n"       # deferred: runs unlocked
+            "    time.sleep(1)\n"               # outside the with
+        )
+        assert lint_source(src, [NoBlockingUnderLock()]) == []
+
+    def test_api_locked_call_is_a_lock(self):
+        src = (
+            "def f(self):\n"
+            "    with self._api.locked(), self._lock:\n"
+            "        retry_on_conflict(self._api, 'Pod', 'p', m)\n"
+        )
+        v = lint_source(src, [NoBlockingUnderLock()])
+        assert rules_of(v) == ["N004"]
+
+    def test_pragma_suppressed(self):
+        src = (
+            "def f(self):\n"
+            "    with _BUILD_LOCK:\n"
+            "        # noslint: N004 — the lock exists to serialize this\n"
+            "        subprocess.run(['make'])\n"
+        )
+        assert lint_source(src, [NoBlockingUnderLock()]) == []
+
+
+# ---------------------------------------------------------------------------
+# N005: swallowed exceptions
+# ---------------------------------------------------------------------------
+
+class TestN005:
+    def test_bare_and_swallowed_flagged(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        pass\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        return False\n"
+        )
+        v = lint_source(src, [NoSwallowedExceptions()])
+        assert rules_of(v) == ["N005", "N005"]
+
+    def test_logged_recorded_narrow_clean(self):
+        src = (
+            "def f():\n"
+            "    first = None\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        logger.exception('tick failed')\n"
+            "    try:\n"
+            "        g()\n"
+            "    except BaseException as e:\n"
+            "        if first is None:\n"
+            "            first = e\n"           # recorded: not swallowed
+            "    try:\n"
+            "        g()\n"
+            "    except (ValueError, KeyError):\n"
+            "        pass\n"                    # narrow: caller's policy
+        )
+        assert lint_source(src, [NoSwallowedExceptions()]) == []
+
+    def test_pragma_suppressed(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    # noslint: N005 — best-effort import hook, see module doc\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert lint_source(src, [NoSwallowedExceptions()]) == []
+
+
+# ---------------------------------------------------------------------------
+# N006: name hygiene
+# ---------------------------------------------------------------------------
+
+class TestN006:
+    def test_undefined_name_flagged(self):
+        src = (
+            "def main(cfg):\n"
+            "    api = build_api(cfg)\n"        # the seed's NameError class
+            "    return api\n"
+        )
+        v = lint_source(src, [NameHygiene()])
+        assert rules_of(v) == ["N006"]
+        assert "build_api" in v[0].message
+
+    def test_unused_import_flagged(self):
+        src = "import os\nimport sys\nprint(sys.argv)\n"
+        v = lint_source(src, [NameHygiene()])
+        assert rules_of(v) == ["N006"]
+        assert "'os'" in v[0].message
+
+    def test_quoted_annotation_and_all_are_uses(self):
+        src = (
+            "from typing import TYPE_CHECKING\n"
+            "if TYPE_CHECKING:\n"
+            "    from nos_tpu.partitioning.state import PartitioningState\n"
+            "from nos_tpu.kube.objects import Pod\n"
+            "__all__ = ['Pod']\n"
+            "def plan(p) -> 'PartitioningState': ...\n"
+        )
+        assert lint_source(src, [NameHygiene()]) == []
+
+    def test_init_py_reexports_exempt(self):
+        src = "from .core import Thing\n"
+        assert lint_source(
+            src, [NameHygiene()],
+            relpath="nos_tpu/foo/__init__.py") == []
+
+    def test_pragma_suppressed(self):
+        src = (
+            "from .state import NodePartitioning"
+            "  # noslint: N006 — re-export for readers\n"
+        )
+        assert lint_source(src, [NameHygiene()]) == []
+
+
+# ---------------------------------------------------------------------------
+# lockcheck: the dynamic half
+# ---------------------------------------------------------------------------
+
+class TestLockcheck:
+    def test_ab_ba_inversion_detected(self):
+        g = LockGraph(name="inv")
+        a, b = g.lock("A"), g.lock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:                      # reverse of the witnessed order
+                pass
+        assert len(g.inversions) == 1
+        text = g.inversions[0].render()
+        assert "A" in text and "B" in text
+        with pytest.raises(AssertionError):
+            g.assert_clean()
+
+    def test_transitive_inversion_detected(self):
+        g = LockGraph(name="trans")
+        a, b, c = g.lock("A"), g.lock("B"), g.lock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:                      # A->B->C established, C->A closes it
+                pass
+        assert g.inversions
+
+    def test_consistent_order_clean(self):
+        g = LockGraph(name="ok")
+        a, b = g.lock("A"), g.lock("B")
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+        g.assert_clean()
+
+    def test_reentrant_reacquire_is_not_an_inversion(self):
+        g = LockGraph(name="re")
+        r = g.lock("R", reentrant=True)
+        with r:
+            with r:
+                pass
+        g.assert_clean()
+
+    def test_cross_thread_order_is_convicted(self):
+        """The inversion need not deadlock THIS run: thread 1 witnesses
+        A->B, thread 2 later does B->A and is convicted (lockdep)."""
+        g = LockGraph(name="xthread")
+        a, b = g.lock("A"), g.lock("B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with a:
+                pass
+        assert g.inversions
+
+    def test_common_gate_lock_is_not_an_inversion(self):
+        """Both orders of A/B witnessed — but every chain runs under
+        gate G, so the chains can never reach their blocking points
+        concurrently: safe (the APIServer-store-lock-over-nested-watch-
+        delivery pattern, derived rather than annotated)."""
+        g = LockGraph(name="gated")
+        gate, a, b = g.lock("G"), g.lock("A"), g.lock("B")
+        with gate:
+            with a:
+                with b:
+                    pass
+        with gate:
+            with b:
+                with a:
+                    pass
+        g.assert_clean()
+        # ...but the same reversal WITHOUT the gate is convicted
+        with b:
+            with a:
+                pass
+        assert g.inversions
+
+    def test_install_instruments_new_locks_and_condition_works(self):
+        g = LockGraph(name="inst")
+        with g.install():
+            lk = threading.Lock()
+            cond = threading.Condition()     # RLock-backed
+            ev = threading.Event()
+
+            def worker():
+                with lk:
+                    pass
+                with cond:
+                    cond.notify_all()
+                ev.set()
+
+            th = threading.Thread(target=worker)
+            with cond:
+                th.start()
+                cond.wait(timeout=2.0)
+            assert ev.wait(timeout=2.0)
+            th.join()
+        # restored after the with-block
+        assert threading.Lock is not type(lk)
+        g.assert_clean()
+
+    def test_guard_state_unlocked_write_detected(self):
+        class Shared:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.field = 0
+
+        g = LockGraph(name="guard")
+        s = Shared()
+        guard_state(s, g)
+        with s._lock:
+            s.field = 1                  # locked: fine
+        g.assert_clean()
+        s.field = 2                      # unlocked: convicted
+        assert len(g.unguarded_writes) == 1
+        assert "field" in g.unguarded_writes[0]
+
+    def test_closed_graph_records_nothing(self):
+        g = LockGraph(name="closed")
+        a, b = g.lock("A"), g.lock("B")
+        with a:
+            with b:
+                pass
+        g.close()
+        with b:
+            with a:                          # would be an inversion
+                pass
+        g.assert_clean()                     # closed: nothing recorded
+
+    def test_registry_describe_guard(self):
+        """Satellite of N003: the dynamic double-registration guard.
+        Same help re-describe is idempotent (re-import, double
+        build_api); a conflicting one raises."""
+        from nos_tpu.exporter.metrics import Registry
+
+        reg = Registry()
+        reg.describe("nos_tpu_x_total", "help")
+        reg.describe("nos_tpu_x_total", "help")          # idempotent
+        with pytest.raises(ValueError, match="already registered"):
+            reg.describe("nos_tpu_x_total", "different")
+
+    def test_guard_state_property_setter_judged_by_inner_write(self):
+        class Shared:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._x = ""
+
+            @property
+            def x(self):
+                with self._lock:
+                    return self._x
+
+            @x.setter
+            def x(self, v):
+                with self._lock:
+                    self._x = v
+
+        g = LockGraph(name="prop")
+        s = Shared()
+        guard_state(s, g)
+        s.x = "plan-1"                   # setter takes the lock itself
+        g.assert_clean()

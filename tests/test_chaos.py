@@ -38,6 +38,7 @@ from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
 from nos_tpu.scheduler.gang import TopologyFilter
 from nos_tpu.scheduler.scheduler import Scheduler
 from nos_tpu.testing.chaos import ChaosAPIServer
+from nos_tpu.testing.lockcheck import LockGraph, guard_state, unguard_all
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
 from nos_tpu.topology.annotations import (
@@ -67,11 +68,17 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
                    drop_watch_rate: float = 0.10) -> SimpleNamespace:
     """One seeded chaos run over the full slice e2e path.  Single
     thread, injected clock: deterministic per seed."""
-    api = ChaosAPIServer(seed, conflict_rate=conflict_rate,
-                         transient_rate=transient_rate,
-                         drop_watch_rate=drop_watch_rate,
-                         replay_after_ops=5)
-    state = ClusterState()
+    # Every lock constructed below (APIServer bus, agents' SharedState,
+    # kubelet sims) is lockdep-instrumented: a lock-order inversion or an
+    # unguarded SharedState write anywhere in the soak fails the seed
+    # (nos_tpu/testing/lockcheck.py; docs/static-analysis.md).
+    lock_graph = LockGraph(name=f"soak-seed-{seed}")
+    with lock_graph.install():
+        api = ChaosAPIServer(seed, conflict_rate=conflict_rate,
+                             transient_rate=transient_rate,
+                             drop_watch_rate=drop_watch_rate,
+                             replay_after_ops=5)
+        state = ClusterState()
     clock = [0.0]
     errors: list[str] = []
 
@@ -82,23 +89,32 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
         except Exception as e:  # noqa: BLE001 — recorded, then asserted on
             errors.append(f"seed={seed} round={round_no} {name}: {e!r}")
 
-    NodeController(api, state, SliceNodeInitializer(api)).bind()
-    PodController(api, state).bind()
-    partitioner = new_slice_partitioner_controller(
-        api, state, batch_timeout_s=BATCH_TIMEOUT_S, batch_idle_s=10.0,
-        clock=lambda: clock[0])
-    partitioner.bind()
-    agents = []
-    round_no = -1  # node creation fires watch callbacks through tick-less paths
-    for i in range(hosts):
-        api.create(KIND_NODE, make_tpu_node(
-            f"host-{i}", pod_id="pod-0", host_index=i))
-        agent = SliceAgent(api, f"host-{i}", FakeTpuRuntime(V5E),
-                           FakePodResources())
-        agent.start()
-        agents.append(agent)
-    scheduler = Scheduler(
-        api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+    # The whole control plane is constructed under install() so every
+    # lock it builds (quarantine list, framework, cluster-state, agents'
+    # KubeletSim/SharedState) joins the acquisition graph — not just the
+    # APIServer bus.
+    with lock_graph.install():
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        partitioner = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S, batch_idle_s=10.0,
+            clock=lambda: clock[0])
+        partitioner.bind()
+        agents = []
+        round_no = -1  # node creation fires watch callbacks via tick-less paths
+        for i in range(hosts):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{i}", pod_id="pod-0", host_index=i))
+            agent = SliceAgent(api, f"host-{i}", FakeTpuRuntime(V5E),
+                               FakePodResources())
+            # guard the handshake state: any field write without _lock
+            # held is a soak failure
+            guard_state(agent.shared, lock_graph,
+                        name="sliceagent.SharedState._lock")
+            agent.start()
+            agents.append(agent)
+        scheduler = Scheduler(
+            api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
 
     # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
     # is always feasible
@@ -127,12 +143,20 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             break
     return SimpleNamespace(api=api, errors=errors, converged=done,
                            rounds=round_no + 1, seed=seed,
-                           quarantined=partitioner.quarantine.names())
+                           quarantined=partitioner.quarantine.names(),
+                           lock_graph=lock_graph)
 
 
 def _assert_soak_ok(result) -> None:
     repro = f"repro: python scripts/diag_chaos.py --seed {result.seed}"
     assert not result.errors, (result.errors[:3], repro)
+    # lockdep verdict: order inversions / unguarded SharedState writes
+    # observed anywhere in the run fail the seed
+    try:
+        result.lock_graph.assert_clean()
+    finally:
+        result.lock_graph.close()
+        unguard_all()   # restore SharedState's patched __setattr__
     assert result.converged, (
         f"seed {result.seed} did not converge in {result.rounds} rounds "
         f"(stats {result.api.stats}, quarantined {result.quarantined}); "
@@ -155,6 +179,23 @@ class TestChaosSoak:
     def test_soak_deep(self, seed):
         _assert_soak_ok(run_slice_soak(seed, hosts=3, pods=5,
                                        drop_watch_rate=0.2))
+
+    def test_replay_mid_drain_is_deferred(self):
+        """White-box: replay_dropped landing inside an active _notify
+        drain must keep events withheld — direct delivery would hand
+        the dropped watcher newer state before older queued events."""
+        api = ChaosAPIServer(0, drop_watch_rate=1.0, replay_after_ops=1000)
+        seen = []
+        api.watch(KIND_NODE, lambda ev, obj: seen.append(ev))
+        from nos_tpu.testing.factory import make_tpu_node as mk
+        api.create(KIND_NODE, mk("h0"))      # ADDED dropped (rate=1.0)
+        assert api._dropped and not seen
+        api._delivering = True               # simulate an active drain
+        api.replay_dropped()
+        assert api._dropped and not seen     # deferred, still withheld
+        api._delivering = False
+        api.replay_dropped()
+        assert not api._dropped and seen == ["MODIFIED"]
 
     def test_same_seed_same_fault_sequence(self):
         a = run_slice_soak(7)

@@ -14,7 +14,10 @@ the test carves the largest shape that fits whatever was observed.
 
 import pytest
 
-from nos_tpu.device import discovery
+# every lock built by the plugin stack is lockdep-checked (conftest)
+pytestmark = pytest.mark.usefixtures("lock_discipline")
+
+from nos_tpu.device import discovery  # noqa: E402
 
 
 def _on_real_tpu() -> bool:

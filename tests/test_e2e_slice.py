@@ -9,7 +9,10 @@ of the reference's envtest + mocked-NVML integration suites (SURVEY.md §4).
 
 import pytest
 
-from nos_tpu.api import constants as C
+# every lock built by the harness is lockdep-checked (conftest fixture)
+pytestmark = pytest.mark.usefixtures("lock_discipline")
+
+from nos_tpu.api import constants as C  # noqa: E402
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
 from nos_tpu.controllers.sliceagent.agent import SliceAgent

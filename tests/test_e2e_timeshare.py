@@ -10,7 +10,12 @@ propagation sleep (mps/partitioner.go:99-100).
 
 from __future__ import annotations
 
-from nos_tpu.api import constants as C
+import pytest
+
+# every lock built by the harness is lockdep-checked (conftest fixture)
+pytestmark = pytest.mark.usefixtures("lock_discipline")
+
+from nos_tpu.api import constants as C  # noqa: E402
 from nos_tpu.controllers.chipagent import ChipAgent
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
