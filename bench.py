@@ -199,6 +199,18 @@ def run_utilization_bench() -> dict:
         return {"error": f"utilization bench failed: {e}"}
 
 
+def run_plan_microbench() -> dict:
+    """bench_plan.py: COW-snapshot plan wall time + fork clone counts on
+    the synthetic v5e-256, and the incremental scheduler's cycle wall
+    (docs/performance.md explains how to read the fields)."""
+    try:
+        from bench_plan import run_bench
+
+        return run_bench(plan_repeats=5, cycles=10)
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        return {"error": f"plan bench failed: {e}"}
+
+
 def main() -> None:
     latency = run_scenario()
     utilization = run_utilization_bench()
@@ -218,6 +230,7 @@ def main() -> None:
             "target_s": BASELINE_S,
             "vs_baseline": round(latency / BASELINE_S, 4),
         },
+        "plan": run_plan_microbench(),
         "packer": run_packer_microbench(),
         "compute": compute,
     }))

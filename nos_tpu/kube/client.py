@@ -251,6 +251,61 @@ class APIServer:
         return self.list("Pod", filter_fn=lambda p: p.spec.node_name == node_name)
 
 
+class Informer:
+    """Watch-maintained local store of one kind — the client-go shared
+    informer analog over the watch bus.
+
+    Keeps the latest object per key ("namespace/name" or "name"), synced
+    by the synthetic-ADDED replay on subscribe and updated on every
+    event; read-mostly consumers (the scheduler's cluster-view cache)
+    get current objects WITHOUT a full `list()` re-copy per read.  The
+    optional `on_event` hook runs synchronously after the store update,
+    in store-commit order, with the event's own deep-copied object —
+    the place to maintain derived indexes and generation counters.
+
+    Works against any watch-capable substrate (APIServer, ChaosAPIServer,
+    the REST client's informer-style watch).  `store=False` skips the
+    local store entirely — for consumers that maintain their own indexes
+    in the hook (the scheduler cache), a duplicate store would just be a
+    second lock acquisition and a second copy of every object."""
+
+    def __init__(self, api, kind: str, on_event: WatchFn | None = None,
+                 store: bool = True) -> None:
+        self._lock = threading.Lock()
+        self._store: dict[str, Any] | None = {} if store else None
+        self._on_event = on_event
+        self._unsubscribe = api.watch(kind, self._handle)
+
+    def _handle(self, event: str, obj: Any) -> None:
+        if self._store is not None:
+            ns = getattr(obj.metadata, "namespace", "")
+            key = f"{ns}/{obj.metadata.name}" if ns else obj.metadata.name
+            with self._lock:
+                if event == "DELETED":
+                    self._store.pop(key, None)
+                else:
+                    self._store[key] = obj
+        if self._on_event is not None:
+            self._on_event(event, obj)
+
+    def items(self) -> dict[str, Any]:
+        """Point-in-time view: the dict is a copy, the objects are the
+        store's own (callers must not mutate them)."""
+        with self._lock:
+            return dict(self._store or {})
+
+    def get(self, key: str) -> Any | None:
+        with self._lock:
+            return (self._store or {}).get(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store or {})
+
+    def close(self) -> None:
+        self._unsubscribe()
+
+
 # Canonical kind names used across the framework.
 KIND_POD = "Pod"
 KIND_NODE = "Node"
@@ -260,7 +315,7 @@ KIND_COMPOSITE_ELASTIC_QUOTA = "CompositeElasticQuota"
 KIND_POD_GROUP = "PodGroup"
 
 __all__ = [
-    "APIServer", "NotFound", "Conflict", "TransientAPIError",
+    "APIServer", "Informer", "NotFound", "Conflict", "TransientAPIError",
     "KIND_POD", "KIND_NODE", "KIND_CONFIGMAP",
     "KIND_ELASTIC_QUOTA", "KIND_COMPOSITE_ELASTIC_QUOTA", "KIND_POD_GROUP",
     "Node", "Pod", "ConfigMap",

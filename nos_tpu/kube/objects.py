@@ -54,6 +54,14 @@ class FastCopy:
         return new
 
 
+def fast_deepcopy(obj):
+    """Deep copy one FastCopy object without copy.deepcopy's dispatch
+    prologue (memo setup, reductor probing) — the per-object hot-path
+    copy for callers that know the class carries the structural
+    __deepcopy__ (e.g. the scheduler's assume cache booking a pod)."""
+    return obj.__deepcopy__({})
+
+
 def new_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 

@@ -17,7 +17,9 @@ from __future__ import annotations
 import logging
 
 from nos_tpu.kube.objects import Pod
-from nos_tpu.scheduler.framework import CycleState, Framework, SharedLister
+from nos_tpu.scheduler.framework import (
+    CycleState, Framework, SharedLister, filter_equivalence_key,
+)
 
 from ..state import PartitioningState
 from .actuator import compute_partitioning_state
@@ -51,6 +53,13 @@ class GeometryPlanner(Planner):
             p for p in self._sorter.sort(pending_pods)
             if self._calculator.requested_profiles(p)
         ]
+        # one generation-gated lister for the whole plan: COW forks keep
+        # the untouched NodeInfos live, so only cloned/reverted nodes are
+        # re-read instead of reconstructing all N infos per candidate
+        lister = snapshot.shared_lister()
+        # equivalence classes are plan-invariant: compute once per pod,
+        # not once per (pod, candidate)
+        equiv_keys = {p.key: filter_equivalence_key(p) for p in pods}
         # iterate by NAME and re-fetch after fork/revert: revert() swaps the
         # snapshot's node objects, so a captured reference would be detached
         candidate_names = [n.name for n in snapshot.get_candidate_nodes()]
@@ -58,25 +67,33 @@ class GeometryPlanner(Planner):
             if tracker.empty:
                 break
             snapshot.fork()
-            node = snapshot.get_node(node_name)
+            # write access: the COW fork clones this node lazily
+            node = snapshot.get_node_for_write(node_name)
             changed = node.update_geometry_for(tracker.lacking)
-            # build the what-if lister once per fork; NodeInfos are live
-            # references, so later add_pods stay visible (hot loop #2)
-            lister = SharedLister(
-                pn.node_info() for pn in snapshot.nodes().values()
-            )
-            placed = 0
-            for pod in list(pods):
+            placed: set[str] = set()
+            # Pod-equivalence memo, scoped to this fork: node capacity
+            # only SHRINKS between placements (the geometry re-carve ran
+            # above, once), so a failed verdict holds for every later pod
+            # of the same equivalence class — the 200-pod batch collapses
+            # to one pipeline run per distinct (namespace, gang, request).
+            failed: set = set()
+            for pod in pods:
                 if tracker.empty:
                     break
+                key = equiv_keys[pod.key]
+                if key in failed:
+                    continue
                 if self._try_add_pod(snapshot, lister, node_name, pod):
                     tracker.remove(pod)
-                    pods.remove(pod)
-                    placed += 1
-            if placed > 0:
+                    placed.add(pod.key)
+                else:
+                    failed.add(key)
+            if placed:
                 snapshot.commit()
+                # one rebuild per node, not an O(n) remove per placement
+                pods = [p for p in pods if p.key not in placed]
                 logger.debug("planner: node %s re-carved (changed=%s, placed=%d)",
-                             node_name, changed, placed)
+                             node_name, changed, len(placed))
             else:
                 snapshot.revert()
         return compute_partitioning_state(snapshot, self._partition_calculator)

@@ -1,18 +1,41 @@
-"""Forkable in-memory cluster snapshot.
+"""Forkable in-memory cluster snapshot — copy-on-write.
 
 Analog of reference internal/partitioning/core/snapshot.go:43-191
 (clusterSnapshot): the planner forks the snapshot per candidate node, mutates
 geometry hypothetically, simulates scheduling, then commits or reverts.
+
+`fork()` is O(1): nothing is copied up front.  The first mutation of a node
+inside a fork (`get_node_for_write` / `add_pod`) clones exactly that node,
+recording the pristine original in the fork's dirty-set; `revert()` restores
+only the dirty entries and `commit()` drops them.  A plan over N nodes that
+dirties K of them therefore pays K clones instead of N per candidate — the
+kube-scheduler snapshot model the reference drives through snapshot.Fork().
+
+Write discipline: mutations inside a fork MUST go through
+`get_node_for_write` (or `add_pod`).  `get_node` and `nodes()` are read
+views — mutating an object obtained from them while forked bypasses the
+dirty-set and revert() cannot undo it.  The group pass mutates via
+`nodes()` deliberately OUTSIDE any fork (its carves are meant to persist).
+
+Every node-object replacement (COW clone, revert restore) bumps that
+node's generation counter; `shared_lister()` returns a lister view that
+re-reads exactly the NodeInfos whose generation moved, so the planner
+builds it once per plan instead of reconstructing all N infos per
+candidate.  `clone()` keeps deep semantics for the controller's
+plan-vs-actuate diff (reference partitioner_controller.go:178-193).
 """
 
 from __future__ import annotations
 
 from typing import Mapping
 
+from nos_tpu.api.constants import ANNOT_GANG_LEASE
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.resources import (
     negatives_only, pod_request, subtract, sum_resources,
 )
+from nos_tpu.scheduler.framework import SharedLister
+from nos_tpu.topology.profile import free_chip_equivalents
 
 from .interfaces import PartitionableNode, SliceFilter
 
@@ -26,13 +49,28 @@ class ClusterSnapshot:
                  slice_filter: SliceFilter) -> None:
         self._nodes: dict[str, PartitionableNode] = dict(nodes)
         self._filter = slice_filter
+        # Fork dirty-set: name -> pristine pre-fork node.  None = not
+        # forked; {} = forked with nothing dirtied yet.
         self._forked: dict[str, PartitionableNode] | None = None
+        # Per-node generation: bumped whenever the node OBJECT is
+        # replaced (COW clone, revert restore) — shared_lister() uses it
+        # to refresh exactly the changed NodeInfos.
+        self._node_gen: dict[str, int] = {}
+        self._structure_gen = 0
+        # Mutation epoch: bumped on every write ACCESS (including handing
+        # out mutable references via nodes()) — gates the derived-view
+        # caches below, which must recompute after any possible write.
+        self._mutation_gen = 0
+        self._candidate_cache: tuple[int, list[str]] | None = None
+        self._free_cache: tuple[int, dict[str, float]] | None = None
+        # Lazy COW clones performed (bench_plan's fork_clones_per_plan).
+        self.cow_clones = 0
 
     # -- fork/commit/revert (snapshot.go:85-117) ---------------------------
     def fork(self) -> None:
         if self._forked is not None:
             raise SnapshotError("snapshot already forked")
-        self._forked = {n: pn.clone() for n, pn in self._nodes.items()}
+        self._forked = {}
 
     def commit(self) -> None:
         self._forked = None
@@ -40,8 +78,11 @@ class ClusterSnapshot:
     def revert(self) -> None:
         if self._forked is None:
             raise SnapshotError("snapshot not forked")
-        self._nodes = self._forked
+        for name, pristine in self._forked.items():
+            self._nodes[name] = pristine
+            self._bump_node(name)
         self._forked = None
+        self._mutation_gen += 1
 
     @property
     def forked(self) -> bool:
@@ -55,12 +96,59 @@ class ClusterSnapshot:
             {n: pn.clone() for n, pn in self._nodes.items()}, self._filter
         )
 
+    # -- write access -------------------------------------------------------
+    def _bump_node(self, name: str) -> None:
+        self._node_gen[name] = self._node_gen.get(name, 0) + 1
+        self._structure_gen += 1
+
+    def _writable(self, name: str) -> PartitionableNode:
+        node = self._nodes.get(name)
+        if node is None:
+            raise SnapshotError(f"unknown node {name}")
+        if self._forked is not None and name not in self._forked:
+            self._forked[name] = node
+            node = node.clone()
+            self.cow_clones += 1
+            self._nodes[name] = node
+            self._bump_node(name)
+        self._mutation_gen += 1
+        return node
+
+    def get_node_for_write(self, name: str) -> PartitionableNode:
+        """The node, safe to mutate: inside a fork the first write access
+        clones it lazily (the copy-on-write) so revert() can restore the
+        pristine original.  Outside a fork, writes hit the base directly
+        (they were never revertible)."""
+        return self._writable(name)
+
+    def add_pod(self, node_name: str, pod: Pod) -> None:
+        """Bind the pod in the snapshot (snapshot.go AddPod): the node's
+        first-fit device accounting plus NodeInfo bookkeeping."""
+        node = self._writable(node_name)
+        if not node.add_pod(pod):
+            raise SnapshotError(f"pod {pod.key} does not fit node {node_name}")
+
     # -- views -------------------------------------------------------------
     def nodes(self) -> dict[str, PartitionableNode]:
+        # Hands out mutable references (the group pass re-carves through
+        # them): conservatively treat as a write access for cache gating.
+        self._mutation_gen += 1
         return dict(self._nodes)
 
     def get_node(self, name: str) -> PartitionableNode:
         return self._nodes[name]
+
+    def node_generation(self, name: str) -> int:
+        """Bumps exactly when the node OBJECT was replaced (COW clone or
+        revert) — in-place mutations keep NodeInfo identity, so a cached
+        reference stays live across them."""
+        return self._node_gen.get(name, 0)
+
+    def shared_lister(self) -> "SnapshotLister":
+        """A SharedLister over this snapshot's live NodeInfos, refreshed
+        per node by generation: build once per plan, stays valid across
+        fork/commit/revert for free."""
+        return SnapshotLister(self)
 
     def get_candidate_nodes(self) -> list[PartitionableNode]:
         """Nodes with any free (unrequested) capacity, best-fit first:
@@ -72,10 +160,14 @@ class ClusterSnapshot:
         new demand lands now decides real utilization.  Hosts carrying
         the scheduler's gang-window lease (ANNOT_GANG_LEASE) go last:
         they are draining toward a stuck multi-host gang and re-carving
-        them for other demand would re-fragment the window."""
-        from nos_tpu.api.constants import ANNOT_GANG_LEASE
-        from nos_tpu.topology.profile import free_chip_equivalents
+        them for other demand would re-fragment the window.
 
+        The computed order is memoised on the mutation epoch: repeated
+        calls with no intervening write return the cached order instead
+        of re-scanning and re-sorting every node."""
+        cached = self._candidate_cache
+        if cached is not None and cached[0] == self._mutation_gen:
+            return [self._nodes[n] for n in cached[1]]
         out = []
         for name in sorted(self._nodes):
             ni = self._nodes[name].node_info()
@@ -85,24 +177,59 @@ class ClusterSnapshot:
                 out.append((leased, free_chip_equivalents(ni.free()),
                             name, self._nodes[name]))
         out.sort(key=lambda t: (t[0], t[1], t[2]))
-        return [n for _, _, _, n in out]
+        self._candidate_cache = (self._mutation_gen, [t[2] for t in out])
+        return [t[3] for t in out]
 
     def get_lacking_slices(self, pod: Pod) -> dict[str, int]:
         """Cluster-wide: (allocatable - requested) - podRequest, negatives
         only, restricted to profile resources (reference snapshot.go:132-165).
-        Returned as profile name -> missing quantity."""
-        free: dict[str, float] = {}
-        for pn in self._nodes.values():
-            free = sum_resources(free, pn.node_info().free())
-        free = {k: max(0.0, v) for k, v in free.items()}
+        Returned as profile name -> missing quantity.  The cluster-wide
+        free aggregate is memoised on the mutation epoch — the tracker
+        calls this once per pending pod against an unchanged snapshot."""
+        cached = self._free_cache
+        if cached is not None and cached[0] == self._mutation_gen:
+            free = cached[1]
+        else:
+            free: dict[str, float] = {}
+            for pn in self._nodes.values():
+                free = sum_resources(free, pn.node_info().free())
+            free = {k: max(0.0, v) for k, v in free.items()}
+            self._free_cache = (self._mutation_gen, free)
         lacking_resources = negatives_only(subtract(free, pod_request(pod)))
         return self._filter.extract_profiles(lacking_resources)
 
-    def add_pod(self, node_name: str, pod: Pod) -> None:
-        """Bind the pod in the snapshot (snapshot.go AddPod): the node's
-        first-fit device accounting plus NodeInfo bookkeeping."""
-        node = self._nodes.get(node_name)
-        if node is None:
-            raise SnapshotError(f"unknown node {node_name}")
-        if not node.add_pod(pod):
-            raise SnapshotError(f"pod {pod.key} does not fit node {node_name}")
+
+class SnapshotLister(SharedLister):
+    """SharedLister view over a ClusterSnapshot.
+
+    NodeInfos are live references into the snapshot's current node
+    objects; an entry is re-read exactly when its node's generation
+    moved (COW clone or revert replaced the object).  In-place mutations
+    (geometry re-carve, hypothetical add_pod) flow through the existing
+    NodeInfo reference and need no refresh at all."""
+
+    def __init__(self, snapshot: ClusterSnapshot) -> None:
+        super().__init__(())
+        self._snapshot = snapshot
+        self._gens: dict[str, int] = {}
+        self._seen_structure = -1
+
+    def _refresh(self) -> None:
+        snap = self._snapshot
+        if snap._structure_gen == self._seen_structure \
+                and len(self._infos) == len(snap._nodes):
+            return
+        for name, pn in snap._nodes.items():
+            gen = snap.node_generation(name)
+            if self._gens.get(name) != gen:
+                self._infos[name] = pn.node_info()
+                self._gens[name] = gen
+        self._seen_structure = snap._structure_gen
+
+    def list(self):
+        self._refresh()
+        return list(self._infos.values())
+
+    def get(self, name: str):
+        self._refresh()
+        return self._infos.get(name)

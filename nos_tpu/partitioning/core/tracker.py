@@ -29,6 +29,7 @@ class SliceTracker:
                 self._pod_lacking[pod.key] = lacking
                 for profile, qty in lacking.items():
                     self._lacking[profile] = self._lacking.get(profile, 0) + qty
+        self._total_lacking = sum(v for v in self._lacking.values() if v > 0)
 
     @property
     def requested(self) -> dict[str, int]:
@@ -40,7 +41,9 @@ class SliceTracker:
 
     @property
     def empty(self) -> bool:
-        return not self.lacking
+        # checked once per pod in the planner's hot loop: an O(1) total
+        # instead of rebuilding the positive-lacking dict every call
+        return self._total_lacking <= 0
 
     def remove(self, pod: Pod) -> None:
         """Decrement on successful placement (tracker.go Remove)."""
@@ -48,4 +51,6 @@ class SliceTracker:
         if not lacking:
             return
         for profile, qty in lacking.items():
-            self._lacking[profile] = max(0, self._lacking.get(profile, 0) - qty)
+            current = self._lacking.get(profile, 0)
+            self._total_lacking -= min(qty, max(0, current))
+            self._lacking[profile] = max(0, current - qty)

@@ -8,7 +8,6 @@ scheduler simulation.
 
 from __future__ import annotations
 
-import copy
 
 from nos_tpu.api import constants as C
 from nos_tpu.kube.objects import Node, Pod
@@ -119,7 +118,9 @@ class TimeshareNode(PartitionableNode):
         c._name = self._name
         c._node_info = self._node_info.clone()
         c._registry = self._registry
-        c.units = copy.deepcopy(self.units)
+        # direct structural unit copies: clone() is the COW fork's unit
+        # of cost, so skip the generic deepcopy dispatch over the list
+        c.units = [u.__deepcopy__(None) for u in self.units]
         c.generation = self.generation
         return c
 

@@ -16,6 +16,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterable, Protocol, runtime_checkable
 
+from nos_tpu.api.constants import LABEL_POD_GROUP
 from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.kube.resources import (
     ResourceList, fits, pod_request, subtract, sum_resources,
@@ -96,12 +97,26 @@ class NodeInfo:
         return False
 
     def clone(self) -> "NodeInfo":
-        import copy
+        # structural copy (FastCopy) without the copy.deepcopy dispatch
+        # prologue: NodeInfo.clone runs per candidate in gang what-ifs
+        # and per COW fork in the planner
         return NodeInfo(
-            node=copy.deepcopy(self.node),
+            node=self.node.__deepcopy__({}),
             pods=list(self.pods),
             requested=dict(self.requested),
         )
+
+
+def filter_equivalence_key(pod: Pod) -> tuple:
+    """Equivalence class of a pod under the in-tree Filter pipeline: the
+    verdict is a pure function of (namespace, gang, request) against
+    fixed node state — quota checks live entirely in PreFilter.  Shared
+    by the scheduler's per-cycle Filter memo and the planner's per-fork
+    memo; a future Filter plugin consulting any OTHER pod attribute must
+    extend this key, or the memos return verdicts for the wrong class."""
+    return (pod.metadata.namespace,
+            pod.metadata.labels.get(LABEL_POD_GROUP, ""),
+            frozenset(pod_request(pod).items()))
 
 
 # ---------------------------------------------------------------------------
@@ -238,11 +253,33 @@ class NodeResourcesFit:
 
 
 class Framework:
-    """Ordered plugin runner (the schedulerruntime.NewFramework analog)."""
+    """Ordered plugin runner (the schedulerruntime.NewFramework analog).
+
+    Extension-point membership is resolved ONCE at construction into
+    dispatch tables: a runtime-checkable Protocol isinstance walks every
+    protocol attribute per call, and with Filter running per pod x node
+    in both the scheduler and the planner simulation it dominated the
+    v5e-256 plan wall time (55% of the profile).  The plugin list is
+    fixed at construction, so the capability check cannot go stale."""
 
     def __init__(self, plugins: Iterable[object] = ()) -> None:
         self._plugins = list(plugins) or [NodeResourcesFit()]
         self._lock = threading.RLock()
+        self._pre_filter = [
+            p for p in self._plugins
+            if isinstance(p, PreFilterPlugin) and hasattr(p, "pre_filter")]
+        self._filter = [
+            p for p in self._plugins
+            if isinstance(p, FilterPlugin) and hasattr(p, "filter")]
+        self._post_filter = [
+            p for p in self._plugins
+            if isinstance(p, PostFilterPlugin) and hasattr(p, "post_filter")]
+        self._extensions = [
+            p for p in self._plugins
+            if isinstance(p, PreFilterExtensions) and hasattr(p, "add_pod")]
+        self._reserve = [
+            p for p in self._plugins
+            if isinstance(p, ReservePlugin) and hasattr(p, "reserve")]
 
     @property
     def plugins(self) -> list[object]:
@@ -251,31 +288,28 @@ class Framework:
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod,
                                nodes: SharedLister) -> Status:
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, PreFilterPlugin) and hasattr(p, "pre_filter"):
-                    st = p.pre_filter(state, pod, nodes)
-                    if not st.is_success:
-                        return st
+            for p in self._pre_filter:
+                st = p.pre_filter(state, pod, nodes)
+                if not st.is_success:
+                    return st
             return Status.ok()
 
     def run_filter_plugins(self, state: CycleState, pod: Pod,
                            node_info: NodeInfo) -> Status:
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, FilterPlugin) and hasattr(p, "filter"):
-                    st = p.filter(state, pod, node_info)
-                    if not st.is_success:
-                        return st
+            for p in self._filter:
+                st = p.filter(state, pod, node_info)
+                if not st.is_success:
+                    return st
             return Status.ok()
 
     def run_post_filter_plugins(self, state: CycleState, pod: Pod,
                                 nodes: SharedLister) -> tuple[str, Status]:
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, PostFilterPlugin) and hasattr(p, "post_filter"):
-                    nominated, st = p.post_filter(state, pod, nodes)
-                    if st.is_success:
-                        return nominated, st
+            for p in self._post_filter:
+                nominated, st = p.post_filter(state, pod, nodes)
+                if st.is_success:
+                    return nominated, st
             return "", Status.unschedulable("no postfilter plugin succeeded")
 
     def run_pre_filter_extension_add_pod(
@@ -285,27 +319,25 @@ class Framework:
         snapshot (reference capacity_scheduling.go:286-302) — used by
         preemption what-ifs and gang placement."""
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, PreFilterExtensions) and hasattr(p, "add_pod"):
-                    st = p.add_pod(state, pod_to_schedule, pod_to_add,
-                                   node_info)
-                    if not st.is_success:
-                        return st
+            for p in self._extensions:
+                st = p.add_pod(state, pod_to_schedule, pod_to_add,
+                               node_info)
+                if not st.is_success:
+                    return st
             return Status.ok()
 
     def run_reserve_plugins(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, ReservePlugin) and hasattr(p, "reserve"):
-                    st = p.reserve(state, pod, node_name)
-                    if not st.is_success:
-                        return st
+            for p in self._reserve:
+                st = p.reserve(state, pod, node_name)
+                if not st.is_success:
+                    return st
             return Status.ok()
 
     def run_unreserve_plugins(self, state: CycleState, pod: Pod,
                               node_name: str) -> None:
         with self._lock:
-            for p in self._plugins:
-                if isinstance(p, ReservePlugin) and hasattr(p, "unreserve"):
+            for p in self._reserve:
+                if hasattr(p, "unreserve"):
                     p.unreserve(state, pod, node_name)

@@ -11,7 +11,6 @@ mark the pod unschedulable so the partitioner notices it
 
 from __future__ import annotations
 
-import copy
 import functools
 import logging
 
@@ -25,10 +24,12 @@ from nos_tpu.api.constants import (
     RESOURCE_TPU,
 )
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
-from nos_tpu.kube.objects import PENDING, RUNNING, Pod
+from nos_tpu.kube.objects import PENDING, RUNNING, Pod, fast_deepcopy
 from nos_tpu.kube.resources import pod_request, sum_resources
+from nos_tpu.scheduler.cache import SchedulerCache
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status, UNSCHEDULABLE,
+    filter_equivalence_key,
 )
 from nos_tpu.scheduler.gang import (
     GANG_HOST_SET_KEY, GANG_POD_ID_KEY, gang_name, gang_slice_windows,
@@ -170,9 +171,31 @@ class Scheduler:
         # Rebuilding it for every pending pod dominated the cycle cost
         # at v5e-256 scale (one full deepcopy of the store per pod).
         self._cycle_lister_cache: SharedLister | None = None
+        # Incremental cluster view (scheduler/cache.py): watch-driven
+        # node/pod indexes with per-node generation invalidation, so
+        # snapshot() rebuilds only the nodes events touched instead of
+        # re-listing (and deep-copying) the whole store per cycle.
+        # Substrates without a watch bus fall back to the full scan.
+        self._cache = SchedulerCache(api) if hasattr(api, "watch") else None
+        # Per-cycle pod-equivalence Filter memo: node name -> equivalence
+        # key -> verdict.  Identical profile-requests skip re-running the
+        # whole Filter pipeline per node; entries die with the node's
+        # assume booking and with the cycle snapshot.
+        self._filter_cache: dict[str, dict] = {}
+
+    def close(self) -> None:
+        """Detach the incremental cache's watch subscriptions.  A
+        replaced Scheduler on a long-lived APIServer must not keep
+        paying two synchronous callbacks (plus per-watcher deep copies)
+        on every write, nor be kept alive by the watcher list."""
+        if self._cache is not None:
+            self._cache.close()
 
     # -- cluster view -------------------------------------------------------
     def snapshot(self) -> SharedLister:
+        if self._cache is not None:
+            return self._cache.snapshot()
+        # full-scan fallback for substrates without a watch bus
         infos: dict[str, NodeInfo] = {}
         for node in self._api.list(KIND_NODE):
             infos[node.metadata.name] = NodeInfo(node=node)
@@ -186,6 +209,7 @@ class Scheduler:
     def _cycle_lister(self) -> SharedLister:
         if self._cycle_lister_cache is None:
             self._cycle_lister_cache = self.snapshot()
+            self._filter_cache = {}
         return self._cycle_lister_cache
 
     def schedule_one(self, pod: Pod) -> str | None:
@@ -208,11 +232,12 @@ class Scheduler:
                     return None
             self._mark_unschedulable(pod, status)
             return None
+        equiv = self._filter_equiv_key(pod)
         feasible: list[NodeInfo] = []
         for ni in lister.list():
             if not self._backfill_allows(pod, ni):
                 continue
-            if self._framework.run_filter_plugins(state, pod, ni).is_success:
+            if self._filter_passes(state, pod, ni, equiv):
                 feasible.append(ni)
         if not feasible:
             nominated, post = self._post_filter_budgeted(state, pod, lister)
@@ -227,20 +252,57 @@ class Scheduler:
             self._framework.run_unreserve_plugins(state, pod, chosen.name)
             self._mark_unschedulable(pod, status)
             return None
-        self._bind(pod, chosen.name)
+        if not self._bind(pod, chosen.name):
+            # The pod vanished mid-cycle: nothing was placed, and the
+            # assume would poison the incremental cache with phantom
+            # capacity (no write happened, so no event invalidates it).
+            # Roll back the reservation: the ledger booked this pod
+            # AFTER its DELETED event fired, so nothing else ever will.
+            self._framework.run_unreserve_plugins(state, pod, chosen.name)
+            return None
         self._assume_bound(pod, chosen.name)
         return chosen.name
+
+    def _filter_equiv_key(self, pod: Pod):
+        """Per-cycle Filter equivalence class (the shared
+        framework.filter_equivalence_key).  Gang members are never
+        cached here: pins in cycle state change the TopologyFilter
+        verdict, and they go through schedule_gang's cloned domains
+        anyway.  None disables caching for this pod."""
+        if gang_name(pod):
+            return None
+        return filter_equivalence_key(pod)
+
+    def _filter_passes(self, state: CycleState, pod: Pod, ni: NodeInfo,
+                       equiv) -> bool:
+        if equiv is None:
+            return self._framework.run_filter_plugins(
+                state, pod, ni).is_success
+        per_node = self._filter_cache.setdefault(ni.name, {})
+        verdict = per_node.get(equiv)
+        if verdict is None:
+            verdict = self._framework.run_filter_plugins(
+                state, pod, ni).is_success
+            per_node[equiv] = verdict
+        return verdict
 
     def _assume_bound(self, pod: Pod, node_name: str) -> None:
         """Book a just-bound pod into the cycle snapshot so later pods
         this cycle see its capacity consumed (the assume cache)."""
+        # the node's capacity changed: its memoised Filter verdicts die
+        self._filter_cache.pop(node_name, None)
+        assumed = fast_deepcopy(pod)
+        assumed.spec.node_name = node_name
+        if self._cache is not None:
+            # also book into the incremental cache: on an async watch
+            # substrate the bind's pod event can lag a node event whose
+            # rebuild would otherwise resurrect the pre-bind view
+            self._cache.assume(assumed)
         lister = self._cycle_lister_cache
         if lister is None:
             return
         ni = lister.get(node_name)
         if ni is not None:
-            assumed = copy.deepcopy(pod)
-            assumed.spec.node_name = node_name
             ni.add_pod(assumed)
 
     def run_cycle(self) -> int:
@@ -456,16 +518,27 @@ class Scheduler:
                 for p2 in members:
                     self._mark_unschedulable(p2, st)
                 return 0
+        bound_members = 0
         for pod, ni in placements:
-            self._bind(pod, ni.name)
-            self._assume_bound(pod, ni.name)
+            if self._bind(pod, ni.name):
+                self._assume_bound(pod, ni.name)
+                bound_members += 1
+            else:
+                # vanished member: un-book its reservation (its DELETED
+                # event fired before reserve booked it — see schedule_one)
+                self._framework.run_unreserve_plugins(state, pod, ni.name)
         if pg is not None:
             # `alive` counts running mates plus the members just bound —
-            # the true scheduled size, not just this cycle's batch
-            set_pod_group_status(self._api, pg, "Scheduled", alive)
+            # the true scheduled size, not just this cycle's batch;
+            # members that vanished mid-cycle bound nothing and are not
+            # reported (a deleted pod already dropped out of `alive`'s
+            # next listing)
+            set_pod_group_status(
+                self._api, pg, "Scheduled",
+                alive - (len(placements) - bound_members))
         logger.info("gang %s: bound %d pods",
-                    gang_name(first), len(placements))
-        return len(placements)
+                    gang_name(first), bound_members)
+        return bound_members
 
     def _backfill_allows(self, pod: Pod, ni: NodeInfo) -> bool:
         """Duration-aware drain-window backfill (__init__); True outside
@@ -930,32 +1003,43 @@ class Scheduler:
 
         return key
 
-    def _patch_pod(self, pod: Pod, mutate) -> None:
+    def _patch_pod(self, pod: Pod, mutate) -> bool:
         """A pod can vanish between this cycle's LIST and the patch —
         deleted by a user, a controller, or this very cycle's drain
         preemption (whole-gang amplification can doom a pod that is
         still in the stale pending list).  A gone pod needs no status:
-        swallow NotFound instead of killing the scheduling cycle."""
+        swallow NotFound instead of killing the scheduling cycle.
+        Returns False exactly on that vanished-pod path."""
         try:
             retry_on_conflict(self._api, KIND_POD, pod.metadata.name,
                               mutate, pod.metadata.namespace,
                               component="scheduler")
         except NotFound:
             logger.debug("scheduler: pod %s vanished mid-cycle", pod.key)
+            return False
+        return True
 
-    def _bind(self, pod: Pod, node_name: str) -> None:
+    def _bind(self, pod: Pod, node_name: str) -> bool:
         # Binding only (the /binding subresource against a real substrate).
         # phase=Running is the KUBELET's claim, not the scheduler's — the
         # node agents make it for the in-memory substrate
         # (controllers/kubelet.py); asserting it here would inflate PDB
         # current_healthy and gang liveness before containers exist.
+        #
+        # Returns whether the bind landed: a vanished pod produced no
+        # write, hence no watch event and no generation bump — assuming
+        # it into the cycle snapshot would permanently pollute the
+        # incremental cache's NodeInfo with phantom capacity (the old
+        # full-rebuild snapshot self-healed; the cache must not).
         def mutate(p: Pod) -> None:
             p.spec.node_name = node_name
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]
-        self._patch_pod(pod, mutate)
+        if not self._patch_pod(pod, mutate):
+            return False
         logger.debug("scheduler: bound %s -> %s", pod.key, node_name)
+        return True
 
     def _nominate(self, pod: Pod, node_name: str) -> None:
         def mutate(p: Pod) -> None:

@@ -35,6 +35,11 @@ else
     echo "    mypy not installed — skipping (install mypy to enable)"
 fi
 
+echo "==> bench_plan.py --smoke (COW clone-count + plan wall gate)"
+if ! env JAX_PLATFORMS=cpu python bench_plan.py --smoke; then
+    rc=1
+fi
+
 if [ "$FAST" -eq 0 ]; then
     echo "==> tier-1 pytest (-m 'not slow')"
     if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

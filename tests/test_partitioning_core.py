@@ -41,16 +41,30 @@ def virgin_v5e(name="n1", **kw):
 class TestSnapshot:
     def test_fork_commit_revert(self):
         snap, _ = snapshot_for([virgin_v5e()])
-        node = snap.get_node("n1")
         snap.fork()
-        assert node.update_geometry_for({"2x2": 2})
+        # write access goes through get_node_for_write: the COW fork
+        # clones the node lazily on this first mutation
+        assert snap.get_node_for_write("n1").update_geometry_for({"2x2": 2})
         snap.revert()
         geo = snap.get_node("n1").geometries()
         assert geo == {0: {"2x4": 1}}
         snap.fork()
-        snap.get_node("n1").update_geometry_for({"2x2": 2})
+        snap.get_node_for_write("n1").update_geometry_for({"2x2": 2})
         snap.commit()
         assert snap.get_node("n1").geometries() == {0: {"2x2": 2}}
+
+    def test_fork_is_lazy(self):
+        # the tentpole contract: fork() copies nothing up front
+        snap, _ = snapshot_for([virgin_v5e("a"), virgin_v5e("b")])
+        snap.fork()
+        snap.commit()
+        assert snap.cow_clones == 0
+        snap.fork()
+        snap.get_node_for_write("a").update_geometry_for({"2x2": 2})
+        snap.get_node_for_write("a")        # same fork: no second clone
+        snap.revert()
+        assert snap.cow_clones == 1
+        assert snap.get_node("a").geometries() == {0: {"2x4": 1}}
 
     def test_double_fork_rejected(self):
         snap, _ = snapshot_for([virgin_v5e()])

@@ -1,0 +1,305 @@
+"""Incremental scheduler cluster view + per-cycle Filter memo.
+
+The watch-driven SchedulerCache must (a) mirror what the full-scan
+snapshot computed, (b) rebuild NodeInfos for exactly the nodes events
+touched (bind / evict / geometry change) and reuse the rest by object
+identity, and (c) the per-cycle pod-equivalence Filter cache must skip
+re-running the pipeline for identical requests while invalidating the
+node a pod was just assumed onto.
+"""
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, Informer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import RUNNING, SUCCEEDED
+from nos_tpu.scheduler.cache import SchedulerCache
+from nos_tpu.scheduler.framework import (
+    CycleState, Framework, NodeInfo, NodeResourcesFit, Status,
+)
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.testing.factory import make_pod, make_slice_pod, make_tpu_node
+
+
+def infos_by_name(lister):
+    return {ni.name: ni for ni in lister.list()}
+
+
+class TestInformer:
+    def test_initial_sync_and_updates(self):
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        informer = Informer(api, KIND_NODE)
+        assert set(informer.items()) == {"n1"}
+        api.create(KIND_NODE, make_tpu_node("n2"))
+        api.delete(KIND_NODE, "n1")
+        assert set(informer.items()) == {"n2"}
+        assert informer.get("n2") is not None
+        assert len(informer) == 1
+
+    def test_close_stops_delivery(self):
+        api = APIServer()
+        informer = Informer(api, KIND_NODE)
+        informer.close()
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        assert len(informer) == 0
+
+    def test_namespaced_keys(self):
+        api = APIServer()
+        events = []
+        informer = Informer(api, KIND_POD,
+                            on_event=lambda ev, o: events.append(ev))
+        api.create(KIND_POD, make_pod(name="p", namespace="ns"))
+        assert set(informer.items()) == {"ns/p"}
+        assert events == ["ADDED"]
+
+
+class TestSchedulerCache:
+    def test_matches_full_scan_snapshot(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.create(KIND_NODE, make_tpu_node("n2"))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="bound", node_name="n1"))
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="pending"))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="done", node_name="n1", phase=SUCCEEDED))
+        view = infos_by_name(cache.snapshot())
+        assert set(view) == {"n1", "n2"}
+        assert [p.metadata.name for p in view["n1"].pods] == ["bound"]
+        assert view["n2"].pods == []
+
+    def test_generation_gated_rebuild(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.create(KIND_NODE, make_tpu_node("n2"))
+        first = infos_by_name(cache.snapshot())
+        second = infos_by_name(cache.snapshot())
+        # nothing changed: identical NodeInfo objects, no rebuild
+        assert first["n1"] is second["n1"]
+        assert first["n2"] is second["n2"]
+        # touching n1 (geometry annotation write) rebuilds ONLY n1
+        api.patch(KIND_NODE, "n1",
+                  mutate=lambda n: n.metadata.annotations.__setitem__(
+                      "k", "v"))
+        third = infos_by_name(cache.snapshot())
+        assert third["n1"] is not second["n1"]
+        assert third["n1"].node.metadata.annotations["k"] == "v"
+        assert third["n2"] is second["n2"]
+
+    def test_bind_and_evict_invalidate_the_node(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        before = infos_by_name(cache.snapshot())["n1"]
+        assert before.pods == []
+        api.patch(KIND_POD, "p", "default",
+                  mutate=lambda p: setattr(p.spec, "node_name", "n1"))
+        bound = infos_by_name(cache.snapshot())["n1"]
+        assert bound is not before
+        assert [p.metadata.name for p in bound.pods] == ["p"]
+        api.delete(KIND_POD, "p", "default")
+        evicted = infos_by_name(cache.snapshot())["n1"]
+        assert evicted is not bound
+        assert evicted.pods == []
+        assert evicted.requested == {}
+
+    def test_pod_bound_before_node_appears(self):
+        # replacement hosts: the pod index is node-existence independent
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="p", node_name="late"))
+        assert infos_by_name(cache.snapshot()) == {}
+        api.create(KIND_NODE, make_tpu_node("late"))
+        view = infos_by_name(cache.snapshot())
+        assert [p.metadata.name for p in view["late"].pods] == ["p"]
+
+    def test_completed_pod_releases_capacity(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="p", node_name="n1", phase=RUNNING))
+        assert infos_by_name(cache.snapshot())["n1"].pods
+        api.patch(KIND_POD, "p", "default",
+                  mutate=lambda p: setattr(p.status, "phase", SUCCEEDED))
+        assert infos_by_name(cache.snapshot())["n1"].pods == []
+
+    def test_node_delete_drops_view(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node("n1"))
+        assert set(infos_by_name(cache.snapshot())) == {"n1"}
+        api.delete(KIND_NODE, "n1")
+        assert infos_by_name(cache.snapshot()) == {}
+
+
+class _CountingFit:
+    """NodeResourcesFit wrapper counting Filter invocations."""
+
+    name = "CountingFit"
+
+    def __init__(self):
+        self.calls = 0
+        self._inner = NodeResourcesFit()
+
+    def filter(self, state: CycleState, pod, node_info) -> Status:
+        self.calls += 1
+        return self._inner.filter(state, pod, node_info)
+
+
+class TestFilterEquivalenceCache:
+    def _cluster(self, nodes=4):
+        api = APIServer()
+        for i in range(nodes):
+            api.create(KIND_NODE, make_tpu_node(
+                f"n{i}", host_index=i,
+                status_geometry={"free": {"2x2": 2}}))
+        return api
+
+    def test_identical_requests_share_verdicts(self):
+        api = self._cluster(nodes=4)
+        plugin = _CountingFit()
+        scheduler = Scheduler(api, Framework([plugin]))
+        for i in range(3):
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"p{i}"))
+        bound = scheduler.run_cycle()
+        assert bound == 3
+        # pod 0: 4 fresh verdicts; pods 1-2: only the node the previous
+        # pod was assumed onto is re-filtered (its verdicts died), the
+        # other 3 come from the memo.
+        assert plugin.calls == 4 + 1 + 1
+
+    def test_gang_members_are_never_cached(self):
+        api = self._cluster(nodes=2)
+        plugin = _CountingFit()
+        scheduler = Scheduler(api, Framework([plugin]))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="g0",
+            labels={C.LABEL_POD_GROUP: "g"}))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="solo"))
+        assert scheduler._filter_equiv_key(
+            api.get(KIND_POD, "g0", "default")) is None
+        assert scheduler._filter_equiv_key(
+            api.get(KIND_POD, "solo", "default")) is not None
+
+    def test_cache_respects_consumed_capacity(self):
+        # one node with room for exactly one pod: the second identical
+        # pod must NOT reuse the stale "fits" verdict after the assume
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}))
+        scheduler = Scheduler(api, Framework([NodeResourcesFit()]))
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="first"))
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="second"))
+        assert scheduler.run_cycle() == 1
+        second = api.get(KIND_POD, "second", "default")
+        assert not second.spec.node_name
+        assert second.is_unschedulable()
+
+
+class TestReviewRegressions:
+    def test_vanished_pod_bind_is_not_assumed(self):
+        # a pod deleted between the cycle's LIST and the bind patch
+        # produces no write (NotFound swallowed), so no watch event and
+        # no generation bump: assuming it would pollute the cached
+        # NodeInfo with phantom capacity FOREVER (the old full-rebuild
+        # snapshot self-healed next cycle; the incremental cache cannot)
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}))
+        scheduler = Scheduler(api, Framework([NodeResourcesFit()]))
+        ghost = make_slice_pod("2x4", 1, name="ghost")   # never created
+        # bind hits NotFound: nothing was placed, nothing is reported
+        assert scheduler.schedule_one(ghost) is None
+        view = infos_by_name(scheduler.snapshot())
+        assert view["n0"].pods == []
+        assert view["n0"].requested == {}
+        # and the freed capacity is actually usable by a real pod
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="real"))
+        assert scheduler.run_cycle() == 1
+
+    def test_close_detaches_the_cache(self):
+        api = APIServer()
+        scheduler = Scheduler(api, Framework())
+        scheduler.close()
+        api.create(KIND_NODE, make_tpu_node("n0"))
+        assert infos_by_name(scheduler._cache.snapshot()) == {}
+
+    def test_vanished_pod_reservation_rolled_back(self):
+        # reserve books the pod into the LIVE quota ledger; when the
+        # bind then hits NotFound (pod deleted mid-cycle, its DELETED
+        # event long gone) the reservation must be unwound or the
+        # namespace's `used` stays inflated forever
+        from nos_tpu.api import constants as C
+        from nos_tpu.api.elasticquota import ElasticQuota, ElasticQuotaSpec
+        from nos_tpu.cmd.assembly import build_scheduler
+        from nos_tpu.kube.client import KIND_ELASTIC_QUOTA
+        from nos_tpu.kube.objects import ObjectMeta
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}))
+        scheduler = build_scheduler(api)
+        api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=ElasticQuotaSpec(min={C.RESOURCE_TPU_MEMORY: 1000.0})))
+        ghost = make_slice_pod("2x4", 1, name="ghost")   # never created
+        assert scheduler.schedule_one(ghost) is None
+        cap = next(p for p in scheduler._framework.plugins
+                   if hasattr(p, "elastic_quota_infos"))
+        info = cap.elastic_quota_infos.get("default")
+        assert info.used.get(C.RESOURCE_TPU_MEMORY, 0.0) == 0.0
+
+    def test_assume_survives_node_event_rebuild(self):
+        # async-substrate coherence: the assumed pod is booked into the
+        # cache indexes, so a node-event rebuild cannot resurrect the
+        # pre-bind view while the pod's own watch event lags
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}))
+        assumed = make_slice_pod("2x4", 1, name="p", node_name="n0")
+        cache.assume(assumed)
+        api.patch(KIND_NODE, "n0",
+                  mutate=lambda n: n.metadata.annotations.__setitem__(
+                      "k", "v"))
+        view = infos_by_name(cache.snapshot())
+        assert [p.metadata.name for p in view["n0"].pods] == ["p"]
+
+
+class TestSchedulerEndToEnd:
+    def test_run_cycle_binds_through_the_cache(self):
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x2": 2}}))
+        scheduler = Scheduler(api, Framework())
+        assert scheduler._cache is not None
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        assert scheduler.run_cycle() == 1
+        assert api.get(KIND_POD, "p", "default").spec.node_name == "n0"
+
+    def test_watchless_substrate_falls_back_to_full_scan(self):
+        class NoWatchAPI:
+            def __init__(self, api):
+                self._api = api
+
+            def __getattr__(self, name):
+                if name == "watch":
+                    raise AttributeError(name)
+                return getattr(self._api, name)
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x2": 2}}))
+        wrapped = NoWatchAPI(api)
+        scheduler = Scheduler(wrapped, Framework())
+        assert scheduler._cache is None
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        assert scheduler.run_cycle() == 1
+        view = infos_by_name(scheduler.snapshot())
+        assert isinstance(view["n0"], NodeInfo)
+        assert [p.metadata.name for p in view["n0"].pods] == ["p"]

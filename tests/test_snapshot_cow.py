@@ -1,0 +1,249 @@
+"""COW snapshot correctness and clone-count regression gates.
+
+The copy-on-write ClusterSnapshot must be observationally identical to
+the seed's eager-clone fork semantics (every node cloned up front) on
+arbitrary mutate/commit/revert sequences — the property test drives both
+implementations through randomized op sequences (including nested
+geometry re-carves and the SnapshotError paths) and compares the visible
+state after every op.  The regression tests pin the tentpole's cost
+contract: a plan clones only the nodes it dirties, never the cluster.
+"""
+
+import random
+
+import pytest
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot, GeometryPlanner, SnapshotError,
+)
+from nos_tpu.partitioning.core.snapshot import SnapshotLister
+from nos_tpu.partitioning.slicepart import (
+    SlicePartitionCalculator, SliceProfileCalculator, SliceSnapshotTaker,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.testing.factory import make_pod, make_slice_pod, make_tpu_node
+
+
+class EagerForkSnapshot(ClusterSnapshot):
+    """The seed's fork semantics, rebuilt on the COW machinery: every
+    node is dirtied (and therefore cloned) up front, so revert restores
+    everything — the reference model for the equivalence property."""
+
+    def fork(self):
+        super().fork()
+        for name in list(self._nodes):
+            self.get_node_for_write(name)
+
+
+PROFILES = ["1x1", "1x2", "2x2", "2x4"]
+
+
+def build_snapshot(cls, node_specs):
+    state = ClusterState()
+    for name, geometry in node_specs:
+        state.update_node(make_tpu_node(name, status_geometry=geometry), [])
+    base = SliceSnapshotTaker().take_snapshot(state)
+    if cls is ClusterSnapshot:
+        return base
+    return cls(base.nodes(), base._filter)
+
+
+def observe(snap):
+    """Everything a consumer can see through the snapshot API."""
+    out = {}
+    for name, node in snap.nodes().items():
+        ni = node.node_info()
+        out[name] = (
+            node.geometries(),
+            tuple(sorted(ni.free().items())),
+            tuple(sorted(p.metadata.name for p in ni.pods)),
+            tuple(sorted(ni.requested.items())),
+        )
+    out["candidates"] = [n.name for n in snap.get_candidate_nodes()]
+    probe = make_slice_pod("2x2", 2, name="probe")
+    out["lacking"] = snap.get_lacking_slices(probe)
+    return out
+
+
+class TestCowEquivalenceProperty:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_sequences_match_eager_semantics(self, seed):
+        rng = random.Random(seed)
+        specs = []
+        for i in range(5):
+            profile = rng.choice(PROFILES)
+            status = rng.choice(["free", "used"])
+            specs.append((f"n{i}", {status: {profile: 1}}))
+        cow = build_snapshot(ClusterSnapshot, specs)
+        eager = build_snapshot(EagerForkSnapshot, specs)
+        pod_seq = [0]
+
+        def op_fork(s):
+            s.fork()
+
+        def op_commit(s):
+            s.commit()
+
+        def op_revert(s):
+            s.revert()
+
+        def op_recarve(s, name=None, lacking=None):
+            s.get_node_for_write(name).update_geometry_for(lacking)
+
+        def op_add_pod(s, name=None, pod=None):
+            s.add_pod(name, pod)
+
+        def op_double_fork(s):
+            s.fork()        # raises when already forked
+
+        def op_add_unknown(s, pod=None):
+            s.add_pod("no-such-node", pod)
+
+        for step in range(40):
+            roll = rng.random()
+            kwargs = {}
+            if not cow.forked:
+                op = op_fork
+            elif roll < 0.15:
+                op = op_commit
+            elif roll < 0.30:
+                op = op_revert
+            elif roll < 0.40:
+                op = rng.choice([op_double_fork, op_add_unknown])
+                if op is op_add_unknown:
+                    kwargs["pod"] = make_slice_pod("1x1", 1, name="ghost")
+            elif roll < 0.75:
+                # nested re-carves: several geometry updates in one fork
+                kwargs["name"] = f"n{rng.randrange(5)}"
+                kwargs["lacking"] = {
+                    rng.choice(PROFILES): rng.randrange(1, 3)}
+                op = op_recarve
+            else:
+                pod_seq[0] += 1
+                kwargs["name"] = f"n{rng.randrange(5)}"
+                kwargs["pod"] = make_slice_pod(
+                    rng.choice(PROFILES), 1, name=f"p{pod_seq[0]}")
+                op = op_add_pod
+
+            results = []
+            for snap in (cow, eager):
+                try:
+                    op(snap, **kwargs)
+                    results.append(("ok", None))
+                except SnapshotError as e:
+                    results.append(("err", type(e).__name__))
+            assert results[0] == results[1], \
+                f"seed={seed} step={step} op={op.__name__}: {results}"
+            assert observe(cow) == observe(eager), \
+                f"seed={seed} step={step} op={op.__name__}: state diverged"
+
+    def test_error_paths_match(self):
+        cow = build_snapshot(ClusterSnapshot, [("n0", {"free": {"2x4": 1}})])
+        with pytest.raises(SnapshotError):
+            cow.revert()                    # not forked
+        cow.fork()
+        with pytest.raises(SnapshotError):
+            cow.fork()                      # double fork
+        with pytest.raises(SnapshotError):
+            cow.add_pod("n0", make_slice_pod("4x4", 1, name="toobig"))
+        # a failed hypothetical bind still dirtied the node (the clone
+        # happened before the fit check); revert must restore it
+        cow.revert()
+        assert cow.get_node("n0").geometries() == {0: {"2x4": 1}}
+
+
+class TestCloneCountRegression:
+    def _cluster_state(self, hosts=64, free_hosts=1):
+        """`hosts - free_hosts` genuinely full hosts (a bound pod consumes
+        every resource, so they are not candidates) + free hosts."""
+        state = ClusterState()
+        for i in range(hosts):
+            if i >= hosts - free_hosts:
+                state.update_node(make_tpu_node(
+                    f"host-{i}", host_index=i,
+                    status_geometry={"free": {"2x4": 1}}), [])
+                continue
+            node = make_tpu_node(f"host-{i}", host_index=i,
+                                 status_geometry={"used": {"2x4": 1}})
+            filler = make_pod(
+                name=f"filler-{i}", node_name=f"host-{i}",
+                resources=dict(node.status.allocatable))
+            state.update_node(node, [filler])
+        return state
+
+    def _planner(self):
+        return GeometryPlanner(
+            framework=Framework(),
+            calculator=SliceProfileCalculator(),
+            partition_calculator=SlicePartitionCalculator(),
+        )
+
+    def test_plan_over_64_hosts_clones_only_dirty_nodes(self):
+        # 63 fully-used hosts + 1 free host; demand re-carves the free
+        # one.  The acceptance contract: clones per plan <= dirty + 1 —
+        # the eager seed paid 64 clones per candidate visited.
+        snap = SliceSnapshotTaker().take_snapshot(self._cluster_state())
+        state = self._planner().plan(
+            snap, [make_slice_pod("2x2", 1, name="p0")])
+        assert state["host-63"].units[0].resources.get(
+            "nos.tpu/slice-2x2", 0) >= 1
+        assert snap.cow_clones <= 2
+        assert snap.cow_clones < 64
+
+    def test_reverted_candidates_cost_one_clone_each(self):
+        # 4 free hosts, demand that fits nowhere: every candidate is
+        # forked, dirtied once and reverted — 1 clone per candidate, not
+        # N per fork.
+        snap = SliceSnapshotTaker().take_snapshot(
+            self._cluster_state(hosts=8, free_hosts=4))
+        self._planner().plan(snap, [make_slice_pod("4x8", 1, name="big")])
+        assert snap.cow_clones <= 4
+
+    def test_snapshot_lister_tracks_fork_lifecycle(self):
+        snap = SliceSnapshotTaker().take_snapshot(
+            self._cluster_state(hosts=3, free_hosts=3))
+        lister = SnapshotLister(snap)
+        before = lister.get("host-0")
+        assert before is snap.get_node("host-0").node_info()
+        snap.fork()
+        snap.get_node_for_write("host-0").update_geometry_for({"2x2": 2})
+        # the COW clone replaced the node object: the lister re-reads it
+        assert lister.get("host-0") is snap.get_node("host-0").node_info()
+        assert lister.get("host-0") is not before
+        # untouched nodes keep NodeInfo identity (no rebuild)
+        assert lister.get("host-1") is snap.get_node("host-1").node_info()
+        snap.revert()
+        assert lister.get("host-0") is snap.get_node("host-0").node_info()
+        assert dict(lister.get("host-0").free()).get(
+            "nos.tpu/slice-2x4", 0) == 1
+
+
+class TestDerivedViewCaches:
+    def test_candidate_list_memoised_until_mutation(self):
+        state = ClusterState()
+        for i in range(4):
+            state.update_node(make_tpu_node(
+                f"n{i}", host_index=i,
+                status_geometry={"free": {"2x4": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        first = [n.name for n in snap.get_candidate_nodes()]
+        epoch = snap._candidate_cache[0]
+        assert [n.name for n in snap.get_candidate_nodes()] == first
+        assert snap._candidate_cache[0] == epoch     # served from memo
+        # a write access invalidates the memo: the next call re-sorts
+        # (n0 lost its chips, so best-fit order puts it first)
+        snap.add_pod("n0", make_slice_pod("2x4", 1, name="filler"))
+        assert [n.name for n in snap.get_candidate_nodes()][0] == "n0"
+        assert snap._candidate_cache[0] != epoch
+
+    def test_lacking_slices_sees_writes(self):
+        state = ClusterState()
+        state.update_node(make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pod: Pod = make_slice_pod("2x4", 1, name="w")
+        assert snap.get_lacking_slices(pod) == {}
+        snap.add_pod("n0", make_slice_pod("2x4", 1, name="eater"))
+        assert snap.get_lacking_slices(pod) == {"2x4": 1}
