@@ -121,8 +121,11 @@ class InjectableClock(Rule):
 
     id = "N002"
     title = "raw clock call in deterministic code (inject a clock)"
-    scope = ("nos_tpu/controllers/", "nos_tpu/partitioning/",
-             "nos_tpu/scheduler/")
+    # obs/ is in scope: span/journal timestamps must come from the
+    # tracer's/journal's injectable clock or chaos seeds stop
+    # reproducing byte-identical flight recordings
+    scope = ("nos_tpu/controllers/", "nos_tpu/obs/",
+             "nos_tpu/partitioning/", "nos_tpu/scheduler/")
 
     BANNED_DOTTED = frozenset({
         "time.time", "time.time_ns", "time.sleep",

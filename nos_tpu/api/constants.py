@@ -36,6 +36,13 @@ LABEL_CAPACITY = f"{GROUP}/capacity"
 CAPACITY_IN_QUOTA = "in-quota"
 CAPACITY_OVER_QUOTA = "over-quota"
 
+# Machine-readable class of an Unschedulable verdict (e.g. "quota-hol"),
+# stamped by the scheduler alongside the PodScheduled condition.  The
+# condition's reason stays the ecosystem-exact "Unschedulable" string
+# (cluster-autoscaler, kueue, and the reference's pkg/util/pod match it
+# verbatim); this label carries the refinement instead.
+LABEL_UNSCHEDULABLE_CLASS = f"{GROUP}/unschedulable-class"
+
 # Node hardware topology labels (the analog of the GPU-operator labels
 # nvidia.com/gpu.{product,count,memory} read in reference pkg/gpu/util.go:30-73).
 # On GKE these would be mirrored from cloud.google.com/gke-tpu-accelerator and

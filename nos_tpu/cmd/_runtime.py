@@ -77,6 +77,16 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._respond(200, REGISTRY.render(),
                           content_type="text/plain; version=0.0.4")
+        elif self.path == "/debug/flightrecorder":
+            # On-demand flight snapshot: the span ring + decision journal
+            # (nos_tpu/obs), the payload `python -m nos_tpu.obs explain`
+            # consumes (docs/observability.md).
+            import json
+
+            from nos_tpu.obs import flight_snapshot
+
+            self._respond(200, json.dumps(flight_snapshot()),
+                          content_type="application/json")
         elif self.path == "/snapshot":
             # Live cluster-state dump + metric series: what the one-shot
             # metricsexporter scrapes (the reference exporter reads the

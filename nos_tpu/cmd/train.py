@@ -107,6 +107,72 @@ def maybe_init_distributed() -> None:
                 worker_id, len(hosts), hosts[0])
 
 
+def report_job_progress(api, name: str, namespace: str,
+                        fraction: float) -> bool:
+    """Write the `nos.tpu/job-progress` annotation on this workload's
+    own Pod — the progress source the scheduler's drain-preemption
+    spare-progress filter reads (docs/scheduler.md): a straggler that
+    has checkpointed past `drain_preempt_spare_progress` is never
+    evicted, because it frees the window faster by finishing.
+
+    Best-effort by design: progress is advisory, and a training step
+    must never die because the API server hiccuped.  Returns whether
+    the annotation landed."""
+    from nos_tpu.api.constants import ANNOT_JOB_PROGRESS
+    from nos_tpu.kube.client import KIND_POD
+    from nos_tpu.utils.retry import retry_on_conflict
+
+    value = f"{max(0.0, min(1.0, fraction)):.4f}"
+
+    def mutate(p) -> None:
+        p.metadata.annotations[ANNOT_JOB_PROGRESS] = value
+
+    try:
+        retry_on_conflict(api, KIND_POD, name, mutate, namespace,
+                          component="train-progress")
+    except Exception:  # noqa: BLE001 — advisory annotation; training
+        # continues, the scheduler just sees stale (lower) progress,
+        # which only errs toward sparing this job less
+        logger.warning("job-progress annotation failed for %s/%s",
+                       namespace, name, exc_info=True)
+        return False
+    return True
+
+
+def progress_reporter(cfg: TrainConfig, environ=None):
+    """Build the per-checkpoint progress callback, or None when the pod
+    identity is unavailable.  Identity comes from the downward API
+    (`POD_NAME`/`POD_NAMESPACE` env, the standard fieldRef projection —
+    deploy/train.yaml wires it); the API substrate comes from the
+    config's kubeconfig (production) — without one there is no cluster
+    to annotate and the hook stays inert."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    name = env.get("POD_NAME", "")
+    namespace = env.get("POD_NAMESPACE", "")
+    # both or nothing: a partially-projected downward API (POD_NAME
+    # without POD_NAMESPACE) must stay inert rather than annotate
+    # <name> in a guessed namespace — a same-named pod there would
+    # inherit this job's progress and be wrongly spared from drain
+    # preemption
+    if not name or not namespace or not cfg.kubeconfig:
+        return None
+    from nos_tpu.cmd._runtime import build_api
+
+    try:
+        api = build_api(cfg)
+    except Exception:  # noqa: BLE001 — advisory hook: a malformed
+        # kubeconfig must not kill the training job at startup; the
+        # scheduler just loses the progress signal, which only errs
+        # toward sparing this job less
+        logger.warning("progress reporter disabled: kubeconfig %s "
+                       "unusable", cfg.kubeconfig, exc_info=True)
+        return None
+    return lambda fraction: report_job_progress(api, name, namespace,
+                                                fraction)
+
+
 def build(cfg: TrainConfig):
     """(trainer, loader, checkpointer, start_state, start_step) from the
     config — separated from main() so tests drive it on a CPU mesh."""
@@ -161,10 +227,14 @@ def build(cfg: TrainConfig):
     return trainer, loader, checkpointer, state, start_step
 
 
-def train(cfg: TrainConfig) -> float | None:
+def train(cfg: TrainConfig, progress_cb=None) -> float | None:
     """Run the loop; returns the final loss, or None when the checkpoint
-    already covers every requested step (nothing to do)."""
+    already covers every requested step (nothing to do).  `progress_cb`
+    (fraction in [0, 1], called after each landed checkpoint) defaults
+    to the downward-API pod annotation reporter when available."""
 
+    if progress_cb is None:
+        progress_cb = progress_reporter(cfg)
     trainer, loader, checkpointer, state, start_step = build(cfg)
     if start_step >= cfg.steps:
         logger.info("checkpoint step %d >= steps %d: training already "
@@ -194,10 +264,15 @@ def train(cfg: TrainConfig) -> float | None:
             logged_at = step
             t0 = time.perf_counter()
         if checkpointer is not None and step % cfg.checkpoint_every == 0:
-            checkpointer.save(step, state)
+            if checkpointer.save(step, state) and progress_cb is not None:
+                # progress is only as durable as the checkpoint backing
+                # it: report AFTER the save lands, never before
+                progress_cb(step / cfg.steps)
     if checkpointer is not None:
         if cfg.steps % cfg.checkpoint_every:
-            checkpointer.save(cfg.steps, state)
+            if checkpointer.save(cfg.steps, state) \
+                    and progress_cb is not None:
+                progress_cb(1.0)
         checkpointer.close()
     return float(loss)
 

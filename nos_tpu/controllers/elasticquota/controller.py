@@ -23,6 +23,8 @@ from nos_tpu.kube.client import (
 )
 from nos_tpu.kube.objects import RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import record as journal_record
 from nos_tpu.quota import TPUResourceCalculator
 from nos_tpu.utils.retry import retry_on_conflict
 
@@ -115,7 +117,8 @@ class _PodsReconciler:
         )
 
     def _patch_capacity_label(self, pod: Pod, desired: str) -> None:
-        if pod.metadata.labels.get(C.LABEL_CAPACITY) == desired:
+        prev = pod.metadata.labels.get(C.LABEL_CAPACITY)
+        if prev == desired:
             return
         try:
             retry_on_conflict(
@@ -125,7 +128,19 @@ class _PodsReconciler:
                 pod.metadata.namespace, component="elasticquota",
             )
         except NotFound:
-            pass
+            return
+        # a label FLIP is the quota decision: the pod started borrowing
+        # over its quota's min (over-quota = preemptible) or its usage
+        # was reclaimed back within min.  The FIRST labeling of a fresh
+        # pod is not a flip — an in-quota pod that never borrowed must
+        # not journal a spurious reclaim (over-quota from the start IS
+        # a borrow decision, so that one is recorded).
+        if desired == C.CAPACITY_OVER_QUOTA:
+            journal_record(J.QUOTA_BORROW, pod.key,
+                           namespace=pod.metadata.namespace)
+        elif prev is not None:
+            journal_record(J.QUOTA_RECLAIM, pod.key,
+                           namespace=pod.metadata.namespace)
 
 
 class ElasticQuotaReconciler:

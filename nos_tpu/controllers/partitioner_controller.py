@@ -29,6 +29,9 @@ from nos_tpu.api import constants as C
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.client import APIServer
 from nos_tpu.kube.objects import PENDING, Pod
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.partitioning.core import (
     Actuator, Planner, QuarantineList, REASON_ACTUATION,
     REASON_PLAN_DEADLINE, SnapshotTaker,
@@ -80,6 +83,9 @@ class PartitionerController:
         self._last_scan = clock()
         # node -> (unreported spec plan id, first seen lagging at)
         self._lag_since: dict[str, tuple[str, float]] = {}
+        # last journaled lagging-node set: handshake waits are polled
+        # every tick, so only TRANSITIONS are decisions worth recording
+        self._last_lagging: frozenset[str] = frozenset()
 
     @property
     def quarantine(self) -> QuarantineList:
@@ -103,6 +109,7 @@ class PartitionerController:
     def process_if_ready(self) -> bool:
         """Poll from the run loop; returns True if a plan cycle ran."""
         self._reconcile_quarantine()
+        self._refresh_lagging_journal()
         rescan_pods = None
         if not self._batcher.ready():
             # An accumulating batch already carries a live trigger and
@@ -149,10 +156,17 @@ class PartitionerController:
             self._state, exclude=self._quarantine.names())
         if not snapshot.nodes():
             return False
-        with REGISTRY.time("nos_tpu_plan_seconds",
-                           labels={"kind": self._kind}):
-            desired = self._planner.plan(snapshot.clone(), pods)
-            self._actuator.apply(snapshot, desired)
+        # the flight recorder's "where did the repartition budget go"
+        # root: planner.plan and actuator.apply nest under it
+        with obs_span("partitioner.plan_cycle", kind=self._kind,
+                      pods=len(pods),
+                      excluded=len(self._quarantine.names())):
+            with REGISTRY.time("nos_tpu_plan_seconds",
+                               labels={"kind": self._kind}):
+                desired = self._planner.plan(snapshot.clone(), pods)
+                actuated = self._actuator.apply(snapshot, desired)
+            journal_record(J.PLAN_CYCLE, self._kind, pods=len(pods),
+                           actuated=actuated)
         REGISTRY.inc("nos_tpu_plans_total", labels={"kind": self._kind})
         REGISTRY.set("nos_tpu_plan_pending_pods",
                      float(len(pods)), labels={"kind": self._kind})
@@ -218,6 +232,43 @@ class PartitionerController:
                 self._lag_since.pop(name, None)
                 self._quarantine.unquarantine(name)
 
+    def _refresh_lagging_journal(self) -> None:
+        """Every-tick resolution check for the handshake-wait journal.
+        New waits are only journaled when a handshake actually blocks a
+        plan (_waiting_for_nodes_to_report_plan), but that check is
+        skipped on idle ticks (empty batcher, no rescan due) — so a node
+        journaled as lagging that has since reported, left the cluster,
+        or been quarantined must be cleared HERE, or the newest record
+        claims it blocks the handshake forever.  Only ever shrinks the
+        set: arming deadlines stays with the blocking-path check."""
+        if not self._last_lagging:
+            return
+        nodes = self._state.nodes()
+        still = set()
+        for name in self._last_lagging:
+            node = nodes.get(name)
+            if node is None or not self._my_kind(node):
+                continue
+            if self._quarantine.is_quarantined(name):
+                continue
+            if not self._node_reported(node):
+                still.add(name)
+        self._journal_lagging_transition(frozenset(still))
+
+    def _journal_lagging_transition(self, lagging: frozenset[str]) -> None:
+        """Journal the lagging set only when it CHANGES (callers poll
+        every tick — steady-state waits are not new decisions).  The
+        empty transition IS recorded (lagging=[]): the operator reading
+        the newest handshake-wait must see the wait resolved, not the
+        stale node list.  List capped like every multi-entity record
+        (one apiserver partition must not blow the bound)."""
+        if lagging == self._last_lagging:
+            return
+        self._last_lagging = lagging
+        journal_record(J.HANDSHAKE_WAIT, self._kind,
+                       lagging=sorted(lagging)[:MAX_JOURNAL_NODES],
+                       lagging_count=len(lagging))
+
     def _waiting_for_nodes_to_report_plan(self) -> bool:
         """spec-partitioning-plan vs status-partitioning-plan per node
         (reference :212-232), with a per-plan deadline: a node lagging
@@ -226,6 +277,7 @@ class PartitionerController:
 
         now = self._clock()
         waiting = False
+        lagging: set[str] = set()
         live = set()
         for node in self._state.nodes().values():
             if not self._my_kind(node):
@@ -243,6 +295,7 @@ class PartitionerController:
             if entry is None or entry[0] != spec_id:
                 # first sight of this plan lagging: arm the deadline
                 self._lag_since[name] = (spec_id, now)
+                lagging.add(name)   # lagging AND blocking
                 waiting = True
             elif now - entry[1] >= self._plan_deadline_s:
                 del self._lag_since[name]
@@ -253,9 +306,13 @@ class PartitionerController:
                     "partitioner[%s]: node %s missed plan %s deadline "
                     "(%.1fs) — quarantined, replanning without it",
                     self._kind, name, spec_id, self._plan_deadline_s)
+                # NOT added to `lagging`: quarantined this tick, so it
+                # no longer blocks the handshake
             else:
+                lagging.add(name)   # lagging AND blocking
                 waiting = True
         # nodes that left the cluster must not pin a stale deadline
         for name in [n for n in self._lag_since if n not in live]:
             del self._lag_since[name]
+        self._journal_lagging_transition(frozenset(lagging))
         return waiting

@@ -207,7 +207,20 @@ def check_confinement(granted: list[int], devices,
     rebasing to the host-local origin — against the granted cells'
     coordinates in the host block.  Raises ConfinementError on count or
     coordinate mismatch; silently returns when the runtime exposes no
-    coords (count is then the only check available)."""
+    coords (count is then the only check available).
+
+    GUARANTEE IS SHAPE-ONLY: both the visible coords and the granted
+    cells are rebased to their own origins before comparison, so the
+    check is **translation-invariant** — a process wrongly confined to a
+    *different same-shape sub-block* of the host passes.  This is
+    inherent, not an oversight: libtpu renumbers visible chips from a
+    local origin, so the absolute position of the visible block is
+    unverifiable from inside the process.  The check proves "I see
+    exactly N chips arranged exactly like my grant", not "I see the
+    grant's exact cells" — cross-slice isolation against a buggy or
+    adversarial granter still rests on the device plugin handing out
+    disjoint cell sets (deviceplugin allocation), and operators must
+    not read a pass as proof of absolute placement."""
     if len(devices) != len(granted):
         raise ConfinementError(
             f"visibility grant promised {len(granted)} chip(s) "

@@ -130,27 +130,54 @@ class Pod(FastCopy):
 
     def is_unschedulable(self) -> bool:
         """Pod marked unschedulable by the scheduler (condition
-        PodScheduled=False/Unschedulable, optionally refined as
-        Unschedulable/<class>).  Reference pkg/util/pod/pod.go:31-39."""
+        PodScheduled=False, reason Unschedulable).  Reference
+        pkg/util/pod/pod.go:31-39.  The split() tolerates conditions
+        persisted by older builds that refined the reason in place
+        ("Unschedulable/<class>") before the class moved to the
+        `nos.tpu/unschedulable-class` label."""
         return any(
             c.type == "PodScheduled" and c.status == "False"
             and c.reason.split("/", 1)[0] == "Unschedulable"
             for c in self.status.conditions
         )
 
+    def unschedulable_class(self) -> str:
+        """Machine-readable refinement of the Unschedulable verdict
+        (e.g. "quota-hol"), from the scheduler-stamped label; "" when
+        unclassified.  Falls back to the legacy in-reason refinement for
+        conditions written by older builds."""
+        from nos_tpu.api.constants import LABEL_UNSCHEDULABLE_CLASS
+
+        cls = self.metadata.labels.get(LABEL_UNSCHEDULABLE_CLASS, "")
+        if cls:
+            return cls
+        for c in self.status.conditions:
+            if c.type == "PodScheduled" and c.status == "False" \
+                    and c.reason.split("/", 1)[0] == "Unschedulable" \
+                    and "/" in c.reason:
+                return c.reason.split("/", 1)[1]
+        return ""
+
     def mark_unschedulable(self, message: str = "",
                            reason: str = "") -> None:
-        """`reason` refines the standard Unschedulable condition reason
-        with a machine-readable class (e.g. "Unschedulable/quota-hol")
-        so controllers can filter without parsing messages."""
+        """The condition reason is the ecosystem-exact "Unschedulable"
+        string — external tooling (cluster-autoscaler, kueue, operator
+        scripts) matches `reason == "Unschedulable"` verbatim, so the
+        machine-readable class `reason` (e.g. "quota-hol") is carried on
+        the `nos.tpu/unschedulable-class` label (read it back via
+        `unschedulable_class()`), never by refining the reason string."""
+        from nos_tpu.api.constants import LABEL_UNSCHEDULABLE_CLASS
+
         self.status.conditions = [
             c for c in self.status.conditions if c.type != "PodScheduled"
         ]
-        cond_reason = f"Unschedulable/{reason}" if reason \
-            else "Unschedulable"
         self.status.conditions.append(
-            PodCondition("PodScheduled", "False", cond_reason, message)
+            PodCondition("PodScheduled", "False", "Unschedulable", message)
         )
+        if reason:
+            self.metadata.labels[LABEL_UNSCHEDULABLE_CLASS] = reason
+        else:
+            self.metadata.labels.pop(LABEL_UNSCHEDULABLE_CLASS, None)
 
 
 @dataclass

@@ -1,0 +1,69 @@
+"""Decision-plane observability: causal tracing, decision journal,
+and the `nos explain` flight recorder.
+
+Three layers over the scheduler ↔ partitioner ↔ actuator pipeline
+(docs/observability.md):
+
+- obs.trace — span API (contextvars propagation, injectable clock,
+  bounded ring exporter, span-latency histograms in the metrics
+  registry);
+- obs.journal — bounded append-only log of decisions (rejections with
+  per-node plugin reasons, plan commits/reverts, quarantine and quota
+  transitions, preemption victim selection);
+- obs.explain — reconstructs "why is this pod pending?" and "where did
+  this plan's budget go?" from a flight snapshot; `python -m
+  nos_tpu.obs` is the CLI, and the cmd/_runtime health server serves
+  live snapshots at /debug/flightrecorder.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .explain import explain_plan, explain_pod
+from .journal import (
+    DecisionJournal, DecisionRecord, get_journal, record, set_journal,
+)
+from .trace import (
+    RingExporter, Span, Tracer, bump, current_span, detail_span,
+    get_tracer, set_tracer, span,
+)
+
+__all__ = [
+    "DecisionJournal", "DecisionRecord", "RingExporter", "Span", "Tracer",
+    "bump", "current_span", "detail_span", "explain_plan", "explain_pod",
+    "flight_snapshot", "get_journal", "get_tracer", "record", "scoped",
+    "set_journal", "set_tracer", "span",
+]
+
+
+def flight_snapshot() -> dict:
+    """The flight-recorder snapshot: every finished span in the ring +
+    the full journal, as plain dicts (JSON-ready).  This is the format
+    obs.explain consumes and /debug/flightrecorder serves."""
+    tracer = get_tracer()
+    journal = get_journal()
+    return {
+        "spans": tracer.ring.dump(),
+        "spans_dropped": tracer.ring.dropped,
+        "journal": journal.dump(),
+        "journal_dropped": journal.dropped,
+    }
+
+
+@contextlib.contextmanager
+def scoped(tracer: Tracer | None = None,
+           journal: DecisionJournal | None = None):
+    """Install a tracer/journal pair for the duration of the block and
+    restore the previous pair on exit — how tests (and the lockcheck-
+    instrumented chaos soak) observe an isolated run without leaking
+    state into the process globals."""
+    prev_tracer = set_tracer(tracer) if tracer is not None else None
+    prev_journal = set_journal(journal) if journal is not None else None
+    try:
+        yield
+    finally:
+        if prev_tracer is not None:
+            set_tracer(prev_tracer)
+        if prev_journal is not None:
+            set_journal(prev_journal)

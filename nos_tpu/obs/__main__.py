@@ -1,0 +1,170 @@
+"""CLI: `python -m nos_tpu.obs` — explain pods/plans from a flight
+snapshot, dump the recorder, or self-test the subsystem.
+
+    python -m nos_tpu.obs explain pod <ns>/<name> --snapshot flight.json
+    python -m nos_tpu.obs explain plan [--kind slice] --url http://host:8080
+    python -m nos_tpu.obs dump --url http://host:8080
+    python -m nos_tpu.obs --selftest
+
+Snapshot sources: `--snapshot FILE` (a saved /debug/flightrecorder
+payload; `-` = stdin) or `--url ADDR` (fetches ADDR/debug/flightrecorder
+live).  `--selftest` runs an in-process end-to-end check of the span
+API, journal, and explain reconstruction — the CI hook in
+scripts/check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import explain_plan, explain_pod
+
+
+def _load_snapshot(args) -> dict:
+    if args.url:
+        from urllib.request import urlopen
+
+        url = args.url.rstrip("/") + "/debug/flightrecorder"
+        with urlopen(url, timeout=10.0) as resp:   # noqa: S310 — operator URL
+            return json.load(resp)
+    if args.snapshot == "-":
+        return json.load(sys.stdin)
+    if args.snapshot:
+        with open(args.snapshot, encoding="utf-8") as fh:
+            return json.load(fh)
+    raise SystemExit(
+        "no snapshot source: pass --snapshot FILE (or '-') or --url ADDR "
+        "(the health server serves /debug/flightrecorder)")
+
+
+def selftest() -> int:
+    """In-process zero-cluster check: spans nest and propagate, the
+    journal stays bounded and ordered, and explain reconstructs a
+    rejection chain naming the plugin.  Prints ok/FAIL, returns rc."""
+    from .journal import POD_BOUND, POD_REJECTED, DecisionJournal
+    from .trace import RingExporter, Tracer
+
+    failures: list[str] = []
+    now = [0.0]
+
+    def clock() -> float:
+        now[0] += 0.5
+        return now[0]
+
+    tracer = Tracer(clock=clock, ring=RingExporter(maxlen=4))
+    journal = DecisionJournal(maxlen=8, clock=clock)
+
+    # span nesting + context propagation
+    with tracer.span("outer", stage="selftest") as outer:
+        with tracer.span("inner") as inner:
+            if inner.trace_id != outer.trace_id:
+                failures.append("child span did not inherit trace id")
+            if inner.parent_id != outer.span_id:
+                failures.append("child span did not link to parent")
+            journal.record(POD_REJECTED, "default/victim",
+                           reason="selftest",
+                           message="no fit anywhere",
+                           nodes={"host-0": "NodeResourcesFit: "
+                                            "insufficient nos.tpu/slice-2x2"},
+                           reason_counts={})
+    if journal.events()[-1].trace_id != outer.trace_id:
+        failures.append("journal record did not capture trace context")
+
+    # ring bound
+    for i in range(10):
+        with tracer.span(f"churn-{i}"):
+            pass
+    if len(tracer.ring) != 4:
+        failures.append(f"ring not bounded: {len(tracer.ring)} != 4")
+    if tracer.ring.dropped != 8:
+        failures.append(f"ring dropped miscounted: {tracer.ring.dropped}")
+
+    # journal bound + total order
+    for i in range(20):
+        journal.record(POD_BOUND, f"default/p{i}", node="host-0")
+    if len(journal) != 8:
+        failures.append(f"journal not bounded: {len(journal)} != 8")
+    seqs = [r.seq for r in journal.events()]
+    if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+        failures.append(f"journal order broken: {seqs}")
+
+    # explain reconstructs the rejection (fresh journal: the churn above
+    # evicted the rejection record — that eviction is itself the test)
+    journal2 = DecisionJournal(maxlen=8, clock=clock)
+    journal2.record(POD_REJECTED, "default/stuck",
+                    reason="", message="no fit",
+                    nodes={"host-0": "NodeResourcesFit: insufficient "
+                                     "nos.tpu/slice-2x2"},
+                    reason_counts={})
+    snapshot = {"spans": tracer.ring.dump(), "journal": journal2.dump()}
+    text = "\n".join(explain_pod(snapshot, "default/stuck"))
+    if "NodeResourcesFit" not in text or "host-0" not in text:
+        failures.append(f"explain lost the rejecting plugin:\n{text}")
+
+    if failures:
+        print("obs selftest: FAIL", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("obs selftest: ok (spans, journal, explain)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nos_tpu.obs",
+        description=__doc__.split("\n")[0])
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the in-process subsystem check")
+    sub = parser.add_subparsers(dest="command")
+
+    p_explain = sub.add_parser("explain", help="reconstruct a causal answer")
+    ex_sub = p_explain.add_subparsers(dest="what", required=True)
+    p_pod = ex_sub.add_parser("pod", help="why is this pod pending?")
+    p_pod.add_argument("key", help="pod as <namespace>/<name>")
+    p_plan = ex_sub.add_parser("plan", help="where did the plan budget go?")
+    p_plan.add_argument("--kind", default=None,
+                        help="partitioning kind (slice|timeshare)")
+    p_dump = sub.add_parser("dump", help="print the raw flight snapshot")
+    for p in (p_pod, p_plan, p_dump):
+        p.add_argument("--snapshot", default="",
+                       help="saved /debug/flightrecorder JSON ('-'=stdin)")
+        p.add_argument("--url", default="",
+                       help="live health server base URL")
+
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.command is None:
+        parser.print_help()
+        return 2
+    try:
+        snapshot = _load_snapshot(args)
+    except json.JSONDecodeError as exc:
+        print(f"snapshot is not valid JSON: {exc}", file=sys.stderr)
+        return 1
+    except OSError as exc:   # unreadable file, unreachable --url
+        print(f"cannot read snapshot: {exc}", file=sys.stderr)
+        return 1
+    if not isinstance(snapshot, dict):
+        print("snapshot is not a flight-recorder payload "
+              "(expected a JSON object)", file=sys.stderr)
+        return 1
+    if args.command == "dump":
+        print(json.dumps(snapshot, indent=2))
+        return 0
+    if args.what == "pod":
+        if "/" not in args.key:
+            print("pod key must be <namespace>/<name>", file=sys.stderr)
+            return 2
+        lines = explain_pod(snapshot, args.key)
+    else:
+        lines = explain_plan(snapshot, kind=args.kind)
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Shared bounded-ring plumbing for the span ring and decision journal.
+
+The memory bound is the contract: a long-lived control plane keeps the
+most recent `maxlen` items and counts what it evicted, instead of
+growing.  Both obs.trace.RingExporter and obs.journal.DecisionJournal
+build on this so the eviction accounting and snapshot consistency live
+in exactly one place.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+
+
+class BoundedRing:
+    """Lock-guarded ``deque(maxlen)`` with an eviction counter.
+
+    Subclasses append via ``_push_locked`` while holding ``self._lock``
+    (so they can fold their own bookkeeping — e.g. a sequence number —
+    into the same critical section) and bump their eviction metric
+    OUTSIDE the lock using the returned flag.  Items must expose
+    ``to_dict()``.
+    """
+
+    def __init__(self, maxlen: int) -> None:
+        self.maxlen = maxlen
+        self._lock = threading.Lock()
+        self._items: deque = deque(maxlen=maxlen)
+        self._dropped = 0
+
+    def _push_locked(self, item) -> bool:
+        """Append (caller holds ``self._lock``); True if one evicted."""
+        evicted = len(self._items) == self.maxlen
+        if evicted:
+            self._dropped += 1
+        self._items.append(item)      # deque(maxlen) evicts oldest
+        return evicted
+
+    def dump(self) -> list[dict]:
+        with self._lock:
+            items = list(self._items)
+        return [i.to_dict() for i in items]
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.dump(), indent=indent)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

@@ -1,0 +1,169 @@
+"""The decision journal: a bounded append-only log of *decisions*.
+
+Metrics answer "how many"; watch events answer "what changed"; neither
+answers the operator's actual question — *why is this pod still
+pending?*  The journal records the decision plane's verdicts at the
+moment they are made, with enough structure to reconstruct the causal
+chain afterwards (obs/explain.py):
+
+- pod rejected — per-node `plugin: reason` detail from the scheduler's
+  Filter pipeline (capped; distinct-reason counts are always complete);
+- pod bound / nominated, gang admitted / rejected;
+- plan cycle ran, per-node geometry commit / revert;
+- node quarantined / released (plan deadline, actuation breaker);
+- quota borrow / reclaim label flips, quota head-of-line claims;
+- preemption victim selection.
+
+Each record carries the ambient trace context (obs/trace.py), so a
+journal line links back to the span tree that produced it.
+
+Design constraints, in priority order:
+
+1. **Bounded memory** — a deque(maxlen) plus an eviction counter; a
+   week-long run keeps the newest `maxlen` decisions, never grows.
+2. **Leaf lock** — `record()` takes the journal lock for the append
+   only and calls nothing under it (no logging, no registry, no other
+   lock), so instrumenting a call site can never add a lock-order edge
+   (verified under lockcheck in the chaos soak).
+3. **Injectable clock** — timestamps come from the journal's clock so
+   chaos seeds reproduce byte-identical journals (noslint N002).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+
+from ._ring import BoundedRing
+from .trace import current_span
+
+REGISTRY.describe("nos_tpu_journal_records_total",
+                  "Decision-journal records appended, per category")
+REGISTRY.describe("nos_tpu_journal_dropped_total",
+                  "Decision records evicted from the bounded journal")
+
+# Per-record multi-entity detail cap: per-node verdicts, gang member
+# lists, lagging-node sets.  Aggregate counts on the record are always
+# complete; the listed entities are capped so one cluster-wide decision
+# cannot blow the journal's memory bound.
+MAX_JOURNAL_NODES = 32
+
+# -- decision categories (the journal's schema; docs/observability.md) ------
+POD_REJECTED = "pod-rejected"
+POD_BOUND = "pod-bound"
+POD_NOMINATED = "pod-nominated"
+GANG_ADMITTED = "gang-admitted"
+GANG_REJECTED = "gang-rejected"
+QUOTA_HOL_CLAIM = "quota-hol-claim"
+QUOTA_BORROW = "quota-borrow"
+QUOTA_RECLAIM = "quota-reclaim"
+PREEMPTION = "preemption"
+PREEMPTION_NONE = "preemption-none"
+PLAN_CYCLE = "plan-cycle"
+PLAN_NODE_COMMITTED = "plan-node-committed"
+PLAN_NODE_REVERTED = "plan-node-reverted"
+NODE_ACTUATED = "node-actuated"
+ACTUATION_FAILED = "actuation-failed"
+QUARANTINED = "quarantined"
+QUARANTINE_RELEASED = "quarantine-released"
+HANDSHAKE_WAIT = "handshake-wait"
+
+
+class DecisionRecord:
+    """One decision.  `subject` is the object the decision is about
+    (pod key "ns/name", node name, "ns/gang", or a kind); `attrs` is
+    category-specific detail (docs/observability.md has the schema)."""
+
+    __slots__ = ("seq", "ts", "category", "subject", "attrs",
+                 "trace_id", "span_id")
+
+    def __init__(self, seq: int, ts: float, category: str, subject: str,
+                 attrs: dict, trace_id: str, span_id: str) -> None:
+        self.seq = seq
+        self.ts = ts
+        self.category = category
+        self.subject = subject
+        self.attrs = attrs
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts": self.ts,
+            "category": self.category,
+            "subject": self.subject,
+            "attrs": dict(self.attrs),
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+        }
+
+
+class DecisionJournal(BoundedRing):
+    """Bounded, totally-ordered (per journal) decision log."""
+
+    def __init__(self, maxlen: int = 4096,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(maxlen)
+        self._clock = clock
+        self._seq = 0
+
+    def record(self, category: str, subject: str,
+               **attrs) -> DecisionRecord:
+        """Append one decision; never raises, never blocks beyond the
+        leaf append lock.  Returns the record (tests assert on it)."""
+        span = current_span()
+        ts = self._clock()
+        rec = DecisionRecord(
+            0, ts, category, subject, attrs,
+            span.trace_id if span is not None else "",
+            span.span_id if span is not None else "")
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            evicted = self._push_locked(rec)
+        REGISTRY.inc("nos_tpu_journal_records_total",
+                     labels={"category": category})
+        if evicted:
+            REGISTRY.inc("nos_tpu_journal_dropped_total")
+        return rec
+
+    # -- reads --------------------------------------------------------------
+    def events(self, category: str | None = None,
+               subject: str | None = None,
+               limit: int | None = None) -> list[DecisionRecord]:
+        """Matching records, oldest first (`limit` keeps the newest N)."""
+        with self._lock:
+            records = list(self._items)
+        if category is not None:
+            records = [r for r in records if r.category == category]
+        if subject is not None:
+            records = [r for r in records if r.subject == subject]
+        if limit is not None:
+            records = records[-limit:]
+        return records
+
+
+# ---------------------------------------------------------------------------
+# Process-global journal (swappable, like obs.trace's tracer)
+# ---------------------------------------------------------------------------
+
+_journal = DecisionJournal()
+
+
+def get_journal() -> DecisionJournal:
+    return _journal
+
+
+def set_journal(journal: DecisionJournal) -> DecisionJournal:
+    global _journal
+    prev = _journal
+    _journal = journal
+    return prev
+
+
+def record(category: str, subject: str, **attrs) -> DecisionRecord:
+    """Record a decision in the process journal — THE call-site API."""
+    return _journal.record(category, subject, **attrs)

@@ -1,0 +1,248 @@
+"""Causal tracing for the decision plane: spans over the scheduler →
+partitioner → actuator pipeline.
+
+The reference `nos` ships Prometheus gauges but no way to see *where a
+repartition's latency budget went*: the plan handshake, the planner's
+geometry search, and per-node actuation all hide inside one
+`plan_seconds` observation.  This module is a deliberately small span
+API — not an OpenTelemetry dependency — instrumenting the decision path
+end to end:
+
+- **Span**: named interval with attributes, monotonically-increasing
+  counters (`bump`), and parent/trace linkage.  Time comes from the
+  tracer's injectable clock, never from a raw `time.*` call at the
+  instrumentation site (noslint N002 covers `nos_tpu/obs/`).
+- **Context propagation** via `contextvars`: the active span follows the
+  call stack (and survives nested framework calls) without threading a
+  span argument through every signature.  Threads started mid-span do
+  NOT inherit it (a fresh thread starts a fresh trace root) — run loops
+  are independent traces by design.
+- **RingExporter**: bounded in-memory ring of finished spans — the
+  flight-recorder half of `python -m nos_tpu.obs` (see obs/explain.py);
+  `dump()`/`to_json()` are the snapshot format served by the health
+  server's `/debug/flightrecorder` endpoint.
+- **Histograms**: every finished span observes
+  `nos_tpu_span_seconds{span=<name>}` in the existing
+  exporter/metrics.py registry, so p50/p99-style latency per decision
+  stage is scrapeable without the ring.
+
+Overhead is the design constraint: a span is one small object, two
+clock reads, and a deque append; the hot pipeline (Filter per pod x
+node) is instrumented with `bump()` counters on the *enclosing* span —
+a ContextVar read plus a dict increment — and only creates real child
+spans in `detailed` mode (tests, post-mortem captures).  The bench_plan
+`--smoke` gate runs with tracing enabled.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import time
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+
+from ._ring import BoundedRing
+
+REGISTRY.describe("nos_tpu_span_seconds",
+                  "Decision-path span latency (count/sum/max per span)")
+REGISTRY.describe("nos_tpu_trace_spans_dropped_total",
+                  "Finished spans evicted from the bounded ring exporter")
+
+#: The active span of this execution context (contextvars: follows the
+#: call stack, isolated per thread).  None = no trace in progress.
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "nos_tpu_obs_span", default=None)
+
+
+class Span:
+    """One named interval on the decision path.  Mutable while open:
+    `set()` attaches attributes, `bump()` increments counters (the
+    cheap aggregate instrumentation for hot loops).  Finished spans are
+    immutable by convention — the ring exporter serializes them."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attrs", "counts", "status")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str, start: float,
+                 attrs: dict | None = None) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end: float | None = None
+        self.attrs: dict = attrs or {}
+        self.counts: dict[str, int] = {}
+        self.status = "ok"
+
+    @property
+    def duration(self) -> float | None:
+        return None if self.end is None else self.end - self.start
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def bump(self, key: str, n: int = 1) -> None:
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "end": self.end,
+            "duration": self.duration,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "counts": dict(self.counts),
+        }
+
+
+class RingExporter(BoundedRing):
+    """Bounded ring of finished spans (newest last) — see BoundedRing
+    for the memory-bound contract."""
+
+    def __init__(self, maxlen: int = 2048) -> None:
+        super().__init__(maxlen)
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            evicted = self._push_locked(span)
+        if evicted:
+            REGISTRY.inc("nos_tpu_trace_spans_dropped_total")
+
+
+class _SpanHandle:
+    """Context manager binding one span into the ambient context."""
+
+    __slots__ = ("_tracer", "_span", "_token")
+
+    def __init__(self, tracer: "Tracer", span: Span) -> None:
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _current.reset(self._token)
+        span = self._span
+        span.end = self._tracer.clock()
+        if exc_type is not None:
+            span.status = f"error:{exc_type.__name__}"
+        self._tracer.ring.export(span)
+        REGISTRY.observe("nos_tpu_span_seconds", span.duration or 0.0,
+                         labels={"span": span.name})
+        return False
+
+
+class _NoopHandle:
+    """Shared do-nothing handle for the disabled tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopHandle()
+
+
+class Tracer:
+    """Span factory with an injectable clock and a bounded ring.
+
+    `detailed=False` (the default) keeps the hot pipeline cheap: inner
+    instrumentation points (`detail_span`) collapse to counter bumps on
+    the enclosing span.  `detailed=True` materializes them as real child
+    spans — used by tests and targeted post-mortem captures, not in the
+    steady state."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 ring: RingExporter | None = None,
+                 enabled: bool = True, detailed: bool = False) -> None:
+        self.clock = clock
+        # `is not None`, not `or`: an empty RingExporter is falsy
+        # (__len__), and `or` would silently swap in a fresh ring
+        self.ring = ring if ring is not None else RingExporter()
+        self.enabled = enabled
+        self.detailed = detailed
+        # Per-tracer, not module-global: a fresh Tracer with an injected
+        # clock must yield byte-identical recordings across runs of the
+        # same chaos seed (count.__next__ is GIL-atomic, like the clock)
+        self._ids = itertools.count(1)
+
+    def span(self, name: str, **attrs):
+        """Open a span as the child of the ambient span (if any)."""
+        if not self.enabled:
+            return _NOOP
+        parent = _current.get()
+        if parent is None:
+            trace_id = f"t{next(self._ids)}"
+            parent_id = ""
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        span = Span(name, trace_id, f"s{next(self._ids)}", parent_id,
+                    self.clock(), attrs or None)
+        return _SpanHandle(self, span)
+
+    def detail_span(self, name: str, **attrs):
+        """A real child span in detailed mode; one counter bump on the
+        enclosing span otherwise (hot-loop instrumentation)."""
+        if self.detailed and self.enabled:
+            return self.span(name, **attrs)
+        parent = _current.get()
+        if parent is not None:
+            parent.bump(name)
+        return _NOOP
+
+
+# ---------------------------------------------------------------------------
+# Process-global tracer (swappable: tests install instrumented instances)
+# ---------------------------------------------------------------------------
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Install `tracer` as the process tracer; returns the previous one
+    so callers (tests, the chaos soak) can restore it."""
+    global _tracer
+    prev = _tracer
+    _tracer = tracer
+    return prev
+
+
+def span(name: str, **attrs):
+    """`with span("scheduler.run_cycle", pods=n) as sp:` — the module-
+    level convenience over the current process tracer."""
+    return _tracer.span(name, **attrs)
+
+
+def detail_span(name: str, **attrs):
+    return _tracer.detail_span(name, **attrs)
+
+
+def current_span() -> Span | None:
+    return _current.get()
+
+
+def bump(key: str, n: int = 1) -> None:
+    """Increment a counter on the ambient span, if any.  The hot-path
+    instrumentation primitive: one ContextVar read + one dict add."""
+    s = _current.get()
+    if s is not None:
+        s.bump(key, n)

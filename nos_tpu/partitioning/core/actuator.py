@@ -19,6 +19,9 @@ import logging
 import uuid
 
 from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.obs.trace import detail_span, span as obs_span
 
 from ..state import PartitioningState
 from .interfaces import Actuator, PartitionCalculator, Partitioner
@@ -70,29 +73,47 @@ class GeometryActuator(Actuator):
             logger.debug("actuator: desired equals current, skipping")
             return False
         plan_id = new_plan_id()
+        with obs_span("actuator.apply", kind=self._kind,
+                      plan_id=plan_id) as sp:
+            changed, failed = self._apply_nodes(
+                current, desired, plan_id)
+            if sp is not None:
+                sp.set("failed", len(failed))
+        if failed:
+            logger.warning("actuator: plan %s applied with %d node "
+                           "failure(s): %s", plan_id, len(failed), failed)
+        return changed
+
+    def _apply_nodes(self, current: PartitioningState,
+                     desired: PartitioningState,
+                     plan_id: str) -> tuple[bool, list[str]]:
+        """Per-failure-domain apply loop (returns changed, failed)."""
         changed = False
         failed: list[str] = []
         for node_name, node_partitioning in desired.items():
             if node_name in current and current[node_name] == node_partitioning:
                 continue
             try:
-                self._partitioner.apply_partitioning(
-                    node_name, plan_id, node_partitioning
-                )
+                with detail_span("actuator.apply_node", node=node_name):
+                    self._partitioner.apply_partitioning(
+                        node_name, plan_id, node_partitioning
+                    )
             except Exception as e:  # noqa: BLE001 — per-node isolation
                 failed.append(node_name)
                 REGISTRY.inc("nos_tpu_actuation_failures_total",
                              labels={"kind": self._kind})
                 streak = (self._quarantine.record_failure(node_name)
                           if self._quarantine else 0)
+                journal_record(J.ACTUATION_FAILED, node_name,
+                               kind=self._kind, plan_id=plan_id,
+                               error=repr(e), streak=streak)
                 logger.warning(
                     "actuator: node %s apply failed (streak %d): %s",
                     node_name, streak, e)
                 continue
             changed = True
+            journal_record(J.NODE_ACTUATED, node_name,
+                           kind=self._kind, plan_id=plan_id)
             if self._quarantine is not None:
                 self._quarantine.record_success(node_name)
-        if failed:
-            logger.warning("actuator: plan %s applied with %d node "
-                           "failure(s): %s", plan_id, len(failed), failed)
-        return changed
+        return changed, failed

@@ -17,6 +17,9 @@ from __future__ import annotations
 import logging
 
 from nos_tpu.kube.objects import Pod
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, SharedLister, filter_equivalence_key,
 )
@@ -45,6 +48,11 @@ class GeometryPlanner(Planner):
     # -- public ------------------------------------------------------------
     def plan(self, snapshot: ClusterSnapshot,
              pending_pods: list[Pod]) -> PartitioningState:
+        with obs_span("planner.plan", pods=len(pending_pods)):
+            return self._plan(snapshot, pending_pods)
+
+    def _plan(self, snapshot: ClusterSnapshot,
+              pending_pods: list[Pod]) -> PartitioningState:
         tracker = SliceTracker(snapshot, self._calculator, pending_pods)
         if tracker.empty:
             return compute_partitioning_state(snapshot, self._partition_calculator)
@@ -66,6 +74,7 @@ class GeometryPlanner(Planner):
         for node_name in candidate_names:
             if tracker.empty:
                 break
+            obs_bump("forks")
             snapshot.fork()
             # write access: the COW fork clones this node lazily
             node = snapshot.get_node_for_write(node_name)
@@ -89,13 +98,21 @@ class GeometryPlanner(Planner):
                 else:
                     failed.add(key)
             if placed:
+                obs_bump("commits")
                 snapshot.commit()
+                journal_record(J.PLAN_NODE_COMMITTED, node_name,
+                               placed=len(placed), changed=changed)
                 # one rebuild per node, not an O(n) remove per placement
                 pods = [p for p in pods if p.key not in placed]
                 logger.debug("planner: node %s re-carved (changed=%s, placed=%d)",
                              node_name, changed, len(placed))
             else:
+                obs_bump("reverts")
                 snapshot.revert()
+                if changed:
+                    # a real decision: the geometry WAS re-carved toward
+                    # the lacking profiles, and still nothing placed
+                    journal_record(J.PLAN_NODE_REVERTED, node_name)
         return compute_partitioning_state(snapshot, self._partition_calculator)
 
     # -- internals ----------------------------------------------------------

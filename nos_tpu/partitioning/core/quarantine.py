@@ -33,6 +33,8 @@ import time
 from typing import Callable
 
 from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import record as journal_record
 
 logger = logging.getLogger(__name__)
 
@@ -76,6 +78,8 @@ class QuarantineList:
                 return False
             self._quarantined[node] = (reason, self._clock())
             self._set_gauge_locked()
+        # outside the lock: the journal is a leaf lock by contract
+        journal_record(J.QUARANTINED, node, kind=self.kind, reason=reason)
         logger.warning("quarantine[%s]: node %s quarantined (%s)",
                        self.kind, node, reason)
         return True
@@ -88,6 +92,8 @@ class QuarantineList:
             self._streaks.pop(node, None)
             self._probe_until.pop(node, None)
             self._set_gauge_locked()
+        journal_record(J.QUARANTINE_RELEASED, node, kind=self.kind,
+                       was=entry[0])
         logger.info("quarantine[%s]: node %s released (was: %s)",
                     self.kind, node, entry[0])
         return True
@@ -109,6 +115,8 @@ class QuarantineList:
             self._streaks.pop(node, None)
             self._probe_until[node] = self._clock() + window_s
             self._set_gauge_locked()
+        journal_record(J.QUARANTINE_RELEASED, node, kind=self.kind,
+                       was=entry[0], probe=True)
         logger.info("quarantine[%s]: node %s released for half-open "
                     "probe (was: %s)", self.kind, node, entry[0])
         return True

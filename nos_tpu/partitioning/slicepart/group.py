@@ -30,6 +30,7 @@ import logging
 from collections import defaultdict
 
 from nos_tpu.kube.objects import Pod
+from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
 from nos_tpu.topology.shape import Shape
 
@@ -63,7 +64,8 @@ class MultiHostGeometryPlanner(GeometryPlanner):
              pending_pods: list[Pod]) -> PartitioningState:
         tracker = SliceTracker(snapshot, self._calculator, pending_pods)
         if not tracker.empty:
-            self._group_pass(snapshot, tracker.lacking, pending_pods)
+            with obs_span("planner.group_pass"):
+                self._group_pass(snapshot, tracker.lacking, pending_pods)
         return super().plan(snapshot, pending_pods)
 
     # -- the pass -----------------------------------------------------------

@@ -32,6 +32,8 @@ from nos_tpu.kube.client import (
 )
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
 from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalculator
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status,
@@ -63,6 +65,42 @@ class PreFilterState:
         # higher-priority ones, plus other-quota ones whose quota is
         # within min).
         self.nominated_with_req = nominated_with_req or dict(pod_req)
+
+
+def victim_prescreen(preemptor: Pod, pv: Pod,
+                     snapshot: ElasticQuotaInfos) -> bool:
+    """Could `pv` EVER be selected as a victim for `preemptor` by
+    `_select_victims_on_node`'s walk?  Used as the performance pre-screen
+    that skips victim-less nodes before paying the what-if clones.
+
+    CONTRACT: this predicate must remain a SUPERSET of the walk's
+    selection branches — it may pass pods the walk later refuses (it
+    ignores the guaranteed-overquota arithmetic and the preemptor's
+    over-min state, both of which only ever *narrow* selection), but it
+    must never refuse a pod the walk could select, or nodes holding
+    valid victims are silently skipped.  Any change to the walk's
+    branch structure (e.g. relaxing the over-quota label requirement on
+    cross-namespace victims) must be mirrored here;
+    tests/test_obs.py::TestVictimPrescreen asserts the superset property
+    over the branch grid.
+
+    The branches, mirroring the walk (reference :516-596):
+    (a) quota-less preemptor: quota-less lower-priority victims only;
+    (b) governed preemptor, same namespace: lower-priority victims;
+    (c) governed preemptor, cross-namespace: governed victims carrying
+        the over-quota label.
+    """
+    preemptor_governed = snapshot.get(preemptor.metadata.namespace) \
+        is not None
+    governed = snapshot.get(pv.metadata.namespace) is not None
+    if not preemptor_governed:
+        return not governed \
+            and pv.spec.priority < preemptor.spec.priority
+    if not governed:
+        return False
+    if pv.metadata.namespace == preemptor.metadata.namespace:
+        return pv.spec.priority < preemptor.spec.priority
+    return is_over_quota(pv)
 
 
 def _spec_unchanged(old: ElasticQuotaInfo, new: ElasticQuotaInfo) -> bool:
@@ -338,6 +376,8 @@ class CapacityScheduling:
                 full = self._expand_eviction(victims, gang_cache)
                 candidates.append((ni.name, full, num_violating))
         if not candidates:
+            journal_record(J.PREEMPTION_NONE, pod.key,
+                           message="preemption found no candidates")
             return "", Status.unschedulable("preemption found no candidates")
 
         best = min(candidates, key=self._candidate_key)
@@ -348,6 +388,9 @@ class CapacityScheduling:
 
         REGISTRY.inc("nos_tpu_preemptions_total")
         REGISTRY.inc("nos_tpu_preemption_victims_total", len(victims))
+        journal_record(J.PREEMPTION, pod.key, node=node_name,
+                       victims=[v.key for v in victims[:MAX_JOURNAL_NODES]],
+                       victim_count=len(victims))
         logger.info("preempting %d pod(s) on %s for %s",
                     len(victims), node_name, pod.key)
         return node_name, Status.ok()
@@ -402,28 +445,12 @@ class CapacityScheduling:
         base_snapshot: ElasticQuotaInfos = state[ELASTIC_QUOTA_SNAPSHOT_KEY]
         pfs: PreFilterState = state[PRE_FILTER_STATE_KEY]
 
-        # Cheap screen before the what-if clones: every victim the walk
-        # below can select is (a) quota-less lower-priority for a
-        # quota-less preemptor, (b) same-namespace lower-priority, or
-        # (c) cross-namespace carrying the over-quota label.  A node
-        # hosting none of those can never yield victims — skip it without
-        # paying the snapshot/NodeInfo clone (the preemption storm at
-        # v5e-256 scale is dominated by victim-less nodes).
-        pod_ns = pod.metadata.namespace
-        preemptor_governed = base_snapshot.get(pod_ns) is not None
-
-        def _maybe_victim(pv: Pod) -> bool:
-            governed = base_snapshot.get(pv.metadata.namespace) is not None
-            if not preemptor_governed:
-                return not governed \
-                    and pv.spec.priority < pod.spec.priority
-            if not governed:
-                return False
-            if pv.metadata.namespace == pod_ns:
-                return pv.spec.priority < pod.spec.priority
-            return is_over_quota(pv)
-
-        if not any(_maybe_victim(pv) for pv in node_info.pods):
+        # Cheap screen before the what-if clones (victim_prescreen, the
+        # shared predicate): a node hosting no possible victim is skipped
+        # without paying the snapshot/NodeInfo clone (the preemption
+        # storm at v5e-256 scale is dominated by victim-less nodes).
+        if not any(victim_prescreen(pod, pv, base_snapshot)
+                   for pv in node_info.pods):
             return [], 0, Status.unschedulable("no victims found")
 
         # Candidate-local what-if copies.

@@ -21,6 +21,8 @@ from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.kube.resources import (
     ResourceList, fits, pod_request, subtract, sum_resources,
 )
+from nos_tpu.obs.trace import (
+    bump as obs_bump, get_tracer as obs_tracer, span as obs_span)
 
 # ---------------------------------------------------------------------------
 # Status codes
@@ -39,6 +41,10 @@ class Status:
     # CapacityScheduling) — lets the scheduler react to WHY a pod is
     # unschedulable without parsing messages.  "" = unclassified.
     reason: str = ""
+    # Name of the plugin that produced a non-success verdict (set by the
+    # Framework runners) — the decision journal's "rejected by plugin P"
+    # provenance.  "" = success or framework-level verdict.
+    plugin: str = ""
 
     @property
     def is_success(self) -> bool:
@@ -287,30 +293,54 @@ class Framework:
 
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod,
                                nodes: SharedLister) -> Status:
+        obs_bump("prefilter_runs")
         with self._lock:
             for p in self._pre_filter:
                 st = p.pre_filter(state, pod, nodes)
                 if not st.is_success:
+                    st.plugin = getattr(p, "name", type(p).__name__)
                     return st
             return Status.ok()
 
     def run_filter_plugins(self, state: CycleState, pod: Pod,
                            node_info: NodeInfo) -> Status:
+        # one counter bump on the enclosing span in every mode (cheap:
+        # Filter runs per pod x node in both the scheduler and the
+        # planner simulation, and explain/troubleshooting read the
+        # reverts/filter_runs ratio); detailed tracers additionally get
+        # a real child span per pipeline run
+        obs_bump("filter_runs")
+        tracer = obs_tracer()
+        if tracer.detailed and tracer.enabled:
+            with tracer.span("framework.filter", pod=pod.key,
+                             node=node_info.name) as sp:
+                st = self._filter_pipeline(state, pod, node_info)
+                if not st.is_success:
+                    sp.set("plugin", st.plugin)
+                    sp.set("reason", st.message)
+                return st
+        return self._filter_pipeline(state, pod, node_info)
+
+    def _filter_pipeline(self, state: CycleState, pod: Pod,
+                         node_info: NodeInfo) -> Status:
         with self._lock:
             for p in self._filter:
                 st = p.filter(state, pod, node_info)
                 if not st.is_success:
+                    st.plugin = getattr(p, "name", type(p).__name__)
                     return st
             return Status.ok()
 
     def run_post_filter_plugins(self, state: CycleState, pod: Pod,
                                 nodes: SharedLister) -> tuple[str, Status]:
-        with self._lock:
-            for p in self._post_filter:
-                nominated, st = p.post_filter(state, pod, nodes)
-                if st.is_success:
-                    return nominated, st
-            return "", Status.unschedulable("no postfilter plugin succeeded")
+        with obs_span("framework.post_filter", pod=pod.key):
+            with self._lock:
+                for p in self._post_filter:
+                    nominated, st = p.post_filter(state, pod, nodes)
+                    if st.is_success:
+                        return nominated, st
+                return "", Status.unschedulable(
+                    "no postfilter plugin succeeded")
 
     def run_pre_filter_extension_add_pod(
             self, state: CycleState, pod_to_schedule: Pod, pod_to_add: Pod,

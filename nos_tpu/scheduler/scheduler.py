@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import logging
+from collections import Counter
 
 from nos_tpu.api.constants import (
     ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
@@ -21,6 +22,7 @@ from nos_tpu.api.constants import (
     LABEL_HOST_INDEX as C_LABEL_HOST_INDEX,
     LABEL_POD_GROUP as C_LABEL_POD_GROUP,
     LABEL_POD_ID as C_LABEL_POD_ID,
+    LABEL_UNSCHEDULABLE_CLASS as C_LABEL_UNSCHEDULABLE_CLASS,
     RESOURCE_TPU,
 )
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
@@ -37,6 +39,9 @@ from nos_tpu.scheduler.gang import (
 )
 from nos_tpu.topology import DEFAULT_REGISTRY
 from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
 from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
@@ -178,10 +183,15 @@ class Scheduler:
         # Substrates without a watch bus fall back to the full scan.
         self._cache = SchedulerCache(api) if hasattr(api, "watch") else None
         # Per-cycle pod-equivalence Filter memo: node name -> equivalence
-        # key -> verdict.  Identical profile-requests skip re-running the
-        # whole Filter pipeline per node; entries die with the node's
-        # assume booking and with the cycle snapshot.
+        # key -> (verdict, why).  Identical profile-requests skip
+        # re-running the whole Filter pipeline per node; entries die with
+        # the node's assume booking and with the cycle snapshot.
         self._filter_cache: dict[str, dict] = {}
+        # True while run_cycle drives the entry points: the cycle
+        # snapshot is shared across its pods.  Direct schedule_one/
+        # schedule_gang calls (public entry points) drop it on exit so
+        # external mutations between calls are seen (ADVICE round 5).
+        self._in_cycle = False
 
     def close(self) -> None:
         """Detach the incremental cache's watch subscriptions.  A
@@ -214,6 +224,22 @@ class Scheduler:
 
     def schedule_one(self, pod: Pod) -> str | None:
         """Try to place one pod; returns the node name or None."""
+        try:
+            return self._schedule_one(pod)
+        finally:
+            if not self._in_cycle:
+                self._drop_cycle_snapshot()
+
+    def _drop_cycle_snapshot(self) -> None:
+        """Public-entry-point hygiene: a direct (out-of-cycle) call must
+        not retain the per-cycle snapshot — external mutations between
+        public-entry-point calls would otherwise go unseen forever
+        (ADVICE round 5)."""
+        self._cycle_lister_cache = None
+        self._filter_cache = {}
+
+    def _schedule_one(self, pod: Pod) -> str | None:
+        obs_bump("schedule_one")
         lister = self._cycle_lister()
         state = CycleState()
         status = self._framework.run_pre_filter_plugins(state, pod, lister)
@@ -234,17 +260,25 @@ class Scheduler:
             return None
         equiv = self._filter_equiv_key(pod)
         feasible: list[NodeInfo] = []
+        rejections: dict[str, str] = {}
         for ni in lister.list():
             if not self._backfill_allows(pod, ni):
+                rejections[ni.name] = \
+                    "Backfill: job would outlive the drain window"
                 continue
-            if self._filter_passes(state, pod, ni, equiv):
+            ok, why = self._filter_passes(state, pod, ni, equiv)
+            if ok:
                 feasible.append(ni)
+            else:
+                rejections[ni.name] = why
         if not feasible:
             nominated, post = self._post_filter_budgeted(state, pod, lister)
             if post.is_success and nominated:
                 self._nominate(pod, nominated)
             else:
-                self._mark_unschedulable(pod, Status.unschedulable("no fit"))
+                self._mark_unschedulable(
+                    pod, Status.unschedulable("no fit"),
+                    node_reasons=rejections)
             return None
         chosen = min(feasible, key=self._score_key(pod, lister))
         status = self._framework.run_reserve_plugins(state, pod, chosen.name)
@@ -274,17 +308,25 @@ class Scheduler:
         return filter_equivalence_key(pod)
 
     def _filter_passes(self, state: CycleState, pod: Pod, ni: NodeInfo,
-                       equiv) -> bool:
+                       equiv) -> tuple[bool, str]:
+        """(verdict, why): why is "plugin: message" on rejection, "" on
+        success — the journal's per-node provenance, carried through the
+        memo so cache hits keep their reason."""
         if equiv is None:
-            return self._framework.run_filter_plugins(
-                state, pod, ni).is_success
+            return self._filter_verdict(state, pod, ni)
         per_node = self._filter_cache.setdefault(ni.name, {})
         verdict = per_node.get(equiv)
         if verdict is None:
-            verdict = self._framework.run_filter_plugins(
-                state, pod, ni).is_success
+            verdict = self._filter_verdict(state, pod, ni)
             per_node[equiv] = verdict
         return verdict
+
+    def _filter_verdict(self, state: CycleState, pod: Pod,
+                        ni: NodeInfo) -> tuple[bool, str]:
+        st = self._framework.run_filter_plugins(state, pod, ni)
+        if st.is_success:
+            return True, ""
+        return False, f"{st.plugin or 'Filter'}: {st.message}"
 
     def _assume_bound(self, pod: Pod, node_name: str) -> None:
         """Book a just-bound pod into the cycle snapshot so later pods
@@ -309,6 +351,17 @@ class Scheduler:
         """Schedule all pending, not-yet-bound pods for this scheduler;
         returns number of pods bound.  Pods sharing a `nos.tpu/pod-group`
         label are admitted all-or-nothing (gang scheduling)."""
+        self._in_cycle = True
+        try:
+            with obs_span("scheduler.run_cycle") as sp:
+                bound = self._run_cycle()
+                if sp is not None:
+                    sp.set("bound", bound)
+                return bound
+        finally:
+            self._in_cycle = False
+
+    def _run_cycle(self) -> int:
         bound = 0
         self._preempt_budget = self._preempt_budget_per_cycle
         self._window_eta = None     # re-estimated per cycle
@@ -399,6 +452,8 @@ class Scheduler:
                 return
         self._quota_hol[ns] = max(self._quota_hol.get(ns, 0),
                                   pod.spec.priority)
+        journal_record(J.QUOTA_HOL_CLAIM, pod.key, namespace=ns,
+                       priority=pod.spec.priority)
 
     def _quota_hol_defers(self, pod: Pod) -> bool:
         blocker = self._quota_hol.get(pod.metadata.namespace)
@@ -415,6 +470,27 @@ class Scheduler:
         and the first placement pins the gang's physical TPU pod); bind
         only if all fit, else mark all unschedulable so the partitioner
         sees the gang's full demand."""
+        try:
+            with obs_span("scheduler.schedule_gang",
+                          gang=f"{members[0].metadata.namespace}"
+                               f"/{gang_name(members[0])}",
+                          members=len(members)):
+                return self._schedule_gang(members)
+        finally:
+            if not self._in_cycle:
+                self._drop_cycle_snapshot()
+
+    def _gang_journal(self, members: list[Pod], admitted: bool,
+                      message: str, bound: int = 0) -> None:
+        first = members[0]
+        subject = f"{first.metadata.namespace}/{gang_name(first)}"
+        journal_record(
+            J.GANG_ADMITTED if admitted else J.GANG_REJECTED, subject,
+            message=message, bound=bound,
+            members=[p.key for p in members[:MAX_JOURNAL_NODES]],
+            members_total=len(members))
+
+    def _schedule_gang(self, members: list[Pod]) -> int:
         first = members[0]
         gang = gang_name(first)
         pg = get_pod_group(self._api, gang, first.metadata.namespace)
@@ -427,6 +503,9 @@ class Scheduler:
             label_selector={C_LABEL_POD_GROUP: gang},
             filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
         if alive < min_member:
+            self._gang_journal(
+                members, False,
+                f"pod group waiting for members ({alive}/{min_member})")
             for pod in members:
                 self._mark_unschedulable(pod, Status.unschedulable(
                     f"pod group waiting for members "
@@ -504,6 +583,7 @@ class Scheduler:
             msg = "gang does not fit as a whole"
             if preempted:
                 msg += " (evicted over-quota victims, retrying)"
+            self._gang_journal(members, False, msg)
             self._reserve_gang_window(
                 (first.metadata.namespace, gang), windows, base)
             for pod in members:
@@ -515,6 +595,9 @@ class Scheduler:
                 # roll back the whole gang
                 for p2, n2 in placements:
                     self._framework.run_unreserve_plugins(state, p2, n2.name)
+                self._gang_journal(
+                    members, False,
+                    f"reserve failed for {pod.key}: {st.message}")
                 for p2 in members:
                     self._mark_unschedulable(p2, st)
                 return 0
@@ -536,6 +619,8 @@ class Scheduler:
             set_pod_group_status(
                 self._api, pg, "Scheduled",
                 alive - (len(placements) - bound_members))
+        self._gang_journal(members, True, "gang admitted",
+                           bound=bound_members)
         logger.info("gang %s: bound %d pods",
                     gang_name(first), bound_members)
         return bound_members
@@ -1036,8 +1121,12 @@ class Scheduler:
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]
+            # a bound pod is no longer unschedulable: the class label
+            # dies with the condition it refines
+            p.metadata.labels.pop(C_LABEL_UNSCHEDULABLE_CLASS, None)
         if not self._patch_pod(pod, mutate):
             return False
+        journal_record(J.POD_BOUND, pod.key, node=node_name)
         logger.debug("scheduler: bound %s -> %s", pod.key, node_name)
         return True
 
@@ -1045,8 +1134,30 @@ class Scheduler:
         def mutate(p: Pod) -> None:
             p.status.nominated_node_name = node_name
         self._patch_pod(pod, mutate)
+        journal_record(J.POD_NOMINATED, pod.key, node=node_name)
 
-    def _mark_unschedulable(self, pod: Pod, status: Status) -> None:
+    def _mark_unschedulable(self, pod: Pod, status: Status,
+                            node_reasons: dict[str, str] | None = None
+                            ) -> None:
         def mutate(p: Pod) -> None:
             p.mark_unschedulable(status.message, status.reason)
         self._patch_pod(pod, mutate)
+        # the journal's "why is this pod pending" substrate: per-reason
+        # counts complete, per-node verdicts capped (MAX_JOURNAL_NODES)
+        attrs: dict = {"reason": status.reason, "message": status.message}
+        if status.plugin:
+            attrs["plugin"] = status.plugin
+        if node_reasons:
+            attrs["nodes"] = dict(sorted(
+                node_reasons.items())[:MAX_JOURNAL_NODES])
+            # reason strings embed per-node numbers (e.g. "used+req over
+            # cap"), so a heterogeneous cluster can mint one distinct
+            # reason per node — cap them too (top-N by node count) and
+            # carry the complete total separately
+            attrs["reason_counts"] = dict(Counter(
+                node_reasons.values()).most_common(MAX_JOURNAL_NODES))
+            attrs["nodes_total"] = len(node_reasons)
+        g = gang_name(pod)
+        if g:
+            attrs["gang"] = f"{pod.metadata.namespace}/{g}"
+        journal_record(J.POD_REJECTED, pod.key, **attrs)

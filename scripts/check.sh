@@ -35,6 +35,11 @@ else
     echo "    mypy not installed — skipping (install mypy to enable)"
 fi
 
+echo "==> obs selftest (python -m nos_tpu.obs --selftest)"
+if ! python -m nos_tpu.obs --selftest; then
+    rc=1
+fi
+
 echo "==> bench_plan.py --smoke (COW clone-count + plan wall gate)"
 if ! env JAX_PLATFORMS=cpu python bench_plan.py --smoke; then
     rc=1

@@ -21,6 +21,7 @@ from types import SimpleNamespace
 
 import pytest
 
+from nos_tpu import obs
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
 from nos_tpu.controllers.sliceagent.agent import SliceAgent
@@ -115,6 +116,13 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             agents.append(agent)
         scheduler = Scheduler(
             api, Framework([NodeResourcesFit(), TopologyFilter(api)]))
+        # Observability instrumented under the SAME lockdep install
+        # window: the tracer ring's and journal's locks join the
+        # acquisition graph, so tracing/journaling adding a lock-order
+        # edge anywhere in the decision plane fails the seed.
+        tracer = obs.Tracer(clock=lambda: clock[0],
+                            ring=obs.RingExporter(maxlen=256))
+        journal = obs.DecisionJournal(maxlen=256, clock=lambda: clock[0])
 
     # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
     # is always feasible
@@ -131,20 +139,22 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             for n in api.list(KIND_NODE))
 
     done = False
-    for round_no in range(max_rounds):
-        clock[0] += BATCH_TIMEOUT_S + 1.0
-        tick("scheduler", scheduler.run_cycle)
-        tick("partitioner", partitioner.process_if_ready)
-        for i, agent in enumerate(agents):
-            tick(f"agent-{i}", agent.tick)
-        api.replay_dropped()        # the round's watch "reconnect"
-        if converged():
-            done = True
-            break
+    with obs.scoped(tracer, journal):
+        for round_no in range(max_rounds):
+            clock[0] += BATCH_TIMEOUT_S + 1.0
+            tick("scheduler", scheduler.run_cycle)
+            tick("partitioner", partitioner.process_if_ready)
+            for i, agent in enumerate(agents):
+                tick(f"agent-{i}", agent.tick)
+            api.replay_dropped()        # the round's watch "reconnect"
+            if converged():
+                done = True
+                break
     return SimpleNamespace(api=api, errors=errors, converged=done,
                            rounds=round_no + 1, seed=seed,
                            quarantined=partitioner.quarantine.names(),
-                           lock_graph=lock_graph)
+                           lock_graph=lock_graph,
+                           tracer=tracer, journal=journal)
 
 
 def _assert_soak_ok(result) -> None:
@@ -161,6 +171,22 @@ def _assert_soak_ok(result) -> None:
         f"seed {result.seed} did not converge in {result.rounds} rounds "
         f"(stats {result.api.stats}, quarantined {result.quarantined}); "
         + repro)
+    # Journal/tracing invariants under chaos: bounded memory, a strictly
+    # increasing total order, and a flight recording that actually
+    # captured the run (every converged soak binds pods and runs plans).
+    journal = result.journal
+    assert len(journal) <= journal.maxlen, repro
+    assert len(result.tracer.ring) <= result.tracer.ring.maxlen, repro
+    seqs = [r.seq for r in journal.events()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), repro
+    from nos_tpu.obs import journal as J
+    cats = {r.category for r in journal.events()}
+    # eviction may have dropped early categories on busy seeds; bounds
+    # are the invariant — but a converged run must at least have bound
+    # pods or have everything evicted (dropped > 0)
+    assert (J.POD_BOUND in cats) or journal.dropped > 0, (cats, repro)
+    span_names = {s["name"] for s in result.tracer.ring.dump()}
+    assert "scheduler.run_cycle" in span_names, repro
 
 
 class TestChaosSoak:
@@ -346,6 +372,42 @@ class TestHandshakeDeadline:
         c.clock[0] += 2.0 * BATCH_TIMEOUT_S
         c.partitioner.process_if_ready()
         assert c.partitioner.quarantine.is_quarantined("host-0")
+
+    def test_handshake_wait_journal_records_transitions_only(self):
+        """The handshake-wait journal records the lagging-set
+        TRANSITIONS — including the empty one (the operator reading the
+        newest record must see the wait resolved, not a stale node
+        list), and a node quarantined this tick is excluded (it no
+        longer blocks the handshake)."""
+        from nos_tpu.obs import journal as J
+
+        c = _Cluster(hosts=2)
+        journal = obs.DecisionJournal(maxlen=64,
+                                      clock=lambda: c.clock[0])
+        with obs.scoped(journal=journal):
+            c.demand("2x2", 1, "want-a")
+            assert c.plan_cycle()           # plan lands; agents dead
+            lagging = sorted(c.planned_nodes())
+            c.demand("2x2", 1, "want-b")
+            assert not c.plan_cycle()       # handshake open: arms
+            waits = journal.events(category=J.HANDSHAKE_WAIT)
+            assert waits, "open handshake did not journal a transition"
+            assert waits[-1].attrs["lagging"] == lagging
+            assert waits[-1].attrs["lagging_count"] == len(lagging)
+            n_waits = len(waits)
+            # steady state: another blocked tick is NOT a new decision
+            assert not c.plan_cycle()
+            assert len(journal.events(
+                category=J.HANDSHAKE_WAIT)) == n_waits
+            # deadline passes: the laggards are quarantined and stop
+            # blocking — the SAME tick journals the empty transition
+            c.clock[0] += 3 * BATCH_TIMEOUT_S + 1.0
+            c.partitioner.process_if_ready()
+            waits = journal.events(category=J.HANDSHAKE_WAIT)
+            assert waits[-1].attrs["lagging"] == []
+            assert waits[-1].attrs["lagging_count"] == 0
+            for name in lagging:
+                assert c.partitioner.quarantine.is_quarantined(name)
 
 
 class TestRescanBackstop:

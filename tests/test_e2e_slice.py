@@ -183,3 +183,54 @@ def test_repartition_preserves_used_devices():
     status = {(a.profile, a.status): a.quantity
               for a in parse_status_annotations(node.metadata.annotations)}
     assert status.get(("2x4", "used")) == 1
+
+
+def test_explain_names_rejecting_plugin_for_pending_pod(tmp_path, capsys):
+    """Acceptance: `python -m nos_tpu.obs explain pod <ns>/<name>`
+    reconstructs the rejection chain — plugin + reason per node — for a
+    deliberately-unschedulable pod, end to end through the real
+    scheduler, the flight snapshot, and the CLI."""
+    import json
+
+    from nos_tpu import obs
+    from nos_tpu.obs.__main__ import main as obs_main
+
+    h = Harness()
+    h.agent.tick()                        # actuate init geometry (2x4)
+
+    clock = [0.0]
+
+    def tick():
+        clock[0] += 1.0
+        return clock[0]
+
+    tracer = obs.Tracer(clock=tick, ring=obs.RingExporter(maxlen=256))
+    journal = obs.DecisionJournal(maxlen=256, clock=tick)
+    with obs.scoped(tracer, journal):
+        # three 2x2 slices = 12 chips: can never fit the 8-chip host, no
+        # matter how the partitioner re-carves — deliberately stuck
+        h.api.create(KIND_POD, make_slice_pod("2x2", 3, name="impossible"))
+        assert h.scheduler.run_cycle() == 0
+        # partitioner tries (and fails) to help: the plan cycle lands in
+        # the flight recorder too
+        h.advance(11.0)
+        h.partitioner.process_if_ready()
+        assert h.scheduler.run_cycle() == 0
+        snap = obs.flight_snapshot()
+
+    path = tmp_path / "flight.json"
+    path.write_text(json.dumps(snap))
+    rc = obs_main(["explain", "pod", "default/impossible",
+                   "--snapshot", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the rejection chain names the plugin and the node it rejected on
+    assert "NodeResourcesFit" in out
+    assert "host-0" in out
+    # and the plan cycle the partitioner ran is explainable as well
+    rc = obs_main(["explain", "plan", "--kind", "slice",
+                   "--snapshot", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "partitioner.plan_cycle" in out
+    assert "planner.plan" in out

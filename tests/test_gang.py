@@ -88,6 +88,39 @@ class TestGangAdmission:
         for i in range(3):
             assert api.get(KIND_POD, f"w-{i}", "default").spec.node_name == ""
 
+    def test_reserve_failure_journals_gang_rejected(self):
+        """The reserve-plugin rollback path is a terminal gang outcome
+        like any other: it must journal GANG_REJECTED, or `explain` for
+        a member of a previously-admitted gang reports the stale
+        admission under the fresh rejection (review regression)."""
+        from nos_tpu import obs
+        from nos_tpu.obs import journal as J
+
+        class RefuseReserve:
+            name = "RefuseReserve"
+
+            def reserve(self, state, pod, node_name):
+                from nos_tpu.scheduler.framework import Status
+                return Status.unschedulable("reserve ledger full")
+
+            def unreserve(self, state, pod, node_name):
+                pass
+
+        api, default_sched = make_cluster(hosts_per_pod={"pod-a": 4})
+        default_sched.close()   # replaced: reserve must be able to fail
+        sched = Scheduler(api, Framework(
+            [NodeResourcesFit(), TopologyFilter(api), RefuseReserve()]))
+        create_pod_group(api, "train", min_member=4)
+        for i in range(4):
+            api.create(KIND_POD, gang_pod(f"w-{i}", "train"))
+        journal = obs.DecisionJournal(maxlen=64)
+        with obs.scoped(journal=journal):
+            assert sched.run_cycle() == 0
+        rejected = journal.events(category=J.GANG_REJECTED)
+        assert rejected, [r.category for r in journal.events()]
+        assert "reserve failed" in rejected[-1].attrs["message"]
+        assert not journal.events(category=J.GANG_ADMITTED)
+
     def test_mixed_gang_and_singles(self):
         api, sched = make_cluster(hosts_per_pod={"pod-a": 3})
         create_pod_group(api, "train", min_member=2)
@@ -453,8 +486,11 @@ class TestQuotaHeadOfLine:
         assert not small.spec.node_name
         msgs = " ".join(c.message or "" for c in small.status.conditions)
         assert "higher-priority quota claim" in msgs
-        assert any(c.reason == "Unschedulable/quota-hol"
+        # ecosystem-exact reason; the machine-readable class rides on
+        # the nos.tpu/unschedulable-class label (ADVICE round 5)
+        assert any(c.reason == "Unschedulable"
                    for c in small.status.conditions)
+        assert small.unschedulable_class() == "quota-hol"
         # other namespaces are unaffected by team's HOL
         api.create(KIND_POD, make_slice_pod(
             "2x2", 1, name="other", namespace="free-ns",

@@ -1,0 +1,707 @@
+"""Tests for nos_tpu/obs: spans, journal, explain, flight recorder —
+plus the victim-prescreen superset contract (ADVICE round 5) and the
+public-entry-point snapshot hygiene regression.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from nos_tpu import obs
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.__main__ import main as obs_main, selftest
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.trace import RingExporter, Tracer, current_span
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        self.now += 1.0
+        return self.now
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_links_parent_and_trace(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=16))
+        with t.span("outer", kind="slice") as outer:
+            assert current_span() is outer
+            with t.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+                assert current_span() is inner
+            assert current_span() is outer
+        assert current_span() is None
+        dumped = {s["name"]: s for s in t.ring.dump()}
+        assert dumped["inner"]["end"] is not None
+        # injected clock: inner opened after outer, closed before it
+        assert dumped["inner"]["start"] > dumped["outer"]["start"]
+        assert dumped["inner"]["end"] < dumped["outer"]["end"]
+        assert dumped["outer"]["attrs"] == {"kind": "slice"}
+
+    def test_propagation_through_calls_and_bumps(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=16))
+        prev = obs.set_tracer(t)
+        try:
+            def hot_path():
+                obs.bump("filter_runs")
+                obs.bump("filter_runs", 2)
+
+            with obs.span("cycle") as sp:
+                hot_path()
+            assert sp.counts == {"filter_runs": 3}
+        finally:
+            obs.set_tracer(prev)
+
+    def test_threads_do_not_inherit_ambient_span(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=16))
+        seen = {}
+
+        def worker():
+            with t.span("in-thread") as sp:
+                seen["parent"] = sp.parent_id
+
+        with t.span("outer"):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert seen["parent"] == ""    # fresh trace root per thread
+
+    def test_exception_marks_status_and_still_exports(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=16))
+        try:
+            with t.span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        (span,) = t.ring.dump()
+        assert span["status"] == "error:ValueError"
+        assert span["end"] is not None
+
+    def test_ring_bounded_and_counts_drops(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=3))
+        for i in range(7):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.ring) == 3
+        assert t.ring.dropped == 4
+        assert [s["name"] for s in t.ring.dump()] == ["s4", "s5", "s6"]
+
+    def test_detail_span_bumps_by_default_and_opens_when_detailed(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8))
+        with t.span("outer") as outer:
+            with t.detail_span("framework.filter"):
+                pass
+        assert outer.counts == {"framework.filter": 1}
+        assert len(t.ring) == 1    # no child span exported
+
+        t2 = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8),
+                    detailed=True)
+        with t2.span("outer"):
+            with t2.detail_span("framework.filter") as child:
+                assert child is not None
+        assert {s["name"] for s in t2.ring.dump()} == \
+            {"outer", "framework.filter"}
+
+    def test_framework_filter_materializes_span_in_detailed_mode(self):
+        """The doc contract: Tracer(detailed=True) turns the hot
+        per-pod x node Filter pipeline's counter bump into a real
+        `framework.filter` child span carrying the rejecting plugin
+        (review regression: the doc promised it, nothing emitted it)."""
+        from nos_tpu.scheduler.framework import (
+            CycleState, Framework, NodeInfo, Status)
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        class Rejector:
+            name = "Rejector"
+
+            def filter(self, state, pod, node_info):
+                return Status.unschedulable("no room")
+
+        fw = Framework([Rejector()])
+        pod = make_slice_pod("2x2", 1, name="stuck")
+        ni = NodeInfo(node=make_tpu_node("host-0"))
+
+        # default tracer: no child span, one counter bump on the parent
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8))
+        with obs.scoped(t, DecisionJournal(maxlen=8, clock=FakeClock())):
+            with t.span("outer") as outer:
+                st = fw.run_filter_plugins(CycleState(), pod, ni)
+        assert not st.is_success
+        assert outer.counts.get("filter_runs") == 1
+        assert [s["name"] for s in t.ring.dump()] == ["outer"]
+
+        # detailed tracer: a real framework.filter span with provenance,
+        # AND the filter_runs counter still lands on the enclosing span
+        # (troubleshooting's reverts/filter_runs ratio must not vanish
+        # in detailed captures — review regression)
+        t2 = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8),
+                    detailed=True)
+        with obs.scoped(t2, DecisionJournal(maxlen=8, clock=FakeClock())):
+            with t2.span("outer") as outer2:
+                st = fw.run_filter_plugins(CycleState(), pod, ni)
+        assert not st.is_success
+        assert outer2.counts.get("filter_runs") == 1
+        spans = {s["name"]: s for s in t2.ring.dump()}
+        assert set(spans) == {"outer", "framework.filter"}
+        child = spans["framework.filter"]
+        assert child["attrs"]["plugin"] == "Rejector"
+        assert child["attrs"]["reason"] == "no room"
+        assert child["attrs"]["node"] == "host-0"
+
+    def test_fresh_tracers_replay_byte_identical(self):
+        """Span/trace ids are per-tracer: the same driven sequence on a
+        fresh Tracer + injected clock yields a byte-identical recording
+        — the chaos-seed replay contract (review regression: a module-
+        global id counter made second runs diverge)."""
+        def drive():
+            t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=16))
+            with t.span("cycle", pods=2):
+                with t.span("inner"):
+                    pass
+            with t.span("cycle", pods=0):
+                pass
+            return t.ring.to_json()
+
+        assert drive() == drive()
+
+    def test_disabled_tracer_is_inert(self):
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8),
+                   enabled=False)
+        with t.span("x") as sp:
+            assert sp is None
+            assert current_span() is None
+        assert len(t.ring) == 0
+
+    def test_span_latency_histogram_lands_in_registry(self):
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=8))
+        with t.span("obs-test-histogram"):
+            pass
+        snap = REGISTRY.snapshot()
+        assert snap["nos_tpu_span_seconds_count"][
+            "span=obs-test-histogram"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_bounded_ordering_and_drop_count(self):
+        j = DecisionJournal(maxlen=5, clock=FakeClock())
+        for i in range(12):
+            j.record(J.POD_BOUND, f"ns/p{i}", node="h0")
+        assert len(j) == 5
+        assert j.dropped == 7
+        seqs = [r.seq for r in j.events()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 5
+        assert seqs[-1] == 12    # seq is total appends, not ring position
+        ts = [r.ts for r in j.events()]
+        assert ts == sorted(ts)
+
+    def test_records_capture_trace_context(self):
+        clock = FakeClock()
+        t = Tracer(clock=clock, ring=RingExporter(maxlen=8))
+        j = DecisionJournal(maxlen=8, clock=clock)
+        with obs.scoped(t, j):
+            with obs.span("cycle") as sp:
+                obs.record(J.POD_REJECTED, "ns/p", reason="r", message="m")
+            obs.record(J.POD_BOUND, "ns/p", node="h0")
+        inside, outside = j.events()
+        assert inside.trace_id == sp.trace_id
+        assert inside.span_id == sp.span_id
+        assert outside.trace_id == ""
+
+    def test_event_filtering(self):
+        j = DecisionJournal(maxlen=16, clock=FakeClock())
+        j.record(J.POD_BOUND, "ns/a", node="h0")
+        j.record(J.POD_REJECTED, "ns/b", reason="", message="no fit")
+        j.record(J.POD_BOUND, "ns/b", node="h1")
+        assert [r.subject for r in j.events(category=J.POD_BOUND)] == \
+            ["ns/a", "ns/b"]
+        assert [r.category for r in j.events(subject="ns/b")] == \
+            [J.POD_REJECTED, J.POD_BOUND]
+        assert len(j.events(limit=2)) == 2
+
+    def test_scoped_restores_globals(self):
+        base_j, base_t = obs.get_journal(), obs.get_tracer()
+        j = DecisionJournal(maxlen=4, clock=FakeClock())
+        t = Tracer(clock=FakeClock(), ring=RingExporter(maxlen=4))
+        with obs.scoped(t, j):
+            assert obs.get_journal() is j
+            assert obs.get_tracer() is t
+        assert obs.get_journal() is base_j
+        assert obs.get_tracer() is base_t
+
+
+# ---------------------------------------------------------------------------
+# Explain (unit: fabricated snapshots)
+# ---------------------------------------------------------------------------
+
+
+def _rec(seq, category, subject, **attrs):
+    return {"seq": seq, "ts": float(seq), "category": category,
+            "subject": subject, "attrs": attrs, "trace_id": "",
+            "span_id": ""}
+
+
+class TestExplainUnit:
+    def test_rejection_chain_names_plugin_per_node(self):
+        snap = {"spans": [], "journal": [_rec(
+            1, J.POD_REJECTED, "ns/stuck", reason="", message="no fit",
+            nodes={"host-0": "NodeResourcesFit: insufficient "
+                             "nos.tpu/slice-2x2",
+                   "host-1": "TopologyFilter: outside pinned domain"},
+            reason_counts={"NodeResourcesFit: insufficient "
+                           "nos.tpu/slice-2x2": 40})]}
+        text = "\n".join(obs.explain_pod(snap, "ns/stuck"))
+        assert "NodeResourcesFit" in text
+        assert "host-0" in text and "host-1" in text
+        # 40 counted for the NodeResourcesFit reason, 2 listed verbatim
+        assert "38 more node(s)" in text
+
+    def test_bound_pod_reports_bound(self):
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_REJECTED, "ns/p", reason="", message="no fit"),
+            _rec(2, J.POD_BOUND, "ns/p", node="host-3"),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "BOUND to node host-3" in text
+
+    def test_node_total_survives_capped_reason_counts(self):
+        """Per-node messages embed per-node numbers, so reason_counts
+        can hold one entry per node — the record caps them and carries
+        the complete node total separately (review regression)."""
+        snap = {"spans": [], "journal": [_rec(
+            1, J.POD_REJECTED, "ns/stuck", reason="", message="no fit",
+            nodes={"host-0": "NodeResourcesFit: 0+4 over 2"},
+            reason_counts={"NodeResourcesFit: 0+4 over 2": 1,
+                           "NodeResourcesFit: 1+4 over 2": 1},
+            nodes_total=200)]}
+        text = "\n".join(obs.explain_pod(snap, "ns/stuck"))
+        assert "199 more node(s)" in text
+
+    def test_gang_bound_pod_reports_bound(self):
+        """Gang binds journal gang-admitted AFTER every member's
+        pod-bound, so the bind must stay definitive even when it is not
+        the newest record (review regression)."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_BOUND, "ns/g-0", node="host-1"),
+            _rec(2, J.GANG_ADMITTED, "ns/gang-1", message="gang admitted",
+                 bound=2, members=["ns/g-0", "ns/g-1"]),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/g-0"))
+        assert "BOUND to node host-1" in text
+
+    def test_rejection_after_bind_is_pending_again(self):
+        """An evicted-and-requeued pod (rejected AFTER its bind) is
+        pending — the old bind must not mask the fresh rejection."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_BOUND, "ns/p", node="host-1"),
+            _rec(2, J.POD_REJECTED, "ns/p", reason="", message="no fit",
+                 nodes={"host-1": "NodeResourcesFit: insufficient"},
+                 reason_counts={}),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "BOUND" not in text
+        assert "NodeResourcesFit" in text
+
+    def test_quota_hol_and_gang_causes_surface(self):
+        snap = {"spans": [], "journal": [
+            _rec(1, J.QUOTA_HOL_CLAIM, "ns/big", namespace="ns",
+                 priority=10),
+            _rec(2, J.GANG_REJECTED, "ns/gang-1",
+                 message="gang does not fit as a whole",
+                 members=["ns/big", "ns/big-2"]),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/big"))
+        assert "head-of-line" in text
+        assert "gang does not fit as a whole" in text
+
+    def test_gang_member_beyond_member_cap_keeps_gang_context(self):
+        """The gang record's member list is capped, so member 33+ is
+        associated through its own rejection's `gang` attr; the member
+        count shown is the complete members_total (review regression)."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_REJECTED, "ns/g-39", reason="",
+                 message="gang does not fit as a whole",
+                 gang="ns/gang-1"),
+            _rec(2, J.GANG_REJECTED, "ns/gang-1",
+                 message="gang does not fit as a whole",
+                 members=[f"ns/g-{i}" for i in range(32)],
+                 members_total=40),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/g-39"))
+        assert "gang ns/gang-1" in text
+        assert "members: 40" in text
+
+    def test_stale_quota_hol_not_blamed_for_later_capacity_rejection(self):
+        """Present-tense context must come from the LATEST scheduling
+        attempt: a pod that was the quota head-of-line claimant cycles
+        ago but is now rejected on pure capacity must not send the
+        operator to debug quota (review regression)."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.QUOTA_HOL_CLAIM, "ns/p", namespace="ns", priority=10),
+            _rec(2, J.POD_REJECTED, "ns/p", reason="quota",
+                 message="no headroom"),
+            _rec(3, J.POD_REJECTED, "ns/p", reason="", message="no fit",
+                 nodes={"host-0": "NodeResourcesFit: insufficient"},
+                 reason_counts={}),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "head-of-line" not in text
+        assert "NodeResourcesFit" in text
+
+    def test_same_attempt_quota_hol_still_surfaces(self):
+        """The claim journaled just before its own cycle's rejection is
+        current context and must survive the recency bound."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_REJECTED, "ns/p", reason="", message="no fit"),
+            _rec(2, J.QUOTA_HOL_CLAIM, "ns/p", namespace="ns", priority=10),
+            _rec(3, J.POD_REJECTED, "ns/p", reason="quota",
+                 message="no headroom"),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "head-of-line" in text
+
+    def test_stale_preemption_not_reported_as_pending_retry(self):
+        """'retry expected next cycle' from a preemption two attempts
+        ago is a lie once a later rejection landed without one."""
+        snap = {"spans": [], "journal": [
+            _rec(1, J.PREEMPTION, "ns/p", node="host-0",
+                 victims=["ns/v0"], victim_count=1),
+            _rec(2, J.POD_REJECTED, "ns/p", reason="", message="no fit"),
+            _rec(3, J.POD_REJECTED, "ns/p", reason="", message="no fit"),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "retry expected next cycle" not in text
+
+    def test_preemption_count_uses_complete_victim_count(self):
+        snap = {"spans": [], "journal": [
+            _rec(1, J.POD_REJECTED, "ns/p", reason="", message="no fit"),
+            _rec(2, J.PREEMPTION, "ns/p", node="host-0",
+                 victims=["ns/v0", "ns/v1"], victim_count=40),
+        ]}
+        text = "\n".join(obs.explain_pod(snap, "ns/p"))
+        assert "evicted 40 victim(s)" in text
+
+    def test_unknown_pod_explains_eviction_possibility(self):
+        text = "\n".join(obs.explain_pod({"spans": [], "journal": []},
+                                         "ns/ghost"))
+        assert "no journaled decisions" in text
+
+    def test_plan_breakdown_tree_and_decisions(self):
+        spans = [
+            {"name": "partitioner.plan_cycle", "trace_id": "t1",
+             "span_id": "s1", "parent_id": "", "start": 0.0, "end": 10.0,
+             "duration": 10.0, "status": "ok",
+             "attrs": {"kind": "slice", "pods": 7}, "counts": {}},
+            {"name": "planner.plan", "trace_id": "t1", "span_id": "s2",
+             "parent_id": "s1", "start": 0.5, "end": 8.0, "duration": 7.5,
+             "status": "ok", "attrs": {},
+             "counts": {"forks": 4, "commits": 2, "filter_runs": 90}},
+            {"name": "actuator.apply", "trace_id": "t1", "span_id": "s3",
+             "parent_id": "s1", "start": 8.0, "end": 9.5, "duration": 1.5,
+             "status": "ok", "attrs": {"plan_id": "abc"}, "counts": {}},
+        ]
+        journal = [_rec(1, J.PLAN_NODE_COMMITTED, "host-0", placed=2,
+                        changed=True)]
+        journal[0]["trace_id"] = "t1"
+        lines = obs.explain_plan({"spans": spans, "journal": journal})
+        text = "\n".join(lines)
+        assert "partitioner.plan_cycle: 10000.0 ms" in text
+        assert "planner.plan: 7500.0 ms (75%)" in text
+        assert "forks: 4" in text
+        assert "plan-node-committed host-0" in text
+
+    def test_plan_kind_filter(self):
+        lines = obs.explain_plan({"spans": [], "journal": []},
+                                 kind="slice")
+        assert "no completed plan cycle" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real scheduler -> journal -> CLI explain
+# ---------------------------------------------------------------------------
+
+
+class TestExplainEndToEnd:
+    def test_scheduler_rejection_explained_through_cli(self, tmp_path,
+                                                       capsys):
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.scheduler.scheduler import Scheduler
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, ring=RingExporter(maxlen=256))
+        journal = DecisionJournal(maxlen=256, clock=clock)
+        with obs.scoped(tracer, journal):
+            api = APIServer()
+            api.create(KIND_NODE, make_tpu_node(
+                "host-0", status_geometry={"free": {"2x4": 1}}))
+            sched = Scheduler(api, Framework())
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name="stuck"))
+            assert sched.run_cycle() == 0
+            snap = obs.flight_snapshot()
+
+        path = tmp_path / "flight.json"
+        path.write_text(json.dumps(snap))
+        rc = obs_main(["explain", "pod", "default/stuck",
+                       "--snapshot", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "NodeResourcesFit" in out
+        assert "host-0" in out
+        assert "nos.tpu/slice-2x2" in out
+        # the run_cycle span made it to the ring with the bind count
+        cycle = [s for s in snap["spans"]
+                 if s["name"] == "scheduler.run_cycle"]
+        assert cycle and cycle[-1]["attrs"]["bound"] == 0
+        # the rejection record carries the complete node total (the
+        # capped nodes/reason_counts views are NOT the size source)
+        rej = [r for r in snap["journal"]
+               if r["category"] == J.POD_REJECTED][-1]
+        assert rej["attrs"]["nodes_total"] == 1
+
+    def test_bound_pod_round_trip(self):
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.scheduler.scheduler import Scheduler
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        clock = FakeClock()
+        with obs.scoped(Tracer(clock=clock, ring=RingExporter(maxlen=64)),
+                        DecisionJournal(maxlen=64, clock=clock)):
+            api = APIServer()
+            api.create(KIND_NODE, make_tpu_node(
+                "host-0", status_geometry={"free": {"2x2": 2}}))
+            sched = Scheduler(api, Framework())
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name="ok"))
+            assert sched.run_cycle() == 1
+            text = "\n".join(obs.explain_pod(obs.flight_snapshot(),
+                                             "default/ok"))
+        assert "BOUND to node host-0" in text
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder endpoint + selftest
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_health_server_serves_snapshot(self):
+        import urllib.request
+
+        from nos_tpu.cmd._runtime import Main
+
+        clock = FakeClock()
+        with obs.scoped(Tracer(clock=clock, ring=RingExporter(maxlen=8)),
+                        DecisionJournal(maxlen=8, clock=clock)):
+            with obs.span("flight-test"):
+                obs.record(J.POD_BOUND, "ns/p", node="h0")
+            main = Main("obs-test", health_addr="127.0.0.1:0")
+            main.start()
+            try:
+                url = f"http://{main.health_address}/debug/flightrecorder"
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    payload = json.load(resp)
+            finally:
+                main.shutdown()
+        assert [s["name"] for s in payload["spans"]] == ["flight-test"]
+        assert payload["journal"][0]["subject"] == "ns/p"
+        assert payload["journal"][0]["trace_id"] == \
+            payload["spans"][0]["trace_id"]
+        assert payload["journal_dropped"] == 0
+
+    def test_selftest_green(self, capsys):
+        assert selftest() == 0
+        assert "ok" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Victim-prescreen superset contract (ADVICE round 5)
+# ---------------------------------------------------------------------------
+
+
+class TestVictimPrescreen:
+    """`victim_prescreen` must stay a SUPERSET of the victim walk's
+    selection branches: a node screened out must be one the walk could
+    never pick victims from.  The grid below runs the REAL
+    `_select_victims_on_node` for every preemptor class and asserts
+    every selected victim also passes the prescreen."""
+
+    def _setup(self):
+        from nos_tpu.api import constants as C
+        from nos_tpu.quota import (
+            ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalculator,
+        )
+        from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+        from nos_tpu.scheduler.framework import (
+            Framework, NodeInfo, NodeResourcesFit,
+        )
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        calc = TPUResourceCalculator()
+        infos = ElasticQuotaInfos()
+        # one 2x2 slice = 64 GB tpu-memory on v5e: team-a's min holds two
+        # of them, team-b's one — so team-b (running two) borrows over min
+        for ns, mn in (("team-a", 128.0), ("team-b", 64.0)):
+            infos.add(ElasticQuotaInfo(
+                resource_name=f"q-{ns}", resource_namespace=ns,
+                namespaces=[ns], min={C.RESOURCE_TPU_MEMORY: mn},
+                max=None, calculator=calc))
+        cs = CapacityScheduling(calc)
+        cs.elastic_quota_infos = infos
+        cs.set_framework(Framework([NodeResourcesFit()]))
+
+        node = make_tpu_node("host-0", status_geometry={"free": {"2x2": 4}})
+        ni = NodeInfo(node=node)
+        victims = [
+            make_slice_pod("2x2", 1, name="free-lo", namespace="freens",
+                           priority=0, node_name="host-0"),
+            make_slice_pod("2x2", 1, name="a-lo", namespace="team-a",
+                           priority=0, node_name="host-0"),
+            make_slice_pod("2x2", 1, name="b-over", namespace="team-b",
+                           priority=0, node_name="host-0",
+                           labels={C.LABEL_CAPACITY:
+                                   C.CAPACITY_OVER_QUOTA}),
+            make_slice_pod("2x2", 1, name="b-in", namespace="team-b",
+                           priority=0, node_name="host-0",
+                           labels={C.LABEL_CAPACITY:
+                                   C.CAPACITY_IN_QUOTA}),
+        ]
+        for v in victims:
+            ni.add_pod(v)
+            info = infos.get(v.metadata.namespace)
+            if info is not None:
+                info.add_pod_if_not_present(v)
+        return cs, ni, infos, calc
+
+    def _run(self, cs, ni, infos, calc, preemptor):
+        from nos_tpu.scheduler.capacityscheduling import (
+            ELASTIC_QUOTA_SNAPSHOT_KEY, PRE_FILTER_STATE_KEY,
+            PreFilterState,
+        )
+        from nos_tpu.scheduler.framework import CycleState
+
+        state = CycleState()
+        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = infos.clone()
+        state[PRE_FILTER_STATE_KEY] = PreFilterState(
+            calc.compute_pod_request(preemptor))
+        victims, _, status = cs._select_victims_on_node(
+            state, preemptor, ni, pdbs=[])
+        return victims
+
+    def test_walk_selection_is_subset_of_prescreen(self):
+        from nos_tpu.scheduler.capacityscheduling import victim_prescreen
+        from nos_tpu.testing.factory import make_slice_pod
+
+        preemptors = [
+            # quota-less preemptor: branch (a) — quota-less victims
+            make_slice_pod("2x2", 1, name="p-free", namespace="freens",
+                           priority=10),
+            # governed, WITHIN min: branch (c) only — cross-namespace
+            # over-quota victims from borrowing quotas
+            make_slice_pod("2x2", 1, name="p-a", namespace="team-a",
+                           priority=10),
+            # governed, OVER min with this request: branches (b) + (c)
+            make_slice_pod("2x2", 2, name="p-a2", namespace="team-a",
+                           priority=10),
+        ]
+        selected_any = 0
+        for preemptor in preemptors:
+            cs, ni, infos, calc = self._setup()
+            victims = self._run(cs, ni, infos, calc, preemptor)
+            selected_any += len(victims)
+            for v in victims:
+                assert victim_prescreen(
+                    preemptor, v, cs.elastic_quota_infos), (
+                    f"walk selected {v.key} for {preemptor.key} but the "
+                    "prescreen refuses it — the screen is no longer a "
+                    "superset of the walk (see victim_prescreen contract)")
+        assert selected_any > 0     # the grid actually exercised the walk
+
+    def test_prescreen_skips_only_victimless_nodes(self):
+        """A node whose pods ALL fail the prescreen yields no victims
+        from the walk either (the screen's soundness direction)."""
+        from nos_tpu.scheduler.capacityscheduling import victim_prescreen
+        from nos_tpu.testing.factory import make_slice_pod
+
+        cs, ni, infos, calc = self._setup()
+        # high-priority governed preemptor from team-a: the only
+        # prescreen-refused pod is b-in (cross-ns, in-quota) and free-lo
+        # (ungoverned)
+        preemptor = make_slice_pod("2x2", 2, name="p", namespace="team-a",
+                                   priority=10)
+        refused = [p for p in ni.pods
+                   if not victim_prescreen(preemptor, p,
+                                           cs.elastic_quota_infos)]
+        assert {p.metadata.name for p in refused} == {"free-lo", "b-in"}
+        victims = self._run(cs, ni, infos, calc, preemptor)
+        assert {v.metadata.name for v in victims}.isdisjoint(
+            {p.metadata.name for p in refused})
+
+
+# ---------------------------------------------------------------------------
+# Journal call-site regressions
+# ---------------------------------------------------------------------------
+
+
+class TestQuotaLabelJournal:
+    """The quota journal records label FLIPS — the first-ever labeling
+    of a fresh in-quota pod is not a reclaim (review regression: every
+    ordinary pod creation used to journal quota-reclaim)."""
+
+    def _setup(self):
+        from nos_tpu.controllers.elasticquota.controller import (
+            _PodsReconciler,
+        )
+        from nos_tpu.kube.client import APIServer, KIND_POD
+        from nos_tpu.quota import TPUResourceCalculator
+        from nos_tpu.testing.factory import make_pod
+
+        api = APIServer()
+        api.create(KIND_POD, make_pod(name="p", namespace="team"))
+        journal = DecisionJournal(maxlen=64, clock=FakeClock())
+        return api, _PodsReconciler(api, TPUResourceCalculator()), journal
+
+    def _pod(self, api):
+        from nos_tpu.kube.client import KIND_POD
+
+        return api.get(KIND_POD, "p", "team")
+
+    def test_first_in_quota_labeling_is_silent_then_flips_journal(self):
+        from nos_tpu.api import constants as C
+
+        api, reconciler, journal = self._setup()
+        with obs.scoped(journal=journal):
+            reconciler._patch_capacity_label(
+                self._pod(api), C.CAPACITY_IN_QUOTA)
+            assert journal.events() == []       # not a flip
+            reconciler._patch_capacity_label(
+                self._pod(api), C.CAPACITY_OVER_QUOTA)
+            reconciler._patch_capacity_label(
+                self._pod(api), C.CAPACITY_IN_QUOTA)
+        assert [r.category for r in journal.events()] == \
+            [J.QUOTA_BORROW, J.QUOTA_RECLAIM]
+
+    def test_fresh_pod_straight_to_over_quota_is_a_borrow(self):
+        from nos_tpu.api import constants as C
+
+        api, reconciler, journal = self._setup()
+        with obs.scoped(journal=journal):
+            reconciler._patch_capacity_label(
+                self._pod(api), C.CAPACITY_OVER_QUOTA)
+        assert [r.category for r in journal.events()] == [J.QUOTA_BORROW]

@@ -303,3 +303,61 @@ class TestSchedulerEndToEnd:
         view = infos_by_name(scheduler.snapshot())
         assert isinstance(view["n0"], NodeInfo)
         assert [p.metadata.name for p in view["n0"].pods] == ["p"]
+
+
+class TestDirectEntryPointSnapshotHygiene:
+    """schedule_one/schedule_gang are public entry points: a direct call
+    (outside run_cycle) must not leave the per-cycle snapshot behind, or
+    external mutations between calls go unseen forever (ADVICE round 5;
+    scheduler.py `_in_cycle`)."""
+
+    def test_direct_schedule_one_drops_cycle_snapshot(self):
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x2": 1}}))
+        scheduler = Scheduler(api, Framework())
+        blocker = make_slice_pod("2x2", 1, name="blocker")
+        api.create(KIND_POD, blocker)
+        assert scheduler.schedule_one(
+            api.get(KIND_POD, "blocker", "default")) == "n0"
+        # the direct call must not retain the snapshot it built
+        assert scheduler._cycle_lister_cache is None
+        assert scheduler._filter_cache == {}
+        # external mutation between direct calls: the blocker vanishes
+        api.delete(KIND_POD, "blocker", "default")
+        late = make_slice_pod("2x2", 1, name="late")
+        api.create(KIND_POD, late)
+        # a stale snapshot would still count the blocker's capacity and
+        # reject; a fresh one sees the freed slice
+        assert scheduler.schedule_one(
+            api.get(KIND_POD, "late", "default")) == "n0"
+
+    def test_direct_schedule_one_failure_also_drops_snapshot(self):
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x4": 1}}))
+        scheduler = Scheduler(api, Framework())
+        stuck = make_slice_pod("2x2", 1, name="stuck")
+        api.create(KIND_POD, stuck)
+        assert scheduler.schedule_one(
+            api.get(KIND_POD, "stuck", "default")) is None
+        assert scheduler._cycle_lister_cache is None
+
+    def test_run_cycle_keeps_snapshot_across_its_own_pods(self, monkeypatch):
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x2": 2}}))
+        scheduler = Scheduler(api, Framework())
+        rebuilds = []
+        orig = Scheduler.snapshot
+
+        def counting(self):
+            rebuilds.append(1)
+            return orig(self)
+
+        monkeypatch.setattr(Scheduler, "snapshot", counting)
+        for i in range(2):
+            api.create(KIND_POD, make_slice_pod("2x2", 1, name=f"p{i}"))
+        assert scheduler.run_cycle() == 2
+        # one snapshot for the whole cycle, not one per pod
+        assert len(rebuilds) == 1

@@ -82,3 +82,77 @@ class TestTrainMain:
         monkeypatch.setenv("TPU_WORKER_ID", "5")
         with pytest.raises(RuntimeError, match="out of range"):
             maybe_init_distributed()
+
+
+class TestJobProgressAnnotation:
+    """The checkpoint hook's `nos.tpu/job-progress` write — the
+    production source of the scheduler's drain-preemption spare-progress
+    filter (docs/scheduler.md; ADVICE round 5)."""
+
+    def _pod_api(self):
+        from nos_tpu.kube.client import APIServer, KIND_POD
+        from nos_tpu.testing.factory import make_pod
+
+        api = APIServer()
+        api.create(KIND_POD, make_pod(name="trainer", namespace="jobs"))
+        return api
+
+    def test_report_writes_clamped_annotation(self):
+        from nos_tpu.api.constants import ANNOT_JOB_PROGRESS
+        from nos_tpu.cmd.train import report_job_progress
+        from nos_tpu.kube.client import KIND_POD
+
+        api = self._pod_api()
+        assert report_job_progress(api, "trainer", "jobs", 0.5)
+        pod = api.get(KIND_POD, "trainer", "jobs")
+        assert pod.metadata.annotations[ANNOT_JOB_PROGRESS] == "0.5000"
+        # clamped into [0, 1] — a buggy fraction must not poison the
+        # scheduler's float parse
+        assert report_job_progress(api, "trainer", "jobs", 7.3)
+        pod = api.get(KIND_POD, "trainer", "jobs")
+        assert pod.metadata.annotations[ANNOT_JOB_PROGRESS] == "1.0000"
+
+    def test_report_is_best_effort_on_vanished_pod(self):
+        from nos_tpu.cmd.train import report_job_progress
+        from nos_tpu.kube.client import APIServer
+
+        # no such pod: the reporter logs and returns False, never raises
+        assert not report_job_progress(APIServer(), "ghost", "jobs", 0.2)
+
+    def test_reporter_inert_without_downward_api_identity(self):
+        from nos_tpu.cmd.train import progress_reporter
+
+        cfg = TrainConfig()
+        assert progress_reporter(cfg, environ={}) is None
+        # partial projection (POD_NAME without POD_NAMESPACE, or the
+        # reverse) must stay inert, not guess a namespace — annotating
+        # a same-named pod elsewhere would wrongly spare it from drain
+        # preemption
+        assert progress_reporter(cfg, environ={"POD_NAME": "t"}) is None
+        assert progress_reporter(
+            cfg, environ={"POD_NAMESPACE": "jobs"}) is None
+        # identity present but no kubeconfig: nothing to annotate against
+        assert progress_reporter(
+            cfg, environ={"POD_NAME": "t", "POD_NAMESPACE": "jobs"}) is None
+
+    def test_reporter_survives_malformed_kubeconfig(self, tmp_path):
+        from nos_tpu.cmd.train import progress_reporter
+
+        # the hook is advisory: a kubeconfig that exists but cannot be
+        # loaded must disable the reporter, not kill train() at startup
+        bad = tmp_path / "kubeconfig"
+        bad.write_text("banana: [unclosed")
+        cfg = TrainConfig(kubeconfig=str(bad))
+        env = {"POD_NAME": "t", "POD_NAMESPACE": "jobs"}
+        assert progress_reporter(cfg, environ=env) is None
+
+    def test_scheduler_reads_reported_progress(self):
+        from nos_tpu.api.constants import ANNOT_JOB_PROGRESS
+        from nos_tpu.cmd.train import report_job_progress
+        from nos_tpu.kube.client import KIND_POD
+        from nos_tpu.scheduler.scheduler import _annotation_progress
+
+        api = self._pod_api()
+        report_job_progress(api, "trainer", "jobs", 0.8)
+        pod = api.get(KIND_POD, "trainer", "jobs")
+        assert _annotation_progress(pod) == 0.8
