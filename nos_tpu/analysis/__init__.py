@@ -1,9 +1,12 @@
 """noslint: project-native static checks for the nos-tpu tree.
 
-`python -m nos_tpu.analysis` runs rules N001–N006 over ``nos_tpu/`` and
+`python -m nos_tpu.analysis` runs rules N001–N010 over ``nos_tpu/`` and
 exits non-zero on any unsuppressed violation; ``tests/test_analysis.py``
 runs the same sweep in tier-1, so a rule violation is a test failure.
-See docs/static-analysis.md for the rule catalog and pragma grammar,
+N001–N006 are single-pass AST rules (rules.py); N007–N010 ride the
+dataflow engine (dataflow.py: CFG, def-use, inevitability, escape,
+cross-file symbol index — rules_flow.py).  See docs/static-analysis.md
+for the rule catalog, pragma grammar, and the ``@guarded_by`` cookbook,
 and nos_tpu/testing/lockcheck.py for the dynamic lock-order half.
 """
 
@@ -11,8 +14,9 @@ from .core import (
     FRAMEWORK_RULE, ModuleSource, Report, Rule, Violation, lint_source, run,
 )
 from .rules import default_rules
+from .rules_flow import flow_rules
 
 __all__ = [
     "FRAMEWORK_RULE", "ModuleSource", "Report", "Rule", "Violation",
-    "default_rules", "lint_source", "run",
+    "default_rules", "flow_rules", "lint_source", "run",
 ]

@@ -3,7 +3,9 @@
 Exit status 0 = clean (the CI/tier-1 contract), 1 = violations.
 ``--format json`` emits machine-readable findings for tooling;
 ``--list-rules`` prints the catalog; ``--show-suppressed`` audits what
-the pragmas are hiding.
+the pragmas are hiding; ``--fix`` applies the mechanical autofixes
+(fix.py) before linting; ``--no-cache`` bypasses the per-file result
+cache (``.noslint_cache/``, see cache.py).
 """
 
 from __future__ import annotations
@@ -13,14 +15,15 @@ import json
 import os
 import sys
 
-from .core import run
+from .cache import ResultCache, rules_signature
+from .core import iter_python_files, run
 from .rules import default_rules
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m nos_tpu.analysis",
-        description="noslint: project-native invariant checks (N001-N006)")
+        description="noslint: project-native invariant checks (N001-N010)")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the nos_tpu "
                         "package)")
@@ -30,6 +33,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the rule catalog and exit")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print pragma-suppressed findings")
+    parser.add_argument("--fix", action="store_true",
+                        help="apply mechanical autofixes (N006 unused "
+                        "imports, N000 naked pragmas) in place, then lint")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the .noslint_cache/ result cache")
     args = parser.parse_args(argv)
 
     rules = default_rules()
@@ -41,7 +49,28 @@ def main(argv: list[str] | None = None) -> int:
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     repo_root = os.path.dirname(os.path.dirname(pkg_dir))
     paths = args.paths or [os.path.dirname(pkg_dir)]
-    report = run(rules, paths, root=repo_root)
+
+    if args.fix:
+        from .fix import fix_file
+
+        for path in iter_python_files(paths):
+            try:
+                fixed = fix_file(path, repo_root)
+            except SyntaxError as e:
+                # the lint pass below reports it as N000; keep fixing
+                # the REST of the tree instead of dying mid-sweep
+                print(f"skip (syntax error): {path}:{e.lineno}",
+                      file=sys.stderr)
+                continue
+            for line in fixed:
+                # stderr: --format json promises ONE document on stdout
+                print(f"fixed: {line}", file=sys.stderr)
+
+    cache = None
+    if not args.no_cache:
+        cache = ResultCache(repo_root,
+                            rules_signature([r.id for r in rules]))
+    report = run(rules, paths, root=repo_root, cache=cache)
 
     if args.format == "json":
         print(json.dumps({
@@ -55,9 +84,13 @@ def main(argv: list[str] | None = None) -> int:
         if args.show_suppressed:
             for v in report.suppressed:
                 print(f"[suppressed] {v.render()}")
+        cache_note = ""
+        if cache is not None and (cache.hits or cache.misses):
+            cache_note = (f", cache {cache.hits} hit(s) / "
+                          f"{cache.misses} miss(es)")
         print(f"noslint: {report.files} file(s), "
               f"{len(report.violations)} violation(s), "
-              f"{len(report.suppressed)} suppressed")
+              f"{len(report.suppressed)} suppressed{cache_note}")
     return 0 if report.ok else 1
 
 

@@ -125,6 +125,10 @@ class Rule:
     title: str = ""
     scope: tuple[str, ...] = ()
     exclude: tuple[str, ...] = ()
+    #: True for rules whose verdicts need the WHOLE tree (finalize-phase
+    #: registries): the result cache (analysis/cache.py) must re-run them
+    #: every time, because another file's change can move their verdicts.
+    cross_file: bool = False
 
     def applies_to(self, mod: ModuleSource) -> bool:
         rel = mod.relpath
@@ -177,11 +181,20 @@ class Report:
 
 
 def run(rules: Iterable[Rule], paths: Iterable[str],
-        root: str | None = None) -> Report:
+        root: str | None = None, cache=None) -> Report:
     """Lint ``paths`` with ``rules``; returns the merged, pragma-filtered
-    report.  ``root`` anchors repo-relative paths (defaults to cwd)."""
+    report.  ``root`` anchors repo-relative paths (defaults to cwd).
+
+    ``cache`` (analysis/cache.py ResultCache) skips the per-file rules on
+    files whose content hash matches a stored entry.  Cross-file rules
+    (``cross_file = True``) always run — their verdicts can move when
+    ANY file changes, so only their per-file accumulation is repeated,
+    never cached.  A rule that accumulates ``finalize`` state across
+    files MUST set ``cross_file`` or the cache will starve it."""
     root = root or os.getcwd()
     rules = list(rules)
+    per_file_rules = [r for r in rules if not r.cross_file]
+    cross_rules = [r for r in rules if r.cross_file]
     mods: list[ModuleSource] = []
     report = Report()
     for path in iter_python_files(paths):
@@ -196,14 +209,30 @@ def run(rules: Iterable[Rule], paths: Iterable[str],
 
     raw: list[Violation] = []
     for mod in mods:
-        raw.extend(_pragma_violations(mod))
-        for rule in rules:
+        file_hash = cache.content_hash(mod.source) if cache else ""
+        per = cache.get(mod.relpath, file_hash) if cache else None
+        if per is None:
+            per = list(_pragma_violations(mod))
+            for rule in per_file_rules:
+                if rule.applies_to(mod):
+                    per.extend(rule.check(mod))
+            if cache is not None:
+                cache.put(mod.relpath, file_hash, per)
+        raw.extend(per)
+        for rule in cross_rules:
             if rule.applies_to(mod):
                 raw.extend(rule.check(mod))
     for rule in rules:
         raw.extend(rule.finalize())
 
+    seen: set[tuple[str, str, int, str]] = set()
     for v in raw:
+        # dataflow paths can judge one source line more than once (the
+        # finally-inlining copies); identical findings collapse to one
+        key = (v.rule, v.path, v.line, v.message)
+        if key in seen:
+            continue
+        seen.add(key)
         mod = by_path.get(v.path)
         if mod is not None and v.rule in mod.suppressed_at(v.line):
             report.suppressed.append(v)
@@ -238,5 +267,14 @@ def lint_source(source: str, rules: Iterable[Rule],
             out.extend(rule.check(mod))
     for rule in rules:
         out.extend(rule.finalize())
-    return [v for v in out
-            if v.rule not in mod.suppressed_at(v.line)]
+    seen: set[tuple[str, str, int, str]] = set()
+    kept: list[Violation] = []
+    for v in out:
+        # same identical-finding collapse as run() — the dataflow
+        # finally-inlining copies can judge one line more than once
+        key = (v.rule, v.path, v.line, v.message)
+        if key in seen or v.rule in mod.suppressed_at(v.line):
+            continue
+        seen.add(key)
+        kept.append(v)
+    return kept

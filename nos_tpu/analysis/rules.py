@@ -174,6 +174,7 @@ class MetricDiscipline(Rule):
     title = "metric naming/registration/label discipline"
     scope = ("nos_tpu/",)
     exclude = ("nos_tpu/exporter/metrics.py", "nos_tpu/analysis/")
+    cross_file = True
 
     TRACKED = frozenset({"inc", "set", "observe", "time", "describe"})
 
@@ -529,6 +530,11 @@ class NameHygiene(Rule):
 
 
 def default_rules() -> list[Rule]:
-    """Fresh instances (N003 carries cross-file state) of N001–N006."""
+    """Fresh instances of every rule: the tokenize/AST passes N001–N006
+    plus the dataflow rules N007–N010 (rules_flow.py; N003 and N009
+    carry cross-file state, hence fresh instances per run)."""
+    from .rules_flow import flow_rules
+
     return [RetryWrappedWrites(), InjectableClock(), MetricDiscipline(),
-            NoBlockingUnderLock(), NoSwallowedExceptions(), NameHygiene()]
+            NoBlockingUnderLock(), NoSwallowedExceptions(), NameHygiene(),
+            *flow_rules()]

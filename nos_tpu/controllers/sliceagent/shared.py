@@ -10,7 +10,11 @@ from __future__ import annotations
 
 import threading
 
+from nos_tpu.utils.guards import guarded_by
 
+
+@guarded_by("_lock", "_report_since_apply", "_last_parsed_plan_id",
+            "_last_applied_signature", "_infeasible_signatures")
 class SharedState:
     def __init__(self) -> None:
         self._lock = threading.RLock()

@@ -13,7 +13,10 @@ import threading
 import time
 from collections import defaultdict
 
+from nos_tpu.utils.guards import guarded_by
 
+
+@guarded_by("_lock", "_counters", "_gauges", "_timers", "_help")
 class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()

@@ -19,6 +19,8 @@ import threading
 from collections import defaultdict
 from typing import Any, Callable
 
+from nos_tpu.utils.guards import guarded_by
+
 from .objects import ConfigMap, Node, Pod
 
 WatchFn = Callable[[str, Any], None]  # (event_type, object) — "ADDED"/"MODIFIED"/"DELETED"
@@ -251,6 +253,7 @@ class APIServer:
         return self.list("Pod", filter_fn=lambda p: p.spec.node_name == node_name)
 
 
+@guarded_by("_lock", "_store")
 class Informer:
     """Watch-maintained local store of one kind — the client-go shared
     informer analog over the watch bus.

@@ -19,6 +19,7 @@ Three layers over the scheduler ↔ partitioner ↔ actuator pipeline
 from __future__ import annotations
 
 import contextlib
+from typing import Iterator
 
 from .explain import explain_plan, explain_pod
 from .journal import (
@@ -53,7 +54,7 @@ def flight_snapshot() -> dict:
 
 @contextlib.contextmanager
 def scoped(tracer: Tracer | None = None,
-           journal: DecisionJournal | None = None):
+           journal: DecisionJournal | None = None) -> Iterator[None]:
     """Install a tracer/journal pair for the duration of the block and
     restore the previous pair on exit — how tests (and the lockcheck-
     instrumented chaos soak) observe an isolated run without leaking

@@ -22,18 +22,22 @@ import sys
 from . import explain_plan, explain_pod
 
 
-def _load_snapshot(args) -> dict:
+def _load_snapshot(args: argparse.Namespace) -> dict:
+    snapshot: dict
     if args.url:
         from urllib.request import urlopen
 
         url = args.url.rstrip("/") + "/debug/flightrecorder"
         with urlopen(url, timeout=10.0) as resp:   # noqa: S310 — operator URL
-            return json.load(resp)
+            snapshot = json.load(resp)
+            return snapshot
     if args.snapshot == "-":
-        return json.load(sys.stdin)
+        snapshot = json.load(sys.stdin)
+        return snapshot
     if args.snapshot:
         with open(args.snapshot, encoding="utf-8") as fh:
-            return json.load(fh)
+            snapshot = json.load(fh)
+            return snapshot
     raise SystemExit(
         "no snapshot source: pass --snapshot FILE (or '-') or --url ADDR "
         "(the health server serves /debug/flightrecorder)")

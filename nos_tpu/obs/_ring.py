@@ -13,7 +13,10 @@ import json
 import threading
 from collections import deque
 
+from nos_tpu.utils.guards import guarded_by
 
+
+@guarded_by("_lock", "_items", "_dropped")
 class BoundedRing:
     """Lock-guarded ``deque(maxlen)`` with an eviction counter.
 
@@ -30,7 +33,7 @@ class BoundedRing:
         self._items: deque = deque(maxlen=maxlen)
         self._dropped = 0
 
-    def _push_locked(self, item) -> bool:
+    def _push_locked(self, item: object) -> bool:
         """Append (caller holds ``self._lock``); True if one evicted."""
         evicted = len(self._items) == self.maxlen
         if evicted:

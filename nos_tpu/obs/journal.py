@@ -35,6 +35,7 @@ import time
 from typing import Callable
 
 from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.utils.guards import guarded_by
 
 from ._ring import BoundedRing
 from .trace import current_span
@@ -101,8 +102,10 @@ class DecisionRecord:
         }
 
 
+@guarded_by("_lock", "_seq")
 class DecisionJournal(BoundedRing):
-    """Bounded, totally-ordered (per journal) decision log."""
+    """Bounded, totally-ordered (per journal) decision log.  Extends the
+    ring's @guarded_by table with the sequence counter (same lock)."""
 
     def __init__(self, maxlen: int = 4096,
                  clock: Callable[[], float] = time.monotonic) -> None:
@@ -111,7 +114,7 @@ class DecisionJournal(BoundedRing):
         self._seq = 0
 
     def record(self, category: str, subject: str,
-               **attrs) -> DecisionRecord:
+               **attrs: object) -> DecisionRecord:
         """Append one decision; never raises, never blocks beyond the
         leaf append lock.  Returns the record (tests assert on it)."""
         span = current_span()
@@ -164,6 +167,6 @@ def set_journal(journal: DecisionJournal) -> DecisionJournal:
     return prev
 
 
-def record(category: str, subject: str, **attrs) -> DecisionRecord:
+def record(category: str, subject: str, **attrs: object) -> DecisionRecord:
     """Record a decision in the process journal — THE call-site API."""
     return _journal.record(category, subject, **attrs)

@@ -39,6 +39,7 @@ from __future__ import annotations
 import contextvars
 import itertools
 import time
+from types import TracebackType
 from typing import Callable
 
 from nos_tpu.exporter.metrics import REGISTRY
@@ -82,7 +83,7 @@ class Span:
     def duration(self) -> float | None:
         return None if self.end is None else self.end - self.start
 
-    def set(self, key: str, value) -> None:
+    def set(self, key: str, value: object) -> None:
         self.attrs[key] = value
 
     def bump(self, key: str, n: int = 1) -> None:
@@ -130,7 +131,9 @@ class _SpanHandle:
         self._token = _current.set(self._span)
         return self._span
 
-    def __exit__(self, exc_type, exc, tb) -> bool:
+    def __exit__(self, exc_type: type[BaseException] | None,
+                 exc: BaseException | None,
+                 tb: TracebackType | None) -> bool:
         _current.reset(self._token)
         span = self._span
         span.end = self._tracer.clock()
@@ -147,10 +150,10 @@ class _NoopHandle:
 
     __slots__ = ()
 
-    def __enter__(self):
+    def __enter__(self) -> None:
         return None
 
-    def __exit__(self, *exc) -> bool:
+    def __exit__(self, *exc: object) -> bool:
         return False
 
 
@@ -180,7 +183,7 @@ class Tracer:
         # same chaos seed (count.__next__ is GIL-atomic, like the clock)
         self._ids = itertools.count(1)
 
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: object) -> "_SpanHandle | _NoopHandle":
         """Open a span as the child of the ambient span (if any)."""
         if not self.enabled:
             return _NOOP
@@ -195,7 +198,8 @@ class Tracer:
                     self.clock(), attrs or None)
         return _SpanHandle(self, span)
 
-    def detail_span(self, name: str, **attrs):
+    def detail_span(self, name: str,
+                    **attrs: object) -> "_SpanHandle | _NoopHandle":
         """A real child span in detailed mode; one counter bump on the
         enclosing span otherwise (hot-loop instrumentation)."""
         if self.detailed and self.enabled:
@@ -226,13 +230,13 @@ def set_tracer(tracer: Tracer) -> Tracer:
     return prev
 
 
-def span(name: str, **attrs):
+def span(name: str, **attrs: object) -> "_SpanHandle | _NoopHandle":
     """`with span("scheduler.run_cycle", pods=n) as sp:` — the module-
     level convenience over the current process tracer."""
     return _tracer.span(name, **attrs)
 
 
-def detail_span(name: str, **attrs):
+def detail_span(name: str, **attrs: object) -> "_SpanHandle | _NoopHandle":
     return _tracer.detail_span(name, **attrs)
 
 

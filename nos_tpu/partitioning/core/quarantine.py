@@ -35,6 +35,7 @@ from typing import Callable
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.utils.guards import guarded_by
 
 logger = logging.getLogger(__name__)
 
@@ -53,10 +54,13 @@ REGISTRY.describe("nos_tpu_actuation_breaker_open_total",
                   "Actuation circuit-breaker openings (failure streaks)")
 
 
+@guarded_by("_lock", "_quarantined", "_streaks", "_probe_until")
 class QuarantineList:
     """Thread-safe quarantine set + per-node failure streaks, shared by
     the partitioner controller (deadline path) and the actuator (circuit
-    breaker path) of one partitioning kind."""
+    breaker path) of one partitioning kind.  The membership/streak maps
+    are @guarded_by the list's lock — certified by noslint N010 and the
+    lockcheck'd chaos soak."""
 
     def __init__(self, kind: str = "",
                  failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,

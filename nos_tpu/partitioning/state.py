@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from nos_tpu.api import constants as C
 from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.scheduler.framework import NodeInfo
+from nos_tpu.utils.guards import guarded_by
 
 # ---------------------------------------------------------------------------
 # Desired state
@@ -66,9 +67,11 @@ class PartitioningState(dict):
 # ---------------------------------------------------------------------------
 
 
+@guarded_by("_lock", "_nodes", "_node_pods", "_partitioning_counts")
 class ClusterState:
     """Thread-safe view of nodes + pod bindings, maintained by the node/pod
-    controllers; the partitioner snapshots it per batch."""
+    controllers; the partitioner snapshots it per batch.  The maps are
+    @guarded_by the state lock (noslint N010 + lockcheck certify it)."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -81,9 +84,9 @@ class ClusterState:
         with self._lock:
             old = self._nodes.get(node.name)
             if old is not None:
-                self._bump_kind(old, -1)
+                self._bump_kind_locked(old, -1)
             self._nodes[node.name] = node
-            self._bump_kind(node, +1)
+            self._bump_kind_locked(node, +1)
             if pods is not None:
                 self._node_pods[node.name] = {p.key: p for p in pods}
             else:
@@ -93,10 +96,12 @@ class ClusterState:
         with self._lock:
             node = self._nodes.pop(name, None)
             if node is not None:
-                self._bump_kind(node, -1)
+                self._bump_kind_locked(node, -1)
             self._node_pods.pop(name, None)
 
-    def _bump_kind(self, node: Node, delta: int) -> None:
+    # the _locked suffix is load-bearing: noslint N010 certifies that
+    # every caller of a *_locked helper already holds the state lock
+    def _bump_kind_locked(self, node: Node, delta: int) -> None:
         kind = node.metadata.labels.get(C.LABEL_PARTITIONING, "")
         if kind:
             self._partitioning_counts[kind] = (

@@ -31,13 +31,20 @@ from __future__ import annotations
 
 import threading
 
-from nos_tpu.kube.client import Informer, KIND_NODE, KIND_POD
-from nos_tpu.kube.objects import PENDING, Pod, RUNNING
+from nos_tpu.kube.client import APIServer, Informer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import Node, PENDING, Pod, RUNNING
 from nos_tpu.scheduler.framework import NodeInfo, SharedLister
+from nos_tpu.utils.guards import guarded_by
 
 
+@guarded_by("_lock", "_node_objs", "_pods_by_node", "_pod_node",
+            "_gen", "_built")
 class SchedulerCache:
-    def __init__(self, api) -> None:
+    """Every index is written on watch fan-out threads AND read by the
+    scheduling loop: the @guarded_by declaration is checked statically
+    (noslint N010) and at soak time (lockcheck.guard_state)."""
+
+    def __init__(self, api: APIServer) -> None:
         self._lock = threading.Lock()
         # node objects live in the cache's OWN index, written in the
         # same critical section as the generation bump: snapshot() must
@@ -64,10 +71,12 @@ class SchedulerCache:
                               store=False)
 
     # -- watch handlers (fire on the API server's synchronous bus) ----------
-    def _bump(self, node_name: str) -> None:
+    # the _locked suffix is load-bearing: noslint N010 certifies
+    # that every caller already holds the cache lock
+    def _bump_locked(self, node_name: str) -> None:
         self._gen[node_name] = self._gen.get(node_name, 0) + 1
 
-    def _on_node(self, event: str, node) -> None:
+    def _on_node(self, event: str, node: Node) -> None:
         name = node.metadata.name
         with self._lock:
             if event == "DELETED":
@@ -75,7 +84,7 @@ class SchedulerCache:
                 self._built.pop(name, None)
             else:
                 self._node_objs[name] = node
-            self._bump(name)
+            self._bump_locked(name)
 
     def _on_pod(self, event: str, pod: Pod) -> None:
         key = pod.key
@@ -87,12 +96,12 @@ class SchedulerCache:
                                      or prev != pod.spec.node_name):
                 self._pods_by_node.get(prev, {}).pop(key, None)
                 del self._pod_node[key]
-                self._bump(prev)
+                self._bump_locked(prev)
             if tracked:
                 node_name = pod.spec.node_name
                 self._pods_by_node.setdefault(node_name, {})[key] = pod
                 self._pod_node[key] = node_name
-                self._bump(node_name)
+                self._bump_locked(node_name)
 
     def assume(self, pod: Pod) -> None:
         """Book a just-bound pod straight into the cache indexes.
@@ -109,7 +118,7 @@ class SchedulerCache:
         with self._lock:
             self._pods_by_node.setdefault(node_name, {})[pod.key] = pod
             self._pod_node[pod.key] = node_name
-            self._bump(node_name)
+            self._bump_locked(node_name)
 
     # -- the per-cycle snapshot ---------------------------------------------
     def snapshot(self) -> SharedLister:

@@ -24,6 +24,7 @@ counts a CEQ's min N times for N namespaces — elasticquotainfo.go:154-174).
 from __future__ import annotations
 
 import logging
+from typing import Any
 
 from nos_tpu.api import constants as C
 from nos_tpu.exporter.metrics import REGISTRY
@@ -110,7 +111,8 @@ def _spec_unchanged(old: ElasticQuotaInfo, new: ElasticQuotaInfo) -> bool:
             and old.max == new.max and old.max_enforced == new.max_enforced)
 
 
-def info_from_quota(obj, calculator, composite: bool = False) -> ElasticQuotaInfo:
+def info_from_quota(obj: Any, calculator: TPUResourceCalculator,
+                    composite: bool = False) -> ElasticQuotaInfo:
     """Build the ledger entry for an ElasticQuota/CompositeElasticQuota
     (the informer's mapping, reference informer.go:139-260)."""
     return ElasticQuotaInfo(
@@ -161,7 +163,7 @@ class CapacityScheduling:
         api.watch(KIND_COMPOSITE_ELASTIC_QUOTA, self._on_ceq_event)
         api.watch(KIND_POD, self._on_pod_event)
 
-    def _on_eq_event(self, event: str, eq) -> None:
+    def _on_eq_event(self, event: str, eq: Any) -> None:
         # A namespace covered by a composite quota is shadowed by it
         # (reference informer.go:139-260).
         ns = eq.metadata.namespace
@@ -182,7 +184,7 @@ class CapacityScheduling:
             self.elastic_quota_infos.add(new)
         self._recount(new)
 
-    def _on_ceq_event(self, event: str, ceq) -> None:
+    def _on_ceq_event(self, event: str, ceq: Any) -> None:
         new = info_from_quota(ceq, self.calculator, composite=True)
         existing = None
         for info in self.elastic_quota_infos.values():
@@ -409,7 +411,8 @@ class CapacityScheduling:
         return out
 
     @staticmethod
-    def _candidate_key(cand: tuple[str, list[Pod], int]):
+    def _candidate_key(
+            cand: tuple[str, list[Pod], int]) -> tuple[int, int, int, int, str]:
         """Node choice mirrors upstream pickOneNodeForPreemption: fewest PDB
         violations, lowest max victim priority, lowest priority sum, fewest
         victims, then name."""

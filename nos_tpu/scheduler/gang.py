@@ -23,11 +23,14 @@ keeping the gang's collectives on ICI rather than DCN.
 from __future__ import annotations
 
 import logging
+from typing import Any
 
 from nos_tpu.api import constants as C
 from nos_tpu.kube.client import APIServer, KIND_POD_GROUP, NotFound
 from nos_tpu.kube.objects import Pod
-from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.scheduler.framework import (
+    CycleState, NodeInfo, SharedLister, Status,
+)
 from nos_tpu.topology.shape import Shape
 
 logger = logging.getLogger(__name__)
@@ -39,16 +42,16 @@ def gang_name(pod: Pod) -> str:
     return pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
 
 
-def get_pod_group(api: APIServer, name: str, namespace: str):
+def get_pod_group(api: APIServer, name: str, namespace: str) -> Any:
     try:
         return api.get(KIND_POD_GROUP, name, namespace)
     except NotFound:
         return None
 
 
-def set_pod_group_status(api: APIServer, pg, phase: str,
+def set_pod_group_status(api: APIServer, pg: Any, phase: str,
                          scheduled: int) -> None:
-    def mutate(o) -> None:
+    def mutate(o: Any) -> None:
         o.status.phase = phase
         o.status.scheduled = scheduled
 
@@ -61,7 +64,7 @@ def set_pod_group_status(api: APIServer, pg, phase: str,
         pass
 
 
-def requested_mesh_chips(pg) -> int | None:
+def requested_mesh_chips(pg: Any) -> int | None:
     """Chip count implied by the PodGroup's mesh shape, if any."""
     if pg is None or not pg.spec.mesh:
         return None
@@ -153,7 +156,8 @@ class TopologyFilter:
     def __init__(self, api: APIServer) -> None:
         self._api = api
 
-    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def pre_filter(self, state: CycleState, pod: Pod,
+                   nodes: SharedLister) -> Status:
         gang = gang_name(pod)
         if not gang:
             return Status.ok()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 import logging
 from collections import Counter
+from typing import Any, Callable
 
 from nos_tpu.api.constants import (
     ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
@@ -62,7 +63,7 @@ def _gen_window_sizes(accel: str) -> tuple[int, ...]:
 
 
 @functools.lru_cache(maxsize=64)
-def _window_sizes_of(gen) -> tuple[int, ...]:
+def _window_sizes_of(gen: Any) -> tuple[int, ...]:
     return tuple(sorted({gen.hosts_for(s) for s in gen.multihost_shapes()}))
 
 
@@ -96,10 +97,13 @@ class Scheduler:
                  drain_preempt_after_cycles: int | None = None,
                  drain_preempt_max_busy_fraction: float = 0.25,
                  drain_preempt_spare_progress: float = 0.75,
-                 drain_preempt_progress_fn=None,
+                 drain_preempt_progress_fn: Callable[
+                     [Pod], float | None] | None = None,
                  preempt_budget_per_cycle: int = 2,
-                 backfill_remaining_fn=None,
-                 backfill_duration_fn=None) -> None:
+                 backfill_remaining_fn: Callable[
+                     [Pod], float | None] | None = None,
+                 backfill_duration_fn: Callable[
+                     [Pod], float | None] | None = None) -> None:
         self._api = api
         self._framework = framework
         self.name = name
@@ -297,7 +301,7 @@ class Scheduler:
         self._assume_bound(pod, chosen.name)
         return chosen.name
 
-    def _filter_equiv_key(self, pod: Pod):
+    def _filter_equiv_key(self, pod: Pod) -> tuple | None:
         """Per-cycle Filter equivalence class (the shared
         framework.filter_equivalence_key).  Gang members are never
         cached here: pins in cycle state change the TopologyFilter
@@ -308,7 +312,7 @@ class Scheduler:
         return filter_equivalence_key(pod)
 
     def _filter_passes(self, state: CycleState, pod: Pod, ni: NodeInfo,
-                       equiv) -> tuple[bool, str]:
+                       equiv: tuple | None) -> tuple[bool, str]:
         """(verdict, why): why is "plugin: message" on rejection, "" on
         success — the journal's per-node provenance, carried through the
         memo so cache hits keep their reason."""
@@ -424,7 +428,7 @@ class Scheduler:
     # the rejection re-records each cycle while the claimant waits.
 
     def _record_quota_hol(self, pod: Pod,
-                          total_request=None) -> None:
+                          total_request: dict | None = None) -> None:
         ns = pod.metadata.namespace
         # Unsatisfiability guard: a claimant whose request ALONE can
         # never pass the quota gates — it exceeds its namespace max, or
@@ -784,7 +788,7 @@ class Scheduler:
         # N: one cycle to adopt the lease, one to arm the counter).
         self._drain_cycles = 0
 
-    def _order_gang_windows(self, windows):
+    def _order_gang_windows(self, windows: list) -> list:
         """Order candidate windows so the FIRST one that fits is also the
         best citizen: windows overlapping the drain lease come last (a
         smaller gang binding into the window a stuck larger gang is
@@ -793,7 +797,7 @@ class Scheduler:
         -busy super-windows) was measured as well and LOST on the
         v5e-256 trace (seed-0 utilization -5 points) — see
         scripts/diag_gang.py for the experiment harness."""
-        def key(item):
+        def key(item: tuple) -> int:
             _, hosts = item
             if hosts is None:
                 return 0
@@ -802,7 +806,9 @@ class Scheduler:
         return sorted(windows, key=key)
 
     def _attempt_gang(self, pins: dict, base: SharedLister,
-                      members: list[Pod]):
+                      members: list[Pod]) -> tuple[
+                          list[tuple[Pod, str]], CycleState, Any,
+                          Pod | None]:
         """Simulate placing the whole gang in one pinned domain over
         clones of the base snapshot.  Returns (placements, state, domain,
         stuck): placements is complete on success; `stuck` is the first
@@ -840,7 +846,7 @@ class Scheduler:
             placements.append((pod, chosen))
         return placements, state, domain, None
 
-    def _gang_total_request(self, members: list[Pod]):
+    def _gang_total_request(self, members: list[Pod]) -> dict | None:
         """Aggregate quota request of a gang, in the capacity plugin's
         currency; None when no capacity plugin is registered."""
         if self._capacity is None:
@@ -959,7 +965,8 @@ class Scheduler:
         return None
 
     # -- internals ----------------------------------------------------------
-    def _reserve_gang_window(self, gang_key: tuple[str, str], windows,
+    def _reserve_gang_window(self, gang_key: tuple[str, str],
+                             windows: list,
                              base: SharedLister) -> None:
         """A stuck multi-host gang leases its most drained candidate
         window (max free chip-equivalents = least left to wait for),
@@ -1002,7 +1009,7 @@ class Scheduler:
             if has == want:
                 continue
 
-            def mutate(n):
+            def mutate(n: Any) -> None:
                 if want:
                     n.metadata.annotations[C_ANNOT_GANG_LEASE] = want
                 else:
@@ -1040,7 +1047,9 @@ class Scheduler:
         return _gen_window_sizes(
             ni.node.metadata.labels.get(C_LABEL_ACCELERATOR, ""))
 
-    def _score_key(self, pod: Pod, lister: SharedLister | None = None):
+    def _score_key(self, pod: Pod,
+                   lister: SharedLister | None = None
+                   ) -> Callable[[NodeInfo], tuple]:
         """Least-requested on the pod's own resources: packs TPU profiles
         tightly (utilization).  Equal-headroom ties prefer hosts whose
         aligned multi-host windows are already broken — placing a
@@ -1073,7 +1082,7 @@ class Scheduler:
                     pen += size  # breaking a whole free window of `size`
             return pen
 
-        def key(ni: NodeInfo):
+        def key(ni: NodeInfo) -> tuple:
             free = ni.free()
             headroom = sum(free.get(r, 0.0) for r in req)
             try:
@@ -1088,7 +1097,7 @@ class Scheduler:
 
         return key
 
-    def _patch_pod(self, pod: Pod, mutate) -> bool:
+    def _patch_pod(self, pod: Pod, mutate: Callable[[Any], None]) -> bool:
         """A pod can vanish between this cycle's LIST and the patch —
         deleted by a user, a controller, or this very cycle's drain
         preemption (whole-gang amplification can doom a pod that is

@@ -365,21 +365,42 @@ def unguard_all() -> None:
 
 
 def guard_state(obj: object, graph: LockGraph,
-                lock_attr: str = "_lock", name: str = "") -> object:
+                lock_attr: str = "_lock", name: str = "",
+                use_annotations: bool = True) -> object:
     """Enforce "writes only with the owning lock held" on ``obj``.
 
-    The object's ``lock_attr`` is replaced with a :class:`CheckedRLock`
-    (so 'held by me' is answerable) and the class's ``__setattr__`` is
-    wrapped once: any later attribute write on a guarded instance
-    without its lock held is recorded on the graph.  Reads stay free —
-    the contract this enforces is SharedState's (every mutator takes
-    ``self._lock``), not full atomicity."""
-    label = name or f"{type(obj).__name__}.{lock_attr}"
-    checked = graph.lock(label, reentrant=True)
-    object.__setattr__(obj, lock_attr, checked)
-    _GUARDED[obj] = (graph, lock_attr)
+    Two contract sources, in priority order:
 
+    1. **@guarded_by annotations** (nos_tpu/utils/guards.py): when the
+       class carries a ``__guarded_by__`` table, THAT is the contract —
+       each declared lock attribute is replaced with a
+       :class:`CheckedRLock` and only writes to the *declared* fields
+       are judged (against their declared lock).  This is the same
+       table noslint N010 checks statically: one annotation, both
+       proofs.  Pass ``use_annotations=False`` to ignore it.
+    2. **legacy whole-object mode**: no annotation — ``lock_attr`` is
+       replaced and EVERY field write without it is convicted (the
+       original PR 2 behavior, still right for ad-hoc test doubles).
+
+    The class's ``__setattr__`` is wrapped once either way.  Reads stay
+    free — the contract is "every mutator takes the lock", not full
+    atomicity."""
     cls = type(obj)
+    table: dict[str, str] = {}
+    if use_annotations:
+        table = dict(getattr(cls, "__guarded_by__", {}) or {})
+    if table:
+        for la in sorted(set(table.values())):
+            label = (f"{name}.{la}" if name
+                     else f"{cls.__name__}.{la}")
+            object.__setattr__(obj, la, graph.lock(label, reentrant=True))
+        _GUARDED[obj] = (graph, table)
+    else:
+        label = name or f"{cls.__name__}.{lock_attr}"
+        object.__setattr__(obj, lock_attr,
+                           graph.lock(label, reentrant=True))
+        _GUARDED[obj] = (graph, lock_attr)
+
     if cls not in _PATCHED_CLASSES:
         original = cls.__setattr__
         _PATCHED_CLASSES[cls] = original
@@ -390,12 +411,17 @@ def guard_state(obj: object, graph: LockGraph,
             # the setter body runs AFTER this interception, so judge the
             # raw field write it performs (which recurses through here)
             # rather than the not-yet-locked property assignment.
-            if entry is not None and attr != entry[1] \
-                    and not entry[0]._closed \
+            if entry is not None and not entry[0]._closed \
                     and not hasattr(getattr(type(self), attr, None),
                                     "__set__"):
-                g, la = entry
-                lock = self.__dict__.get(la)
+                g, contract = entry
+                if isinstance(contract, dict):
+                    # annotated: only declared fields, per-field lock
+                    la = contract.get(attr)
+                else:
+                    # legacy: every field except the lock itself
+                    la = contract if attr != contract else None
+                lock = self.__dict__.get(la) if la is not None else None
                 if isinstance(lock, CheckedLock) \
                         and not lock.held_by_current_thread():
                     g.unguarded_writes.append(

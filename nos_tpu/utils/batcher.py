@@ -14,9 +14,12 @@ import threading
 import time
 from typing import Callable, Generic, TypeVar
 
+from nos_tpu.utils.guards import guarded_by
+
 T = TypeVar("T")
 
 
+@guarded_by("_lock", "_items", "_first_add", "_last_add")
 class Batcher(Generic[T]):
     def __init__(self, timeout_s: float, idle_s: float,
                  clock: Callable[[], float] = time.monotonic) -> None:

@@ -1,0 +1,68 @@
+"""``@guarded_by``: declared lock ownership for shared mutable state.
+
+The decision plane's thread-safety today rests on conventions written in
+comments ("every mutator takes ``self._lock``").  This decorator makes
+the convention a *declaration* that two independent checkers read:
+
+- **statically** — noslint N010 (nos_tpu/analysis/rules_flow.py) proves
+  every write site of a declared field sits syntactically under
+  ``with self.<lock>:`` (or inside a ``*_locked`` method, whose call
+  sites must themselves hold the lock);
+- **dynamically** — ``nos_tpu.testing.lockcheck.guard_state`` reads the
+  same ``__guarded_by__`` table and convicts any runtime write to a
+  declared field without its lock held, under the chaos soak.
+
+One annotation, two proofs — the same contract PR 2 split between
+comments and ``guard_state`` call-site arguments.
+
+Usage::
+
+    @guarded_by("_lock", "_nodes", "_gen", "_built")
+    class SchedulerCache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            ...
+
+Stacking is allowed for classes with more than one lock; each field
+belongs to exactly one lock (re-declaring a field raises at import
+time — the table must be unambiguous for both checkers).  The lock and
+field names must be string literals: N010 checks them without running
+the code.  Subclasses inherit the parent's table and may extend it
+(``DecisionJournal`` adds ``_seq`` to ``BoundedRing``'s ``_items``).
+
+Runtime cost: one class attribute.  The decorator changes no behavior.
+"""
+
+from __future__ import annotations
+
+from typing import TypeVar
+
+T = TypeVar("T", bound=type)
+
+
+def guarded_by(lock_attr: str, *fields: str):
+    """Declare that ``fields`` may only be written with ``self.<lock_attr>``
+    held.  Returns a class decorator; see the module docstring."""
+    if not lock_attr or not fields or not all(fields):
+        raise ValueError("guarded_by needs a lock attribute name and "
+                         "at least the fields it guards")
+
+    def decorate(cls: T) -> T:
+        # copy-on-extend: never mutate a base class's table in place
+        table = dict(getattr(cls, "__guarded_by__", {}))
+        for f in fields:
+            prior = table.get(f)
+            if prior is not None and prior != lock_attr:
+                raise ValueError(
+                    f"{cls.__name__}.{f} declared guarded by both "
+                    f"{prior!r} and {lock_attr!r} — one lock per field")
+            table[f] = lock_attr
+        cls.__guarded_by__ = table
+        return cls
+
+    return decorate
+
+
+def guarded_fields(cls: type) -> dict[str, str]:
+    """The declared field -> lock-attribute table ({} when undeclared)."""
+    return dict(getattr(cls, "__guarded_by__", {}))

@@ -17,16 +17,19 @@ FAST=0
 
 rc=0
 
-echo "==> noslint (python -m nos_tpu.analysis)"
+# Result cache (.noslint_cache/, content-hashed + rule-versioned) keeps
+# the dataflow rules fast on unchanged files; --no-cache to bypass.
+echo "==> noslint (python -m nos_tpu.analysis, rules N001-N010)"
 if ! python -m nos_tpu.analysis; then
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/)"
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/)"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
-            nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils; then
+            nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
+            nos_tpu/scheduler nos_tpu/obs; then
         rc=1
     fi
 else

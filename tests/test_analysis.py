@@ -51,10 +51,11 @@ class TestTreeIsClean:
         assert report.ok, f"noslint violations:\n{rendered}"
 
     def test_cli_exits_zero_and_lists_rules(self, capsys):
-        assert noslint_main([PACKAGE]) == 0
+        assert noslint_main([PACKAGE, "--no-cache"]) == 0
         assert noslint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rule_id in ("N001", "N002", "N003", "N004", "N005", "N006"):
+        for rule_id in ("N001", "N002", "N003", "N004", "N005", "N006",
+                        "N007", "N008", "N009", "N010"):
             assert rule_id in out
 
     def test_every_suppression_carries_a_reason(self):
@@ -354,6 +355,242 @@ class TestN006:
             "  # noslint: N006 — re-export for readers\n"
         )
         assert lint_source(src, [NameHygiene()]) == []
+
+
+# ---------------------------------------------------------------------------
+# --fix: mechanical autofixes (fix.py)
+# ---------------------------------------------------------------------------
+
+class TestAutofix:
+    FIXTURE = (
+        "import os\n"
+        "import sys, json\n"
+        "from typing import (\n"
+        "    Any,\n"
+        "    Callable,\n"
+        ")\n"
+        "\n"
+        "def f(api, cm):\n"
+        "    # noslint: N001\n"
+        "    api.update('ConfigMap', cm)\n"
+        "    print(sys.argv, json.dumps({}))\n"
+        "    x: Any = 1\n"
+        "    return x\n"
+    )
+
+    def _write(self, tmp_path):
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        target = pkg / "mod.py"
+        target.write_text(self.FIXTURE)
+        return target
+
+    def test_fixes_unused_imports_and_naked_pragmas(self, tmp_path):
+        from nos_tpu.analysis.fix import fix_file
+
+        target = self._write(tmp_path)
+        fixes = fix_file(str(target), str(tmp_path))
+        text = target.read_text()
+        assert "import os" not in text
+        assert "Callable" not in text
+        assert "import sys, json" in text          # used names survive
+        assert "from typing import Any" in text
+        assert "noslint" not in text               # naked pragma removed
+        assert len(fixes) == 3
+        # the fixed file still parses and the suppressed finding
+        # re-surfaced (the pragma was hiding a real N001)
+        import ast as _ast
+        _ast.parse(text)
+        v = lint_source(text, [RetryWrappedWrites()])
+        assert rules_of(v) == ["N001"]
+
+    def test_idempotent(self, tmp_path):
+        from nos_tpu.analysis.fix import fix_file
+
+        target = self._write(tmp_path)
+        fix_file(str(target), str(tmp_path))
+        once = target.read_text()
+        assert fix_file(str(target), str(tmp_path)) == []
+        assert target.read_text() == once
+
+    def test_suppressed_unused_import_not_fixed(self, tmp_path):
+        from nos_tpu.analysis.fix import fix_file
+
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        target = pkg / "mod.py"
+        target.write_text(
+            "from .state import Thing"
+            "  # noslint: N006 — re-export for readers\n")
+        assert fix_file(str(target), str(tmp_path)) == []
+        assert "Thing" in target.read_text()
+
+    def test_naked_pragma_over_unused_import_converges_in_one_run(
+            self, tmp_path):
+        """A naked pragma suppressing an auto-fixable N006: the pragma
+        fixer runs first, so the re-surfaced unused import is removed in
+        the SAME run — the opposite order needed two runs, breaking the
+        idempotency contract."""
+        from nos_tpu.analysis.fix import fix_file
+
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        target = pkg / "mod.py"
+        target.write_text("import os  # noslint: N006\nx = 1\n")
+        fixes = fix_file(str(target), str(tmp_path))
+        assert len(fixes) == 2            # pragma gone AND import gone
+        assert "import os" not in target.read_text()
+        assert fix_file(str(target), str(tmp_path)) == []
+
+    def test_partial_rewrite_never_destroys_comments(self, tmp_path):
+        """A partial import rewrite goes through ast.unparse, which
+        would erase comments on the SURVIVING aliases — including an
+        audited `# noslint` pragma for another rule.  Such statements
+        are skipped (the N006 finding stays for a human); an import
+        removed WHOLE still goes, comments and all."""
+        from nos_tpu.analysis.fix import fix_file
+
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        target = pkg / "mod.py"
+        original = (
+            "from typing import (\n"
+            "    Any,  # load-bearing comment about Any\n"
+            "    Callable,\n"
+            ")\n"
+            "import os  # goes with the whole statement\n"
+            "\n"
+            "x: Any = 1\n"
+        )
+        target.write_text(original)
+        fixes = fix_file(str(target), str(tmp_path))
+        text = target.read_text()
+        # Callable is still unused but untouchable without eating the
+        # comment; os was removed whole, its trailing comment with it
+        assert "load-bearing comment" in text
+        assert "Callable" in text
+        assert "import os" not in text
+        assert len(fixes) == 1
+        # skipping is stable: a second run changes nothing
+        assert fix_file(str(target), str(tmp_path)) == []
+        assert target.read_text() == text
+
+    def test_cli_fix_skips_unparsable_file_and_keeps_sweeping(
+            self, tmp_path, capsys):
+        """fix_file raises SyntaxError on an unparsable file; the CLI
+        loop must skip-and-report it (the lint pass downgrades it to an
+        N000 finding) instead of dying with a traceback mid-sweep."""
+        from nos_tpu.analysis.__main__ import main
+        from nos_tpu.analysis.fix import fix_file
+
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        broken = pkg / "broken.py"
+        broken.write_text("def oops(:\n")
+        with pytest.raises(SyntaxError):
+            fix_file(str(broken), str(tmp_path))
+        rc = main(["--fix", "--no-cache", str(broken)])
+        captured = capsys.readouterr()
+        assert rc == 1                      # reported as a finding...
+        assert "syntax error" in captured.out
+        assert "skip (syntax error)" in captured.err   # ...not a crash
+        assert "Traceback" not in captured.err
+
+
+# ---------------------------------------------------------------------------
+# .noslint_cache/: the per-file result cache (cache.py)
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    def _cache(self, tmp_path):
+        from nos_tpu.analysis.cache import ResultCache, rules_signature
+
+        return ResultCache(
+            str(tmp_path),
+            rules_signature([r.id for r in default_rules()]))
+
+    def _tree(self, tmp_path):
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir(exist_ok=True)
+        a = pkg / "a.py"
+        b = pkg / "b.py"
+        a.write_text("import os\n")                # N006 unused import
+        b.write_text("x = 1\n")
+        return a, b
+
+    def test_hit_serves_identical_results(self, tmp_path):
+        a, b = self._tree(tmp_path)
+        cache = self._cache(tmp_path)
+        cold = run(default_rules(), [str(tmp_path / "nos_tpu")],
+                   root=str(tmp_path), cache=cache)
+        assert cache.misses == 2 and cache.hits == 0
+        cache2 = self._cache(tmp_path)
+        warm = run(default_rules(), [str(tmp_path / "nos_tpu")],
+                   root=str(tmp_path), cache=cache2)
+        assert cache2.hits == 2 and cache2.misses == 0
+        assert [v.render() for v in warm.violations] == \
+            [v.render() for v in cold.violations]
+        assert rules_of(cold.violations) == ["N006"]
+
+    def test_content_change_invalidates_that_file_only(self, tmp_path):
+        a, b = self._tree(tmp_path)
+        run(default_rules(), [str(tmp_path / "nos_tpu")],
+            root=str(tmp_path), cache=self._cache(tmp_path))
+        a.write_text("import os\nprint(os.sep)\n")     # now used
+        cache = self._cache(tmp_path)
+        rep = run(default_rules(), [str(tmp_path / "nos_tpu")],
+                  root=str(tmp_path), cache=cache)
+        assert cache.hits == 1 and cache.misses == 1   # only a.py re-ran
+        assert rep.ok
+
+    def test_readonly_checkout_degrades_to_cacheless(self, tmp_path,
+                                                     monkeypatch):
+        """A checkout where .noslint_cache/ cannot be created must lint
+        normally, not die — put() swallows the makedirs failure too."""
+        import os as _os
+
+        self._tree(tmp_path)
+
+        def deny(*a, **k):
+            raise PermissionError("read-only filesystem")
+
+        monkeypatch.setattr(_os, "makedirs", deny)
+        cache = self._cache(tmp_path)
+        rep = run(default_rules(), [str(tmp_path / "nos_tpu")],
+                  root=str(tmp_path), cache=cache)
+        assert rules_of(rep.violations) == ["N006"]   # linted fine
+        assert cache.hits == 0                        # and cached nothing
+
+    def test_rules_signature_change_invalidates_everything(self, tmp_path):
+        from nos_tpu.analysis.cache import ResultCache
+
+        self._tree(tmp_path)
+        run(default_rules(), [str(tmp_path / "nos_tpu")],
+            root=str(tmp_path), cache=self._cache(tmp_path))
+        stale = ResultCache(str(tmp_path), "different-signature")
+        run(default_rules(), [str(tmp_path / "nos_tpu")],
+            root=str(tmp_path), cache=stale)
+        assert stale.misses == 2 and stale.hits == 0
+
+    def test_cross_file_rules_bypass_the_cache(self, tmp_path):
+        """N003's verdict about b.py moves when a.py changes — a cached
+        b.py entry must not pin the stale verdict."""
+        pkg = tmp_path / "nos_tpu"
+        pkg.mkdir()
+        a = pkg / "a.py"
+        b = pkg / "b.py"
+        a.write_text("REGISTRY = object()\n"
+                     "REGISTRY.describe('nos_tpu_x_total', 'help')\n")
+        b.write_text("from .a import REGISTRY\n"
+                     "REGISTRY.inc('nos_tpu_x_total')\n")
+        rep = run(default_rules(), [str(pkg)], root=str(tmp_path),
+                  cache=self._cache(tmp_path))
+        assert rep.ok
+        a.write_text("y = 1\n")                    # describe vanishes
+        rep = run(default_rules(), [str(pkg)], root=str(tmp_path),
+                  cache=self._cache(tmp_path))
+        assert [v.rule for v in rep.violations] == ["N003"]
+        assert rep.violations[0].path.endswith("b.py")   # though b cached
 
 
 # ---------------------------------------------------------------------------

@@ -111,7 +111,7 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             # guard the handshake state: any field write without _lock
             # held is a soak failure
             guard_state(agent.shared, lock_graph,
-                        name="sliceagent.SharedState._lock")
+                        name="sliceagent.SharedState")
             agent.start()
             agents.append(agent)
         scheduler = Scheduler(
@@ -123,6 +123,17 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
         tracer = obs.Tracer(clock=lambda: clock[0],
                             ring=obs.RingExporter(maxlen=256))
         journal = obs.DecisionJournal(maxlen=256, clock=lambda: clock[0])
+        # @guarded_by contracts, dynamically: guard_state reads each
+        # class's __guarded_by__ table (nos_tpu/utils/guards.py) — the
+        # SAME declaration noslint N010 proves statically — and convicts
+        # any runtime write to a declared field without its lock held.
+        guard_state(state, lock_graph, name="partitioning.ClusterState")
+        guard_state(partitioner.quarantine, lock_graph,
+                    name="core.QuarantineList")
+        guard_state(journal, lock_graph, name="obs.DecisionJournal")
+        if scheduler._cache is not None:
+            guard_state(scheduler._cache, lock_graph,
+                        name="scheduler.SchedulerCache")
 
     # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
     # is always feasible
