@@ -77,6 +77,18 @@ class PartitionerConfig(ManagerConfig):
     # Per-plan handshake deadline before a silent node is quarantined
     # (docs/protocol.md).  0 = default (3x batch_timeout_s).
     plan_deadline_s: float = 0.0
+    # Replan epoch: plan cycles run at most once per this many seconds;
+    # unschedulable pods arriving inside the running epoch accumulate
+    # into the next cycle's batch (docs/performance.md, "Fleet-scale
+    # planning").  0 = default (the batch idle window).
+    replan_epoch_s: float = 0.0
+    # Sharded parallel planning engages when the snapshot holds at
+    # least this many nodes across 2+ plan pools (machine class x
+    # failure domain); below it the planner is byte-identical
+    # sequential.  0 = always shard multi-pool snapshots.
+    plan_shard_min_hosts: int = 128
+    # Plan shard worker threads; 0 = auto (bounded by CPU count).
+    plan_workers: int = 0
     # Geometry-override file (SetKnownGeometries analog, reference
     # known_configs.go:144-150 wired at cmd/gpupartitioner/:370-380).
     known_geometries_file: str = ""
@@ -100,6 +112,12 @@ class PartitionerConfig(ManagerConfig):
             raise ConfigError(
                 "plan_deadline_s below batch_timeout_s would quarantine "
                 "nodes still inside a normal batch window")
+        if self.replan_epoch_s < 0:
+            raise ConfigError("replan_epoch_s must be >= 0")
+        if self.plan_shard_min_hosts < 0:
+            raise ConfigError("plan_shard_min_hosts must be >= 0")
+        if self.plan_workers < 0:
+            raise ConfigError("plan_workers must be >= 0")
         if self.known_geometries_file and \
                 not pathlib.Path(self.known_geometries_file).is_file():
             raise ConfigError(

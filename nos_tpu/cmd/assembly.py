@@ -44,11 +44,15 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
         NodeController(api, state, SliceNodeInitializer(api)).bind()
         PodController(api, state).bind()
         plan_deadline = cfg.plan_deadline_s or None
+        replan_epoch = cfg.replan_epoch_s or None
         if cfg.kind in (SLICE_KIND, HYBRID_KIND):
             ctl = new_slice_partitioner_controller(
                 api, state, batch_timeout_s=cfg.batch_timeout_s,
                 batch_idle_s=cfg.batch_idle_s,
-                plan_deadline_s=plan_deadline)
+                plan_deadline_s=plan_deadline,
+                replan_epoch_s=replan_epoch,
+                plan_shard_min_hosts=cfg.plan_shard_min_hosts,
+                plan_workers=cfg.plan_workers)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-slice", ctl.process_if_ready,
@@ -59,7 +63,10 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
                 batch_idle_s=cfg.batch_idle_s,
                 cm_name=cfg.device_plugin_cm_name,
                 cm_namespace=cfg.device_plugin_cm_namespace,
-                plan_deadline_s=plan_deadline)
+                plan_deadline_s=plan_deadline,
+                replan_epoch_s=replan_epoch,
+                plan_shard_min_hosts=cfg.plan_shard_min_hosts,
+                plan_workers=cfg.plan_workers)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-timeshare", ctl.process_if_ready,

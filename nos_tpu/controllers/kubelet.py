@@ -104,7 +104,15 @@ class KubeletSim:
         with the scheduler's bind notification, closing the window where
         the actuator could still see a just-bound pod's device as free."""
         if self._active and self._unsub is None:
-            self._unsub = self._api.watch(KIND_POD, self._on_event)
+            # field-selector analog (a real kubelet watches
+            # spec.nodeName=<self>): evaluated before the bus pays the
+            # per-watcher deep copy, so a fleet of kubelet sims does not
+            # turn every pod write into an O(nodes) copy fan-out
+            node = self._node
+            self._unsub = self._api.watch(
+                KIND_POD, self._on_event,
+                selector=lambda pod:
+                    getattr(pod.spec, "node_name", "") == node)
 
     def unbind(self) -> None:
         if self._unsub is not None:

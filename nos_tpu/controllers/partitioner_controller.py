@@ -48,6 +48,8 @@ REGISTRY.describe("nos_tpu_plan_seconds",
 REGISTRY.describe("nos_tpu_plans_total", "Partitioning plans computed")
 REGISTRY.describe("nos_tpu_plan_pending_pods",
                   "Pending pods the last plan tried to place")
+REGISTRY.describe("nos_tpu_replan_epoch_deferred_total",
+                  "Ready batches held back to the next replan epoch")
 
 # Default plan deadline as a multiple of the batch timeout: a healthy
 # agent reports within one report interval, so 3 full batch windows of
@@ -63,6 +65,7 @@ class PartitionerController:
                  quarantine: QuarantineList | None = None,
                  plan_deadline_s: float | None = None,
                  rescan_interval_s: float | None = None,
+                 replan_epoch_s: float | None = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._api = api
         self._state = cluster_state
@@ -79,8 +82,22 @@ class PartitionerController:
         self._rescan_interval_s = (
             rescan_interval_s if rescan_interval_s is not None
             else batcher.timeout_s)
+        # Replan epoch: plan cycles run at most once per epoch, however
+        # fast triggers arrive — unschedulable pods landing inside the
+        # running epoch ACCUMULATE in the batcher and ride the next
+        # cycle (one replan per epoch, not one per pod: at fleet scale
+        # pods trickling in slower than the idle window would otherwise
+        # buy one full-cluster replan each).  Default: the batch idle
+        # window, which preserves the historical cadence (a batch can
+        # never become ready sooner than idle_s after the previous
+        # drain's last add anyway).
+        self._replan_epoch_s = (replan_epoch_s if replan_epoch_s is not None
+                                else batcher.idle_s)
         self._clock = clock
         self._last_scan = clock()
+        # first plan is never deferred: the epoch starts one period ago
+        self._last_plan = clock() - self._replan_epoch_s
+        self._epoch_deferring = False
         # node -> (unreported spec plan id, first seen lagging at)
         self._lag_since: dict[str, tuple[str, float]] = {}
         # last journaled lagging-node set: handshake waits are polled
@@ -110,6 +127,15 @@ class PartitionerController:
         """Poll from the run loop; returns True if a plan cycle ran."""
         self._reconcile_quarantine()
         self._refresh_lagging_journal()
+        if self._clock() - self._last_plan < self._replan_epoch_s:
+            # inside the running replan epoch: triggers keep
+            # accumulating in the batcher, the next cycle takes them all
+            if not self._epoch_deferring and self._batcher.ready():
+                self._epoch_deferring = True
+                REGISTRY.inc("nos_tpu_replan_epoch_deferred_total",
+                             labels={"kind": self._kind})
+            return False
+        self._epoch_deferring = False
         rescan_pods = None
         if not self._batcher.ready():
             # An accumulating batch already carries a live trigger and
@@ -136,10 +162,15 @@ class PartitionerController:
             # nothing plannable right now (e.g. every node of this kind
             # is quarantined): restore the trigger, so the pending
             # demand is replanned as soon as a node recovers — without
-            # this the pods would strand until fresh pod churn
+            # this the pods would strand until fresh pod churn.  The
+            # epoch is NOT stamped: no plan ran, recovery must not wait
+            # out a full epoch.
             for pod in items:
                 self._batcher.add(pod.key, pod)
             return False
+        # the epoch runs plan-end to plan-start: stamped only when a
+        # cycle actually ran
+        self._last_plan = self._clock()
         return True
 
     def process_pending_pods(self, pods: list[Pod] | None = None) -> bool:

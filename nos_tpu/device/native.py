@@ -140,6 +140,13 @@ def _bind(so: pathlib.Path):
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_int]
+    lib.nos_fit_batch.restype = ctypes.c_int
+    lib.nos_fit_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64)]
     return lib
 
 
@@ -219,6 +226,76 @@ def native_packer(block: Shape, key: tuple, occupied: int,
             _pack_failed_keys.clear()
         _pack_failed_keys.add(full_key)
         return NotImplemented
+
+
+# Bit 63 of a nos_fit_batch miss mask flags the chip-equivalent guard
+# (tpu_shim.cc): the resource indices occupy bits 0..62.
+FIT_MISS_CHIP_GUARD = 1 << 63
+FIT_MAX_RESOURCES = 63
+
+
+def fit_batch_available(build: bool = False) -> bool:
+    """Whether the native batch fit screen can run (shim loadable)."""
+    return _load(allow_build=build) is not None
+
+
+def fit_batch_raw(free_arr: "ctypes.Array[ctypes.c_double]",
+                  req_arr: "ctypes.Array[ctypes.c_double]",
+                  cap_arr: "ctypes.Array[ctypes.c_double]",
+                  used_arr: "ctypes.Array[ctypes.c_double]",
+                  chips_arr: "ctypes.Array[ctypes.c_double]",
+                  n_nodes: int, n_classes: int, n_res: int,
+                  out_arr: "ctypes.Array[ctypes.c_uint8]",
+                  miss_arr: "ctypes.Array[ctypes.c_uint64] | None" = None,
+                  ) -> bool:
+    """Zero-copy variant of fit_batch for hot callers that pre-build
+    (and reuse) the ctypes buffers — the planner compiles its class
+    request matrix ONCE per plan and pays only one node row per
+    candidate.  Returns False when the shim is unavailable/rejects."""
+    lib = _load(allow_build=False)      # never compile from a hot path
+    if lib is None or n_res > FIT_MAX_RESOURCES:
+        return False
+    rc = lib.nos_fit_batch(free_arr, req_arr, cap_arr, used_arr,
+                           chips_arr, n_nodes, n_classes, n_res,
+                           out_arr, miss_arr)
+    return rc == 0
+
+
+def fit_batch(free_flat: list[float], req_flat: list[float],
+              node_cap_chips: list[float], node_used_chips: list[float],
+              class_chips: list[float], n_nodes: int, n_classes: int,
+              n_res: int, want_miss: bool = True
+              ) -> tuple[bytes, list[int] | None] | None:
+    """Bridge to nos_fit_batch (tpu_shim.cc): N nodes x M classes
+    resource-fit verdicts with NodeResourcesFit's exact semantics.
+
+    Returns (verdict bytes, miss masks) — verdict[i*n_classes+j] == 1
+    means class j fits node i; miss masks carry the failing resource
+    indices (bit 63 = chip guard) for exact message reconstruction.
+    None when the shim is unavailable or rejects the arguments (the
+    caller falls back to the Python pipeline).  Like every shim entry
+    point this goes through ctypes' CDLL, which RELEASES the GIL for
+    the duration of the call — concurrent plan shards screening at
+    once genuinely overlap (tests/test_native.py pins the overlap)."""
+    if n_res > FIT_MAX_RESOURCES:
+        return None
+    lib = _load(allow_build=False)      # never compile from a hot path
+    if lib is None:
+        return None
+    cells = n_nodes * n_classes
+    out = (ctypes.c_uint8 * max(1, cells))()
+    miss = (ctypes.c_uint64 * max(1, cells))() if want_miss else None
+    rc = lib.nos_fit_batch(
+        (ctypes.c_double * max(1, len(free_flat)))(*free_flat),
+        (ctypes.c_double * max(1, len(req_flat)))(*req_flat),
+        (ctypes.c_double * max(1, len(node_cap_chips)))(*node_cap_chips),
+        (ctypes.c_double * max(1, len(node_used_chips)))(*node_used_chips),
+        (ctypes.c_double * max(1, len(class_chips)))(*class_chips),
+        n_nodes, n_classes, n_res, out, miss)
+    if rc != 0:
+        return None
+    return bytes(out[:cells]), (list(miss[:cells])
+                                if miss is not None else None)
 
 
 def install_native_packer(build: bool = False) -> bool:

@@ -52,7 +52,9 @@ class APIServer:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._stores: dict[str, dict[str, Any]] = defaultdict(dict)
-        self._watchers: dict[str, list[WatchFn]] = defaultdict(list)
+        # (callback, selector): selector None = deliver everything
+        self._watchers: dict[str, list[
+            tuple[WatchFn, Callable[[Any], bool] | None]]] = defaultdict(list)
         self._admission: dict[str, list[Callable[["APIServer", Any], None]]] = \
             defaultdict(list)
         self._rv = 0
@@ -99,7 +101,14 @@ class APIServer:
         try:
             while self._event_queue:
                 k, ev, o = self._event_queue.pop(0)
-                for fn in list(self._watchers.get(k, [])):
+                for fn, selector in list(self._watchers.get(k, [])):
+                    # Field-selector analog: the per-watcher deep copy is
+                    # the write-path hot spot at fleet scale (every
+                    # kubelet sim watches pods), so selector-rejected
+                    # events skip it.  Selectors read the queued copy and
+                    # MUST NOT mutate it.
+                    if selector is not None and not selector(o):
+                        continue
                     try:
                         fn(ev, copy.deepcopy(o))
                     except BaseException as e:
@@ -230,18 +239,37 @@ class APIServer:
                 out.append(copy.deepcopy(obj))
             return out
 
-    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+    def watch(self, kind: str, fn: WatchFn,
+              selector: Callable[[Any], bool] | None = None
+              ) -> Callable[[], None]:
         """Register a watcher; returns an unsubscribe function.  New watchers
-        receive synthetic ADDED events for existing objects (informer sync)."""
+        receive synthetic ADDED events for existing objects (informer sync).
+
+        `selector` is the field-selector analog (a real kubelet watches
+        pods with spec.nodeName=<self>): evaluated BEFORE the per-watcher
+        deep copy, against an object the selector must not mutate.  At
+        fleet scale this is the difference between every pod write
+        fanning out N-nodes deep copies and fanning out a handful.
+
+        Unlike an apiserver fieldSelector, an object that STOPS
+        matching is simply not delivered — no synthetic DELETED is
+        synthesized for leaving the selection.  Select only on fields
+        that are stable for the object's relevant lifetime (a pod's
+        spec.nodeName is set once at bind and immutable until
+        deletion); a selector over a mutable field would leave the
+        watcher holding the last matching state forever."""
+        entry = (fn, selector)
         with self._lock:
-            self._watchers[kind].append(fn)
+            self._watchers[kind].append(entry)
             for obj in list(self._stores[kind].values()):
+                if selector is not None and not selector(obj):
+                    continue
                 fn("ADDED", copy.deepcopy(obj))
 
         def unsubscribe() -> None:
             with self._lock:
-                if fn in self._watchers[kind]:
-                    self._watchers[kind].remove(fn)
+                if entry in self._watchers[kind]:
+                    self._watchers[kind].remove(entry)
 
         return unsubscribe
 

@@ -302,12 +302,20 @@ class KubeClient:
         return out
 
     # -- watch --------------------------------------------------------------
-    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+    def watch(self, kind: str, fn: WatchFn,
+              selector=None) -> Callable[[], None]:
         """Informer-style: replay existing objects as ADDED synchronously,
         then stream; every (re)connect re-lists and diffs against what was
         already delivered, so events raced between list and stream — or
         dropped across a 410/reconnect — are recovered as synthetic
-        ADDED/MODIFIED/DELETED."""
+        ADDED/MODIFIED/DELETED.
+
+        `selector` filters delivered objects client-side (the in-memory
+        substrate's field-selector analog; a production deployment would
+        push it down as an apiserver fieldSelector).  Objects that stop
+        matching are NOT reported (no synthetic DELETED on leaving the
+        selection) — select only on fields stable for the object's
+        relevant lifetime, e.g. a pod's spec.nodeName."""
         stop = threading.Event()
         # (namespace, name) -> resource_version already delivered
         known: dict[tuple[str, str], int] = {}
@@ -317,6 +325,8 @@ class KubeClient:
                     obj.metadata.name)
 
         def deliver(event: str, obj) -> None:
+            if selector is not None and not selector(obj):
+                return
             key = obj_key(obj)
             if event == "DELETED":
                 known.pop(key, None)

@@ -349,6 +349,61 @@ int nos_pack(const int* block_dims, int ndims, const int* shapes_flat,
   return rc == 0 ? (int)acc.size() : rc;
 }
 
+// Batch resource-fit screen backing the scheduler/planner Filter hot
+// loop (nos_tpu/scheduler/native_filter.py).  Semantics mirror
+// framework.py NodeResourcesFit exactly, on the same doubles:
+//   fit(i, j) = for every resource r with req[j][r] > 0:
+//                 free[i][r] >= req[j][r]
+//               and (class_chips[j] == 0 or
+//                    node_used_chips[i] + class_chips[j]
+//                      <= node_cap_chips[i])
+// free_m: n_nodes*n_res doubles (row per node, resource order fixed by
+// the caller); req_m: n_classes*n_res.  out: n_nodes*n_classes bytes
+// (1 = fits).  miss_out (may be null): per (node, class) bitmask of
+// failing resource indices, bit 63 = chip-guard failure — the caller
+// reconstructs NodeResourcesFit's exact rejection message from it.
+// The chip guard is only evaluated when the resource check passed,
+// matching the Python control flow.  Returns 0, or -3 on bad args
+// (n_res must leave bit 63 free).
+//
+// Stateless and lock-free by design: concurrent plan shards call this
+// through ctypes' CDLL, which releases the GIL for the duration, so
+// native filtering from parallel shards genuinely overlaps.
+int nos_fit_batch(const double* free_m, const double* req_m,
+                  const double* node_cap_chips,
+                  const double* node_used_chips,
+                  const double* class_chips,
+                  int n_nodes, int n_classes, int n_res,
+                  uint8_t* out, uint64_t* miss_out) {
+  if (n_nodes < 0 || n_classes < 0 || n_res < 0 || n_res > 63 ||
+      !free_m || !req_m || !node_cap_chips || !node_used_chips ||
+      !class_chips || !out)
+    return -3;
+  for (int i = 0; i < n_nodes; ++i) {
+    const double* free_row = free_m + (size_t)i * n_res;
+    for (int j = 0; j < n_classes; ++j) {
+      const double* req_row = req_m + (size_t)j * n_res;
+      uint64_t miss = 0;
+      bool fit = true;
+      for (int r = 0; r < n_res; ++r) {
+        double v = req_row[r];
+        if (v > 0 && free_row[r] < v) {
+          fit = false;
+          miss |= 1ull << r;
+        }
+      }
+      if (fit && class_chips[j] > 0 &&
+          node_used_chips[i] + class_chips[j] > node_cap_chips[i]) {
+        fit = false;
+        miss |= 1ull << 63;
+      }
+      out[(size_t)i * n_classes + j] = fit ? 1 : 0;
+      if (miss_out) miss_out[(size_t)i * n_classes + j] = miss;
+    }
+  }
+  return 0;
+}
+
 int nos_runtime_delete_slice(void* h, const char* id) {
   auto* rt = static_cast<Runtime*>(h);
   std::lock_guard<std::mutex> lock(rt->mu);

@@ -204,13 +204,32 @@ def explain_plan(snapshot: dict, kind: str | None = None) -> list[str]:
         for k, v in sorted(s.get("counts", {}).items()):
             lines.append(f"{pad}  · {k}: {v}")
 
+    # sharded plans: attribute plan time per pool (the plan_shard spans
+    # run on worker threads but carry the cycle's trace via context
+    # propagation, so they are part of this tree)
+    shards = [s for s in _span_tree(spans, root)
+              if s["name"] == "plan_shard" and s.get("duration")]
+    if shards:
+        lines.append("shard time by pool:")
+        shard_total = sum(s["duration"] for s in shards)
+        for s in sorted(shards, key=lambda s: -(s["duration"] or 0.0)):
+            attrs = s.get("attrs", {})
+            pct = (f" ({s['duration'] / shard_total * 100:.0f}% of shard "
+                   f"time)" if shard_total else "")
+            lines.append(
+                f"  {attrs.get('pool', '?')}: "
+                f"{s['duration'] * 1000:.1f} ms{pct} "
+                f"[nodes={attrs.get('nodes', '?')}, "
+                f"pods={attrs.get('pods', '?')}]")
+
     trace_id = root["trace_id"]
     decisions = [r for r in snapshot.get("journal", [])
                  if r.get("trace_id") == trace_id
                  and r["category"] in (J.PLAN_NODE_COMMITTED,
                                        J.PLAN_NODE_REVERTED,
                                        J.NODE_ACTUATED,
-                                       J.ACTUATION_FAILED)]
+                                       J.ACTUATION_FAILED,
+                                       J.PLAN_SHARD_MERGED)]
     if decisions:
         lines.append("decisions in this cycle:")
         for r in decisions:

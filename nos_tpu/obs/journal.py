@@ -63,6 +63,7 @@ QUOTA_RECLAIM = "quota-reclaim"
 PREEMPTION = "preemption"
 PREEMPTION_NONE = "preemption-none"
 PLAN_CYCLE = "plan-cycle"
+PLAN_SHARD_MERGED = "plan-shard-merged"
 PLAN_NODE_COMMITTED = "plan-node-committed"
 PLAN_NODE_REVERTED = "plan-node-reverted"
 NODE_ACTUATED = "node-actuated"

@@ -9,6 +9,8 @@ from .snapshot import ClusterSnapshot, SnapshotError
 from .tracker import SliceTracker
 from .sorter import ProfileAwareSorter
 from .planner import GeometryPlanner
+from .parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
+from .pools import PlanPool, partition_pools, split_pods
 from .actuator import GeometryActuator, new_plan_id
 from .quarantine import (
     QuarantineList, REASON_ACTUATION, REASON_PLAN_DEADLINE,
@@ -20,5 +22,7 @@ __all__ = [
     "SliceFilter", "SnapshotTaker", "Sorter",
     "ClusterSnapshot", "SnapshotError", "SliceTracker", "ProfileAwareSorter",
     "GeometryPlanner", "GeometryActuator", "new_plan_id",
+    "ParallelGeometryPlanner", "PLAN_SHARD_MIN_HOSTS",
+    "PlanPool", "partition_pools", "split_pods",
     "QuarantineList", "REASON_ACTUATION", "REASON_PLAN_DEADLINE",
 ]

@@ -1,0 +1,200 @@
+"""ParallelGeometryPlanner: sharded planning over independent plan pools.
+
+The sequential planner replans the whole cluster per pending batch —
+fine at one v5e-256 pod, quadratic pain at fleet scale.  This planner
+splits the snapshot into plan pools (machine class + failure domain,
+partitioning/core/pools.py), plans every pool concurrently on a worker
+pool with per-shard COW sub-snapshots, and merges the per-pool desired
+states deterministically (pool-key order; shards own disjoint node
+sets, so the merge is a conflict-free union).
+
+Contracts:
+
+- **Single-pool inputs are byte-identical to the sequential planner**:
+  with one pool (or below `min_shard_hosts`) this class delegates to
+  one sequential planner on the whole snapshot — no shard path at all.
+  tests/test_parallel_plan.py pins this with a randomized
+  observational-equivalence property.
+- **Shards share nothing mutable**: each shard gets its own planner
+  instance (own Framework — the framework lock would otherwise
+  serialize the shards), its own sub-snapshot sharing node OBJECTS with
+  siblings only across disjoint name sets, and its own tracker/lister.
+  Shared infrastructure (decision journal, span ring, metrics registry)
+  is reached only through its own leaf locks (noslint N009/N010; the
+  chaos soak runs this planner under lockcheck).
+- **Observability**: every shard runs inside a `plan_shard` span
+  parented under the caller's ambient span (the submitting thread's
+  context is propagated into the worker via `contextvars`), observes
+  `nos_tpu_plan_shard_seconds{pool=}`, and the merge journals one
+  PLAN_SHARD_MERGED record so `nos explain plan` can attribute plan
+  time per pool.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.objects import Pod
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.trace import span as obs_span
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+
+from ..state import PartitioningState
+from .interfaces import Planner, SliceCalculator
+from .pools import PlanPool, partition_pools, split_pods
+from .snapshot import ClusterSnapshot
+
+REGISTRY.describe("nos_tpu_plan_shard_seconds",
+                  "Per-pool shard planning time within one parallel plan")
+REGISTRY.describe("nos_tpu_plan_shards_total",
+                  "Plan shards executed by the parallel planner")
+
+
+# Below this many snapshot nodes the parallel planner stays sequential
+# by default: one v5e-256 pod (64 hosts) plans in ~50 ms already, and
+# the sequential path is the byte-identity anchor small clusters and
+# the existing benches rely on.  Sharding earns its keep at fleet scale.
+PLAN_SHARD_MIN_HOSTS = 128
+
+
+def default_plan_workers() -> int:
+    """Worker-pool size when not configured: bounded by the host."""
+    return max(2, min(16, os.cpu_count() or 4))
+
+
+class ParallelGeometryPlanner(Planner):
+    def __init__(self, planner_factory: Callable[[], Planner],
+                 calculator: SliceCalculator,
+                 kind: str = "",
+                 registry: TopologyRegistry = DEFAULT_REGISTRY,
+                 max_workers: int = 0,
+                 min_shard_hosts: int = 0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        """`planner_factory` builds one sequential planner per shard (a
+        fresh Framework each — the framework's plugin lock must not be
+        shared across shards).  `min_shard_hosts` keeps small clusters
+        on the sequential path: sharding only engages when the snapshot
+        holds at least that many nodes AND more than one pool (0 =
+        shard whenever there are two pools)."""
+        self._factory = planner_factory
+        self._calculator = calculator
+        self._kind = kind
+        self._registry = registry
+        self._max_workers = max_workers or default_plan_workers()
+        self._min_shard_hosts = min_shard_hosts
+        self._clock = clock
+        # Delegate for the sequential path; also the proof anchor of the
+        # single-pool byte-identity contract (same instance semantics).
+        self._sequential = planner_factory()
+        self._pool_lock = threading.Lock()
+        self._executor: ThreadPoolExecutor | None = None
+        # Reused shard planners, one per concurrent shard slot: the
+        # sequential planners are stateless between plans, and building
+        # a Framework (runtime-checkable Protocol isinstance per
+        # extension point) 16x per plan was measurable at fleet scale.
+        # plan() is not reentrant (the controller run loop is the one
+        # caller), so index i is owned by shard i of the current plan.
+        self._shard_planners: list[Planner] = []
+        # Last plan's shard attribution (pool key -> seconds), exposed
+        # for benches/tests; replaced wholesale per plan (no lock: the
+        # reference swap is atomic, readers get one coherent dict).
+        self.last_shard_seconds: dict[str, float] = {}
+
+    # -- worker pool --------------------------------------------------------
+    def _pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="nos-plan-shard")
+            return self._executor
+
+    def close(self) -> None:
+        """Shut the worker pool down (idempotent); the planner falls
+        back to lazily re-creating it if planned again."""
+        with self._pool_lock:
+            executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=True)
+
+    # -- Planner ------------------------------------------------------------
+    def plan(self, snapshot: ClusterSnapshot,
+             pending_pods: list[Pod]) -> PartitioningState:
+        pools = partition_pools(snapshot)
+        n_nodes = sum(len(p.nodes) for p in pools)
+        if len(pools) <= 1 or (self._min_shard_hosts
+                               and n_nodes < self._min_shard_hosts):
+            # the byte-identity contract: one pool (or a small cluster)
+            # IS the sequential planner, not a one-shard simulation of it
+            return self._sequential.plan(snapshot, pending_pods)
+        by_pool, infeasible = split_pods(
+            pools, pending_pods, self._calculator, self._registry)
+        with obs_span("planner.plan", pods=len(pending_pods),
+                      shards=len(pools)) as sp:
+            t0 = self._clock()
+            futures: list[tuple[PlanPool, Future[
+                tuple[PartitioningState, float]]]] = []
+            executor = self._pool()
+            while len(self._shard_planners) < len(pools):
+                self._shard_planners.append(self._factory())
+            for i, pool in enumerate(pools):     # already key-sorted
+                shard_snapshot = snapshot.subset(pool.nodes)
+                shard_pods = by_pool.get(pool.key, [])
+                ctx = contextvars.copy_context()
+                futures.append((pool, executor.submit(
+                    ctx.run, self._run_shard, self._shard_planners[i],
+                    pool, shard_snapshot, shard_pods)))
+            # deterministic merge: pool-key order, never completion
+            # order.  On a shard failure every OTHER future must still
+            # be drained before the exception propagates — the reused
+            # per-slot shard planners are single-thread objects, and a
+            # retrying caller must never submit to a planner that is
+            # still running the aborted plan's shard.
+            merged = PartitioningState()
+            shard_seconds: dict[str, float] = {}
+            first_exc: BaseException | None = None
+            for pool, future in futures:
+                try:
+                    shard_state, seconds = future.result()
+                except BaseException as e:  # noqa: BLE001 — drained + re-raised below
+                    if first_exc is None:
+                        first_exc = e
+                    continue
+                if first_exc is None:
+                    merged.update(shard_state)
+                    shard_seconds[pool.key] = seconds
+            if first_exc is not None:
+                raise first_exc
+            self.last_shard_seconds = shard_seconds
+            wall = self._clock() - t0
+            if sp is not None:
+                sp.set("infeasible", len(infeasible))
+        journal_record(
+            J.PLAN_SHARD_MERGED, self._kind or "plan",
+            shards=len(pools), nodes=n_nodes,
+            pods=len(pending_pods), infeasible=len(infeasible),
+            pools=[p.key for p in pools][:MAX_JOURNAL_NODES],
+            wall_ms=round(wall * 1e3, 3))
+        return merged
+
+    # -- shard task (worker thread) -----------------------------------------
+    def _run_shard(self, planner: Planner, pool: PlanPool,
+                   shard_snapshot: ClusterSnapshot,
+                   shard_pods: list[Pod]) -> tuple[PartitioningState, float]:
+        with obs_span("plan_shard", pool=pool.key, nodes=len(pool.nodes),
+                      pods=len(shard_pods)):
+            t0 = self._clock()
+            state = planner.plan(shard_snapshot, shard_pods)
+            seconds = self._clock() - t0
+        REGISTRY.observe("nos_tpu_plan_shard_seconds", seconds,
+                         labels={"pool": pool.key})
+        REGISTRY.inc("nos_tpu_plan_shards_total",
+                     labels={"kind": self._kind or "plan"})
+        return state, seconds

@@ -20,9 +20,12 @@ from nos_tpu.kube.objects import Pod
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import record as journal_record
 from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
+from nos_tpu.kube.resources import pod_request
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, SharedLister, filter_equivalence_key,
 )
+from nos_tpu.scheduler.framework import _slice_chips
+from nos_tpu.scheduler.native_filter import FitPrescreen
 
 from ..state import PartitioningState
 from .actuator import compute_partitioning_state
@@ -39,11 +42,21 @@ logger = logging.getLogger(__name__)
 class GeometryPlanner(Planner):
     def __init__(self, framework: Framework, calculator: SliceCalculator,
                  partition_calculator: PartitionCalculator,
-                 sorter: Sorter | None = None) -> None:
+                 sorter: Sorter | None = None,
+                 native_prescreen: bool = True) -> None:
         self._framework = framework
         self._calculator = calculator
         self._partition_calculator = partition_calculator
         self._sorter = sorter or ProfileAwareSorter(calculator)
+        # Native batch fit screen (scheduler/native_filter.py): definite
+        # resource-misfit classes are pruned per candidate node in ONE
+        # GIL-releasing C call instead of one Python pipeline run each.
+        # Verdict-sound only (fail => the pipeline would fail); passing
+        # classes still run the real pipeline, so decisions are
+        # byte-identical with and without it.
+        prescreen = FitPrescreen(framework) if native_prescreen else None
+        self._prescreen = (prescreen if prescreen is not None
+                           and prescreen.verdict_sound else None)
 
     # -- public ------------------------------------------------------------
     def plan(self, snapshot: ClusterSnapshot,
@@ -52,8 +65,10 @@ class GeometryPlanner(Planner):
             return self._plan(snapshot, pending_pods)
 
     def _plan(self, snapshot: ClusterSnapshot,
-              pending_pods: list[Pod]) -> PartitioningState:
-        tracker = SliceTracker(snapshot, self._calculator, pending_pods)
+              pending_pods: list[Pod],
+              tracker: SliceTracker | None = None) -> PartitioningState:
+        if tracker is None:
+            tracker = SliceTracker(snapshot, self._calculator, pending_pods)
         if tracker.empty:
             return compute_partitioning_state(snapshot, self._partition_calculator)
 
@@ -65,9 +80,25 @@ class GeometryPlanner(Planner):
         # the untouched NodeInfos live, so only cloned/reverted nodes are
         # re-read instead of reconstructing all N infos per candidate
         lister = snapshot.shared_lister()
-        # equivalence classes are plan-invariant: compute once per pod,
-        # not once per (pod, candidate)
-        equiv_keys = {p.key: filter_equivalence_key(p) for p in pods}
+        # Per-pod (pod, key, equivalence class) hoisted for the whole
+        # plan: pod.key is a computed property and the candidate loop
+        # touches every pod per candidate — at fleet scale the property
+        # calls alone were a visible slice of the plan profile.  The
+        # native prescreen compiles its class request matrix once per
+        # plan for the same reason.
+        entries = [(p, p.key, filter_equivalence_key(p)) for p in pods]
+        class_order: list = []
+        compiled = None
+        prescreen = self._prescreen
+        if prescreen is not None:
+            class_table: dict = {}
+            for p, _, ekey in entries:
+                if ekey not in class_table:
+                    req = pod_request(p)
+                    class_table[ekey] = (req, _slice_chips(req))
+            class_order = list(class_table)
+            compiled = prescreen.compile_classes(
+                [class_table[k] for k in class_order])
         # iterate by NAME and re-fetch after fork/revert: revert() swaps the
         # snapshot's node objects, so a captured reference would be detached
         candidate_names = [n.name for n in snapshot.get_candidate_nodes()]
@@ -86,24 +117,35 @@ class GeometryPlanner(Planner):
             # of the same equivalence class — the 200-pod batch collapses
             # to one pipeline run per distinct (namespace, gang, request).
             failed: set = set()
-            for pod in pods:
+            if compiled is not None and prescreen is not None:
+                # seed the memo with the native batch screen's definite
+                # fails (superset contract: native fail => pipeline
+                # fail), one GIL-releasing call over every class
+                # against this candidate's post-carve state; verdicts
+                # for already-placed classes are never consulted
+                verdicts = prescreen.screen_compiled(
+                    node.node_info(), compiled)
+                if verdicts is not None:
+                    failed.update(k for k, ok in zip(class_order, verdicts)
+                                  if not ok)
+                    obs_bump("prescreen_fails", len(failed))
+            for pod, pkey, ekey in entries:
                 if tracker.empty:
                     break
-                key = equiv_keys[pod.key]
-                if key in failed:
+                if ekey in failed:
                     continue
                 if self._try_add_pod(snapshot, lister, node_name, pod):
                     tracker.remove(pod)
-                    placed.add(pod.key)
+                    placed.add(pkey)
                 else:
-                    failed.add(key)
+                    failed.add(ekey)
             if placed:
                 obs_bump("commits")
                 snapshot.commit()
                 journal_record(J.PLAN_NODE_COMMITTED, node_name,
                                placed=len(placed), changed=changed)
                 # one rebuild per node, not an O(n) remove per placement
-                pods = [p for p in pods if p.key not in placed]
+                entries = [e for e in entries if e[1] not in placed]
                 logger.debug("planner: node %s re-carved (changed=%s, placed=%d)",
                              node_name, changed, len(placed))
             else:

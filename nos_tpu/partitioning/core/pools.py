@@ -1,0 +1,272 @@
+"""Pool partitioning: shard the planning decision plane by failure domain.
+
+A *plan pool* is the set of snapshot nodes sharing one machine class
+(accelerator generation label) and one failure domain (physical TPU pod,
+``nos.tpu/pod-id``).  Pools are the natural sharding boundary of the
+planner:
+
+- the per-node re-carve loop never moves capacity between nodes, and a
+  node can only ever provide slice shapes of its OWN generation — a
+  lacking profile of another generation scores zero against every
+  candidate geometry (topology/slice_unit.py ``update_geometry_for``),
+  so cross-pool entries in the lacking table cannot change any carve;
+- the multi-host group pass carves windows strictly WITHIN one physical
+  pod (slicepart/group.py groups by pod-id), never across the pool
+  boundary.
+
+Pending pods are split by the pool(s) their requested geometry can land
+on: a shape profile is eligible on a pool whose generation lists it in
+its slice-shape table; size-style profiles (timeshare ``<N>gb``) are
+generation-agnostic and eligible everywhere.  A pod eligible in several
+pools is assigned to exactly ONE — the pool with the most remaining
+free chip-equivalents after accounting demand already assigned during
+this split — deterministically (ties break on pool key), so the same
+snapshot and batch always produce the same shards.  Pods eligible
+nowhere (cross-pool-infeasible: no present generation supports their
+shape) are returned separately; no amount of re-carving could ever
+place them, exactly as the sequential planner would carve nothing for
+them.
+
+docs/performance.md ("Fleet-scale planning") states the merge
+determinism contract built on these rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+from nos_tpu.topology.known import Generation
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.objects import Pod
+from nos_tpu.topology import DEFAULT_REGISTRY, Shape, TopologyRegistry
+from nos_tpu.topology.profile import (
+    free_chip_equivalents, shape_from_resource,
+)
+
+from .interfaces import SliceCalculator
+from .snapshot import ClusterSnapshot
+
+
+@dataclass(frozen=True)
+class PlanPool:
+    """One shard of the planning plane: machine class + failure domain."""
+
+    key: str                    # "<accelerator>|<pod-id>"
+    accelerator: str            # LABEL_ACCELERATOR value ("" = unlabeled)
+    domain: str                 # LABEL_POD_ID value ("" = unlabeled)
+    nodes: tuple[str, ...]      # member node names, sorted
+    free_chips: float           # free chip-equivalents across members
+    # Per-member free SLICE chip-equivalents, sorted descending
+    # (profile resources only — the whole-chip resource a host also
+    # advertises would double-count its capacity and defeat the
+    # per-host capacity screen).
+    node_slice_free: tuple[float, ...]
+
+    @property
+    def max_node_slice_free(self) -> float:
+        return self.node_slice_free[0] if self.node_slice_free else 0.0
+
+
+def partition_pools(snapshot: ClusterSnapshot) -> list[PlanPool]:
+    """Group the snapshot's nodes into plan pools, sorted by key."""
+    members: dict[tuple[str, str], list[str]] = {}
+    free: dict[tuple[str, str], float] = {}
+    slice_free: dict[tuple[str, str], list[float]] = {}
+    for name, node in snapshot.nodes().items():
+        # one node_info() read per node: this runs per plan over the
+        # whole fleet
+        ni = node.node_info()
+        labels = ni.node.metadata.labels
+        key = (labels.get(C.LABEL_ACCELERATOR, ""),
+               labels.get(C.LABEL_POD_ID, ""))
+        node_free_map = ni.free()
+        members.setdefault(key, []).append(name)
+        free[key] = free.get(key, 0.0) + free_chip_equivalents(node_free_map)
+        slice_free.setdefault(key, []).append(_slice_free(node_free_map))
+    return [
+        PlanPool(key=f"{accel}|{domain}", accelerator=accel, domain=domain,
+                 nodes=tuple(sorted(members[(accel, domain)])),
+                 free_chips=free[(accel, domain)],
+                 node_slice_free=tuple(sorted(
+                     slice_free[(accel, domain)], reverse=True)))
+        for accel, domain in sorted(members)
+    ]
+
+
+def _slice_free(free: dict[str, float]) -> float:
+    """Free chip-equivalents in SLICE profile resources only."""
+    total = 0.0
+    for res, qty in free.items():
+        if qty <= 0:
+            continue
+        shape = shape_from_resource(res)
+        if shape is not None:
+            total += shape.chips * qty
+    return total
+
+
+def _profile_chips(profile: str, qty: int) -> float:
+    """Chip-equivalents of `qty` units of a profile (shape profiles by
+    chip count, size profiles at face value)."""
+    if "x" in profile:
+        try:
+            return float(Shape.parse(profile).chips * qty)
+        except ValueError:
+            return float(qty)
+    return float(qty)
+
+
+@lru_cache(maxsize=256)
+def _shape_table(gen: Generation) -> frozenset[Shape]:
+    return frozenset(s.canonical() for s in gen.slice_shapes)
+
+
+@lru_cache(maxsize=8192)
+def _shapes_eligible(profiles: tuple[str, ...],
+                     gen: Generation) -> bool:
+    """Memoised per (profile spelling tuple, generation): the split
+    runs per pod x pool, but the distinct profile combinations per
+    batch are a handful.  Shape profiles check the generation's
+    slice-shape table; size-style profiles ("<N>gb") check the
+    generation's per-CHIP HBM — timeshare units are carved per chip
+    (TimeshareUnit.hbm_gb = hbm_gb_per_chip, partitioning/timeshare/
+    node.py), so a 30gb profile can never be carved on a 16 GB/chip
+    generation however much total HBM the host holds."""
+    table = _shape_table(gen)
+    for profile in profiles:
+        if "x" not in profile:
+            if profile.endswith("gb"):
+                try:
+                    if int(profile[:-2]) > gen.hbm_gb_per_chip:
+                        return False
+                except ValueError:
+                    pass        # unknown spelling: the planner decides
+            continue
+        try:
+            shape = Shape.parse(profile).canonical()
+        except ValueError:
+            return False
+        if shape not in table:
+            return False
+    return True
+
+
+def _eligible(profiles: tuple[str, ...], pool: PlanPool,
+              registry: TopologyRegistry) -> bool:
+    """Can every requested profile land on this pool's generation?
+
+    An unregistered accelerator label is conservatively eligible — the
+    planner's own simulation is the authority there, as it is
+    sequentially."""
+    gen = registry.generations.get(pool.accelerator)
+    if gen is None:
+        return True
+    return _shapes_eligible(profiles, gen)
+
+
+def _capacity_ok(profiles: tuple[str, ...], pool: PlanPool,
+                 registry: TopologyRegistry) -> bool:
+    """NECESSARY capacity conditions for the pool to possibly serve the
+    profiles: a single-host shape needs some member with at least its
+    chips free (re-carving rearranges a host's free chips, it never
+    creates them); a multi-host shape spanning K hosts needs K members
+    each with a whole free block (the group pass only dedicates
+    fully-free hosts as shards — aggregate free chips on half-used
+    hosts can never become a window).  Alignment/contiguity is NOT
+    checked — these are necessary screens, not feasibility proofs.
+    Used to DEMOTE eligible-but-hopeless pools in the split so a pod is
+    not deterministically starved on a fragmented pool while a capable
+    sibling pool sits idle; when no eligible pool passes, the caller
+    falls back to the full eligible set (the demotion is an assignment
+    heuristic, never an infeasibility verdict)."""
+    gen = registry.generations.get(pool.accelerator)
+    if gen is None:
+        return True
+    for profile in profiles:
+        if "x" not in profile:
+            continue        # size profiles: screened by _eligible
+        shape = Shape.parse(profile)
+        span = gen.hosts_for(shape)
+        if span <= 1:
+            if pool.max_node_slice_free < shape.chips:
+                return False
+        else:
+            whole = gen.chips_per_host
+            free_hosts = sum(1 for f in pool.node_slice_free if f >= whole)
+            if free_hosts < span:
+                return False
+    return True
+
+
+def split_pods(
+    pools: list[PlanPool], pods: list[Pod], calculator: SliceCalculator,
+    registry: TopologyRegistry = DEFAULT_REGISTRY,
+) -> tuple[dict[str, list[Pod]], list[Pod]]:
+    """Assign each pending pod to exactly one eligible pool.
+
+    Returns (pool key -> pods in original batch order, infeasible pods).
+    Assignment is deterministic: the eligible pool with the most free
+    chip-equivalents NET of demand already assigned in this split wins;
+    ties break on pool key.  Accounting assigned demand spreads a burst
+    of pool-agnostic pods instead of piling them all onto the currently
+    freest pool.
+
+    Pod-group members are assigned ATOMICALLY (one unit, aggregate
+    chips): scattering a gang across pools would make every shard's
+    group pass carve a multi-host window for the same gang, and the
+    merged plan would reconfigure several physical pods for one job."""
+    by_pool: dict[str, list[Pod]] = {p.key: [] for p in pools}
+    remaining: dict[str, float] = {p.key: p.free_chips for p in pools}
+    infeasible: list[Pod] = []
+    # split-local eligibility memo: a batch has a handful of distinct
+    # profile combinations, so the per-pool check runs once per
+    # combination, not once per (pod, pool)
+    elig_memo: dict[tuple[str, ...], list[PlanPool]] = {}
+
+    # assignment units in first-appearance order: singles alone, every
+    # member of one pod group together
+    units: list[list[Pod]] = []
+    gang_unit: dict[tuple[str, str], list[Pod]] = {}
+    for pod in pods:
+        gang = pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+        if not gang:
+            units.append([pod])
+            continue
+        key = (pod.metadata.namespace, gang)
+        unit = gang_unit.get(key)
+        if unit is None:
+            unit = gang_unit[key] = []
+            units.append(unit)
+        unit.append(pod)
+
+    for unit in units:
+        profiles: dict[str, int] = {}
+        for pod in unit:
+            for pr, qty in calculator.requested_profiles(pod).items():
+                profiles[pr] = profiles.get(pr, 0) + qty
+        if not profiles:
+            # no profile demand: nothing for any shard's planner to do,
+            # exactly as the sequential planner filters these out
+            infeasible.extend(unit)
+            continue
+        screen_profiles = tuple(sorted(set(profiles)))
+        eligible = elig_memo.get(screen_profiles)
+        if eligible is None:
+            full = [p for p in pools
+                    if _eligible(screen_profiles, p, registry)]
+            capable = [p for p in full
+                       if _capacity_ok(screen_profiles, p, registry)]
+            # capacity demotion, never an infeasibility verdict: with
+            # no capable pool, keep the full eligible set
+            eligible = capable or full
+            elig_memo[screen_profiles] = eligible
+        if not eligible:
+            infeasible.extend(unit)
+            continue
+        chips = sum(_profile_chips(pr, q) for pr, q in profiles.items())
+        best = max(eligible, key=lambda p: (remaining[p.key], p.key))
+        by_pool[best.key].extend(unit)
+        remaining[best.key] -= chips
+    return by_pool, infeasible

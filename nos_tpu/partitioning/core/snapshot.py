@@ -27,7 +27,7 @@ plan-vs-actuate diff (reference partitioner_controller.go:178-193).
 
 from __future__ import annotations
 
-from typing import Mapping
+from typing import Iterable, Mapping
 
 from nos_tpu.api.constants import ANNOT_GANG_LEASE
 from nos_tpu.kube.objects import Pod
@@ -95,6 +95,26 @@ class ClusterSnapshot:
         return ClusterSnapshot(
             {n: pn.clone() for n, pn in self._nodes.items()}, self._filter
         )
+
+    def subset(self, names: "Iterable[str]") -> "ClusterSnapshot":
+        """A fresh snapshot restricted to `names`, SHARING the node
+        objects (no copy): the shard snapshots of the parallel planner.
+
+        Each subset carries its own fork/dirty/generation state, so a
+        shard's COW fork clones into the shard's own node map and never
+        writes through to this snapshot's entries.  In-place mutations
+        (the group pass's deliberate out-of-fork carves) DO write
+        through — concurrent subsets are therefore safe exactly when
+        their name sets are disjoint, which the pool partitioner
+        guarantees (partitioning/core/pools.py)."""
+        if self._forked is not None:
+            raise SnapshotError("cannot subset a forked snapshot")
+        names = sorted(names)       # materialize: generators iterate once
+        missing = [n for n in names if n not in self._nodes]
+        if missing:
+            raise SnapshotError(f"unknown node(s) {missing}")
+        return ClusterSnapshot(
+            {n: self._nodes[n] for n in names}, self._filter)
 
     # -- write access -------------------------------------------------------
     def _bump_node(self, name: str) -> None:

@@ -18,13 +18,24 @@ class SliceTracker:
         self._requested: dict[str, int] = {}
         self._lacking: dict[str, int] = {}
         self._pod_lacking: dict[str, dict[str, int]] = {}
+        # Per-class lacking memo: against one unchanged snapshot, a
+        # pod's lacking table is a pure function of its requested
+        # profiles (get_lacking_slices restricts to profile resources),
+        # so a fleet batch pays one derivation per distinct request,
+        # not per pod.  The shared tables are read-only by contract
+        # (remove() pops, never mutates entries).
+        class_lacking: dict[frozenset, dict[str, int]] = {}
         for pod in pods:
             requested = calculator.requested_profiles(pod)
             if not requested:
                 continue
             for profile, qty in requested.items():
                 self._requested[profile] = self._requested.get(profile, 0) + qty
-            lacking = snapshot.get_lacking_slices(pod)
+            key = frozenset(requested.items())
+            lacking = class_lacking.get(key)
+            if lacking is None:
+                lacking = snapshot.get_lacking_slices(pod)
+                class_lacking[key] = lacking
             if lacking:
                 self._pod_lacking[pod.key] = lacking
                 for profile, qty in lacking.items():

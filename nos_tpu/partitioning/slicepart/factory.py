@@ -10,6 +10,7 @@ from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
 from ..core import GeometryActuator, QuarantineList
+from ..core.parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from ..state import ClusterState
 from .calculators import SlicePartitionCalculator, SliceProfileCalculator
 from .group import MultiHostGeometryPlanner
@@ -22,19 +23,31 @@ def new_slice_partitioner_controller(
     framework: Framework | None = None,
     batch_timeout_s: float = 60.0, batch_idle_s: float = 10.0,
     plan_deadline_s: float | None = None,
+    replan_epoch_s: float | None = None,
+    plan_shard_min_hosts: int = PLAN_SHARD_MIN_HOSTS,
+    plan_workers: int = 0,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
 
     partition_calculator = SlicePartitionCalculator()
-    planner = MultiHostGeometryPlanner(
-        framework=framework or Framework(),
-        calculator=SliceProfileCalculator(),
-        partition_calculator=partition_calculator,
-    )
+
+    def make_planner() -> MultiHostGeometryPlanner:
+        # one framework per shard unless the caller pinned one: the
+        # framework's plugin lock must not serialize concurrent shards
+        return MultiHostGeometryPlanner(
+            framework=framework or Framework(),
+            calculator=SliceProfileCalculator(),
+            partition_calculator=partition_calculator,
+        )
+
     kwargs = {}
     if clock is not None:
         kwargs["clock"] = clock
+    planner = ParallelGeometryPlanner(
+        make_planner, SliceProfileCalculator(), kind=SLICE_KIND,
+        max_workers=plan_workers, min_shard_hosts=plan_shard_min_hosts,
+        **kwargs)
     # one quarantine list shared by actuator (circuit breaker) and
     # controller (plan deadline): a node is one failure domain, however
     # it failed
@@ -46,7 +59,8 @@ def new_slice_partitioner_controller(
         api=api, cluster_state=cluster_state, kind=SLICE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=SliceSnapshotTaker(), batcher=batcher,
-        quarantine=quarantine, plan_deadline_s=plan_deadline_s, **kwargs,
+        quarantine=quarantine, plan_deadline_s=plan_deadline_s,
+        replan_epoch_s=replan_epoch_s, **kwargs,
     )
 
 

@@ -62,19 +62,28 @@ class MultiHostGeometryPlanner(GeometryPlanner):
 
     def plan(self, snapshot: ClusterSnapshot,
              pending_pods: list[Pod]) -> PartitioningState:
-        tracker = SliceTracker(snapshot, self._calculator, pending_pods)
-        if not tracker.empty:
-            with obs_span("planner.group_pass"):
-                self._group_pass(snapshot, tracker.lacking, pending_pods)
-        return super().plan(snapshot, pending_pods)
+        with obs_span("planner.plan", pods=len(pending_pods)):
+            tracker = SliceTracker(snapshot, self._calculator, pending_pods)
+            changed = False
+            if not tracker.empty:
+                with obs_span("planner.group_pass"):
+                    changed = self._group_pass(
+                        snapshot, tracker.lacking, pending_pods)
+            # an untouched snapshot means the tracker's lacking math is
+            # still exact: reuse it instead of re-deriving per pod
+            return self._plan(snapshot, pending_pods,
+                              tracker=None if changed else tracker)
 
     # -- the pass -----------------------------------------------------------
     def _group_pass(self, snapshot: ClusterSnapshot,
-                    lacking: dict[str, int], pending_pods: list[Pod]) -> None:
+                    lacking: dict[str, int], pending_pods: list[Pod]) -> bool:
+        """Returns True when any host's geometry was mutated (carved or
+        reclaimed) — the caller's tracker is stale exactly then."""
+        mutated = False
         nodes = [n for n in snapshot.nodes().values()
                  if isinstance(n, SliceNode)]
         if not nodes:
-            return
+            return mutated
         # Classification is per generation: a profile can be sub-host on
         # v5e (8 chips/host) and multi-host on v4 (4 chips/host) at once.
         gens = {n.generation for n in nodes}
@@ -89,7 +98,7 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                 sub_lacking_chips += shape.chips * qty
 
         if sub_lacking_chips:
-            self._reclaim_free_instances(nodes, sub_lacking_chips)
+            mutated |= self._reclaim_free_instances(nodes, sub_lacking_chips)
 
         by_pod: dict[str, list[SliceNode]] = defaultdict(list)
         for n in nodes:
@@ -130,20 +139,24 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                         continue
                     for w in window:
                         w.make_member_of(shape)
+                    mutated = True
                     remaining[shape] -= hosts
                     logger.info(
                         "group pass: carved %s across %s",
                         shape.name, [w.name for w in window])
+        return mutated
 
     def _reclaim_free_instances(self, nodes: list[SliceNode],
-                                lacking_chips: int) -> None:
+                                lacking_chips: int) -> bool:
         """Break up multi-host instances whose every shard is free — the
         per-node loop then re-carves the blocks for sub-host demand.  An
         instance with ANY used shard is untouchable, and instances are
         reclaimed only while the lacking sub-host demand exceeds what
         non-member hosts' re-carvable free capacity can supply (a free
         slice reserved for an assembling gang must not churn under small-pod
-        arrivals the rest of the cluster can absorb)."""
+        arrivals the rest of the cluster can absorb).  Returns True when
+        any instance was reclaimed."""
+        mutated = False
         deficit = lacking_chips
         for n in nodes:
             if n.is_multihost_member():
@@ -153,7 +166,7 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                     continue
                 deficit -= sum(s.chips * c for s, c in u.free.items())
         if deficit <= 0:
-            return
+            return mutated
 
         by_pod: dict[str, list[SliceNode]] = defaultdict(list)
         for n in nodes:
@@ -172,12 +185,14 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                 hosts = gen.hosts_for(shape)
                 for window in aligned_windows(shards, hosts):
                     if deficit <= 0:
-                        return
+                        return mutated
                     if any(w.has_used_slices() for w in window):
                         continue
                     for w in window:
                         w.reset_virgin()
+                    mutated = True
                     deficit -= shape.chips
                     logger.info(
                         "group pass: reclaimed free %s at %s",
                         shape.name, [w.name for w in window])
+        return mutated

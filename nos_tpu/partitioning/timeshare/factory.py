@@ -10,6 +10,7 @@ from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
 from ..core import GeometryActuator, GeometryPlanner, QuarantineList
+from ..core.parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from ..state import ClusterState
 from .calculators import TimesharePartitionCalculator, TimeshareProfileCalculator
 from .partitioner import (
@@ -25,19 +26,29 @@ def new_timeshare_partitioner_controller(
     cm_name: str = DEVICE_PLUGIN_CM_NAME,
     cm_namespace: str = DEVICE_PLUGIN_CM_NAMESPACE,
     plan_deadline_s: float | None = None,
+    replan_epoch_s: float | None = None,
+    plan_shard_min_hosts: int = PLAN_SHARD_MIN_HOSTS,
+    plan_workers: int = 0,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
 
     partition_calculator = TimesharePartitionCalculator()
-    planner = GeometryPlanner(
-        framework=framework or Framework(),
-        calculator=TimeshareProfileCalculator(),
-        partition_calculator=partition_calculator,
-    )
+
+    def make_planner() -> GeometryPlanner:
+        return GeometryPlanner(
+            framework=framework or Framework(),
+            calculator=TimeshareProfileCalculator(),
+            partition_calculator=partition_calculator,
+        )
+
     kwargs = {}
     if clock is not None:
         kwargs["clock"] = clock
+    planner = ParallelGeometryPlanner(
+        make_planner, TimeshareProfileCalculator(), kind=TIMESHARE_KIND,
+        max_workers=plan_workers, min_shard_hosts=plan_shard_min_hosts,
+        **kwargs)
     quarantine = QuarantineList(kind=TIMESHARE_KIND, **kwargs)
     actuator = GeometryActuator(
         TimesharePartitioner(api, cm_name, cm_namespace),
@@ -47,5 +58,6 @@ def new_timeshare_partitioner_controller(
         api=api, cluster_state=cluster_state, kind=TIMESHARE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=TimeshareSnapshotTaker(), batcher=batcher,
-        quarantine=quarantine, plan_deadline_s=plan_deadline_s, **kwargs,
+        quarantine=quarantine, plan_deadline_s=plan_deadline_s,
+        replan_epoch_s=replan_epoch_s, **kwargs,
     )

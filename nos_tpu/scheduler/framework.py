@@ -291,9 +291,20 @@ class Framework:
     def plugins(self) -> list[object]:
         return list(self._plugins)
 
+    @property
+    def filter_chain(self) -> list[object]:
+        """The Filter dispatch table in run order — the native
+        prescreen (scheduler/native_filter.py) gates its soundness
+        levels on this chain's shape."""
+        return list(self._filter)
+
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod,
                                nodes: SharedLister) -> Status:
         obs_bump("prefilter_runs")
+        if not self._pre_filter:
+            # planner frameworks typically register no PreFilter plugin;
+            # skip the lock round-trip on the per-pod x candidate path
+            return Status.ok()
         with self._lock:
             for p in self._pre_filter:
                 st = p.pre_filter(state, pod, nodes)

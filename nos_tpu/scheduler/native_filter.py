@@ -1,0 +1,188 @@
+"""Native prescreen for the Filter per-pod x node hot loop.
+
+The Filter pipeline runs per (pod equivalence class, node) in both the
+scheduler's cycle and the planner's what-if simulation; at fleet scale
+(1024+ hosts) the Python pipeline — lock, plugin dispatch, Status
+allocation — dominates the cycle even though almost every verdict is a
+plain resource comparison.  `FitPrescreen` pushes exactly that
+comparison into the C++ shim (tpu_shim.cc `nos_fit_batch`, next to the
+packer) as a batch call that RELEASES the GIL, so concurrent plan
+shards screening at once genuinely overlap.
+
+Soundness is a superset contract, never a semantic fork:
+
+- the native math replays `NodeResourcesFit.filter` bit-for-bit on the
+  same doubles (request <= free per requested resource, then the
+  chip-equivalent aggregate guard), so a native FAIL is exactly a
+  NodeResourcesFit fail;
+- a pipeline containing the exact in-tree `NodeResourcesFit` class
+  fails whenever any plugin fails, so native-fail implies
+  pipeline-fail: fail verdicts may be recorded without running the
+  pipeline (`verdict_sound`);
+- when NodeResourcesFit additionally runs FIRST in the chain, the
+  pipeline's failure Status on such a node IS NodeResourcesFit's, so
+  the exact rejection message can be reconstructed from the native
+  miss mask (`message_exact`) — the scheduler's journal/explain output
+  is byte-identical with and without the screen;
+- native PASS verdicts decide nothing: those (class, node) pairs still
+  run the full Python pipeline.
+
+A subclassed or re-ordered plugin chain disables the corresponding
+level automatically; an unavailable shim disables everything (every
+screen call falls back to `None`, callers run the pure-Python path).
+tests/test_native.py pins the native-vs-Python equivalence property.
+
+Cost discipline: the planner calls `compile_classes` ONCE per plan —
+the request matrix, chip vector and output buffers become reusable
+ctypes arrays — so each candidate node pays one free-row fill plus one
+GIL-free C call, not a fresh marshal of every class.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from nos_tpu.device import native
+from nos_tpu.kube.resources import ResourceList
+
+from .framework import Framework, NodeInfo, NodeResourcesFit, _slice_chips
+
+
+class CompiledClasses:
+    """Plan-lifetime screen state: the class request matrix and scratch
+    buffers, marshalled once.  NOT thread-safe — one instance per
+    planning thread (each plan shard compiles its own)."""
+
+    __slots__ = ("universe", "n", "n_res", "req_arr", "chips_arr",
+                 "free_arr", "cap_arr", "used_arr", "out_arr", "any_chips")
+
+    def __init__(self, universe: list[str],
+                 classes: list[tuple[ResourceList, int]]) -> None:
+        self.universe = universe
+        self.n = len(classes)
+        self.n_res = len(universe)
+        req_flat = [
+            float(request.get(name, 0.0))
+            for request, _ in classes for name in universe]
+        self.req_arr = (ctypes.c_double * max(1, len(req_flat)))(*req_flat)
+        self.chips_arr = (ctypes.c_double * self.n)(
+            *[float(chips) for _, chips in classes])
+        self.any_chips = any(chips for _, chips in classes)
+        self.free_arr = (ctypes.c_double * max(1, self.n_res))()
+        self.cap_arr = (ctypes.c_double * 1)()
+        self.used_arr = (ctypes.c_double * 1)()
+        self.out_arr = (ctypes.c_uint8 * self.n)()
+
+
+class FitPrescreen:
+    """Batch resource-fit screen bound to one framework's filter chain."""
+
+    def __init__(self, framework: Framework) -> None:
+        chain = framework.filter_chain
+        self.verdict_sound = any(
+            type(p) is NodeResourcesFit for p in chain)
+        self.message_exact = bool(chain) and \
+            type(chain[0]) is NodeResourcesFit
+
+    # -- planner path: one node x M compiled classes, verdicts only ---------
+    def compile_classes(
+        self, classes: list[tuple[ResourceList, int]],
+    ) -> CompiledClasses | None:
+        """Marshal the plan's equivalence classes once; None when the
+        screen cannot run (unsound chain, shim missing, too many
+        distinct resources)."""
+        if not self.verdict_sound or not classes:
+            return None
+        if not native.fit_batch_available():
+            return None
+        universe = sorted({
+            name for request, _ in classes
+            for name, qty in request.items() if qty > 0})
+        if len(universe) > native.FIT_MAX_RESOURCES:
+            return None
+        return CompiledClasses(universe, classes)
+
+    def screen_compiled(self, node_info: NodeInfo,
+                        compiled: CompiledClasses) -> list[bool] | None:
+        """Verdict per compiled class against one node state; None =
+        screen unavailable (caller runs the pipeline)."""
+        free = node_info.free()
+        for i, name in enumerate(compiled.universe):
+            compiled.free_arr[i] = free.get(name, 0.0)
+        if compiled.any_chips:
+            compiled.cap_arr[0] = float(_slice_chips(node_info.allocatable))
+            compiled.used_arr[0] = float(_slice_chips(node_info.requested))
+        if not native.fit_batch_raw(
+                compiled.free_arr, compiled.req_arr, compiled.cap_arr,
+                compiled.used_arr, compiled.chips_arr, 1, compiled.n,
+                compiled.n_res, compiled.out_arr):
+            return None
+        return [compiled.out_arr[j] == 1 for j in range(compiled.n)]
+
+    # -- scheduler path: N nodes x one class, exact messages ----------------
+    def screen_nodes(
+        self, node_infos: list[NodeInfo], request: ResourceList,
+        pod_chips: int,
+        chip_cache: dict[str, tuple[int, int]] | None = None,
+    ) -> list[str | None] | None:
+        """Per-node rejection message for native fails (None entry =
+        native pass, run the pipeline); None overall = unavailable.
+        Messages are NodeResourcesFit's exact strings, prefixed the way
+        the scheduler memoises them ("NodeResourcesFit: ...") — only
+        valid under `message_exact`.  `chip_cache` (node name ->
+        (cap, used) chip-equivalents) amortises the aggregate-guard
+        scans across the classes of one cycle; the caller owns its
+        invalidation (drop a node's entry whenever its requested set
+        changes)."""
+        if not self.message_exact or not node_infos:
+            return None
+        universe = sorted(
+            name for name, qty in request.items() if qty > 0)
+        if len(universe) > native.FIT_MAX_RESOURCES:
+            return None
+        req_flat = [float(request[name]) for name in universe]
+        free_flat: list[float] = []
+        chips: list[tuple[int, int]] = []
+        for ni in node_infos:
+            free = ni.free()
+            free_flat.extend(free.get(name, 0.0) for name in universe)
+            if not pod_chips:
+                chips.append((0, 0))
+                continue
+            cached = chip_cache.get(ni.name) if chip_cache is not None \
+                else None
+            if cached is None:
+                cached = (_slice_chips(ni.allocatable),
+                          _slice_chips(ni.requested))
+                if chip_cache is not None:
+                    chip_cache[ni.name] = cached
+            chips.append(cached)
+        result = native.fit_batch(
+            free_flat, req_flat,
+            [float(c) for c, _ in chips], [float(u) for _, u in chips],
+            [float(pod_chips)],
+            len(node_infos), 1, len(universe))
+        if result is None:
+            return None
+        verdicts, miss = result
+        if miss is None:
+            return None
+        out: list[str | None] = []
+        for i in range(len(node_infos)):
+            if verdicts[i] == 1:
+                out.append(None)
+                continue
+            mask = miss[i]
+            if mask & ~native.FIT_MISS_CHIP_GUARD:
+                missing = sorted(
+                    universe[r] for r in range(len(universe))
+                    if mask & (1 << r))
+                out.append("NodeResourcesFit: insufficient "
+                           + ", ".join(missing))
+            else:
+                cap, used = chips[i]
+                out.append(
+                    f"NodeResourcesFit: insufficient slice chips "
+                    f"({used}+{pod_chips} over {cap}; "
+                    f"geometry in flux)")
+        return out

@@ -32,8 +32,9 @@ from nos_tpu.kube.resources import pod_request, sum_resources
 from nos_tpu.scheduler.cache import SchedulerCache
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status, UNSCHEDULABLE,
-    filter_equivalence_key,
+    _slice_chips, filter_equivalence_key,
 )
+from nos_tpu.scheduler.native_filter import FitPrescreen
 from nos_tpu.scheduler.gang import (
     GANG_HOST_SET_KEY, GANG_POD_ID_KEY, gang_name, gang_slice_windows,
     get_pod_group, set_pod_group_status,
@@ -191,6 +192,35 @@ class Scheduler:
         # re-running the whole Filter pipeline per node; entries die with
         # the node's assume booking and with the cycle snapshot.
         self._filter_cache: dict[str, dict] = {}
+        # Native batch fit screen (scheduler/native_filter.py): definite
+        # NodeResourcesFit fails are memoised from ONE GIL-releasing C
+        # call over all unseen nodes instead of a Python pipeline run
+        # each.  message_exact required — the memo carries the exact
+        # rejection strings the journal/explain output relies on.
+        _screen = FitPrescreen(framework)
+        self._prescreen = _screen if _screen.message_exact else None
+        # chip-equivalent (cap, used) per node for the screen's
+        # aggregate guard — cycle-scoped, dropped per node on assume
+        self._chips_cache: dict[str, tuple[int, int]] = {}
+        # equivalence classes already screened this cycle: later pods of
+        # the class skip even the is-anything-unseen scan (an assumed
+        # node's dropped memo entry just falls back to the pipeline)
+        self._screened_classes: set = set()
+        # Per-class full-scan cache: (feasible NodeInfos, per-node
+        # rejections, memoised rejection attrs) for one equivalence
+        # class against the UNCHANGED cycle state.  The per-pod x node
+        # loop is the fleet's steady-state cycle cost, and every pod of
+        # a class sees the identical verdict set — so the fleet pays one
+        # scan per class per state, not per pod.  Invalidated wholesale
+        # whenever node state moves (assume, preemption, cycle reset);
+        # disabled while duration-aware backfill is on (its verdicts are
+        # per-pod, not per-class).
+        self._class_scan_cache: dict[tuple, Any] = {}
+        # Per-cycle window-busy map for _score_key's fragmentation
+        # penalty: building it per scoring decision was O(pods x nodes)
+        # per cycle at fleet scale.  Lives and dies with the cycle
+        # snapshot; assume() marks the bound host busy in place.
+        self._busy_map_cache: dict[tuple[str, int], bool] | None = None
         # True while run_cycle drives the entry points: the cycle
         # snapshot is shared across its pods.  Direct schedule_one/
         # schedule_gang calls (public entry points) drop it on exit so
@@ -224,6 +254,10 @@ class Scheduler:
         if self._cycle_lister_cache is None:
             self._cycle_lister_cache = self.snapshot()
             self._filter_cache = {}
+            self._chips_cache = {}
+            self._screened_classes = set()
+            self._class_scan_cache = {}
+            self._busy_map_cache = None
         return self._cycle_lister_cache
 
     def schedule_one(self, pod: Pod) -> str | None:
@@ -241,6 +275,47 @@ class Scheduler:
         (ADVICE round 5)."""
         self._cycle_lister_cache = None
         self._filter_cache = {}
+        self._busy_map_cache = None
+        self._chips_cache = {}
+        self._screened_classes = set()
+        self._class_scan_cache = {}
+
+    def _seed_filter_memo_native(self, pod: Pod, equiv: tuple,
+                                 lister: SharedLister) -> None:
+        """Seed the per-cycle Filter memo with the native batch screen's
+        definite fails for this pod's equivalence class (superset
+        contract: native fail => the pipeline fails with exactly the
+        memoised message — see native_filter.py).  Native passes decide
+        nothing; those nodes still run the real pipeline."""
+        assert self._prescreen is not None
+        if equiv in self._screened_classes:
+            return
+        from nos_tpu.device import native
+        if not native.fit_batch_available(build=False):
+            # shim-less deployment: latch the screen OFF before paying
+            # any per-node marshalling — the pure-Python pipeline path
+            # must not get slower for lack of a .so (decided once, at
+            # the first scheduling cycle)
+            self._prescreen = None
+            return
+        self._screened_classes.add(equiv)
+        unseen = [ni for ni in lister.list()
+                  if equiv not in self._filter_cache.get(ni.name, ())]
+        if not unseen:
+            return
+        req = pod_request(pod)
+        msgs = self._prescreen.screen_nodes(unseen, req, _slice_chips(req),
+                                            chip_cache=self._chips_cache)
+        if msgs is None:
+            return
+        seeded = 0
+        for ni, why in zip(unseen, msgs):
+            if why is not None:
+                self._filter_cache.setdefault(ni.name, {})[equiv] = \
+                    (False, why)
+                seeded += 1
+        if seeded:
+            obs_bump("prescreen_fails", seeded)
 
     def _schedule_one(self, pod: Pod) -> str | None:
         obs_bump("schedule_one")
@@ -263,26 +338,46 @@ class Scheduler:
             self._mark_unschedulable(pod, status)
             return None
         equiv = self._filter_equiv_key(pod)
-        feasible: list[NodeInfo] = []
-        rejections: dict[str, str] = {}
-        for ni in lister.list():
-            if not self._backfill_allows(pod, ni):
-                rejections[ni.name] = \
-                    "Backfill: job would outlive the drain window"
-                continue
-            ok, why = self._filter_passes(state, pod, ni, equiv)
-            if ok:
-                feasible.append(ni)
-            else:
-                rejections[ni.name] = why
+        if equiv is not None and self._prescreen is not None:
+            self._seed_filter_memo_native(pod, equiv, lister)
+        # Per-class scan cache: every pod of one equivalence class sees
+        # the identical per-node verdicts against unchanged state, so
+        # the fleet-wide loop runs once per class, not once per pod.
+        # Duration-aware backfill makes verdicts per-pod: bypass then.
+        cacheable = equiv is not None and (
+            self._backfill_duration_fn is None
+            or not self._reserved_hosts)
+        scan = self._class_scan_cache.get(equiv) if cacheable else None
+        if scan is None:
+            feasible: list[NodeInfo] = []
+            rejections: dict[str, str] = {}
+            for ni in lister.list():
+                # ni.name is a two-hop property and this loop runs per
+                # pod x node over the whole fleet: read it once
+                name = ni.name
+                if not self._backfill_allows(pod, ni, name):
+                    rejections[name] = \
+                        "Backfill: job would outlive the drain window"
+                    continue
+                ok, why = self._filter_passes(state, pod, ni, equiv, name)
+                if ok:
+                    feasible.append(ni)
+                else:
+                    rejections[name] = why
+            scan = [feasible, rejections, None]
+            if cacheable:
+                self._class_scan_cache[equiv] = scan
+        feasible, rejections = scan[0], scan[1]
         if not feasible:
             nominated, post = self._post_filter_budgeted(state, pod, lister)
             if post.is_success and nominated:
                 self._nominate(pod, nominated)
             else:
+                if scan[2] is None:
+                    scan[2] = self._node_reason_attrs(rejections)
                 self._mark_unschedulable(
                     pod, Status.unschedulable("no fit"),
-                    node_reasons=rejections)
+                    node_attrs=scan[2])
             return None
         chosen = min(feasible, key=self._score_key(pod, lister))
         status = self._framework.run_reserve_plugins(state, pod, chosen.name)
@@ -312,13 +407,17 @@ class Scheduler:
         return filter_equivalence_key(pod)
 
     def _filter_passes(self, state: CycleState, pod: Pod, ni: NodeInfo,
-                       equiv: tuple | None) -> tuple[bool, str]:
+                       equiv: tuple | None,
+                       name: str | None = None) -> tuple[bool, str]:
         """(verdict, why): why is "plugin: message" on rejection, "" on
         success — the journal's per-node provenance, carried through the
-        memo so cache hits keep their reason."""
+        memo so cache hits keep their reason.  `name` lets fleet-scale
+        loops pass the already-read node name (ni.name is a two-hop
+        property)."""
         if equiv is None:
             return self._filter_verdict(state, pod, ni)
-        per_node = self._filter_cache.setdefault(ni.name, {})
+        per_node = self._filter_cache.setdefault(
+            name if name is not None else ni.name, {})
         verdict = per_node.get(equiv)
         if verdict is None:
             verdict = self._filter_verdict(state, pod, ni)
@@ -335,10 +434,14 @@ class Scheduler:
     def _assume_bound(self, pod: Pod, node_name: str) -> None:
         """Book a just-bound pod into the cycle snapshot so later pods
         this cycle see its capacity consumed (the assume cache)."""
-        # the node's capacity changed: its memoised Filter verdicts die
+        # the node's capacity changed: its memoised Filter verdicts die,
+        # and every class's cached full scan with them
         self._filter_cache.pop(node_name, None)
+        self._chips_cache.pop(node_name, None)
+        self._class_scan_cache = {}
         assumed = fast_deepcopy(pod)
         assumed.spec.node_name = node_name
+        self._mark_busy(node_name)
         if self._cache is not None:
             # also book into the incremental cache: on an async watch
             # substrate the bind's pod event can lag a node event whose
@@ -350,6 +453,33 @@ class Scheduler:
         ni = lister.get(node_name)
         if ni is not None:
             ni.add_pod(assumed)
+
+    @staticmethod
+    def _window_key(labels: dict) -> tuple[str, int] | None:
+        """(pod-id, host-index) of a node's labels, or None when it has
+        no pod-id / an unparsable index — ONE parsing for the busy-map
+        builder, the in-place busy marker, and the score penalty, so
+        they can never disagree on the key encoding."""
+        pid = labels.get(C_LABEL_POD_ID, "")
+        if not pid:
+            return None
+        try:
+            return pid, int(labels.get(C_LABEL_HOST_INDEX, "0"))
+        except ValueError:
+            return None
+
+    def _mark_busy(self, node_name: str) -> None:
+        """Keep the cycle's window-busy map truthful after a bind: the
+        host now has a pod, so whole-free-window penalties involving it
+        must stop firing this cycle."""
+        if self._busy_map_cache is None or self._cycle_lister_cache is None:
+            return
+        ni = self._cycle_lister_cache.get(node_name)
+        if ni is None:
+            return
+        key = self._window_key(ni.node.metadata.labels)
+        if key is not None:
+            self._busy_map_cache[key] = True
 
     def run_cycle(self) -> int:
         """Schedule all pending, not-yet-bound pods for this scheduler;
@@ -371,6 +501,7 @@ class Scheduler:
         self._window_eta = None     # re-estimated per cycle
         self._quota_hol: dict[str, int] = {}
         self._cycle_lister_cache = None     # fresh snapshot per cycle
+        self._busy_map_cache = None
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
@@ -415,6 +546,7 @@ class Scheduler:
         # public entry points and must see fresh state when driven
         # outside run_cycle (they rebuild lazily)
         self._cycle_lister_cache = None
+        self._busy_map_cache = None
         return bound
 
     # -- quota head-of-line -------------------------------------------------
@@ -629,10 +761,12 @@ class Scheduler:
                     gang_name(first), bound_members)
         return bound_members
 
-    def _backfill_allows(self, pod: Pod, ni: NodeInfo) -> bool:
+    def _backfill_allows(self, pod: Pod, ni: NodeInfo,
+                         name: str | None = None) -> bool:
         """Duration-aware drain-window backfill (__init__); True outside
         the reserved window or when the feature is off."""
-        if ni.name not in self._reserved_hosts \
+        if (name if name is not None else ni.name) \
+                not in self._reserved_hosts \
                 or self._backfill_duration_fn is None \
                 or self._backfill_remaining_fn is None:
             return True
@@ -673,6 +807,7 @@ class Scheduler:
         if status.is_success:
             # victims were evicted: the cycle snapshot is stale
             self._cycle_lister_cache = None
+            self._busy_map_cache = None
         return nominated, status
 
     def _maybe_drain_preempt(self) -> None:
@@ -1024,21 +1159,27 @@ class Scheduler:
                 logger.debug("lease annotation patch failed for %s",
                              node.metadata.name)
 
+    def _cycle_busy_map(self, lister: SharedLister) -> dict:
+        """The window-busy map, cached for the cycle when the given
+        lister IS the cycle snapshot (mutations route through
+        _mark_busy/_busy_map_cache invalidation); rebuilt fresh for any
+        other lister (direct entry points, gang what-if domains)."""
+        if lister is not self._cycle_lister_cache:
+            return self._window_busy_map(lister)
+        if self._busy_map_cache is None:
+            self._busy_map_cache = self._window_busy_map(lister)
+        return self._busy_map_cache
+
     def _window_busy_map(self, lister: SharedLister) -> dict:
         """(pod_id, host_index) -> has-pods, for fragmentation-aware
         scoring.  Built once per scoring decision from the cycle's
         lister view."""
         busy: dict[tuple[str, int], bool] = {}
         for ni in lister.list():
-            labels = ni.node.metadata.labels
-            pid = labels.get(C_LABEL_POD_ID, "")
-            if not pid:
+            key = self._window_key(ni.node.metadata.labels)
+            if key is None:
                 continue
-            try:
-                idx = int(labels.get(C_LABEL_HOST_INDEX, "0"))
-            except ValueError:
-                continue
-            busy[(pid, idx)] = busy.get((pid, idx), False) or bool(ni.pods)
+            busy[key] = busy.get(key, False) or bool(ni.pods)
         return busy
 
     @staticmethod
@@ -1060,19 +1201,15 @@ class Scheduler:
         (lexicographic order would put host-10 before host-2 and fragment
         every window)."""
         req = pod_request(pod)
-        busy = self._window_busy_map(lister) if lister is not None else {}
+        busy = self._cycle_busy_map(lister) if lister is not None else {}
 
         def window_penalty(ni: NodeInfo) -> int:
             if not busy:
                 return 0
-            labels = ni.node.metadata.labels
-            pid = labels.get(C_LABEL_POD_ID, "")
-            if not pid:
+            wkey = self._window_key(ni.node.metadata.labels)
+            if wkey is None:
                 return 0
-            try:
-                idx = int(labels.get(C_LABEL_HOST_INDEX, "0"))
-            except ValueError:
-                return 0
+            pid, idx = wkey
             pen = 0
             for size in self._window_sizes(ni):
                 start = (idx // size) * size
@@ -1145,27 +1282,40 @@ class Scheduler:
         self._patch_pod(pod, mutate)
         journal_record(J.POD_NOMINATED, pod.key, node=node_name)
 
+    @staticmethod
+    def _node_reason_attrs(node_reasons: dict[str, str]) -> dict:
+        """Journal attrs for a per-node rejection map: per-node verdicts
+        capped (MAX_JOURNAL_NODES), per-reason counts complete.  Reason
+        strings embed per-node numbers (e.g. "used+req over cap"), so a
+        heterogeneous cluster can mint one distinct reason per node —
+        cap them too (top-N by node count) and carry the complete total
+        separately.  Computed once per equivalence class per cycle (the
+        class scan cache memoises the result: at fleet scale sorting
+        1024 rejections per pending pod was measurable)."""
+        if not node_reasons:
+            return {}
+        return {
+            "nodes": dict(sorted(
+                node_reasons.items())[:MAX_JOURNAL_NODES]),
+            "reason_counts": dict(Counter(
+                node_reasons.values()).most_common(MAX_JOURNAL_NODES)),
+            "nodes_total": len(node_reasons),
+        }
+
     def _mark_unschedulable(self, pod: Pod, status: Status,
-                            node_reasons: dict[str, str] | None = None
-                            ) -> None:
+                            node_reasons: dict[str, str] | None = None,
+                            node_attrs: dict | None = None) -> None:
         def mutate(p: Pod) -> None:
             p.mark_unschedulable(status.message, status.reason)
         self._patch_pod(pod, mutate)
-        # the journal's "why is this pod pending" substrate: per-reason
-        # counts complete, per-node verdicts capped (MAX_JOURNAL_NODES)
+        # the journal's "why is this pod pending" substrate
         attrs: dict = {"reason": status.reason, "message": status.message}
         if status.plugin:
             attrs["plugin"] = status.plugin
-        if node_reasons:
-            attrs["nodes"] = dict(sorted(
-                node_reasons.items())[:MAX_JOURNAL_NODES])
-            # reason strings embed per-node numbers (e.g. "used+req over
-            # cap"), so a heterogeneous cluster can mint one distinct
-            # reason per node — cap them too (top-N by node count) and
-            # carry the complete total separately
-            attrs["reason_counts"] = dict(Counter(
-                node_reasons.values()).most_common(MAX_JOURNAL_NODES))
-            attrs["nodes_total"] = len(node_reasons)
+        if node_attrs is None and node_reasons:
+            node_attrs = self._node_reason_attrs(node_reasons)
+        if node_attrs:
+            attrs.update(node_attrs)
         g = gang_name(pod)
         if g:
             attrs["gang"] = f"{pod.metadata.namespace}/{g}"

@@ -178,7 +178,9 @@ class ChaosAPIServer(APIServer):
         super().delete(kind, name, namespace)
         self._tick_ops()
 
-    def watch(self, kind: str, fn: WatchFn) -> Callable[[], None]:
+    def watch(self, kind: str, fn: WatchFn,
+              selector: Callable[[Any], bool] | None = None
+              ) -> Callable[[], None]:
         def chaotic(event: str, obj: Any) -> None:
             if self._faultable(kind):
                 with self._chaos_lock:
@@ -192,4 +194,6 @@ class ChaosAPIServer(APIServer):
                     return
             fn(event, obj)
 
-        return super().watch(kind, chaotic)
+        # selector applies upstream of the drop roulette: dropped events
+        # were already selector-passing, so replay stays coherent
+        return super().watch(kind, chaotic, selector=selector)

@@ -22,9 +22,17 @@ from dataclasses import dataclass, field
 from .shape import Shape
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class Generation:
-    """One TPU generation's physical parameters."""
+    """One TPU generation's physical parameters.
+
+    eq=False: generations are compared (and hashed) by IDENTITY — every
+    consumer holds the shared registry instance, `load_overrides`
+    installs a NEW object (so identity-keyed caches invalidate
+    correctly), and the derived-table caches key on Generation at
+    per-pod x node rates where a field-wise dataclass hash (re-hashing
+    the whole slice-shape table per lookup) was a measured fleet-plan
+    hot spot."""
 
     name: str                     # accelerator label value, e.g. "tpu-v5e"
     ndims: int                    # ICI mesh rank (2 for v5e, 3 for v4/v5p)

@@ -12,6 +12,8 @@ Two families, mirroring the reference's two partitioning modes:
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 from nos_tpu.api import constants as C
 from nos_tpu.kube.resources import ResourceList
 
@@ -22,12 +24,16 @@ from .shape import Shape
 # ---------------------------------------------------------------------------
 
 
+@lru_cache(maxsize=4096)
 def slice_resource_name(shape: Shape | str) -> str:
     s = shape if isinstance(shape, Shape) else Shape.parse(shape)
     return f"{C.RESOURCE_SLICE_PREFIX}{s.canonical().name}"
 
 
+@lru_cache(maxsize=4096)
 def shape_from_resource(resource: str) -> Shape | None:
+    # memoised: the resource-name vocabulary is tiny and this regex ran
+    # per resource per pod x node in every Filter/score hot loop
     m = C.SLICE_RESOURCE_RE.match(resource)
     return Shape.parse(m.group("shape")) if m else None
 
@@ -51,10 +57,12 @@ def extract_slice_requests(request: ResourceList) -> dict[Shape, int]:
 # ---------------------------------------------------------------------------
 
 
+@lru_cache(maxsize=4096)
 def timeshare_resource_name(gb: int) -> str:
     return f"{C.RESOURCE_TIMESHARE_PREFIX}{gb}gb"
 
 
+@lru_cache(maxsize=4096)
 def gb_from_resource(resource: str) -> int | None:
     m = C.TIMESHARE_RESOURCE_RE.match(resource)
     return int(m.group("gb")) if m else None

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass
-from functools import reduce, total_ordering
+from functools import lru_cache, reduce, total_ordering
 from operator import mul
 
 
@@ -26,7 +26,11 @@ class Shape:
             raise ValueError(f"invalid shape dims {self.dims}")
 
     @staticmethod
+    @lru_cache(maxsize=65536)
     def parse(s: str) -> "Shape":
+        # memoised: Shape is frozen, so sharing one instance per spelling
+        # is safe, and parse() runs in every decision-plane hot loop
+        # (profile extraction, geometry scoring) at per-pod x node rates
         try:
             dims = tuple(int(d) for d in s.lower().split("x"))
         except ValueError as e:
@@ -42,7 +46,17 @@ class Shape:
         return "x".join(str(d) for d in self.dims)
 
     def canonical(self) -> "Shape":
-        return Shape(tuple(sorted(self.dims)))
+        # per-instance memo (frozen dataclass: not a field, so eq/hash/
+        # repr are untouched): canonical() runs in every profile
+        # extraction and geometry-scoring hot loop, and most shapes ARE
+        # already canonical — return self then, no object churn
+        try:
+            return object.__getattribute__(self, "_canonical")
+        except AttributeError:
+            dims = tuple(sorted(self.dims))
+            c = self if dims == self.dims else Shape(dims)
+            object.__setattr__(self, "_canonical", c)
+            return c
 
     def orientations(self) -> list[tuple[int, ...]]:
         """All distinct axis permutations (placement orientations)."""

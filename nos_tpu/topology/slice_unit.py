@@ -11,6 +11,7 @@ validity *is* packing feasibility (SURVEY.md §7 hard part 1).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Mapping
 
 from .errors import InvalidGeometryError
@@ -148,7 +149,46 @@ class SliceUnit:
         """Re-carve free capacity to provide as many lacking slices as
         possible; keep the current geometry if no candidate strictly
         improves.  Hot loop #1 (reference mig/gpu.go:158-212: score every
-        allowed geometry against the lacking profiles)."""
+        allowed geometry against the lacking profiles).
+
+        The search is memoised (pin-free units only): the score of any
+        candidate depends on a lacking count only up to what one block
+        can physically provide (min(free, n) saturates at the per-block
+        capacity), so counts are clamped before keying — a fleet plan
+        asking 100 virgin v5e hosts to carve toward {1x1: 500} resolves
+        the search once, not per candidate."""
+        block_chips = self.generation.host_block.chips
+        relevant: dict[Shape, int] = {}
+        for s, n in lacking.items():
+            if n <= 0:
+                continue
+            cap = block_chips // s.chips
+            if cap <= 0:
+                continue    # cannot appear in any geometry: scores 0
+            relevant[s] = min(n, cap)
+        if not relevant:
+            # every candidate (and the current geometry) scores 0, so
+            # nothing can strictly improve — the unmemoised search
+            # returns False here too
+            return False
+        if self.placed_used or self.placed_free:
+            # pins make feasibility placement-dependent: exact search
+            best_geo = self._search_recarve(relevant)
+        else:
+            cached = _best_recarve(
+                self.generation,
+                frozenset((s, c) for s, c in self.used.items() if c > 0),
+                frozenset((s, c) for s, c in self.free.items() if c > 0),
+                frozenset(relevant.items()))
+            best_geo = dict(cached) if cached is not None else None
+        if best_geo is None:
+            return False
+        self.apply_geometry(best_geo)
+        return True
+
+    def _search_recarve(self,
+                        lacking: Mapping[Shape, int]) -> dict[Shape, int] | None:
+        """The exhaustive score-every-allowed-geometry search."""
 
         def score(free: Mapping[Shape, int]) -> int:
             return sum(min(free.get(s, 0), n) for s, n in lacking.items())
@@ -164,10 +204,7 @@ class SliceUnit:
             if sc > best or (sc == best and best_geo is not None
                              and sum(geo.values()) < sum(best_geo.values())):
                 best, best_geo = sc, dict(geo)
-        if best_geo is None:
-            return False
-        self.apply_geometry(best_geo)
-        return True
+        return best_geo
 
     # -- multi-host membership ---------------------------------------------
     def is_multihost_shard(self) -> bool:
@@ -234,3 +271,23 @@ class SliceUnit:
                 dst.append(src.pop(i))
                 return
         self._drop_placement_data()
+
+
+@lru_cache(maxsize=8192)
+def _best_recarve(generation: Generation,
+                  used_key: frozenset, free_key: frozenset,
+                  lacking_key: frozenset) -> tuple | None:
+    """Memoised pin-free re-carve search.  Sound because, without
+    placement pins, the search outcome is a pure function of
+    (generation, used counts, free counts, clamped lacking): candidate
+    enumeration and count-level feasibility consult nothing else
+    (can_apply_geometry's placement branch is unreachable).  Keys are
+    zero-normalised by the caller; the result is the chosen geometry as
+    sorted items (Shape is frozen, so sharing is safe) or None for
+    keep-current."""
+    probe = SliceUnit(generation=generation,
+                      used=dict(used_key), free=dict(free_key))
+    best = probe._search_recarve(dict(lacking_key))
+    if best is None:
+        return None
+    return tuple(sorted(best.items()))

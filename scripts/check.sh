@@ -48,6 +48,11 @@ if ! env JAX_PLATFORMS=cpu python bench_plan.py --smoke; then
     rc=1
 fi
 
+echo "==> bench_fleet.py --smoke (shard-count + sharded plan wall gate)"
+if ! env JAX_PLATFORMS=cpu python bench_fleet.py --smoke; then
+    rc=1
+fi
+
 if [ "$FAST" -eq 0 ]; then
     echo "==> tier-1 pytest (-m 'not slow')"
     if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,569 @@
+"""Sharded parallel planning: pools, merge determinism, equivalence.
+
+The fleet-scale decision plane's correctness story, pinned:
+
+- pool partitioning groups by machine class + failure domain and the
+  pod split is deterministic, capacity-aware, and drops only
+  cross-pool-infeasible pods;
+- `ClusterSnapshot.subset` shares node objects but isolates fork/COW
+  state, so concurrent shards over disjoint pools never write through
+  to each other;
+- the parallel planner is BYTE-IDENTICAL to the sequential planner on
+  single-pool inputs (randomized property), and observationally
+  equivalent on multi-pool snapshots whose pod geometry classes are
+  pool-unique (the merge determinism contract, docs/performance.md) —
+  including cross-pool-infeasible pods and quarantined nodes;
+- a chaos-soak variant runs the worker pool under lockcheck
+  instrumentation: any lock-order inversion or unguarded write across
+  shard threads fails the seed;
+- epoch-batched replans: ready batches inside the running epoch defer
+  and accumulate into ONE plan cycle.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from nos_tpu import obs
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.obs import journal as J
+from nos_tpu.partitioning.core import (
+    ParallelGeometryPlanner, SnapshotError, partition_pools, split_pods,
+)
+from nos_tpu.partitioning.slicepart import (
+    SlicePartitionCalculator, SliceProfileCalculator, SliceSnapshotTaker,
+)
+from nos_tpu.partitioning.slicepart.group import MultiHostGeometryPlanner
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler.framework import Framework
+from nos_tpu.testing.factory import (
+    make_pod, make_slice_pod, make_tpu_node,
+)
+from nos_tpu.testing.lockcheck import LockGraph
+from nos_tpu.topology import V4, V5E
+
+CALC = SliceProfileCalculator()
+
+
+def make_sequential() -> MultiHostGeometryPlanner:
+    return MultiHostGeometryPlanner(
+        framework=Framework(), calculator=SliceProfileCalculator(),
+        partition_calculator=SlicePartitionCalculator())
+
+
+def make_parallel(**kw) -> ParallelGeometryPlanner:
+    kw.setdefault("min_shard_hosts", 0)
+    return ParallelGeometryPlanner(
+        make_sequential, SliceProfileCalculator(), kind="slice", **kw)
+
+
+def canon(state) -> dict:
+    """PartitioningState -> comparable plain dict (byte-level canon)."""
+    return {name: np._canon() for name, np in state.items()}
+
+
+# v5e profiles vs v4 profiles: no spelling collides, so every pod's
+# eligible pool is unique — the premise of the multi-pool equivalence
+# property (see pools.py docstring / docs/performance.md).
+V5E_PROFILES = ["1x1", "1x2", "2x2", "2x4", "4x4"]
+V4_PROFILES = ["1x1x1", "1x1x2", "1x2x2", "2x2x2"]
+GEOMETRIES = {
+    V5E: [{"free": {"2x4": 1}}, {"free": {"2x2": 2}},
+          {"free": {"1x1": 4, "1x2": 2}}, {"used": {"2x4": 1}},
+          {"used": {"2x2": 1}, "free": {"2x2": 1}}],
+    V4: [{"free": {"1x2x2": 1}}, {"free": {"1x1x2": 2}},
+         {"used": {"1x2x2": 1}}, {"used": {"1x1x2": 1},
+                                  "free": {"1x1x2": 1}}],
+}
+
+
+def random_state(rng: random.Random, gens, pools_per_gen: int = 2,
+                 hosts_per_pool: int = 6) -> ClusterState:
+    state = ClusterState()
+    for gen in gens:
+        for p in range(pools_per_gen):
+            for h in range(hosts_per_pool):
+                geo = rng.choice(GEOMETRIES[gen])
+                state.update_node(make_tpu_node(
+                    f"{gen.name}-{p}-h{h}", generation=gen,
+                    pod_id=f"{gen.name}-pod-{p}", host_index=h,
+                    status_geometry=dict(geo)), [])
+    return state
+
+
+def random_pods(rng: random.Random, gens, n: int,
+                infeasible: int = 0) -> list:
+    pods = []
+    gang_i = 0
+    for i in range(n):
+        gen = rng.choice(gens)
+        profiles = V5E_PROFILES if gen is V5E else V4_PROFILES
+        profile = rng.choice(profiles)
+        labels = None
+        if profile == "4x4":            # v5e multi-host: gang-labeled
+            labels = {C.LABEL_POD_GROUP: f"ppgang-{gang_i}"}
+            gang_i += 1
+        pods.append(make_slice_pod(profile, 1, name=f"pp-{i}",
+                                   labels=labels,
+                                   priority=rng.randrange(3)))
+    for i in range(infeasible):
+        # no present generation supports 7x7: cross-pool-infeasible
+        pods.append(make_slice_pod("7x7", 1, name=f"pp-inf-{i}"))
+    rng.shuffle(pods)
+    return pods
+
+
+class TestPools:
+    def test_partition_groups_by_class_and_domain(self):
+        state = random_state(random.Random(0), [V5E, V4])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        assert [p.key for p in pools] == sorted(p.key for p in pools)
+        assert len(pools) == 4
+        for pool in pools:
+            for name in pool.nodes:
+                assert name.startswith(f"{pool.accelerator}-")
+
+    def test_split_is_deterministic_and_pool_unique(self):
+        rng = random.Random(1)
+        state = random_state(rng, [V5E, V4])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        pods = random_pods(random.Random(2), [V5E, V4], 20, infeasible=2)
+        a, inf_a = split_pods(pools, pods, CALC)
+        b, inf_b = split_pods(pools, pods, CALC)
+        assert {k: [p.key for p in v] for k, v in a.items()} == \
+            {k: [p.key for p in v] for k, v in b.items()}
+        assert [p.key for p in inf_a] == [p.key for p in inf_b]
+        assert len(inf_a) == 2
+        # every feasible pod landed in exactly one pool of its generation
+        assigned = [p.key for v in a.values() for p in v]
+        assert len(assigned) == len(set(assigned)) == len(pods) - 2
+        for key, members in a.items():
+            accel = key.split("|")[0]
+            for pod in members:
+                profile = next(iter(CALC.requested_profiles(pod)))
+                is_v5e = "x" in profile and profile.count("x") == 1
+                assert (accel == "tpu-v5e") == is_v5e
+
+    def test_split_demotes_fragmented_pools(self):
+        """A pod is not deterministically starved on the freest-but-
+        fragmented pool while a capable sibling pool exists: pools
+        whose every host has fewer free chips than a requested single-
+        host shape are demoted from assignment."""
+        state = ClusterState()
+        # pool-0: more TOTAL free chips, but fragmented (2 free 1x1 per
+        # host, rest used) — no host could ever re-carve a 2x4
+        for h in range(8):
+            node = make_tpu_node(
+                f"frag{h}", pod_id="pod-0", host_index=h,
+                status_geometry={"free": {"1x1": 2},
+                                 "used": {"1x1": 6}})
+            filler = make_pod(name=f"fragfill{h}", node_name=f"frag{h}",
+                              resources={"nos.tpu/slice-1x1": 6})
+            state.update_node(node, [filler])
+        # pool-1: one virgin host (8 free chips on one host)
+        state.update_node(make_tpu_node(
+            "virgin", pod_id="pod-1", host_index=0,
+            status_geometry={"free": {"2x4": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        assert pools[0].free_chips > pools[1].free_chips  # the trap
+        by_pool, inf = split_pods(
+            pools, [make_slice_pod("2x4", 1, name="whole")], CALC)
+        assert not inf
+        assert [p.metadata.name for p in by_pool[pools[1].key]] == ["whole"]
+        # but a 1x1 pod (fits any host) still goes to the freest pool
+        by_pool, _ = split_pods(
+            pools, [make_slice_pod("1x1", 1, name="tiny")], CALC)
+        assert [p.metadata.name for p in by_pool[pools[0].key]] == ["tiny"]
+
+    def test_split_keeps_gangs_atomic(self):
+        """All members of one pod group land in ONE pool — scattered
+        members would make every shard carve a multi-host window for
+        the same gang."""
+        state = ClusterState()
+        for p in range(2):
+            for h in range(4):
+                state.update_node(make_tpu_node(
+                    f"g{p}{h}", pod_id=f"pod-{p}", host_index=h,
+                    status_geometry={"free": {"2x4": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        gang = [make_slice_pod("4x4", 1, name=f"m{i}",
+                               labels={C.LABEL_POD_GROUP: "bigone"})
+                for i in range(4)]
+        # interleave singles so per-pod accounting WOULD have scattered
+        # the gang across the two equal pools
+        pods = [gang[0], make_slice_pod("1x1", 1, name="s0"), gang[1],
+                make_slice_pod("1x1", 1, name="s1"), gang[2], gang[3]]
+        by_pool, inf = split_pods(pools, pods, CALC)
+        assert not inf
+        homes = {k for k, v in by_pool.items()
+                 if any(p.metadata.name.startswith("m") for p in v)}
+        assert len(homes) == 1, by_pool
+
+    def test_split_spreads_by_remaining_capacity(self):
+        # two identical pools: pool-agnostic demand must alternate, not
+        # pile onto one pool
+        state = ClusterState()
+        for p in range(2):
+            for h in range(2):
+                state.update_node(make_tpu_node(
+                    f"n{p}{h}", pod_id=f"pod-{p}", host_index=h,
+                    status_geometry={"free": {"2x4": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        pods = [make_slice_pod("2x4", 1, name=f"s{i}") for i in range(4)]
+        by_pool, _ = split_pods(pools, pods, CALC)
+        sizes = sorted(len(v) for v in by_pool.values())
+        assert sizes == [2, 2]
+
+
+class TestSubset:
+    def test_subset_shares_objects_but_isolates_forks(self):
+        state = random_state(random.Random(3), [V5E])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        names = sorted(snap.nodes())[:3]
+        sub = snap.subset(names)
+        assert sub.get_node(names[0]) is snap.get_node(names[0])
+        sub.fork()
+        sub.get_node_for_write(names[0]).update_geometry_for({"1x1": 8})
+        # the COW clone replaced the SUBSET's entry only
+        assert sub.get_node(names[0]) is not snap.get_node(names[0])
+        sub.revert()
+        assert sub.get_node(names[0]) is snap.get_node(names[0])
+
+    def test_subset_rejects_unknown_and_forked(self):
+        state = random_state(random.Random(4), [V5E])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        with pytest.raises(SnapshotError):
+            snap.subset(["nope"])
+        snap.fork()
+        with pytest.raises(SnapshotError):
+            snap.subset(sorted(snap.nodes())[:1])
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_single_pool_byte_identical(self, seed):
+        """One pool => the parallel planner IS the sequential planner."""
+        rng = random.Random(1000 + seed)
+        state = random_state(rng, [V5E], pools_per_gen=1, hosts_per_pool=8)
+        taker = SliceSnapshotTaker()
+        pods = random_pods(random.Random(2000 + seed), [V5E], 14)
+        seq = make_sequential().plan(taker.take_snapshot(state), pods)
+        parallel = make_parallel()
+        par = parallel.plan(taker.take_snapshot(state), pods)
+        parallel.close()
+        assert canon(par) == canon(seq)
+
+    @pytest.mark.parametrize("seed", range(14))
+    def test_multi_pool_observational_equivalence(self, seed):
+        """Pool-unique pod classes (one pool per machine class, V5E's
+        2-D profile spellings disjoint from V4's 3-D ones): sharded ==
+        sequential byte-for-byte, including cross-pool-infeasible pods
+        and quarantined nodes.  Same-class multi-pool splits are a
+        deliberate policy divergence — covered by the determinism test
+        below, per the merge contract in docs/performance.md."""
+        rng = random.Random(3000 + seed)
+        state = random_state(rng, [V5E, V4], pools_per_gen=1,
+                             hosts_per_pool=10)
+        taker = SliceSnapshotTaker()
+        pods = random_pods(random.Random(4000 + seed), [V5E, V4], 18,
+                           infeasible=seed % 3)
+        # quarantine a couple of nodes: excluded from BOTH snapshots,
+        # exactly as the controller excludes them
+        all_names = sorted(state.nodes())
+        exclude = set(rng.sample(all_names, k=seed % 4))
+        seq = make_sequential().plan(
+            taker.take_snapshot(state, exclude=exclude), pods)
+        parallel = make_parallel()
+        par = parallel.plan(
+            taker.take_snapshot(state, exclude=exclude), pods)
+        parallel.close()
+        assert canon(par) == canon(seq)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_same_class_multi_pool_is_deterministic(self, seed):
+        """Pools of one machine class share profile classes, so the
+        capacity-aware split is a policy choice, not a replay of the
+        sequential planner — but it must be DETERMINISTIC: same
+        snapshot + batch => identical merged plan, across runs and
+        worker counts."""
+        rng = random.Random(5000 + seed)
+        state = random_state(rng, [V5E, V4], pools_per_gen=2)
+        taker = SliceSnapshotTaker()
+        pods = random_pods(random.Random(6000 + seed), [V5E, V4], 16)
+        results = []
+        for workers in (1, 2, 4):
+            parallel = make_parallel(max_workers=workers)
+            results.append(canon(parallel.plan(
+                taker.take_snapshot(state), pods)))
+            parallel.close()
+        assert results[0] == results[1] == results[2]
+
+    def test_multi_pool_merge_covers_every_node(self):
+        state = random_state(random.Random(7), [V5E, V4])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        n_nodes = len(snap.nodes())
+        parallel = make_parallel()
+        desired = parallel.plan(snap, random_pods(
+            random.Random(8), [V5E, V4], 10))
+        parallel.close()
+        assert len(desired) == n_nodes
+
+    def test_below_min_shard_hosts_stays_sequential(self):
+        state = random_state(random.Random(9), [V5E, V4])
+        parallel = make_parallel(min_shard_hosts=10_000)
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        ring = obs.RingExporter(maxlen=64)
+        with obs.scoped(obs.Tracer(ring=ring)):
+            parallel.plan(snap, random_pods(random.Random(10),
+                                            [V5E, V4], 6))
+        parallel.close()
+        assert not [s for s in ring.dump() if s["name"] == "plan_shard"]
+
+
+class TestObservability:
+    def test_shard_spans_journal_and_explain(self):
+        state = random_state(random.Random(11), [V5E, V4])
+        taker = SliceSnapshotTaker()
+        pods = random_pods(random.Random(12), [V5E, V4], 12)
+        ring = obs.RingExporter(maxlen=256)
+        tracer = obs.Tracer(ring=ring)
+        journal = obs.DecisionJournal(maxlen=256)
+        parallel = make_parallel()
+        with obs.scoped(tracer, journal):
+            # the controller's root span: explain plan keys off it
+            with tracer.span("partitioner.plan_cycle", kind="slice"):
+                parallel.plan(taker.take_snapshot(state), pods)
+        parallel.close()
+        spans = ring.dump()
+        shards = [s for s in spans if s["name"] == "plan_shard"]
+        assert len(shards) == 4
+        pools = {s["attrs"]["pool"] for s in shards}
+        assert len(pools) == 4
+        # worker-thread spans are parented INTO the cycle's trace
+        roots = [s for s in spans
+                 if s["name"] == "partitioner.plan_cycle"]
+        assert all(s["trace_id"] == roots[0]["trace_id"] for s in shards)
+        merged = journal.events(category=J.PLAN_SHARD_MERGED)
+        assert len(merged) == 1
+        assert merged[0].attrs["shards"] == 4
+        assert merged[0].trace_id == roots[0]["trace_id"]
+
+        from nos_tpu.obs.explain import explain_plan
+        snapshot = {"spans": spans,
+                    "journal": [r.to_dict() for r in journal.events()]}
+        lines = explain_plan(snapshot)
+        text = "\n".join(lines)
+        assert "shard time by pool:" in text
+        for key in pools:
+            assert key in text
+        assert "plan-shard-merged" in text
+
+    def test_shard_histogram_observed_per_pool(self):
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        state = random_state(random.Random(13), [V5E, V4])
+        parallel = make_parallel()
+        parallel.plan(SliceSnapshotTaker().take_snapshot(state),
+                      random_pods(random.Random(14), [V5E, V4], 8))
+        parallel.close()
+        text = REGISTRY.render()
+        assert "nos_tpu_plan_shard_seconds" in text
+        assert 'pool="tpu-v5e|tpu-v5e-pod-0"' in text
+
+
+@pytest.mark.chaos
+class TestParallelChaosSoak:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_worker_pool_under_lockcheck(self, seed):
+        """The shard worker pool under lockdep: inversions or unguarded
+        shared-state writes across shard threads fail the seed; the
+        merged plan still matches the sequential planner."""
+        lock_graph = LockGraph(name=f"parallel-plan-seed-{seed}")
+        rng = random.Random(7000 + seed)
+        with lock_graph.install():
+            state = random_state(rng, [V5E, V4], pools_per_gen=2,
+                                 hosts_per_pool=5)
+            tracer = obs.Tracer(ring=obs.RingExporter(maxlen=256))
+            journal = obs.DecisionJournal(maxlen=256)
+            parallel = make_parallel(max_workers=4)
+        taker = SliceSnapshotTaker()
+        pods = random_pods(random.Random(8000 + seed), [V5E, V4], 16,
+                           infeasible=1)
+        try:
+            with obs.scoped(tracer, journal):
+                with lock_graph.install():
+                    par = parallel.plan(taker.take_snapshot(state), pods)
+                    par2 = parallel.plan(taker.take_snapshot(state), pods)
+            # concurrent shards under lockdep are still deterministic
+            assert canon(par) == canon(par2)
+            lock_graph.assert_clean()
+        finally:
+            parallel.close()
+            lock_graph.close()
+
+
+class TestEpochBatching:
+    def _cluster(self, replan_epoch_s=None):
+        api = APIServer()
+        clock = [100.0]
+        state = ClusterState()
+        from nos_tpu.controllers.node_controller import NodeController
+        from nos_tpu.controllers.pod_controller import PodController
+        from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        from nos_tpu.partitioning.slicepart.factory import (
+            new_slice_partitioner_controller,
+        )
+
+        ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=60.0, batch_idle_s=10.0,
+            replan_epoch_s=replan_epoch_s, clock=lambda: clock[0])
+        ctl.bind()
+        api.create(KIND_NODE, make_tpu_node("host-0"))
+        self._ack_plan(api)
+        return api, ctl, clock
+
+    @staticmethod
+    def _ack_plan(api):
+        """Stand-in agent: report status == spec so the handshake never
+        blocks (this suite tests the epoch gate, not the handshake)."""
+        from nos_tpu.api import constants as AC
+        from nos_tpu.topology.annotations import spec_plan_id
+
+        node = api.get(KIND_NODE, "host-0")
+        pid = spec_plan_id(node.metadata.annotations, family="slice")
+        if pid:
+            def mutate(n):
+                n.metadata.annotations[
+                    AC.status_plan_annotation("slice")] = pid
+            api.patch(KIND_NODE, "host-0", mutate=mutate)
+
+    def _unschedulable(self, api, name):
+        pod = make_slice_pod("2x2", 1, name=name)
+        pod.mark_unschedulable("no fit")
+        api.create(KIND_POD, pod)
+
+    def test_ready_batch_defers_inside_epoch(self):
+        api, ctl, clock = self._cluster(replan_epoch_s=30.0)
+        self._unschedulable(api, "a")
+        clock[0] += 61.0
+        assert ctl.process_if_ready()          # first plan: never deferred
+        # two more triggers, batch ready, but the epoch is still running
+        self._unschedulable(api, "b")
+        self._unschedulable(api, "c")
+        self._ack_plan(api)
+        clock[0] += 15.0                       # > idle window, < epoch
+        assert not ctl.process_if_ready()
+        assert len(ctl._batcher) == 2          # accumulating, not dropped
+        clock[0] += 20.0                       # epoch elapsed
+        assert ctl.process_if_ready()          # ONE replan takes both
+        assert len(ctl._batcher) == 0
+
+    def test_epoch_defaults_to_idle_window(self):
+        _, ctl, _ = self._cluster()
+        assert ctl._replan_epoch_s == 10.0
+
+    @staticmethod
+    def _deferred_total() -> float:
+        from nos_tpu.exporter.metrics import REGISTRY
+
+        for line in REGISTRY.render().splitlines():
+            if line.startswith("nos_tpu_replan_epoch_deferred_total") \
+                    and 'kind="slice"' in line:
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    def test_deferral_metric_counts_transitions(self):
+        api, ctl, clock = self._cluster(replan_epoch_s=30.0)
+        self._unschedulable(api, "a")
+        clock[0] += 61.0
+        assert ctl.process_if_ready()
+        self._ack_plan(api)
+        self._unschedulable(api, "b")
+        clock[0] += 15.0
+        before = self._deferred_total()
+        assert not ctl.process_if_ready()
+        assert self._deferred_total() == before + 1   # one transition
+        assert not ctl.process_if_ready()      # same epoch: no double count
+        assert self._deferred_total() == before + 1
+        clock[0] += 20.0
+        assert ctl.process_if_ready()
+
+
+class TestShardFailure:
+    def test_failed_shard_drains_siblings_and_planner_is_reusable(self):
+        """A raising shard must not leave sibling futures running when
+        plan() propagates: the per-slot shard planners are reused, so a
+        retrying caller would otherwise race a still-running thread."""
+        class Boom(Exception):
+            pass
+
+        def mk():
+            planner = make_sequential()
+            orig = planner.plan
+
+            def plan(snapshot, pods):
+                if any(n.startswith("tpu-v4") for n in snapshot.nodes()):
+                    raise Boom()
+                return orig(snapshot, pods)
+
+            planner.plan = plan  # type: ignore[method-assign]
+            return planner
+
+        par = ParallelGeometryPlanner(
+            mk, SliceProfileCalculator(), kind="slice", min_shard_hosts=0)
+        taker = SliceSnapshotTaker()
+        bad = random_state(random.Random(42), [V5E, V4])
+        with pytest.raises(Boom):
+            par.plan(taker.take_snapshot(bad),
+                     random_pods(random.Random(1), [V5E, V4], 6))
+        good = random_state(random.Random(43), [V5E])   # 2 v5e pools
+        desired = par.plan(taker.take_snapshot(good),
+                           random_pods(random.Random(2), [V5E], 6))
+        assert len(desired) == 12
+        par.close()
+
+
+class TestTimeshareEligibility:
+    def test_gb_profile_skips_undersized_generation(self):
+        """A timeshare profile bigger than a generation's per-CHIP HBM
+        (timeshare units carve per chip: v5e 16 GB, v5p 95 GB) never
+        lands on that generation's pools, even when they are freer."""
+        from nos_tpu.partitioning.timeshare.calculators import (
+            TimeshareProfileCalculator,
+        )
+        from nos_tpu.testing.factory import make_timeshare_pod
+        from nos_tpu.topology import V5P
+
+        state = ClusterState()
+        for h in range(4):      # v5e pool: freer by chip-equivalents
+            state.update_node(make_tpu_node(
+                f"e{h}", pod_id="pe", host_index=h,
+                status_geometry={"free": {"2x4": 1}}), [])
+        state.update_node(make_tpu_node(
+            "p0", generation=V5P, pod_id="pp", host_index=0,
+            status_geometry={"free": {"1x2x2": 1}}), [])
+        snap = SliceSnapshotTaker().take_snapshot(state)
+        pools = partition_pools(snap)
+        assert len(pools) == 2
+        by_pool, inf = split_pods(
+            pools, [make_timeshare_pod(30, 1, name="big")],
+            TimeshareProfileCalculator())
+        assert not inf
+        v5p_key = next(p.key for p in pools if "v5p" in p.key)
+        assert [p.metadata.name for p in by_pool[v5p_key]] == ["big"]
+        # and one no generation's CHIP can hold is infeasible everywhere
+        _, inf = split_pods(
+            pools, [make_timeshare_pod(200, 1, name="huge")],
+            TimeshareProfileCalculator())
+        assert [p.metadata.name for p in inf] == ["huge"]
