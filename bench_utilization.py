@@ -63,8 +63,10 @@ seconds of wall clock while preserving every control-loop interaction.
 
 from __future__ import annotations
 
+import argparse
 import json
 import random
+import sys
 import time
 
 from nos_tpu.api import constants as C
@@ -87,8 +89,13 @@ from nos_tpu.kube.client import (
     APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_NODE,
     KIND_POD, KIND_POD_GROUP, NotFound,
 )
+from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
 from nos_tpu.kube.resources import pod_request
+from nos_tpu.obs.slo import (
+    GAUGE_FLOOR, LATENCY, RATE_CEILING, SLOEngine, SLOObjective,
+)
+from nos_tpu.obs.timeseries import TimeSeriesSampler
 from nos_tpu.partitioning.slicepart import SliceNodeInitializer
 from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
@@ -210,6 +217,35 @@ PHASES = [
 # (the scheduler's quota head-of-line rule keys on it) and may preempt
 # the team's own over-min singles.
 GANG_PRIORITY = 10
+
+# -- SLO plane (obs/slo.py) -------------------------------------------------
+# The bench runs the REAL telemetry substrate: the scheduler records
+# nos_tpu_schedule_latency_seconds{class=} per bind (virtual clock), a
+# TimeSeriesSampler ticks the registry every sim tick, and the engine
+# judges these objectives as error-budget burn rates.  Targets are the
+# bench's own published envelope (class p90s land 12-36 s on this
+# trace), not aspirations — the --smoke gate asserts the MACHINERY
+# (verdicts exist, budgets computed), the targets make breaches rare
+# but reachable by a genuine regression.
+REGISTRY.describe("nos_tpu_cluster_utilization",
+                  "Live-capacity chip utilization sampled per sim tick")
+SLO_FAST_WINDOW_S = 30.0
+SLO_SLOW_WINDOW_S = 120.0
+
+
+def slo_objectives() -> list[SLOObjective]:
+    return [
+        SLOObjective(name="schedule-latency", kind=LATENCY,
+                     metric="nos_tpu_schedule_latency_seconds",
+                     target=120.0, each_label="class", compliance=0.9,
+                     min_events=5),
+        SLOObjective(name="utilization-floor", kind=GAUGE_FLOOR,
+                     metric="nos_tpu_cluster_utilization",
+                     target=0.5, compliance=0.9),
+        SLOObjective(name="rebind-ceiling", kind=RATE_CEILING,
+                     metric="nos_tpu_drain_preemptions_total",
+                     target=1.0),
+    ]
 
 
 def percentile(xs, q: float, digits: int):
@@ -341,7 +377,15 @@ class Sim:
         self.scheduler = build_scheduler(
             api, HBM_GB, drain_preempt_after_cycles=40,
             drain_preempt_progress_fn=self._pod_progress,
-            shard_chips_per_host=CHIPS_PER_HOST, **extra)
+            shard_chips_per_host=CHIPS_PER_HOST, clock=clock, **extra)
+        # SLO plane: sampler + engine on the virtual clock (one tick per
+        # sim tick), judging the module-level objectives over the same
+        # registry the scheduler's histograms land in.
+        self.slo_engine = SLOEngine(
+            TimeSeriesSampler(clock=clock, maxlen=2048),
+            slo_objectives(),
+            fast_window_s=SLO_FAST_WINDOW_S,
+            slow_window_s=SLO_SLOW_WINDOW_S, clock=clock)
         self.capacity: CapacityScheduling = next(
             p for p in self.scheduler._framework.plugins
             if isinstance(p, CapacityScheduling))
@@ -660,12 +704,15 @@ class Sim:
         lost = TOTAL_CHIPS - self.live_chips
         if lost > 0:
             self.lost_chip_seconds += lost * TICK_S
-        if self.now[0] < WARMUP_S:
-            return
         used = sum(
             chip_equiv(p) for p in self.api.list(KIND_POD)
             if p.spec.node_name and p.status.phase == RUNNING)
-        self._util_area += min(1.0, used / self.live_chips) * TICK_S
+        utilization = min(1.0, used / self.live_chips)
+        # the SLO engine's utilization-floor objective reads this gauge
+        REGISTRY.set("nos_tpu_cluster_utilization", utilization)
+        if self.now[0] < WARMUP_S:
+            return
+        self._util_area += utilization * TICK_S
         self._util_time += TICK_S
 
     # -- main loop ---------------------------------------------------------
@@ -688,6 +735,10 @@ class Sim:
             self._record_binds()
             self._check_recovered()
             self._sample_utilization()
+            if self.now[0] >= WARMUP_S:
+                # SLO judgement starts with utilization sampling: the
+                # fill ramp from an empty cluster is not an SLO event
+                self.slo_engine.tick()
             self._check_invariants()
 
         lat = self.latencies
@@ -714,6 +765,7 @@ class Sim:
                 "over_quota_evicted_pods": self.over_quota_evictions,
                 "invariant_violations": dict(self.invariant_violations),
             },
+            "slo": self.slo_engine.report(),
             "node_loss": {
                 "killed": list(KILL_NODES),
                 "kill_t_s": NODE_KILL_T,
@@ -761,6 +813,22 @@ def run_seeds(seeds=range(5)) -> dict:
     rebinds = [x for sim in sims for x in sim._rebind_latencies]
     ready = [r["node_loss"]["replacement_ready_s"] for r in runs.values()
              if r["node_loss"]["replacement_ready_s"] is not None]
+    # pooled SLO verdict block (one per objective x class x seed): the
+    # payload `python -m nos_tpu.obs slo` renders — per-class p99 in
+    # `value`, burn rates, budget remaining
+    slo_verdicts = []
+    for seed, r in runs.items():
+        for v in r["slo"]["verdicts"]:
+            slo_verdicts.append({**v, "seed": seed})
+    first_slo = runs[next(iter(runs))]["slo"]
+    slo_block = {
+        "fast_window_s": first_slo["fast_window_s"],
+        "slow_window_s": first_slo["slow_window_s"],
+        "burn_threshold": first_slo["burn_threshold"],
+        "objectives": first_slo["objectives"],
+        "verdicts": slo_verdicts,
+        "breaches": sum(1 for v in slo_verdicts if v["breached"]),
+    }
     return {
         "utilization_pct": round(sum(utils) / len(utils), 4),
         "utilization_min": round(min(utils), 4),
@@ -773,6 +841,7 @@ def run_seeds(seeds=range(5)) -> dict:
         "p50_schedule_latency_s": pct(lat, 0.50, 3),
         "p90_schedule_latency_s": pct(lat, 0.90, 3),
         "schedule_latency_by_class": latency_summary(by_class),
+        "slo": slo_block,
         "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
         "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
         "drain_evicted_pods": sum(s_.drain_evictions for s_ in sims),
@@ -808,9 +877,75 @@ def run_seeds(seeds=range(5)) -> dict:
     }
 
 
-def main() -> None:
-    out = run_seeds()
-    out["vs_target"] = round(out["utilization_pct"] / UTILIZATION_TARGET, 4)
+def run_smoke() -> dict:
+    """The SLO telemetry regression gate (scripts/check.sh): ONE seed on
+    a shortened trace, asserting the telemetry plane end to end — the
+    scheduler's per-class latency histogram populated with bucket
+    series, per-class summaries in the JSON, and the SLO engine
+    producing complete verdicts.  Raises AssertionError on regression;
+    wall-time bound is generous (machinery gate, not a perf gate —
+    bench_fleet --smoke owns the cycle-latency bound)."""
+    global TRACE_S, WARMUP_S, SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S
+    prev = (TRACE_S, WARMUP_S, SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S)
+    # SLO windows shrunk with the trace so the slow window is fully
+    # covered (a half-filled window is "not yet observable" by design)
+    TRACE_S, WARMUP_S = 90.0, 30.0
+    SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S = 15.0, 40.0
+    t0 = time.perf_counter()
+    try:
+        sim = Sim(seed=0)
+        result = sim.run()
+    finally:
+        (TRACE_S, WARMUP_S,
+         SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S) = prev
+    wall = time.perf_counter() - t0
+
+    by_class = result["schedule_latency_by_class"]
+    assert by_class, "no per-class schedule latencies recorded"
+    render = REGISTRY.render()
+    assert 'nos_tpu_schedule_latency_seconds_bucket{class="' in render, \
+        "/metrics missing per-class schedule-latency bucket series"
+    assert ',le="+Inf"}' in render, "histogram missing the +Inf bucket"
+    verdicts = result["slo"]["verdicts"]
+    assert verdicts, "SLO engine produced no verdicts"
+    latency_verdicts = [v for v in verdicts
+                        if v["metric"] == "nos_tpu_schedule_latency_seconds"]
+    assert latency_verdicts, "no schedule-latency SLO verdicts"
+    for v in verdicts:
+        for field in ("burn_fast", "burn_slow", "budget_remaining",
+                      "breached", "target"):
+            assert field in v, f"verdict missing {field}: {v}"
+    assert {v["class"] for v in latency_verdicts} <= \
+        set(by_class) | {""}, "verdict classes disagree with the trace"
+    assert wall < 300.0, f"smoke trace took {wall:.1f}s (> 300s bound)"
+    return {
+        "smoke": "ok",
+        "wall_s": round(wall, 1),
+        "classes": sorted(by_class),
+        "verdicts": len(verdicts),
+        "breaches": sum(1 for v in verdicts if v["breached"]),
+        "slo": result["slo"],
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="utilization + SLO bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed shortened-trace SLO telemetry gate")
+    ap.add_argument("--slo-report", default="",
+                    help="also write the SLO verdict block to this file "
+                         "(CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_seeds()
+        out["vs_target"] = round(
+            out["utilization_pct"] / UTILIZATION_TARGET, 4)
+    if args.slo_report:
+        with open(args.slo_report, "w", encoding="utf-8") as fh:
+            json.dump(out.get("slo", {}), fh, indent=2)
+        print(f"slo report written to {args.slo_report}", file=sys.stderr)
     print(json.dumps(out))
 
 

@@ -172,17 +172,25 @@ class MetricDiscipline(Rule):
 
     id = "N003"
     title = "metric naming/registration/label discipline"
+    # NB: the exclude list names the Registry implementation and the
+    # analyzer itself ONLY — nos_tpu/obs/ (timeseries, slo) is in scope
+    # like any other emitter; test_analysis pins that it stays so.
     scope = ("nos_tpu/",)
     exclude = ("nos_tpu/exporter/metrics.py", "nos_tpu/analysis/")
     cross_file = True
 
-    TRACKED = frozenset({"inc", "set", "observe", "time", "describe"})
+    TRACKED = frozenset({"inc", "set", "observe", "time", "describe",
+                         "quantile"})
+    #: verbs that may carry a `buckets=` histogram layout
+    BUCKET_BEARING = frozenset({"observe", "describe"})
 
     def __init__(self) -> None:
         # name -> [(path, line)]
         self._described: dict[str, list[tuple[str, int]]] = {}
         # name -> [(path, line, label_keys | None)]
         self._used: dict[str, list[tuple[str, int, frozenset | None]]] = {}
+        # name -> [(path, line, bucket bounds)]
+        self._buckets: dict[str, list[tuple[str, int, tuple]]] = {}
         self._pending: list[Violation] = []
 
     def check(self, mod: ModuleSource) -> Iterable[Violation]:
@@ -212,12 +220,53 @@ class MetricDiscipline(Rule):
                     f"metric {name!r} must match "
                     "^nos_tpu_[a-z0-9_]+$ (project namespace)"))
             site = (mod.relpath, node.lineno)
+            if func.attr in self.BUCKET_BEARING:
+                self._check_buckets(mod, node, name)
             if func.attr == "describe":
                 self._described.setdefault(name, []).append(site)
             else:
                 self._used.setdefault(name, []).append(
                     site + (self._label_keys(node),))
         return ()
+
+    def _check_buckets(self, mod: ModuleSource, node: ast.Call,
+                       name: str) -> None:
+        """A `buckets=` histogram layout must be a literal tuple/list of
+        increasing numbers — the layout is part of the series contract
+        (all call sites and the scrape config agree on `le=` values),
+        so it must be statically checkable like the metric name."""
+        for kw in node.keywords:
+            if kw.arg != "buckets":
+                continue
+            val = kw.value
+            if isinstance(val, ast.Constant) and val.value is None:
+                return
+            values: list[float] | None = None
+            if isinstance(val, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, (int, float))
+                    and not isinstance(e.value, bool)
+                    for e in val.elts):
+                values = [float(e.value) for e in val.elts]
+            if values is None:
+                self._pending.append(Violation(
+                    self.id, mod.relpath, node.lineno,
+                    f"metric {name!r}: buckets= must be a literal "
+                    "tuple/list of numbers — the le= series layout is "
+                    "part of the scrape contract and must be statically "
+                    "checkable"))
+                return
+            if not values or any(b2 <= b1 for b1, b2
+                                 in zip(values, values[1:])):
+                self._pending.append(Violation(
+                    self.id, mod.relpath, node.lineno,
+                    f"metric {name!r}: buckets must be non-empty and "
+                    "strictly increasing (the Registry raises at "
+                    "runtime; fix it here first)"))
+                return
+            self._buckets.setdefault(name, []).append(
+                (mod.relpath, node.lineno, tuple(values)))
+            return
 
     @staticmethod
     def _label_keys(node: ast.Call) -> frozenset | None:
@@ -265,6 +314,16 @@ class MetricDiscipline(Rule):
                         f"from {sorted(canonical)} at "
                         f"{known[0][0]}:{known[0][1]} — one label schema "
                         "per metric or the series explode")
+        for name, bsites in sorted(self._buckets.items()):
+            first_path, first_line, canonical_b = bsites[0]
+            for path, line, bounds in bsites[1:]:
+                if bounds != canonical_b:
+                    yield Violation(
+                        self.id, path, line,
+                        f"metric {name!r} bucket layout {bounds} differs "
+                        f"from {canonical_b} at {first_path}:{first_line} "
+                        "— one bucket layout per histogram (the Registry "
+                        "raises on the conflict at runtime)")
 
 
 class NoBlockingUnderLock(Rule):

@@ -55,6 +55,10 @@ class ManagerConfig:
     # REST substrate adapter (nos_tpu/kube/rest.py) instead of the
     # in-memory API seam.  "" = in-memory (sim / tests).
     kubeconfig: str = ""
+    # SLO sampler/engine tick interval (obs/slo.py): the registry is
+    # sampled into windowed series and every objective re-judged this
+    # often; /debug/slo serves the verdicts.  0 disables.
+    slo_interval_s: float = 1.0
 
     def validate(self) -> None:
         for field in ("health_probe_addr", "metrics_addr"):
@@ -64,6 +68,8 @@ class ManagerConfig:
         if self.kubeconfig and not pathlib.Path(self.kubeconfig).is_file():
             raise ConfigError(
                 f"kubeconfig {self.kubeconfig!r} does not exist")
+        if self.slo_interval_s < 0:
+            raise ConfigError("slo_interval_s must be >= 0")
 
 
 @dataclasses.dataclass

@@ -87,6 +87,21 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
 
             self._respond(200, json.dumps(flight_snapshot()),
                           content_type="application/json")
+        elif self.path == "/debug/slo":
+            # The SLO engine's latest verdicts (burn rates, budget
+            # remaining, per-class quantiles) — `python -m nos_tpu.obs
+            # slo --url` consumes this (docs/observability.md).
+            import json
+
+            from nos_tpu.obs.slo import get_engine
+
+            engine = get_engine()
+            if engine is None:
+                self._respond(404, "no SLO engine installed "
+                                   "(Main.attach_slo)")
+                return
+            self._respond(200, json.dumps(engine.report()),
+                          content_type="application/json")
         elif self.path == "/snapshot":
             # Live cluster-state dump + metric series: what the one-shot
             # metricsexporter scrapes (the reference exporter reads the
@@ -97,9 +112,13 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
             import json
 
             from nos_tpu.kube.serialize import dump_state
+            from nos_tpu.obs.slo import get_engine
 
             payload = {"state": dump_state(self.main.api),
                        "metrics": REGISTRY.snapshot()}
+            engine = get_engine()
+            if engine is not None:
+                payload["slo"] = engine.report()
             self._respond(200, json.dumps(payload),
                           content_type="application/json")
         else:
@@ -158,6 +177,21 @@ class Main:
             self._loops.append(loop)
             if self._started:
                 loop.start()
+
+    def attach_slo(self, engine=None, interval_s: float = 1.0) -> None:
+        """Install an SLO engine (obs/slo.py) as this process's and add
+        its tick as a run loop: the sampler snapshots the registry every
+        `interval_s` and the engine re-judges every objective.  With no
+        engine given, builds one over the default objectives."""
+        from nos_tpu.obs.slo import (
+            SLOEngine, default_objectives, set_engine,
+        )
+        from nos_tpu.obs.timeseries import TimeSeriesSampler
+
+        if engine is None:
+            engine = SLOEngine(TimeSeriesSampler(), default_objectives())
+        set_engine(engine)
+        self.add_loop("slo-sampler", engine.tick, interval_s)
 
     def attach_leader_election(self, elector) -> None:
         """Gate every run loop on holding the lease (loops added before

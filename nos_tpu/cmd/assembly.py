@@ -92,7 +92,8 @@ def build_scheduler(api: APIServer,
                     shard_chips_per_host: int = 0,
                     preempt_budget_per_cycle: int = 2,
                     backfill_remaining_fn=None,
-                    backfill_duration_fn=None) -> Scheduler:
+                    backfill_duration_fn=None,
+                    clock=None) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
     from nos_tpu.quota import TPUResourceCalculator
@@ -102,6 +103,7 @@ def build_scheduler(api: APIServer,
     fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
     plugin.set_framework(fw)
     plugin.attach(api)
+    kwargs = {} if clock is None else {"clock": clock}
     return Scheduler(
         api, fw,
         drain_preempt_after_cycles=drain_preempt_after_cycles or None,
@@ -110,4 +112,5 @@ def build_scheduler(api: APIServer,
         drain_preempt_progress_fn=drain_preempt_progress_fn,
         preempt_budget_per_cycle=preempt_budget_per_cycle,
         backfill_remaining_fn=backfill_remaining_fn,
-        backfill_duration_fn=backfill_duration_fn)
+        backfill_duration_fn=backfill_duration_fn,
+        **kwargs)

@@ -27,9 +27,12 @@ from nos_tpu.kube.client import APIServer
 logger = logging.getLogger("nos_tpu.cmd.metricsexporter")
 
 
-def load_source(source: str) -> tuple[APIServer, dict | None]:
-    """(APIServer, metric series) from a live main's /snapshot URL or a
-    dumped state file."""
+def load_source(source: str) -> tuple[APIServer, dict | None, dict | None]:
+    """(APIServer, metric series, SLO report) from a live main's
+    /snapshot URL or a dumped state file.  The metric series carry
+    histogram buckets (`<name>_bucket` with `le=` labels) and the SLO
+    report is the observed process's verdict block, when its engine is
+    installed."""
     from nos_tpu.kube.serialize import load_state
 
     if source.startswith(("http://", "https://")):
@@ -41,7 +44,8 @@ def load_source(source: str) -> tuple[APIServer, dict | None]:
         if not isinstance(data, dict):
             raise ValueError(f"snapshot payload is {type(data).__name__}, "
                              f"expected object")
-        return load_state(data.get("state", {})), data.get("metrics")
+        return (load_state(data.get("state", {})), data.get("metrics"),
+                data.get("slo"))
     with open(source) as f:
         data = json.load(f)
     if not isinstance(data, dict):
@@ -49,7 +53,7 @@ def load_source(source: str) -> tuple[APIServer, dict | None]:
                          f"expected object")
     # bare dump_state files and full /snapshot payloads both accepted
     state = data.get("state", data)
-    return load_state(state), data.get("metrics")
+    return load_state(state), data.get("metrics"), data.get("slo")
 
 
 def export(payload: dict, endpoint: str = "", out: str = "") -> int:
@@ -86,9 +90,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     metrics_override = None
+    slo_report = None
     if args.source:
         try:
-            api, metrics_override = load_source(args.source)
+            api, metrics_override, slo_report = load_source(args.source)
         except (OSError, ValueError) as e:
             logger.error("cannot read --source %s: %s", args.source, e)
             return 1
@@ -102,6 +107,15 @@ def main(argv=None) -> int:
     if metrics_override is not None:
         # the observed process's series, not this one-shot's empty registry
         payload["metrics"] = metrics_override
+    if slo_report is None:
+        # this process's own engine, when one is installed in-process
+        from nos_tpu.obs.slo import get_engine
+
+        engine = get_engine()
+        if engine is not None:
+            slo_report = engine.report()
+    if slo_report is not None:
+        payload["slo"] = slo_report
     return export(payload, endpoint=args.endpoint, out=args.out)
 
 

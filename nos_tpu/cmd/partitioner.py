@@ -92,6 +92,8 @@ def main(argv=None) -> int:
     m, _ = build_partitioner_main(api, state, cfg)
     if args.sim:
         add_sim(m, api, args.sim)
+    if cfg.slo_interval_s > 0:
+        m.attach_slo(interval_s=cfg.slo_interval_s)
     m.run_until_stopped()
     return 0
 

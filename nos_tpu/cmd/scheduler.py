@@ -46,6 +46,8 @@ def main(argv=None) -> int:
         m.attach_leader_election(
             LeaderElector(api, "nos-tpu-scheduler-leader"))
     m.add_loop("scheduler", scheduler.run_cycle, cfg.cycle_interval_s)
+    if cfg.slo_interval_s > 0:
+        m.attach_slo(interval_s=cfg.slo_interval_s)
     m.run_until_stopped()
     return 0
 

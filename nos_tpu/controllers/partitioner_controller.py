@@ -50,6 +50,9 @@ REGISTRY.describe("nos_tpu_plan_pending_pods",
                   "Pending pods the last plan tried to place")
 REGISTRY.describe("nos_tpu_replan_epoch_deferred_total",
                   "Ready batches held back to the next replan epoch")
+REGISTRY.describe("nos_tpu_actuation_latency_seconds",
+                  "Plan write to actuation-landed (status plan id "
+                  "caught up) per node, labelled by pool")
 
 # Default plan deadline as a multiple of the batch timeout: a healthy
 # agent reports within one report interval, so 3 full batch windows of
@@ -103,6 +106,12 @@ class PartitionerController:
         # last journaled lagging-node set: handshake waits are polled
         # every tick, so only TRANSITIONS are decisions worth recording
         self._last_lagging: frozenset[str] = frozenset()
+        # node -> (spec plan id, plan-write time): actuation in flight.
+        # Resolved into nos_tpu_actuation_latency_seconds{kind,pool}
+        # when the node's status plan id catches up — the plan→
+        # actuation-landed half of the latency SLO story (the scheduler
+        # owns queue-admission→bind).
+        self._actuation_started: dict[str, tuple[str, float]] = {}
 
     @property
     def quarantine(self) -> QuarantineList:
@@ -127,6 +136,7 @@ class PartitionerController:
         """Poll from the run loop; returns True if a plan cycle ran."""
         self._reconcile_quarantine()
         self._refresh_lagging_journal()
+        self._observe_landed_actuations()
         if self._clock() - self._last_plan < self._replan_epoch_s:
             # inside the running replan epoch: triggers keep
             # accumulating in the batcher, the next cycle takes them all
@@ -201,7 +211,56 @@ class PartitionerController:
         REGISTRY.inc("nos_tpu_plans_total", labels={"kind": self._kind})
         REGISTRY.set("nos_tpu_plan_pending_pods",
                      float(len(pods)), labels={"kind": self._kind})
+        self._start_actuation_clocks()
         return True
+
+    # -- actuation-landed latency -------------------------------------------
+    def _start_actuation_clocks(self) -> None:
+        """After a plan cycle: every node of this kind whose spec plan id
+        is ahead of its status has an actuation in flight — stamp its
+        clock.  A node re-planned mid-flight restarts the clock (the new
+        plan supersedes the old spec; latency is measured against the
+        plan the agent will actually report)."""
+        now = self._clock()
+        for node in self._state.nodes().values():
+            if not self._my_kind(node):
+                continue
+            annots = node.metadata.annotations
+            spec_id = spec_plan_id(annots, family=self._kind)
+            if not spec_id or status_plan_id(annots,
+                                             family=self._kind) == spec_id:
+                continue
+            name = node.metadata.name
+            entry = self._actuation_started.get(name)
+            if entry is None or entry[0] != spec_id:
+                self._actuation_started[name] = (spec_id, now)
+
+    def _observe_landed_actuations(self) -> None:
+        """Resolve in-flight actuation clocks: a node whose status plan
+        id caught up to the stamped spec observes one
+        nos_tpu_actuation_latency_seconds{kind,pool} sample.  Vanished
+        nodes and superseded plans just drop their entry (the next plan
+        cycle re-stamps)."""
+        if not self._actuation_started:
+            return
+        now = self._clock()
+        nodes = self._state.nodes()
+        for name, (plan_id, t0) in list(self._actuation_started.items()):
+            node = nodes.get(name)
+            if node is None or not self._my_kind(node):
+                del self._actuation_started[name]
+                continue
+            annots = node.metadata.annotations
+            if spec_plan_id(annots, family=self._kind) != plan_id:
+                del self._actuation_started[name]     # superseded
+                continue
+            if status_plan_id(annots, family=self._kind) == plan_id:
+                del self._actuation_started[name]
+                pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
+                REGISTRY.observe(
+                    "nos_tpu_actuation_latency_seconds",
+                    max(0.0, now - t0),
+                    labels={"kind": self._kind, "pool": pool})
 
     def _rescan_due(self) -> list[Pod] | None:
         """Level-triggered backstop for the event-triggered batch path

@@ -1,42 +1,113 @@
-"""In-process metrics: counters/gauges/timers with a Prometheus text dump.
+"""In-process metrics: counters/gauges/histograms with a Prometheus dump.
 
 The analog of controller-runtime's default Prometheus registry that every
 reference main exposes through kube-rbac-proxy
 (config/gpupartitioner/prometheus/monitor.yaml:1-20).  Components call
 `inc`/`set`/`observe` on the process-global REGISTRY; the cmd/_runtime
 health server serves it at /metrics in the Prometheus exposition format.
+
+`observe` records a NATIVE histogram: per-series bucket counts (default
+log-spaced bounds from 1 ms to 60 s, overridable per metric via
+``describe(..., buckets=...)`` or the first ``observe(...,
+buckets=...)``), plus count/sum and a **windowed** max.  ``render()``
+emits Prometheus-conventional ``_bucket{le=...}`` / ``_sum`` /
+``_count`` series under ``# TYPE <name> histogram``; ``quantile()``
+serves p50/p99-style questions in-process without a scrape stack
+(docs/observability.md, "Histograms and quantiles").
+
+Windowed-max semantics: ``<name>_max`` is the largest observation since
+the last ``reset_window()`` (the SLO sampler calls it every tick,
+obs/timeseries.py), not since process start — a one-off startup spike
+must not dominate the gauge for the process lifetime.
+
+Derived-series namespace: a histogram ``foo`` owns ``foo_bucket``,
+``foo_sum``, ``foo_count`` and ``foo_max``.  Registering a scalar metric
+under any of those names (or a histogram whose derived names collide
+with an existing scalar) raises instead of silently merging the series.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from collections import defaultdict
 
 from nos_tpu.utils.guards import guarded_by
 
+#: Default histogram bounds: log-spaced from 1 ms to 60 s — schedule
+#: latencies (ms for serving classes) through repartition walls (tens of
+#: seconds) land in distinct buckets.  Upper bound open (+Inf implicit).
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
 
-@guarded_by("_lock", "_counters", "_gauges", "_timers", "_help")
+#: Suffixes a histogram metric derives; the scalar namespace must not
+#: collide with them (see _guard_* below).
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count", "_max")
+
+
+def histogram_quantile(bounds: tuple[float, ...], bucket_counts,
+                       count: float, q: float,
+                       observed_max: float = 0.0) -> float | None:
+    """Prometheus-style quantile estimate from per-bucket (NON-cumulative)
+    counts: linear interpolation inside the bucket holding rank q*count.
+    The +Inf bucket has no upper bound — the estimate there is the best
+    known ceiling, max(last bound, observed max).  None with no samples.
+
+    Shared by Registry.quantile (lifetime counts) and the SLO engine
+    (windowed bucket deltas, obs/slo.py).
+    """
+    if count <= 0:
+        return None
+    rank = q * count
+    cumulative = 0.0
+    for i, n in enumerate(bucket_counts):
+        if n <= 0:
+            continue
+        if cumulative + n >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - cumulative) / n
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        cumulative += n
+    # rank falls in the +Inf bucket
+    return max(bounds[-1] if bounds else 0.0, observed_max)
+
+
+@guarded_by("_lock", "_counters", "_gauges", "_timers", "_help",
+            "_buckets", "_scalar_names")
 class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
-        # histogram-lite: count + sum + max per series
-        self._timers: dict[tuple[str, tuple], list[float]] = {}
+        # histogram per series: [count, sum, windowed max, bucket counts]
+        # (bucket counts NON-cumulative, parallel to _buckets[name])
+        self._timers: dict[tuple[str, tuple], list] = {}
         self._help: dict[str, str] = {}
+        # histogram bucket bounds per metric NAME (all series of one
+        # metric share bounds — label consistency, N003's twin)
+        self._buckets: dict[str, tuple[float, ...]] = {}
+        # scalar (counter/gauge) metric names, for the derived-series
+        # collision guard
+        self._scalar_names: set[str] = set()
 
     @staticmethod
     def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
         return name, tuple(sorted((labels or {}).items()))
 
-    def describe(self, name: str, help_text: str) -> None:
-        """Register a metric's HELP text.  Idempotent for the same text
-        (module re-import, double build_api) but a CONFLICTING
-        re-registration raises: two call sites claiming one series name
-        with different meanings is the double-registration bug class
-        noslint N003 bans statically — this guard catches the dynamic
-        remainder (name built at runtime, plugin registering late)."""
+    def describe(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] | list[float] | None = None
+                 ) -> None:
+        """Register a metric's HELP text (and, for histograms, its bucket
+        bounds).  Idempotent for the same text (module re-import, double
+        build_api) but a CONFLICTING re-registration raises: two call
+        sites claiming one series name with different meanings is the
+        double-registration bug class noslint N003 bans statically —
+        this guard catches the dynamic remainder (name built at runtime,
+        plugin registering late)."""
         with self._lock:
             existing = self._help.get(name)
             if existing is not None and existing != help_text:
@@ -45,77 +116,207 @@ class Registry:
                     f"help text ({existing!r} != {help_text!r}); one "
                     "describe per metric — see docs/static-analysis.md")
             self._help[name] = help_text
+            if buckets is not None:
+                self._guard_histogram_locked(name)
+                self._register_buckets_locked(name, buckets)
+
+    def _register_buckets_locked(self, name: str, buckets) -> tuple:
+        """Validate + pin bucket bounds for `name` (caller holds the
+        lock).  Conflicting bounds raise — all series and all call sites
+        of one histogram share one bucket layout."""
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or any(b2 <= b1 for b1, b2
+                             in zip(bounds, bounds[1:])):
+            raise ValueError(
+                f"metric {name!r}: buckets must be non-empty and "
+                f"strictly increasing, got {bounds}")
+        existing = self._buckets.get(name)
+        if existing is not None and existing != bounds:
+            raise ValueError(
+                f"metric {name!r} already has buckets {existing}, "
+                f"conflicting registration {bounds} — one bucket layout "
+                "per histogram")
+        self._buckets[name] = bounds
+        return bounds
+
+    def _guard_scalar_locked(self, name: str) -> None:
+        """A counter/gauge name must not shadow a histogram or any of
+        its derived series (`foo_count` vs histogram `foo`) — the old
+        snapshot()/render() silently merged them."""
+        if name in self._buckets:
+            raise ValueError(
+                f"metric {name!r} is already a histogram — scalar and "
+                "histogram kinds cannot share a name")
+        for suffix in _DERIVED_SUFFIXES:
+            if name.endswith(suffix) \
+                    and name[: -len(suffix)] in self._buckets:
+                raise ValueError(
+                    f"scalar metric {name!r} collides with histogram "
+                    f"{name[: -len(suffix)]!r}'s derived {suffix} "
+                    "series — pick a non-derived name")
+        self._scalar_names.add(name)
+
+    def _guard_histogram_locked(self, name: str) -> None:
+        if name in self._scalar_names:
+            raise ValueError(
+                f"metric {name!r} is already a counter/gauge — scalar "
+                "and histogram kinds cannot share a name")
+        for suffix in _DERIVED_SUFFIXES:
+            if (name + suffix) in self._scalar_names:
+                raise ValueError(
+                    f"histogram {name!r} would derive {name + suffix!r}, "
+                    "which is already a scalar metric — pick another "
+                    "name")
 
     def inc(self, name: str, value: float = 1.0,
             labels: dict | None = None) -> None:
         with self._lock:
+            self._guard_scalar_locked(name)
             self._counters[self._key(name, labels)] += value
 
     def set(self, name: str, value: float,
             labels: dict | None = None) -> None:
         with self._lock:
+            self._guard_scalar_locked(name)
             self._gauges[self._key(name, labels)] = value
 
     def observe(self, name: str, seconds: float,
-                labels: dict | None = None) -> None:
+                labels: dict | None = None,
+                buckets: tuple[float, ...] | list[float] | None = None
+                ) -> None:
+        """Record one observation into `name`'s histogram.  `buckets`
+        (first call or describe wins; conflicts raise) overrides the
+        DEFAULT_BUCKETS layout for this metric."""
         with self._lock:
-            agg = self._timers.setdefault(self._key(name, labels),
-                                          [0.0, 0.0, 0.0])
+            bounds = self._buckets.get(name)
+            if bounds is None:
+                self._guard_histogram_locked(name)
+                bounds = self._register_buckets_locked(
+                    name, buckets if buckets is not None
+                    else DEFAULT_BUCKETS)
+            elif buckets is not None:
+                self._register_buckets_locked(name, buckets)
+            agg = self._timers.get(key := self._key(name, labels))
+            if agg is None:
+                agg = self._timers[key] = [0.0, 0.0, 0.0,
+                                           [0] * len(bounds)]
             agg[0] += 1
             agg[1] += seconds
             agg[2] = max(agg[2], seconds)
+            idx = bisect_left(bounds, seconds)
+            if idx < len(bounds):
+                agg[3][idx] += 1
+            # seconds > last bound: lands only in the implicit +Inf
+            # bucket, whose cumulative count IS agg[0]
+
+    def quantile(self, name: str, q: float,
+                 labels: dict | None = None) -> float | None:
+        """In-process quantile estimate (e.g. q=0.99) over `name`'s
+        lifetime observations for one label set; None with no samples.
+        Linear interpolation inside the owning bucket — the resolution
+        is the bucket layout, good enough for SLO verdicts without a
+        scrape stack."""
+        with self._lock:
+            agg = self._timers.get(self._key(name, labels))
+            if agg is None:
+                return None
+            bounds = self._buckets.get(name, DEFAULT_BUCKETS)
+            count, _, mx, per_bucket = agg
+            return histogram_quantile(bounds, per_bucket, count, q,
+                                      observed_max=mx)
 
     def time(self, name: str, labels: dict | None = None):
         """with REGISTRY.time("nos_tpu_plan_seconds"): ..."""
         return _Timer(self, name, labels)
 
+    def reset_window(self) -> None:
+        """Start a new max window: zero every histogram's windowed max
+        (the `<name>_max` gauge semantics — see the module docstring).
+        Called by the SLO sampler each tick; counts/sums/buckets are
+        cumulative and unaffected."""
+        with self._lock:
+            for agg in self._timers.values():
+                agg[2] = 0.0
+
     def snapshot(self) -> dict:
-        """All series as a plain dict (the metricsexporter payload)."""
+        """All series as a plain dict (the metricsexporter payload).
+        Histogram `foo` contributes `foo_count` / `foo_sum` / `foo_max`
+        plus `foo_bucket` whose series carry a trailing `le=` label
+        (cumulative counts, `le=+Inf` == count)."""
         with self._lock:
             out: dict[str, dict] = {}
             for (name, labels), v in self._counters.items():
                 out.setdefault(name, {})[_series(labels)] = v
             for (name, labels), v in self._gauges.items():
                 out.setdefault(name, {})[_series(labels)] = v
-            for (name, labels), (cnt, total, mx) in self._timers.items():
+            for (name, labels), agg in self._timers.items():
+                cnt, total, mx, per_bucket = agg
                 series = _series(labels)
                 out.setdefault(name + "_count", {})[series] = cnt
                 out.setdefault(name + "_sum", {})[series] = total
                 out.setdefault(name + "_max", {})[series] = mx
+                bounds = self._buckets.get(name, DEFAULT_BUCKETS)
+                bucket_out = out.setdefault(name + "_bucket", {})
+                cumulative = 0
+                for le, n in zip(bounds, per_bucket):
+                    cumulative += n
+                    bucket_out[_series_le(labels, _le_str(le))] = cumulative
+                bucket_out[_series_le(labels, "+Inf")] = cnt
             return out
 
     def render(self) -> str:
-        """Prometheus text exposition."""
+        """Prometheus text exposition: counters, gauges, then
+        histograms (``# TYPE <name> histogram`` with `_bucket{le=}` /
+        `_sum` / `_count`, plus the windowed `_max` gauge)."""
         lines: list[str] = []
         with self._lock:
-            items = []
-            for (name, labels), v in sorted(self._counters.items()):
-                items.append((name, "counter", labels, v))
-            for (name, labels), v in sorted(self._gauges.items()):
-                items.append((name, "gauge", labels, v))
-            for (name, labels), (cnt, total, mx) in sorted(
-                    self._timers.items()):
-                items.append((name + "_count", "counter", labels, cnt))
-                items.append((name + "_sum", "counter", labels, total))
-                items.append((name + "_max", "gauge", labels, mx))
             seen_types: set[str] = set()
-            for name, typ, labels, v in items:
-                if name not in seen_types:
-                    seen_types.add(name)
-                    base = name.removesuffix("_count").removesuffix(
-                        "_sum").removesuffix("_max")
-                    if base in self._help:
-                        lines.append(f"# HELP {name} {self._help[base]}")
-                    lines.append(f"# TYPE {name} {typ}")
-                label_s = ""
-                if labels:
-                    inner = ",".join(
-                        f'{k}="{_escape_label(val)}"' for k, val in labels)
-                    label_s = "{" + inner + "}"
-                lines.append(f"{name}{label_s} {v}")
+
+            def head(name: str, typ: str, help_name: str | None = None
+                     ) -> None:
+                if name in seen_types:
+                    return
+                seen_types.add(name)
+                help_text = self._help.get(help_name or name)
+                if help_text is not None:
+                    lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} {typ}")
+
+            for (name, labels), v in sorted(self._counters.items()):
+                head(name, "counter")
+                lines.append(f"{name}{_render_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                head(name, "gauge")
+                lines.append(f"{name}{_render_labels(labels)} {v}")
+            for (name, labels), agg in sorted(self._timers.items()):
+                cnt, total, mx, per_bucket = agg
+                bounds = self._buckets.get(name, DEFAULT_BUCKETS)
+                head(name, "histogram")
+                cumulative = 0
+                for le, n in zip(bounds, per_bucket):
+                    cumulative += n
+                    lset = labels + (("le", _le_str(le)),)
+                    lines.append(
+                        f"{name}_bucket{_render_labels(lset)} {cumulative}")
+                lset = labels + (("le", "+Inf"),)
+                lines.append(f"{name}_bucket{_render_labels(lset)} "
+                             f"{int(cnt)}")
+                lines.append(f"{name}_sum{_render_labels(labels)} {total}")
+                lines.append(f"{name}_count{_render_labels(labels)} "
+                             f"{int(cnt)}")
+            # windowed max rides as its own gauge metric, after the
+            # histogram block so TYPE lines never interleave one metric
+            for (name, labels), agg in sorted(self._timers.items()):
+                head(name + "_max", "gauge", help_name=name)
+                lines.append(f"{name}_max{_render_labels(labels)} "
+                             f"{agg[2]}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
+        """Drop all series DATA.  Schema registrations (help text,
+        bucket layouts, scalar/histogram kinds) survive: they describe
+        what a metric IS, and a post-reset emitter must not be able to
+        silently re-register an old name with a different shape."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
@@ -129,8 +330,26 @@ def _escape_label(val) -> str:
         .replace("\n", "\\n")
 
 
+def _le_str(bound: float) -> str:
+    """Canonical le= rendering: no trailing zeros, ints stay ints."""
+    return f"{bound:g}"
+
+
+def _render_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(val)}"' for k, val in labels)
+    return "{" + inner + "}"
+
+
 def _series(labels: tuple) -> str:
     return ",".join(f"{k}={v}" for k, v in labels) or ""
+
+
+def _series_le(labels: tuple, le: str) -> str:
+    base = _series(labels)
+    return f"{base},le={le}" if base else f"le={le}"
 
 
 class _Timer:

@@ -25,42 +25,54 @@ from .explain import explain_plan, explain_pod
 from .journal import (
     DecisionJournal, DecisionRecord, get_journal, record, set_journal,
 )
+from .slo import (
+    SLOEngine, SLOObjective, get_engine, set_engine,
+)
+from .timeseries import TimeSeriesSampler
 from .trace import (
     RingExporter, Span, Tracer, bump, current_span, detail_span,
     get_tracer, set_tracer, span,
 )
 
 __all__ = [
-    "DecisionJournal", "DecisionRecord", "RingExporter", "Span", "Tracer",
+    "DecisionJournal", "DecisionRecord", "RingExporter", "SLOEngine",
+    "SLOObjective", "Span", "TimeSeriesSampler", "Tracer",
     "bump", "current_span", "detail_span", "explain_plan", "explain_pod",
-    "flight_snapshot", "get_journal", "get_tracer", "record", "scoped",
-    "set_journal", "set_tracer", "span",
+    "flight_snapshot", "get_engine", "get_journal", "get_tracer", "record",
+    "scoped", "set_engine", "set_journal", "set_tracer", "span",
 ]
 
 
 def flight_snapshot() -> dict:
     """The flight-recorder snapshot: every finished span in the ring +
-    the full journal, as plain dicts (JSON-ready).  This is the format
+    the full journal, as plain dicts (JSON-ready), plus the SLO
+    engine's latest report when one is installed.  This is the format
     obs.explain consumes and /debug/flightrecorder serves."""
     tracer = get_tracer()
     journal = get_journal()
-    return {
+    snapshot = {
         "spans": tracer.ring.dump(),
         "spans_dropped": tracer.ring.dropped,
         "journal": journal.dump(),
         "journal_dropped": journal.dropped,
     }
+    engine = get_engine()
+    if engine is not None:
+        snapshot["slo"] = engine.report()
+    return snapshot
 
 
 @contextlib.contextmanager
 def scoped(tracer: Tracer | None = None,
-           journal: DecisionJournal | None = None) -> Iterator[None]:
-    """Install a tracer/journal pair for the duration of the block and
-    restore the previous pair on exit — how tests (and the lockcheck-
-    instrumented chaos soak) observe an isolated run without leaking
-    state into the process globals."""
+           journal: DecisionJournal | None = None,
+           engine: SLOEngine | None = None) -> Iterator[None]:
+    """Install a tracer/journal (and optionally an SLO engine) for the
+    duration of the block and restore the previous set on exit — how
+    tests (and the lockcheck-instrumented chaos soak) observe an
+    isolated run without leaking state into the process globals."""
     prev_tracer = set_tracer(tracer) if tracer is not None else None
     prev_journal = set_journal(journal) if journal is not None else None
+    prev_engine = set_engine(engine) if engine is not None else None
     try:
         yield
     finally:
@@ -68,3 +80,5 @@ def scoped(tracer: Tracer | None = None,
             set_tracer(prev_tracer)
         if prev_journal is not None:
             set_journal(prev_journal)
+        if engine is not None:
+            set_engine(prev_engine)

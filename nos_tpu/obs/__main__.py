@@ -1,15 +1,20 @@
-"""CLI: `python -m nos_tpu.obs` — explain pods/plans from a flight
-snapshot, dump the recorder, or self-test the subsystem.
+"""CLI: `python -m nos_tpu.obs` — explain pods/plans, report SLO
+verdicts, render the fleet scoreboard, dump the recorder, or self-test
+the subsystem.
 
     python -m nos_tpu.obs explain pod <ns>/<name> --snapshot flight.json
     python -m nos_tpu.obs explain plan [--kind slice] --url http://host:8080
+    python -m nos_tpu.obs slo --snapshot bench.json
+    python -m nos_tpu.obs top --url http://host:8080
     python -m nos_tpu.obs dump --url http://host:8080
     python -m nos_tpu.obs --selftest
 
-Snapshot sources: `--snapshot FILE` (a saved /debug/flightrecorder
-payload; `-` = stdin) or `--url ADDR` (fetches ADDR/debug/flightrecorder
-live).  `--selftest` runs an in-process end-to-end check of the span
-API, journal, and explain reconstruction — the CI hook in
+Snapshot sources: `--snapshot FILE` (a saved /debug/flightrecorder,
+/snapshot, /debug/slo, or bench.py payload; `-` = stdin) or `--url
+ADDR` (fetches the right endpoint live: /debug/flightrecorder for
+explain/dump, /debug/slo for slo, /snapshot for top).  `--selftest`
+runs an in-process end-to-end check of the span API, journal, explain
+reconstruction, time-series sampler, and SLO engine — the CI hook in
 scripts/check.sh.
 """
 
@@ -22,12 +27,13 @@ import sys
 from . import explain_plan, explain_pod
 
 
-def _load_snapshot(args: argparse.Namespace) -> dict:
+def _load_snapshot(args: argparse.Namespace,
+                   endpoint: str = "/debug/flightrecorder") -> dict:
     snapshot: dict
     if args.url:
         from urllib.request import urlopen
 
-        url = args.url.rstrip("/") + "/debug/flightrecorder"
+        url = args.url.rstrip("/") + endpoint
         with urlopen(url, timeout=10.0) as resp:   # noqa: S310 — operator URL
             snapshot = json.load(resp)
             return snapshot
@@ -40,14 +46,185 @@ def _load_snapshot(args: argparse.Namespace) -> dict:
             return snapshot
     raise SystemExit(
         "no snapshot source: pass --snapshot FILE (or '-') or --url ADDR "
-        "(the health server serves /debug/flightrecorder)")
+        f"(the health server serves {endpoint})")
+
+
+def _find_slo_block(payload: dict) -> dict | None:
+    """The SLO report inside any payload shape we serve: a /debug/slo
+    body (verdicts at top level), a flight/state snapshot or bench
+    output carrying an "slo" block, or bench.py's single JSON nesting
+    the utilization block."""
+    if "verdicts" in payload and "objectives" in payload:
+        return payload
+    for holder in (payload, payload.get("utilization", {})):
+        block = holder.get("slo") if isinstance(holder, dict) else None
+        if isinstance(block, dict) and "verdicts" in block:
+            return block
+    return None
+
+
+def _fmt(v: object, digits: int = 2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def _rejecting_plugin(journal: list[dict], slo_class: str) -> str:
+    """Newest pod-rejected record of this workload class → its plugin
+    (or the dominant per-node reason): the one-command join from an SLO
+    breach to the decision that causes it."""
+    for rec in reversed(journal):
+        if rec.get("category") != "pod-rejected":
+            continue
+        attrs = rec.get("attrs", {})
+        if attrs.get("class") != slo_class:
+            continue
+        if attrs.get("plugin"):
+            return str(attrs["plugin"])
+        counts = attrs.get("reason_counts") or {}
+        if counts:
+            top = max(counts.items(), key=lambda kv: kv[1])[0]
+            return str(top).split(":")[0]
+        return attrs.get("reason") or "unknown"
+    return ""
+
+
+def cmd_slo(payload: dict) -> int:
+    """Render the SLO report: per objective/class — value vs target,
+    burn rates, budget remaining, breach verdict (journal-joined to the
+    rejecting plugin when the payload carries a journal)."""
+    block = _find_slo_block(payload)
+    if block is None:
+        print("payload carries no SLO report — is an engine installed "
+              "(Main.attach_slo) / did the bench run with SLOs?",
+              file=sys.stderr)
+        return 1
+    journal = payload.get("journal", [])
+    verdicts = block.get("verdicts", [])
+    print(f"SLO report (fast window {block.get('fast_window_s')}s, "
+          f"slow {block.get('slow_window_s')}s, burn threshold "
+          f"{block.get('burn_threshold')}):")
+    if not verdicts:
+        print("  no verdicts yet (engine has not evaluated a window)")
+        return 0
+    breached = 0
+    for v in verdicts:
+        state = "BREACH" if v.get("breached") else "ok"
+        cls = v.get("class") or "-"
+        line = (f"  [{state}] {v.get('objective')} class={cls}: "
+                f"value={_fmt(v.get('value'), 3)} "
+                f"target={_fmt(v.get('target'), 3)} "
+                f"burn fast/slow={_fmt(v.get('burn_fast'))}"
+                f"/{_fmt(v.get('burn_slow'))} "
+                f"budget remaining={_fmt(v.get('budget_remaining'))}")
+        print(line)
+        if v.get("breached"):
+            breached += 1
+            plugin = _rejecting_plugin(journal, cls)
+            if plugin:
+                print(f"         rejecting plugin for class {cls}: "
+                      f"{plugin} — `explain pod` a pending pod of this "
+                      "class for the per-node chain")
+    print(f"{breached} breached / {len(verdicts)} verdict(s)")
+    return 0
+
+
+def cmd_top(payload: dict) -> int:
+    """One-shot fleet scoreboard from a /snapshot payload: utilization,
+    per-pool fragmentation, pending-by-class, SLO budget remaining."""
+    state = payload.get("state")
+    if not isinstance(state, dict):
+        print("payload carries no cluster state — `obs top` wants the "
+              "/snapshot endpoint (or its saved JSON), not "
+              "/debug/flightrecorder", file=sys.stderr)
+        return 1
+    from nos_tpu.api import constants as C
+    from nos_tpu.kube.client import KIND_NODE, KIND_POD
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.kube.serialize import load_state
+    from nos_tpu.topology.profile import free_chip_equivalents
+    from nos_tpu.utils.pod_util import workload_class
+
+    api = load_state(state)
+    pools: dict[str, dict] = {}
+    for node in api.list(KIND_NODE):
+        pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
+        agg = pools.setdefault(pool, {"hosts": 0, "chips": 0.0,
+                                      "used": 0.0, "busy_hosts": 0})
+        agg["hosts"] += 1
+        try:
+            agg["chips"] += float(
+                node.metadata.labels.get(C.LABEL_CHIP_COUNT, "0") or 0)
+        except ValueError:
+            pass
+    pending: dict[str, int] = {}
+    used_by_node: dict[str, float] = {}
+    for pod in api.list(KIND_POD):
+        if not pod.spec.node_name:
+            cls = workload_class(pod)
+            pending[cls] = pending.get(cls, 0) + 1
+            continue
+        used_by_node.setdefault(pod.spec.node_name, 0.0)
+        used_by_node[pod.spec.node_name] += \
+            free_chip_equivalents(pod_request(pod))
+    for node in api.list(KIND_NODE):
+        pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
+        used = used_by_node.get(node.metadata.name, 0.0)
+        pools[pool]["used"] += used
+        if used > 0:
+            pools[pool]["busy_hosts"] += 1
+
+    total_chips = sum(p["chips"] for p in pools.values())
+    total_used = sum(p["used"] for p in pools.values())
+    util = total_used / total_chips if total_chips else 0.0
+    print(f"fleet: {sum(p['hosts'] for p in pools.values())} host(s), "
+          f"{total_chips:g} chips, utilization {util:.3f}")
+    print("pool             hosts  chips   used   free  util  frag")
+    for pool in sorted(pools):
+        p = pools[pool]
+        free = max(0.0, p["chips"] - p["used"])
+        putil = p["used"] / p["chips"] if p["chips"] else 0.0
+        # fragmentation: the fraction of free chips stranded on hosts
+        # that already run something — free capacity a whole-host (or
+        # aligned-window) gang cannot use without a re-carve
+        idle_hosts = p["hosts"] - p["busy_hosts"]
+        chips_per_host = p["chips"] / p["hosts"] if p["hosts"] else 0.0
+        whole_free = idle_hosts * chips_per_host
+        frag = 1.0 - (whole_free / free) if free > 0 else 0.0
+        print(f"{pool:<16} {p['hosts']:>5} {p['chips']:>6g} "
+              f"{p['used']:>6.1f} {free:>6.1f} {putil:>5.2f} "
+              f"{max(0.0, frag):>5.2f}")
+    if pending:
+        print("pending by class:")
+        for cls in sorted(pending):
+            print(f"  {cls:<20} {pending[cls]}")
+    else:
+        print("pending by class: none")
+    block = _find_slo_block(payload)
+    if block is not None and block.get("verdicts"):
+        print("SLO budget remaining:")
+        for v in block["verdicts"]:
+            state_s = "BREACH" if v.get("breached") else "ok"
+            print(f"  {v.get('objective')}/{v.get('class') or '-':<16} "
+                  f"{_fmt(v.get('budget_remaining'))} [{state_s}]")
+    return 0
 
 
 def selftest() -> int:
     """In-process zero-cluster check: spans nest and propagate, the
-    journal stays bounded and ordered, and explain reconstructs a
-    rejection chain naming the plugin.  Prints ok/FAIL, returns rc."""
-    from .journal import POD_BOUND, POD_REJECTED, DecisionJournal
+    journal stays bounded and ordered, explain reconstructs a rejection
+    chain naming the plugin, the sampler stays bounded and rolls the
+    max window, and an injected latency regression flips an SLO breach
+    that recovers.  Prints ok/FAIL, returns rc."""
+    from nos_tpu.exporter.metrics import Registry
+    from .journal import (
+        POD_BOUND, POD_REJECTED, SLO_BREACH, SLO_RECOVERED,
+        DecisionJournal,
+    )
+    from .slo import LATENCY, SLOEngine, SLOObjective
+    from .timeseries import TimeSeriesSampler
     from .trace import RingExporter, Tracer
 
     failures: list[str] = []
@@ -107,12 +284,61 @@ def selftest() -> int:
     if "NodeResourcesFit" not in text or "host-0" not in text:
         failures.append(f"explain lost the rejecting plugin:\n{text}")
 
+    # time-series sampler: bounded ring + windowed max reset on tick
+    ts_now = [0.0]
+    reg = Registry()
+    sampler = TimeSeriesSampler(registry=reg, maxlen=4,
+                                clock=lambda: ts_now[0])
+    reg.observe("nos_tpu_selftest_seconds", 5.0)
+    for i in range(6):
+        ts_now[0] += 1.0
+        point = sampler.tick()
+    if len(sampler) != 4:
+        failures.append(f"sampler not bounded: {len(sampler)} != 4")
+    if point.get("nos_tpu_selftest_seconds_max") != 0.0:
+        failures.append("windowed max did not reset on sampler tick")
+
+    # SLO engine: a latency regression breaches, recovery journals
+    slo_now = [0.0]
+    slo_clock = lambda: slo_now[0]  # noqa: E731
+    reg2 = Registry()
+    journal3 = DecisionJournal(maxlen=64, clock=slo_clock)
+    engine = SLOEngine(
+        TimeSeriesSampler(registry=reg2, clock=slo_clock),
+        [SLOObjective(name="selftest-latency", kind=LATENCY,
+                      metric="nos_tpu_selftest_latency_seconds",
+                      target=0.05, each_label="class")],
+        fast_window_s=10.0, slow_window_s=30.0, clock=slo_clock)
+    from . import scoped
+
+    with scoped(journal=journal3):
+        for phase, latency in ((40, 0.01), (40, 2.0), (80, 0.01)):
+            for _ in range(phase):
+                slo_now[0] += 1.0
+                reg2.observe("nos_tpu_selftest_latency_seconds", latency,
+                             labels={"class": "selftest"})
+                engine.tick()
+    cats = [r.category for r in journal3.events()
+            if r.category in (SLO_BREACH, SLO_RECOVERED)]
+    if cats != [SLO_BREACH, SLO_RECOVERED]:
+        failures.append(
+            f"SLO breach/recovery sequence wrong: {cats}")
+    breach = next((r for r in journal3.events()
+                   if r.category == SLO_BREACH), None)
+    if breach is not None and breach.attrs.get("slo_class") != "selftest":
+        failures.append("SLO breach lost the breaching class")
+    # quantile estimator sanity on the registry itself
+    q99 = reg2.quantile("nos_tpu_selftest_latency_seconds", 0.5,
+                        labels={"class": "selftest"})
+    if q99 is None:
+        failures.append("registry quantile returned None with samples")
+
     if failures:
         print("obs selftest: FAIL", file=sys.stderr)
         for f in failures:
             print(f"  - {f}", file=sys.stderr)
         return 1
-    print("obs selftest: ok (spans, journal, explain)")
+    print("obs selftest: ok (spans, journal, explain, timeseries, slo)")
     return 0
 
 
@@ -132,9 +358,14 @@ def main(argv: list[str] | None = None) -> int:
     p_plan.add_argument("--kind", default=None,
                         help="partitioning kind (slice|timeshare)")
     p_dump = sub.add_parser("dump", help="print the raw flight snapshot")
-    for p in (p_pod, p_plan, p_dump):
+    p_slo = sub.add_parser(
+        "slo", help="SLO verdicts: per-class p99, burn rates, budget")
+    p_top = sub.add_parser(
+        "top", help="one-shot fleet scoreboard (utilization, "
+                    "fragmentation, pending, budget)")
+    for p in (p_pod, p_plan, p_dump, p_slo, p_top):
         p.add_argument("--snapshot", default="",
-                       help="saved /debug/flightrecorder JSON ('-'=stdin)")
+                       help="saved snapshot JSON ('-'=stdin)")
         p.add_argument("--url", default="",
                        help="live health server base URL")
 
@@ -144,8 +375,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.command is None:
         parser.print_help()
         return 2
+    # `slo` fetches the FLIGHT snapshot, not /debug/slo: the flight
+    # payload embeds the engine report AND the journal, so the
+    # breach→rejecting-plugin join works on the live-URL path too
+    endpoint = {"top": "/snapshot"}.get(
+        args.command, "/debug/flightrecorder")
     try:
-        snapshot = _load_snapshot(args)
+        snapshot = _load_snapshot(args, endpoint=endpoint)
     except json.JSONDecodeError as exc:
         print(f"snapshot is not valid JSON: {exc}", file=sys.stderr)
         return 1
@@ -159,6 +395,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "dump":
         print(json.dumps(snapshot, indent=2))
         return 0
+    if args.command == "slo":
+        return cmd_slo(snapshot)
+    if args.command == "top":
+        return cmd_top(snapshot)
     if args.what == "pod":
         if "/" not in args.key:
             print("pod key must be <namespace>/<name>", file=sys.stderr)

@@ -71,6 +71,8 @@ ACTUATION_FAILED = "actuation-failed"
 QUARANTINED = "quarantined"
 QUARANTINE_RELEASED = "quarantine-released"
 HANDSHAKE_WAIT = "handshake-wait"
+SLO_BREACH = "slo-breach"
+SLO_RECOVERED = "slo-recovered"
 
 
 class DecisionRecord:

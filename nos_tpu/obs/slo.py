@@ -1,0 +1,424 @@
+"""Declarative SLOs over the sampled registry: error-budget burn rates.
+
+An ``SLOObjective`` states a promise — "serve-class p99 schedule
+latency stays under 50 ms", "fleet utilization stays above 0.9",
+"rebind evictions stay under 0.2/s" — and the ``SLOEngine`` judges it
+the SRE way: not on instantaneous values (one slow pod at 3 a.m. must
+not page) but on **error-budget burn rates over two windows**.  With
+compliance target ``c`` (default 0.99), the budget is the ``1 - c``
+fraction of events allowed to be bad; the burn rate is how many times
+faster than that allowance the window actually spent it.  A breach
+requires BOTH the fast window (is it happening *now*?) and the slow
+window (is it *significant*?) to burn above the threshold — the
+multi-window, multi-burn-rate pattern from the SRE workbook.
+
+Objective kinds:
+
+- ``latency`` — events are observations of a histogram metric
+  (e.g. ``nos_tpu_schedule_latency_seconds``); bad = above ``target``
+  seconds (judged against bucket bounds, conservatively: the largest
+  bound <= target).  ``each_label="class"`` fans one objective out to
+  a verdict per observed label value — per-class p99 tracking without
+  enumerating classes up front.
+- ``gauge_floor`` — events are sample points of a gauge (e.g. a
+  utilization gauge); bad = sampled below ``target``.
+- ``rate_ceiling`` — a counter's per-second increase (e.g. rebind /
+  eviction totals); burn = rate / ``target`` directly.
+
+Verdict TRANSITIONS are journaled as ``SLO_BREACH`` /
+``SLO_RECOVERED`` (obs/journal.py) with the ambient trace id, so
+``python -m nos_tpu.obs slo`` can name the breaching class and — via
+the same journal's ``pod-rejected`` records — the rejecting plugin in
+one command.  The engine itself is driven from ONE run loop
+(``Main.add_loop`` or a bench tick): ``tick()`` samples then
+evaluates; it holds no lock of its own and calls the journal only
+through its leaf-locked ``record()``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from nos_tpu.exporter.metrics import histogram_quantile
+
+from . import journal as J
+from .journal import record as journal_record
+from .timeseries import SamplePoint, TimeSeriesSampler
+from .trace import span as obs_span
+
+#: (start, end) sample points spanning one evaluation window, or None
+#: while the window is not yet observable (timeseries.bracket).
+Bracket = tuple[SamplePoint, SamplePoint] | None
+#: (burn rate, reported value, budget remaining) — Nones when the
+#: window has no points or too few events.
+BurnTriple = tuple[float | None, float | None, float | None]
+
+LATENCY = "latency"
+GAUGE_FLOOR = "gauge_floor"
+RATE_CEILING = "rate_ceiling"
+
+
+@dataclass(frozen=True)
+class SLOObjective:
+    """One declarative objective (module docstring has the kinds)."""
+
+    name: str
+    kind: str                      # latency | gauge_floor | rate_ceiling
+    metric: str                    # base metric name (no derived suffix)
+    target: float                  # seconds / floor value / per-second rate
+    labels: tuple = ()             # series selector ((key, value), ...)
+    each_label: str = ""           # fan out per value of this label key
+    compliance: float = 0.99      # good-event fraction the SLO promises
+    quantile: float = 0.99        # reported quantile (latency kind)
+    # Minimum events in a window before it is judged (latency kind): a
+    # low-traffic class where ONE slow event is 100% of the window must
+    # read "not yet observable", not page at burn 50 (SRE low-traffic
+    # rule).
+    min_events: int = 1
+
+    def __post_init__(self) -> None:
+        if self.kind not in (LATENCY, GAUGE_FLOOR, RATE_CEILING):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not (0.0 < self.compliance < 1.0):
+            raise ValueError("compliance must be in (0, 1)")
+        if self.target <= 0.0:
+            # a zero ceiling would make burn = rate/0 = inf, and
+            # json.dumps renders inf as the non-JSON token Infinity,
+            # breaking every strict consumer of /debug/slo and the
+            # bench's one-JSON-stdout contract — express zero
+            # tolerance as a tiny positive ceiling instead
+            raise ValueError("target must be > 0 (zero-tolerance "
+                             "ceilings: use a tiny positive target)")
+        if isinstance(self.labels, dict):  # ergonomic constructor form
+            object.__setattr__(self, "labels",
+                               tuple(sorted(self.labels.items())))
+
+
+def _parse_series(series: str) -> dict[str, str]:
+    """Inverse of metrics._series: "k=v,k2=v2" -> dict ("" -> {})."""
+    if not series:
+        return {}
+    out: dict[str, str] = {}
+    for part in series.split(","):
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+def _matches(labels: dict[str, str], selector: tuple) -> bool:
+    return all(labels.get(k) == str(v) for k, v in selector)
+
+
+class SLOEngine:
+    """Evaluates objectives against the sampler's windowed points and
+    journals verdict transitions.  Single-driver contract: exactly one
+    loop calls ``tick()``/``evaluate()`` (state is the breach latch,
+    not lock-guarded); readers consume ``report()`` output."""
+
+    def __init__(self, sampler: TimeSeriesSampler,
+                 objectives: list[SLOObjective],
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 300.0,
+                 burn_threshold: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._sampler = sampler
+        self._objectives = list(objectives)
+        self._fast_s = fast_window_s
+        self._slow_s = slow_window_s
+        self._burn_threshold = burn_threshold
+        self._clock = clock
+        # (objective name, fanout label value) -> currently breached
+        self._breached: dict[tuple[str, str], bool] = {}
+        # remembered (objective, fanout, selector) per judged key, so a
+        # fanned-out class whose series VANISH (registry reset, engine
+        # re-pointed) is still re-judged — its breach latch resolves to
+        # SLO_RECOVERED (no data = not burning) instead of silently
+        # disappearing from report() with the latch stuck
+        self._judged_ctx: dict[tuple[str, str],
+                               tuple[SLOObjective, str, tuple]] = {}
+        self._last_verdicts: list[dict] = []
+
+    @property
+    def sampler(self) -> TimeSeriesSampler:
+        return self._sampler
+
+    @property
+    def objectives(self) -> list[SLOObjective]:
+        return list(self._objectives)
+
+    def tick(self) -> list[dict]:
+        """Sample the registry, then re-judge every objective — the run
+        -loop entry point."""
+        self._sampler.tick()
+        return self.evaluate()
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self) -> list[dict]:
+        """One verdict dict per (objective x fanned-out label value);
+        journals SLO_BREACH / SLO_RECOVERED on transitions, carrying
+        the ambient trace id via the slo.evaluate span."""
+        fast = self._sampler.bracket(self._fast_s)
+        slow = self._sampler.bracket(self._slow_s)
+        verdicts: list[dict] = []
+        seen: set[tuple[str, str]] = set()
+        with obs_span("slo.evaluate", objectives=len(self._objectives)):
+            for obj in self._objectives:
+                for fanout, selector in self._expand(obj):
+                    seen.add((obj.name, fanout))
+                    verdicts.append(
+                        self._judge(obj, fanout, selector, fast, slow))
+            # latched-breached keys whose series vanished this round:
+            # re-judge them anyway so the breach resolves (burns read
+            # None without data -> not breached -> SLO_RECOVERED) and
+            # the episode closes in both journal and report
+            for key in [k for k, br in self._breached.items()
+                        if k not in seen]:
+                if self._breached[key]:
+                    obj, fanout, selector = self._judged_ctx[key]
+                    verdicts.append(
+                        self._judge(obj, fanout, selector, fast, slow))
+                else:
+                    del self._breached[key]
+                    del self._judged_ctx[key]
+        self._last_verdicts = verdicts
+        return verdicts
+
+    def _expand(self, obj: SLOObjective) -> list[tuple[str, tuple]]:
+        """Concrete (fanout value, full selector) pairs for one
+        objective: the static selector alone, or one per observed value
+        of ``each_label`` in the newest sample."""
+        if not obj.each_label:
+            return [("", obj.labels)]
+        latest = self._sampler.latest()
+        if latest is None:
+            return []
+        suffix = "_count" if obj.kind == LATENCY else ""
+        seen: set[str] = set()
+        out: list[tuple[str, tuple]] = []
+        for series in latest.values.get(obj.metric + suffix, {}):
+            labels = _parse_series(series)
+            value = labels.get(obj.each_label)
+            if value is None or value in seen:
+                continue
+            if not _matches(labels, obj.labels):
+                continue
+            seen.add(value)
+            out.append((value, obj.labels + ((obj.each_label, value),)))
+        return sorted(out)
+
+    def _judge(self, obj: SLOObjective, fanout: str, selector: tuple,
+               fast: Bracket, slow: Bracket) -> dict:
+        if obj.kind == LATENCY:
+            burn_fast, _, _ = self._latency_burn(obj, selector, fast)
+            burn_slow, quantile, budget = self._latency_burn(
+                obj, selector, slow)
+            value = quantile
+        elif obj.kind == GAUGE_FLOOR:
+            burn_fast, _, _ = self._gauge_burn(obj, selector, fast)
+            burn_slow, value, budget = self._gauge_burn(
+                obj, selector, slow)
+        else:   # RATE_CEILING
+            burn_fast, _, _ = self._rate_burn(obj, selector, fast)
+            burn_slow, value, budget = self._rate_burn(
+                obj, selector, slow)
+
+        # multi-window verdict: breach only when both windows burn.
+        # None = window not yet observable (too few points / no events):
+        # never a breach, never a recovery trigger either.
+        breached = (burn_fast is not None and burn_slow is not None
+                    and burn_fast >= self._burn_threshold
+                    and burn_slow >= self._burn_threshold)
+        verdict = {
+            "objective": obj.name,
+            "kind": obj.kind,
+            "metric": obj.metric,
+            "labels": dict(selector),
+            "class": fanout or dict(selector).get("class", ""),
+            "target": obj.target,
+            "value": value,
+            "burn_fast": burn_fast,
+            "burn_slow": burn_slow,
+            "budget_remaining": budget,
+            "breached": breached,
+        }
+        key = (obj.name, fanout)
+        self._judged_ctx[key] = (obj, fanout, selector)
+        was = self._breached.get(key, False)
+        if breached != was:
+            self._breached[key] = breached
+            journal_record(
+                J.SLO_BREACH if breached else J.SLO_RECOVERED,
+                obj.name + (f"/{fanout}" if fanout else ""),
+                kind=obj.kind, metric=obj.metric,
+                slo_class=verdict["class"], target=obj.target,
+                value=value, burn_fast=burn_fast, burn_slow=burn_slow,
+                budget_remaining=budget)
+        return verdict
+
+    # -- per-kind window math ------------------------------------------------
+    @staticmethod
+    def _each_series_delta(name: str, selector: tuple,
+                           start: SamplePoint, end: SamplePoint
+                           ) -> list[tuple[str | None, float]]:
+        """Per-series (le label, delta) of `name` between the bracket
+        ends, matching `selector`.  A negative delta means the registry
+        was reset mid-window (process restart): resync to the end
+        value instead of reporting negative traffic."""
+        out: list[tuple[str | None, float]] = []
+        for series, v_end in end.values.get(name, {}).items():
+            labels = _parse_series(series)
+            le = labels.pop("le", None)
+            if not _matches(labels, selector):
+                continue
+            v_start = start.values.get(name, {}).get(series, 0.0)
+            delta = v_end - v_start
+            if delta < 0:
+                delta = v_end
+            out.append((le, delta))
+        return out
+
+    def _delta_total(self, name: str, selector: tuple,
+                     bracket: Bracket) -> float | None:
+        if bracket is None:
+            return None
+        return sum(d for _, d in self._each_series_delta(
+            name, selector, *bracket))
+
+    def _delta_by_le(self, name: str, selector: tuple,
+                     bracket: Bracket) -> dict[str, float]:
+        assert bracket is not None
+        out: dict[str, float] = {}
+        for le, delta in self._each_series_delta(name, selector,
+                                                 *bracket):
+            if le is not None:
+                out[le] = out.get(le, 0.0) + delta
+        return out
+
+    def _latency_burn(self, obj: SLOObjective, selector: tuple,
+                      bracket: Bracket) -> BurnTriple:
+        """(burn rate, quantile estimate, budget remaining) for a
+        histogram metric over one bracket; Nones when the window has no
+        points or no events."""
+        if bracket is None:
+            return None, None, None
+        total = self._delta_total(obj.metric + "_count", selector,
+                                  bracket)
+        if not total or total < obj.min_events:
+            return None, None, None
+        by_le = self._delta_by_le(obj.metric + "_bucket", selector,
+                                  bracket)
+        bounds = sorted((float(le) for le in by_le if le != "+Inf"))
+        cumulative = [by_le[f"{b:g}"] for b in bounds]
+        # conservative good-event count: observations provably <= target
+        # (cumulative at the largest bound <= target)
+        good = 0.0
+        for b, c in zip(bounds, cumulative):
+            if b <= obj.target:
+                good = c
+            else:
+                break
+        bad_fraction = max(0.0, 1.0 - good / total)
+        allowed = 1.0 - obj.compliance
+        burn = bad_fraction / allowed
+        budget = 1.0 - burn
+        per_bucket = [cumulative[0]] + [
+            cumulative[i] - cumulative[i - 1]
+            for i in range(1, len(cumulative))]
+        quantile = histogram_quantile(tuple(bounds), per_bucket, total,
+                                      obj.quantile)
+        return burn, quantile, budget
+
+    def _gauge_burn(self, obj: SLOObjective, selector: tuple,
+                    bracket: Bracket) -> BurnTriple:
+        """Fraction of sample points below the floor, burn-scaled."""
+        if bracket is None:
+            return None, None, None
+        start, end = bracket
+        pts = [p for p in self._sampler.points()
+               if start.ts <= p.ts <= end.ts]
+        total = 0
+        bad = 0
+        newest: float | None = None
+        for p in pts:
+            for series, v in p.values.get(obj.metric, {}).items():
+                if not _matches(_parse_series(series), selector):
+                    continue
+                total += 1
+                newest = v
+                if v < obj.target:
+                    bad += 1
+        if total == 0:
+            return None, None, None
+        burn = (bad / total) / (1.0 - obj.compliance)
+        return burn, newest, 1.0 - burn
+
+    def _rate_burn(self, obj: SLOObjective, selector: tuple,
+                   bracket: Bracket) -> BurnTriple:
+        """Counter increase per second vs the ceiling."""
+        if bracket is None:
+            return None, None, None
+        start, end = bracket
+        seconds = end.ts - start.ts
+        if seconds <= 0:
+            return None, None, None
+        delta = self._delta_total(obj.metric, selector, bracket)
+        rate = (delta or 0.0) / seconds
+        burn = rate / obj.target       # target > 0 by __post_init__
+        return burn, rate, 1.0 - burn
+
+    # -- surfaces ------------------------------------------------------------
+    def report(self) -> dict:
+        """The /debug/slo payload: config + the latest verdicts (embeds
+        in flight snapshots and the bench JSON as the "slo" block)."""
+        return {
+            "ts": self._clock(),
+            "fast_window_s": self._fast_s,
+            "slow_window_s": self._slow_s,
+            "burn_threshold": self._burn_threshold,
+            "objectives": [
+                {"name": o.name, "kind": o.kind, "metric": o.metric,
+                 "target": o.target, "labels": dict(o.labels),
+                 "each_label": o.each_label, "compliance": o.compliance}
+                for o in self._objectives],
+            "verdicts": list(self._last_verdicts),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-global engine (swappable, like obs.trace's tracer): the cmd
+# mains install one so /debug/slo and flight snapshots can serve it.
+# ---------------------------------------------------------------------------
+
+_engine: SLOEngine | None = None
+
+
+def get_engine() -> SLOEngine | None:
+    return _engine
+
+
+def set_engine(engine: SLOEngine | None) -> SLOEngine | None:
+    global _engine
+    prev = _engine
+    _engine = engine
+    return prev
+
+
+def default_objectives() -> list[SLOObjective]:
+    """The stock objectives a cmd main installs when the operator
+    enables SLO evaluation without writing any config: per-class p99
+    schedule latency (fanned out over observed classes) and per-pool
+    actuation latency.  Targets are deliberately loose defaults —
+    docs/observability.md's SLO cookbook shows tightening them per
+    class."""
+    return [
+        SLOObjective(
+            name="schedule-latency", kind=LATENCY,
+            metric="nos_tpu_schedule_latency_seconds",
+            target=30.0, each_label="class"),
+        SLOObjective(
+            name="actuation-latency", kind=LATENCY,
+            metric="nos_tpu_actuation_latency_seconds",
+            target=30.0, each_label="pool"),
+    ]

@@ -1,0 +1,118 @@
+"""Windowed time-series sampling of the metrics registry.
+
+The SLO engine (obs/slo.py) needs *windowed* views — "what did the
+per-class latency histogram do over the last 60 s vs the last 5 min" —
+but the Registry only holds lifetime aggregates.  The
+``TimeSeriesSampler`` bridges them: a run loop (or a bench tick) calls
+``tick()``, which snapshots every registry series into one bounded
+``SamplePoint`` ring and rolls the registry's max window
+(``Registry.reset_window()`` — the ``<name>_max`` gauges are
+max-since-last-tick by contract, exporter/metrics.py).
+
+Design constraints mirror the decision journal's (obs/journal.py):
+
+1. **Bounded memory** — a deque(maxlen) of points plus an eviction
+   counter; a week-long run keeps the newest ``maxlen`` ticks.
+2. **Leaf lock** — ``tick()`` computes the whole point (registry
+   snapshot, clock read) BEFORE taking the ring lock and calls nothing
+   under it, so sampling can never add a lock-order edge (verified
+   under lockcheck in the chaos soak).
+3. **Injectable clock** — sample timestamps come from the sampler's
+   clock so chaos seeds reproduce byte-identical series (noslint N002).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from nos_tpu.exporter.metrics import REGISTRY, Registry
+
+from ._ring import BoundedRing
+
+REGISTRY.describe("nos_tpu_timeseries_points_dropped_total",
+                  "Sample points evicted from the bounded series ring")
+
+
+class SamplePoint:
+    """One tick's view of every registry series: ``values`` is the
+    ``Registry.snapshot()`` dict (name -> {series: value}, histograms
+    expanded into ``_bucket``/``_sum``/``_count``/``_max``)."""
+
+    __slots__ = ("ts", "values")
+
+    def __init__(self, ts: float, values: dict) -> None:
+        self.ts = ts
+        self.values = values
+
+    def get(self, name: str, series: str = "") -> float | None:
+        return self.values.get(name, {}).get(series)
+
+    def to_dict(self) -> dict:
+        return {"ts": self.ts, "values": self.values}
+
+
+class TimeSeriesSampler(BoundedRing):
+    """Bounded ring of registry sample points (see module docstring).
+
+    ``maxlen`` x tick interval is the longest window the SLO engine can
+    evaluate; the default 720 points at a 1 s tick covers the 5-minute
+    slow window 140x over, at 15 s ticks it covers 3 hours.
+    """
+
+    def __init__(self, registry: Registry | None = None,
+                 maxlen: int = 720,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(maxlen)
+        self._registry = registry if registry is not None else REGISTRY
+        self._clock = clock
+
+    def tick(self) -> SamplePoint:
+        """Sample every series and roll the max window.  The snapshot
+        and clock read happen OUTSIDE the ring lock (leaf-lock
+        contract); the registry's own lock is released before ours is
+        taken, so no lock nesting exists on this path."""
+        values = self._registry.snapshot()
+        self._registry.reset_window()
+        point = SamplePoint(self._clock(), values)
+        with self._lock:
+            evicted = self._push_locked(point)
+        if evicted:
+            # into the SAMPLED registry: a sampler over a private
+            # registry must surface its truncation in that registry's
+            # own exposition, not pollute the process-global one
+            self._registry.inc("nos_tpu_timeseries_points_dropped_total")
+        return point
+
+    # -- windowed reads ------------------------------------------------------
+    def points(self) -> list[SamplePoint]:
+        """All retained points, oldest first."""
+        with self._lock:
+            return list(self._items)
+
+    def latest(self) -> SamplePoint | None:
+        with self._lock:
+            return self._items[-1] if self._items else None
+
+    def bracket(self, window_s: float) -> tuple[SamplePoint, SamplePoint] | None:
+        """(start, end) points spanning AT LEAST ``window_s`` seconds
+        ending at the newest sample: start is the newest point at or
+        before ``end.ts - window_s``.  None until the ring has actually
+        covered a full window — a half-filled window must read as "not
+        yet observable", never as a verdict (the SLO engine's cold-start
+        rule: no paging while the series is still filling)."""
+        with self._lock:
+            pts = list(self._items)
+        if len(pts) < 2:
+            return None
+        end = pts[-1]
+        cutoff = end.ts - window_s
+        start: SamplePoint | None = None
+        for p in pts:
+            if p.ts <= cutoff:
+                start = p
+            else:
+                break
+        if start is None or start is end:
+            return None
+        return start, end

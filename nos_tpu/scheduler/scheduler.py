@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from collections import Counter
 from typing import Any, Callable
 
@@ -44,12 +45,27 @@ from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
 from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
+from nos_tpu.utils.pod_util import workload_class
 from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
 
 REGISTRY.describe("nos_tpu_drain_preemptions_total",
                   "Straggler pods evicted to complete a window drain")
+# Batch-scale bucket layout: the default 1 ms - 60 s layout serves
+# control-loop latencies, but batch/gang schedule latencies run minutes
+# on a saturated fleet — the top buckets must resolve them or every
+# queue-heavy class collapses into +Inf.
+REGISTRY.describe("nos_tpu_schedule_latency_seconds",
+                  "Queue-admission to bind latency per workload class "
+                  "(gang = last member bound)",
+                  buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                           120.0, 240.0, 480.0))
+REGISTRY.describe("nos_tpu_schedule_pending_age_seconds",
+                  "Oldest still-pending pod's age per workload class")
+REGISTRY.describe("nos_tpu_schedule_pending_pods",
+                  "Still-pending pods per workload class after a cycle")
 
 
 def _gen_window_sizes(accel: str) -> tuple[int, ...]:
@@ -104,10 +120,16 @@ class Scheduler:
                  backfill_remaining_fn: Callable[
                      [Pod], float | None] | None = None,
                  backfill_duration_fn: Callable[
-                     [Pod], float | None] | None = None) -> None:
+                     [Pod], float | None] | None = None,
+                 clock: Callable[[], float] = time.time) -> None:
         self._api = api
         self._framework = framework
         self.name = name
+        # Schedule-latency clock: must share a time domain with pod
+        # creation_timestamps (wall clock in production, the virtual
+        # trace clock in sims/benches) — queue-admission→bind latency is
+        # clock() - creation_timestamp.  Injectable per noslint N002.
+        self._clock = clock
         # Drain preemption (opt-in): once a gang has held the window
         # lease this many scheduling cycles, the last stragglers on the
         # window (at most the given fraction of its chip capacity,
@@ -221,6 +243,9 @@ class Scheduler:
         # per cycle at fleet scale.  Lives and dies with the cycle
         # snapshot; assume() marks the bound host busy in place.
         self._busy_map_cache: dict[tuple[str, int], bool] | None = None
+        # Workload classes with a live pending gauge (so a drained
+        # class's gauges reset to 0 instead of freezing)
+        self._pending_classes: set[str] = set()
         # True while run_cycle drives the entry points: the cycle
         # snapshot is shared across its pods.  Direct schedule_one/
         # schedule_gang calls (public entry points) drop it on exit so
@@ -394,6 +419,7 @@ class Scheduler:
             self._framework.run_unreserve_plugins(state, pod, chosen.name)
             return None
         self._assume_bound(pod, chosen.name)
+        self._observe_schedule_latency([pod])
         return chosen.name
 
     def _filter_equiv_key(self, pod: Pod) -> tuple | None:
@@ -547,6 +573,7 @@ class Scheduler:
         # outside run_cycle (they rebuild lazily)
         self._cycle_lister_cache = None
         self._busy_map_cache = None
+        self._publish_pending_gauges()
         return bound
 
     # -- quota head-of-line -------------------------------------------------
@@ -755,6 +782,10 @@ class Scheduler:
             set_pod_group_status(
                 self._api, pg, "Scheduled",
                 alive - (len(placements) - bound_members))
+        if bound_members == len(members):
+            # gang latency = last member bound, measured from the
+            # EARLIEST admission (the gang waited as one unit)
+            self._observe_schedule_latency(members)
         self._gang_journal(members, True, "gang admitted",
                            bound=bound_members)
         logger.info("gang %s: bound %d pods",
@@ -1250,6 +1281,52 @@ class Scheduler:
             return False
         return True
 
+    def _observe_schedule_latency(self, pods: list[Pod]) -> None:
+        """Record queue-admission→bind latency into the per-class SLO
+        histogram.  One observation per scheduling unit: a single pod
+        observes itself; a gang is passed whole once its LAST member
+        bound (the gang's latency is the straggler's).  Pods without a
+        creation timestamp (tests, hand-made objects) observe nothing —
+        a fabricated zero admission time would poison the p99."""
+        ts = min(p.metadata.creation_timestamp for p in pods)
+        if ts <= 0.0:
+            return
+        latency = self._clock() - ts
+        if latency < 0.0:
+            return      # clock domains disagree: no honest sample exists
+        REGISTRY.observe("nos_tpu_schedule_latency_seconds", latency,
+                         labels={"class": workload_class(pods[0])})
+
+    def _publish_pending_gauges(self) -> None:
+        """Per-class pending-pod gauges after a cycle: how many pods of
+        each workload class are still waiting and the oldest one's age —
+        the scoreboard's pending-by-class column and the SLO engine's
+        leading breach indicator.  Classes that drained set 0 (a gauge
+        that silently freezes at its last value reads as a live
+        backlog)."""
+        now = self._clock()
+        count: dict[str, int] = {}
+        oldest: dict[str, float] = {}
+        for p in self._api.pods_by_phase(PENDING):
+            if p.spec.node_name or p.spec.scheduler_name != self.name:
+                continue
+            cls = workload_class(p)
+            count[cls] = count.get(cls, 0) + 1
+            ts = p.metadata.creation_timestamp
+            if 0.0 < ts <= now:
+                oldest[cls] = max(oldest.get(cls, 0.0), now - ts)
+        for cls in self._pending_classes - set(count):
+            REGISTRY.set("nos_tpu_schedule_pending_pods", 0.0,
+                         labels={"class": cls})
+            REGISTRY.set("nos_tpu_schedule_pending_age_seconds", 0.0,
+                         labels={"class": cls})
+        for cls, n in count.items():
+            REGISTRY.set("nos_tpu_schedule_pending_pods", float(n),
+                         labels={"class": cls})
+            REGISTRY.set("nos_tpu_schedule_pending_age_seconds",
+                         oldest.get(cls, 0.0), labels={"class": cls})
+        self._pending_classes = set(count)
+
     def _bind(self, pod: Pod, node_name: str) -> bool:
         # Binding only (the /binding subresource against a real substrate).
         # phase=Running is the KUBELET's claim, not the scheduler's — the
@@ -1308,8 +1385,11 @@ class Scheduler:
         def mutate(p: Pod) -> None:
             p.mark_unschedulable(status.message, status.reason)
         self._patch_pod(pod, mutate)
-        # the journal's "why is this pod pending" substrate
-        attrs: dict = {"reason": status.reason, "message": status.message}
+        # the journal's "why is this pod pending" substrate; `class`
+        # joins rejections to SLO breach records (obs slo names the
+        # breaching class's rejecting plugin through it)
+        attrs: dict = {"reason": status.reason, "message": status.message,
+                       "class": workload_class(pod)}
         if status.plugin:
             attrs["plugin"] = status.plugin
         if node_attrs is None and node_reasons:

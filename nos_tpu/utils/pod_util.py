@@ -21,6 +21,33 @@ def extra_resources_could_help_scheduling(pod: Pod) -> bool:
     )
 
 
+def workload_class(pod: Pod) -> str:
+    """Telemetry workload class: the machine class / time-share unit the
+    pod consumes, the `class=` label of every per-class SLO series
+    (nos_tpu_schedule_latency_seconds, pending gauges — see
+    docs/observability.md).  Mirrors the bench trace taxonomy:
+    ``gang-<shape>`` for pod-group members, ``slice-<shape>`` for
+    single slice consumers, ``ts-<gb>`` for time-share units,
+    ``other`` for anything else.  Classes must stay LOW-cardinality:
+    they come from the finite profile table, never from pod names."""
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.topology.profile import (
+        extract_slice_requests, extract_timeshare_requests,
+    )
+
+    req = pod_request(pod)
+    slices = extract_slice_requests(req)
+    if slices:
+        shape = max(slices, key=lambda s: (s.chips, str(s)))
+        kind = ("gang" if pod.metadata.labels.get(C.LABEL_POD_GROUP)
+                else "slice")
+        return f"{kind}-{shape}"
+    timeshare = extract_timeshare_requests(req)
+    if timeshare:
+        return f"ts-{max(timeshare)}"
+    return "other"
+
+
 def is_over_quota(pod: Pod) -> bool:
     return pod.metadata.labels.get(C.LABEL_CAPACITY) == C.CAPACITY_OVER_QUOTA
 

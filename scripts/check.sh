@@ -53,6 +53,13 @@ if ! env JAX_PLATFORMS=cpu python bench_fleet.py --smoke; then
     rc=1
 fi
 
+echo "==> bench_utilization.py --smoke (SLO telemetry gate: per-class histograms + verdicts)"
+if ! env JAX_PLATFORMS=cpu python bench_utilization.py --smoke \
+        --slo-report "${SLO_REPORT_PATH:-/tmp/nos_tpu_slo_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
 if [ "$FAST" -eq 0 ]; then
     echo "==> tier-1 pytest (-m 'not slow')"
     if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

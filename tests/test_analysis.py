@@ -210,6 +210,62 @@ class TestN003:
         v = lint_source(src, [MetricDiscipline()])
         assert any("string literal" in x.message for x in v)
 
+    # -- histogram bucket extension -----------------------------------------
+    def test_literal_buckets_clean(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_seconds', 'h',\n"
+            "                  buckets=(0.1, 1.0, 10.0))\n"
+            "REGISTRY.observe('nos_tpu_x_seconds', 0.2,\n"
+            "                 labels={'class': 'a'})\n"
+        )
+        assert lint_source(src, [MetricDiscipline()]) == []
+
+    def test_non_literal_buckets_flagged(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_seconds', 'h')\n"
+            "REGISTRY.observe('nos_tpu_x_seconds', 0.2, buckets=BOUNDS)\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("literal tuple/list" in x.message for x in v)
+
+    def test_non_increasing_buckets_flagged(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_seconds', 'h',\n"
+            "                  buckets=(1.0, 1.0, 2.0))\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("strictly increasing" in x.message for x in v)
+
+    def test_conflicting_bucket_layouts_flagged(self):
+        src = (
+            "REGISTRY.describe('nos_tpu_x_seconds', 'h',\n"
+            "                  buckets=(0.1, 1.0))\n"
+            "REGISTRY.observe('nos_tpu_x_seconds', 0.2,\n"
+            "                 buckets=(0.5, 5.0))\n"
+        )
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("bucket layout" in x.message for x in v)
+
+    def test_quantile_requires_literal_name(self):
+        src = "REGISTRY.quantile(metric_var, 0.99)\n"
+        v = lint_source(src, [MetricDiscipline()])
+        assert any("string literal" in x.message for x in v)
+
+    def test_exclude_list_does_not_exempt_obs_modules(self):
+        """The rule's exclusions name the Registry implementation and
+        the analyzer ONLY — a future exclude entry silently exempting
+        nos_tpu/obs/ (timeseries, slo: heavy emitters) would turn the
+        rule off exactly where the new series are minted."""
+        for entry in MetricDiscipline.exclude:
+            assert not entry.startswith("nos_tpu/obs"), entry
+        assert MetricDiscipline.exclude == (
+            "nos_tpu/exporter/metrics.py", "nos_tpu/analysis/")
+        # and a violation planted under an obs-like path fires
+        v = lint_source("REGISTRY.inc('nos_tpu_obs_only_total')\n",
+                        [MetricDiscipline()],
+                        relpath="nos_tpu/obs/fixture.py")
+        assert any("never registered" in x.message for x in v)
+
 
 # ---------------------------------------------------------------------------
 # N004: no blocking under lock

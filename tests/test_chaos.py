@@ -22,6 +22,7 @@ from types import SimpleNamespace
 import pytest
 
 from nos_tpu import obs
+from nos_tpu.obs import slo as slo_mod
 from nos_tpu.controllers.node_controller import NodeController
 from nos_tpu.controllers.pod_controller import PodController
 from nos_tpu.controllers.sliceagent.agent import SliceAgent
@@ -134,6 +135,19 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
         if scheduler._cache is not None:
             guard_state(scheduler._cache, lock_graph,
                         name="scheduler.SchedulerCache")
+        # SLO plane under the same window: the sampler's ring lock joins
+        # the graph, so its leaf-lock contract (tick computes the
+        # registry snapshot BEFORE its own lock) is verified, not
+        # assumed — a sampler that nested the registry lock under its
+        # ring lock would fail every seed here.
+        sampler = obs.TimeSeriesSampler(maxlen=64,
+                                        clock=lambda: clock[0])
+        slo_engine = obs.SLOEngine(
+            sampler, slo_mod.default_objectives(),
+            fast_window_s=BATCH_TIMEOUT_S,
+            slow_window_s=3 * BATCH_TIMEOUT_S,
+            clock=lambda: clock[0])
+        guard_state(sampler, lock_graph, name="obs.TimeSeriesSampler")
 
     # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
     # is always feasible
@@ -150,13 +164,14 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             for n in api.list(KIND_NODE))
 
     done = False
-    with obs.scoped(tracer, journal):
+    with obs.scoped(tracer, journal, engine=slo_engine):
         for round_no in range(max_rounds):
             clock[0] += BATCH_TIMEOUT_S + 1.0
             tick("scheduler", scheduler.run_cycle)
             tick("partitioner", partitioner.process_if_ready)
             for i, agent in enumerate(agents):
                 tick(f"agent-{i}", agent.tick)
+            tick("slo", slo_engine.tick)
             api.replay_dropped()        # the round's watch "reconnect"
             if converged():
                 done = True
@@ -165,7 +180,8 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
                            rounds=round_no + 1, seed=seed,
                            quarantined=partitioner.quarantine.names(),
                            lock_graph=lock_graph,
-                           tracer=tracer, journal=journal)
+                           tracer=tracer, journal=journal,
+                           sampler=sampler, slo_engine=slo_engine)
 
 
 def _assert_soak_ok(result) -> None:
@@ -198,6 +214,11 @@ def _assert_soak_ok(result) -> None:
     assert (J.POD_BOUND in cats) or journal.dropped > 0, (cats, repro)
     span_names = {s["name"] for s in result.tracer.ring.dump()}
     assert "scheduler.run_cycle" in span_names, repro
+    # SLO sampler invariants under chaos: bounded ring, one point per
+    # soak round (the engine ticked every round without raising)
+    assert len(result.sampler) <= result.sampler.maxlen, repro
+    assert len(result.sampler) == min(result.rounds,
+                                      result.sampler.maxlen), repro
 
 
 class TestChaosSoak:

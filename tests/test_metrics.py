@@ -1,0 +1,372 @@
+"""Registry histogram semantics + Prometheus exposition validity.
+
+A mini text-format parser asserts `render()` output round-trips:
+HELP/TYPE placement, label escaping, bucket monotonicity, `le`
+ordering, `+Inf` bucket == `_count` — the contract a real Prometheus
+scraper enforces.  Plus the derived-series namespace guards (a scalar
+named `foo_count` must not merge with histogram `foo`), windowed-max
+semantics, and the in-process quantile estimator.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from nos_tpu.exporter.metrics import (
+    DEFAULT_BUCKETS, Registry, histogram_quantile,
+)
+
+
+# ---------------------------------------------------------------------------
+# mini text-format parser
+# ---------------------------------------------------------------------------
+
+def _unescape(val: str) -> str:
+    out = []
+    i = 0
+    while i < len(val):
+        c = val[i]
+        if c == "\\" and i + 1 < len(val):
+            nxt = val[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            else:
+                raise ValueError(f"bad escape \\{nxt} in label value")
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str) -> dict[str, str]:
+    """Parse `k="v",k2="v2"` honouring escapes — a quote inside a value
+    must be escaped or this raises (that IS the validity test)."""
+    labels: dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        key = body[i:eq]
+        assert body[eq + 1] == '"', f"label {key}: unquoted value"
+        j = eq + 2
+        raw = []
+        while True:
+            c = body[j]
+            if c == "\\":
+                raw.append(body[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            assert c != "\n", "raw newline inside a label value"
+            raw.append(c)
+            j += 1
+        labels[key] = _unescape("".join(raw))
+        i = j + 1
+        if i < len(body):
+            assert body[i] == ",", f"junk after label {key}"
+            i += 1
+    return labels
+
+
+class Exposition:
+    """Parsed render() output: samples + per-metric HELP/TYPE metadata,
+    with placement rules enforced while parsing."""
+
+    def __init__(self, text: str) -> None:
+        assert text.endswith("\n"), "exposition must end with a newline"
+        self.samples: list[tuple[str, dict[str, str], float]] = []
+        self.meta: dict[str, dict[str, str]] = {}
+        samples_seen: set[str] = set()
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind = line[2:6].strip().lower()
+                rest = line[7:]
+                name, _, value = rest.partition(" ")
+                meta = self.meta.setdefault(name, {})
+                assert kind not in meta, f"duplicate {kind} for {name}"
+                assert name not in samples_seen, \
+                    f"{kind} for {name} after its samples"
+                meta[kind] = value
+                continue
+            assert not line.startswith("#"), f"unknown comment: {line}"
+            body, _, value_s = line.rpartition(" ")
+            if "{" in body:
+                name, _, labelpart = body.partition("{")
+                assert labelpart.endswith("}"), line
+                labels = _parse_labels(labelpart[:-1])
+            else:
+                name, labels = body, {}
+            samples_seen.add(name)
+            value = float(value_s)
+            self.samples.append((name, labels, value))
+
+    def series(self, name: str) -> list[tuple[dict[str, str], float]]:
+        return [(lbl, v) for n, lbl, v in self.samples if n == name]
+
+    def family_of(self, sample_name: str) -> str:
+        """The metric family a sample belongs to: histogram children
+        (`_bucket`/`_sum`/`_count`) roll up to the base name."""
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name.removesuffix(suffix)
+            if base != sample_name \
+                    and self.meta.get(base, {}).get("type") == "histogram":
+                return base
+        return sample_name
+
+
+# ---------------------------------------------------------------------------
+# exposition validity
+# ---------------------------------------------------------------------------
+
+class TestExpositionValidity:
+    def _registry(self) -> Registry:
+        reg = Registry()
+        reg.describe("nos_t_total", "a counter")
+        reg.describe("nos_t_gauge", "a gauge")
+        reg.describe("nos_t_seconds", "a histogram")
+        reg.inc("nos_t_total", labels={"kind": "slice"})
+        reg.set("nos_t_gauge", 7.5)
+        for v in (0.003, 0.02, 0.02, 0.7, 3.0, 100.0):
+            reg.observe("nos_t_seconds", v, labels={"class": "a"})
+        reg.observe("nos_t_seconds", 0.04, labels={"class": "b"})
+        return reg
+
+    def test_every_sample_has_type_placed_before_it(self):
+        exp = Exposition(self._registry().render())
+        for name, _, _ in exp.samples:
+            family = exp.family_of(name)
+            assert "type" in exp.meta.get(family, {}), \
+                f"sample {name} has no TYPE for family {family}"
+
+    def test_help_precedes_type_for_described_metrics(self):
+        text = self._registry().render()
+        for base in ("nos_t_total", "nos_t_seconds"):
+            help_i = text.index(f"# HELP {base} ")
+            type_i = text.index(f"# TYPE {base} ")
+            assert help_i < type_i
+
+    def test_histogram_type_and_children(self):
+        exp = Exposition(self._registry().render())
+        assert exp.meta["nos_t_seconds"]["type"] == "histogram"
+        assert exp.meta["nos_t_seconds_max"]["type"] == "gauge"
+        for child in ("nos_t_seconds_bucket", "nos_t_seconds_sum",
+                      "nos_t_seconds_count", "nos_t_seconds_max"):
+            assert exp.series(child), f"missing {child}"
+
+    def test_le_ordering_and_bucket_monotonicity(self):
+        exp = Exposition(self._registry().render())
+        for cls in ("a", "b"):
+            buckets = [(lbl["le"], v) for lbl, v
+                       in exp.series("nos_t_seconds_bucket")
+                       if lbl["class"] == cls]
+            les = [le for le, _ in buckets]
+            assert les[-1] == "+Inf"
+            finite = [float(le) for le in les[:-1]]
+            assert finite == sorted(finite), "le not ascending"
+            assert len(set(finite)) == len(finite), "duplicate le"
+            counts = [v for _, v in buckets]
+            assert counts == sorted(counts), "bucket counts not cumulative"
+
+    def test_inf_bucket_equals_count(self):
+        exp = Exposition(self._registry().render())
+        for cls, expected in (("a", 6), ("b", 1)):
+            inf = [v for lbl, v in exp.series("nos_t_seconds_bucket")
+                   if lbl["class"] == cls and lbl["le"] == "+Inf"]
+            cnt = [v for lbl, v in exp.series("nos_t_seconds_count")
+                   if lbl["class"] == cls]
+            assert inf == [expected] and cnt == [expected]
+
+    def test_sum_present_and_plausible(self):
+        exp = Exposition(self._registry().render())
+        total = [v for lbl, v in exp.series("nos_t_seconds_sum")
+                 if lbl["class"] == "a"]
+        assert total == [pytest.approx(0.003 + 0.02 + 0.02 + 0.7
+                                       + 3.0 + 100.0)]
+
+    def test_label_escaping_round_trips(self):
+        reg = Registry()
+        nasty = 'a"b\\c\nd'
+        reg.inc("nos_esc_total", labels={"v": nasty})
+        exp = Exposition(reg.render())
+        [(labels, value)] = exp.series("nos_esc_total")
+        assert labels["v"] == nasty
+        assert value == 1.0
+
+    def test_observation_beyond_last_bound_lands_only_in_inf(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 999.0)
+        exp = Exposition(reg.render())
+        buckets = exp.series("nos_t_seconds_bucket")
+        for lbl, v in buckets:
+            assert v == (1 if lbl["le"] == "+Inf" else 0)
+
+
+# ---------------------------------------------------------------------------
+# derived-series namespace (satellite: suffix collisions)
+# ---------------------------------------------------------------------------
+
+class TestDerivedSeriesNamespace:
+    def test_scalar_colliding_with_histogram_derived_name_raises(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 0.1)
+        for suffix in ("_count", "_sum", "_max", "_bucket"):
+            with pytest.raises(ValueError, match="collides"):
+                reg.inc(f"nos_t_seconds{suffix}")
+            with pytest.raises(ValueError, match="collides"):
+                reg.set(f"nos_t_seconds{suffix}", 1.0)
+
+    def test_histogram_colliding_with_existing_scalar_raises(self):
+        reg = Registry()
+        reg.inc("nos_t_seconds_count")      # user counter, odd name, legal
+        with pytest.raises(ValueError, match="already a scalar"):
+            reg.observe("nos_t_seconds", 0.1)
+
+    def test_same_name_scalar_and_histogram_raises(self):
+        reg = Registry()
+        reg.observe("nos_x_seconds", 0.1)
+        with pytest.raises(ValueError, match="histogram"):
+            reg.inc("nos_x_seconds")
+        reg2 = Registry()
+        reg2.inc("nos_x_seconds")
+        with pytest.raises(ValueError, match="counter/gauge"):
+            reg2.observe("nos_x_seconds", 0.1)
+
+    def test_scalar_genuinely_ending_in_sum_keeps_its_own_help(self):
+        """Regression: the old render() removesuffix-chained base names,
+        so `nos_t_burn_sum`'s HELP was looked up under `nos_t_burn` —
+        a metric that never existed — and dropped."""
+        reg = Registry()
+        reg.describe("nos_t_burn_sum", "genuinely ends in _sum")
+        reg.inc("nos_t_burn_sum", 2.0)
+        text = reg.render()
+        assert "# HELP nos_t_burn_sum genuinely ends in _sum" in text
+        exp = Exposition(reg.render())
+        assert exp.series("nos_t_burn_sum") == [({}, 2.0)]
+
+
+# ---------------------------------------------------------------------------
+# windowed max (satellite)
+# ---------------------------------------------------------------------------
+
+class TestWindowedMax:
+    def test_max_resets_on_window_roll_counts_do_not(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 5.0)
+        reg.observe("nos_t_seconds", 1.0)
+        snap = reg.snapshot()
+        assert snap["nos_t_seconds_max"][""] == 5.0
+        reg.reset_window()
+        snap = reg.snapshot()
+        assert snap["nos_t_seconds_max"][""] == 0.0
+        assert snap["nos_t_seconds_count"][""] == 2      # cumulative
+        assert snap["nos_t_seconds_sum"][""] == pytest.approx(6.0)
+        reg.observe("nos_t_seconds", 0.5)
+        assert reg.snapshot()["nos_t_seconds_max"][""] == 0.5
+
+    def test_startup_spike_does_not_dominate_after_roll(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 60.0)      # one-off startup spike
+        reg.reset_window()
+        reg.observe("nos_t_seconds", 0.01)
+        assert reg.snapshot()["nos_t_seconds_max"][""] == 0.01
+
+
+# ---------------------------------------------------------------------------
+# buckets + quantiles
+# ---------------------------------------------------------------------------
+
+class TestBucketsAndQuantiles:
+    def test_custom_buckets_render_and_conflicts_raise(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 0.5, buckets=(0.1, 1.0, 10.0))
+        exp = Exposition(reg.render())
+        les = [lbl["le"] for lbl, _ in exp.series("nos_t_seconds_bucket")]
+        assert les == ["0.1", "1", "10", "+Inf"]
+        with pytest.raises(ValueError, match="conflicting"):
+            reg.observe("nos_t_seconds", 0.5, buckets=(0.2, 2.0))
+        # re-registering the SAME layout is idempotent
+        reg.observe("nos_t_seconds", 0.5, buckets=(0.1, 1.0, 10.0))
+
+    def test_describe_pins_buckets(self):
+        reg = Registry()
+        reg.describe("nos_t_seconds", "h", buckets=(1.0, 2.0))
+        reg.observe("nos_t_seconds", 1.5)
+        exp = Exposition(reg.render())
+        les = [lbl["le"] for lbl, _ in exp.series("nos_t_seconds_bucket")]
+        assert les == ["1", "2", "+Inf"]
+
+    def test_invalid_buckets_raise(self):
+        reg = Registry()
+        with pytest.raises(ValueError, match="strictly increasing"):
+            reg.observe("nos_t_seconds", 0.1, buckets=(1.0, 1.0))
+        with pytest.raises(ValueError, match="strictly increasing"):
+            reg.observe("nos_t_seconds", 0.1, buckets=())
+
+    def test_quantile_interpolates_within_bucket(self):
+        reg = Registry()
+        for _ in range(100):
+            reg.observe("nos_t_seconds", 0.3, buckets=(0.1, 0.5, 1.0))
+        # all mass in (0.1, 0.5]: median interpolates to its midpoint
+        assert reg.quantile("nos_t_seconds", 0.5) == pytest.approx(0.3)
+
+    def test_quantile_none_without_samples(self):
+        reg = Registry()
+        assert reg.quantile("nos_t_nothing_seconds", 0.99) is None
+
+    def test_quantile_inf_bucket_reports_observed_max(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 500.0)
+        q = reg.quantile("nos_t_seconds", 0.99)
+        assert q == 500.0
+
+    def test_quantile_tracks_distribution_tail(self):
+        reg = Registry()
+        for i in range(99):
+            reg.observe("nos_t_seconds", 0.002)
+        reg.observe("nos_t_seconds", 20.0)
+        p50 = reg.quantile("nos_t_seconds", 0.50)
+        p995 = reg.quantile("nos_t_seconds", 0.995)
+        assert p50 < 0.01
+        assert p995 > 10.0
+
+    def test_histogram_quantile_helper_edge_cases(self):
+        assert histogram_quantile((1.0,), [0], 0, 0.5) is None
+        # rank exactly on a bucket boundary
+        assert histogram_quantile((1.0, 2.0), [1, 1], 2, 0.5) \
+            == pytest.approx(1.0)
+        assert not math.isnan(
+            histogram_quantile(DEFAULT_BUCKETS,
+                               [0] * len(DEFAULT_BUCKETS), 3, 0.9))
+
+
+# ---------------------------------------------------------------------------
+# snapshot payload (metricsexporter contract)
+# ---------------------------------------------------------------------------
+
+class TestSnapshotPayload:
+    def test_snapshot_carries_bucket_series_with_le(self):
+        reg = Registry()
+        reg.observe("nos_t_seconds", 0.003, labels={"class": "a"})
+        snap = reg.snapshot()
+        buckets = snap["nos_t_seconds_bucket"]
+        assert "class=a,le=0.005" in buckets
+        assert buckets["class=a,le=+Inf"] == 1
+        assert snap["nos_t_seconds_count"]["class=a"] == 1
+        assert snap["nos_t_seconds_max"]["class=a"] == 0.003
+
+    def test_snapshot_counters_and_gauges_unchanged(self):
+        reg = Registry()
+        reg.inc("nos_t_total", 3.0, labels={"kind": "slice"})
+        reg.set("nos_t_gauge", 7.0)
+        snap = reg.snapshot()
+        assert snap["nos_t_total"]["kind=slice"] == 3.0
+        assert snap["nos_t_gauge"][""] == 7.0

@@ -1,0 +1,652 @@
+"""SLO telemetry plane: sampler windows, burn-rate engine, journal
+transitions, surfaces (/debug/slo, obs slo / obs top), and the
+acceptance chain — an injected latency regression flips a journaled
+SLO_BREACH that the CLI links to the breaching class and its rejecting
+plugin.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nos_tpu import obs
+from nos_tpu.exporter.metrics import Registry
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.__main__ import main as obs_main
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.slo import (
+    GAUGE_FLOOR, LATENCY, RATE_CEILING, SLOEngine, SLOObjective,
+)
+from nos_tpu.obs.timeseries import TimeSeriesSampler
+from nos_tpu.obs.trace import RingExporter, Tracer
+
+
+class Clock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_engine(reg: Registry, clock: Clock,
+                objectives: list[SLOObjective],
+                fast: float = 10.0, slow: float = 30.0,
+                threshold: float = 2.0) -> SLOEngine:
+    return SLOEngine(TimeSeriesSampler(registry=reg, clock=clock),
+                     objectives, fast_window_s=fast, slow_window_s=slow,
+                     burn_threshold=threshold, clock=clock)
+
+
+LAT = "nos_tpu_schedule_latency_seconds"
+
+
+def latency_objective(**kw) -> SLOObjective:
+    defaults = dict(name="lat", kind=LATENCY, metric=LAT, target=0.1,
+                    each_label="class", compliance=0.9)
+    defaults.update(kw)
+    return SLOObjective(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+class TestSampler:
+    def test_bounded_with_eviction_counter(self):
+        clock = Clock()
+        reg = Registry()
+        sampler = TimeSeriesSampler(registry=reg, maxlen=3, clock=clock)
+        for i in range(7):
+            clock.t += 1.0
+            sampler.tick()
+        assert len(sampler) == 3
+        pts = sampler.points()
+        assert [p.ts for p in pts] == [5.0, 6.0, 7.0]
+        snap = reg.snapshot()
+        assert snap["nos_tpu_timeseries_points_dropped_total"][""] == 4
+
+    def test_tick_rolls_the_max_window(self):
+        clock = Clock()
+        reg = Registry()
+        sampler = TimeSeriesSampler(registry=reg, clock=clock)
+        reg.observe("nos_t_seconds", 9.0)
+        clock.t = 1.0
+        first = sampler.tick()
+        assert first.get("nos_t_seconds_max") == 9.0
+        clock.t = 2.0
+        second = sampler.tick()
+        assert second.get("nos_t_seconds_max") == 0.0
+
+    def test_bracket_requires_full_window_coverage(self):
+        """Cold-start rule: a half-filled window is 'not yet
+        observable', never a verdict."""
+        clock = Clock()
+        sampler = TimeSeriesSampler(registry=Registry(), clock=clock)
+        assert sampler.bracket(5.0) is None
+        for t in (1.0, 2.0, 3.0):
+            clock.t = t
+            sampler.tick()
+        assert sampler.bracket(5.0) is None     # only 2 s covered
+        clock.t = 7.0
+        sampler.tick()
+        start, end = sampler.bracket(5.0)
+        assert (start.ts, end.ts) == (2.0, 7.0)
+
+    def test_bracket_picks_newest_point_at_or_before_cutoff(self):
+        clock = Clock()
+        sampler = TimeSeriesSampler(registry=Registry(), clock=clock)
+        for t in (1.0, 2.0, 3.0, 10.0):
+            clock.t = t
+            sampler.tick()
+        start, end = sampler.bracket(8.0)
+        assert (start.ts, end.ts) == (2.0, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# engine verdicts
+# ---------------------------------------------------------------------------
+
+class TestEngineLatency:
+    def _drive(self, engine: SLOEngine, reg: Registry, clock: Clock,
+               ticks: int, latency: float, cls: str = "serve") -> list:
+        verdicts = []
+        for _ in range(ticks):
+            clock.t += 1.0
+            reg.observe(LAT, latency, labels={"class": cls})
+            verdicts = engine.tick()
+        return verdicts
+
+    def test_breach_and_recovery_journal_with_class_and_trace(self):
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        journal = DecisionJournal(maxlen=64, clock=clock)
+        tracer = Tracer(clock=clock, ring=RingExporter(maxlen=64))
+        with obs.scoped(tracer, journal):
+            self._drive(engine, reg, clock, 40, 0.01)
+            assert not [r for r in journal.events()
+                        if r.category == J.SLO_BREACH]
+            v = self._drive(engine, reg, clock, 40, 5.0)
+            assert [x["breached"] for x in v] == [True]
+            v = self._drive(engine, reg, clock, 80, 0.01)
+            assert [x["breached"] for x in v] == [False]
+        transitions = [r for r in journal.events()
+                       if r.category in (J.SLO_BREACH, J.SLO_RECOVERED)]
+        assert [r.category for r in transitions] == \
+            [J.SLO_BREACH, J.SLO_RECOVERED]
+        breach = transitions[0]
+        assert breach.subject == "lat/serve"
+        assert breach.attrs["slo_class"] == "serve"
+        assert breach.attrs["burn_slow"] >= 2.0
+        assert breach.attrs["budget_remaining"] < 0
+        # the ambient slo.evaluate span linked the record into a trace
+        assert breach.trace_id
+        spans = {s["name"] for s in tracer.ring.dump()}
+        assert "slo.evaluate" in spans
+
+    def test_fast_burst_alone_does_not_breach(self):
+        """Multi-window rule: a burst that burns the fast window but is
+        invisible at the slow window's scale is not a breach."""
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()],
+                             fast=5.0, slow=200.0)
+        self._drive(engine, reg, clock, 210, 0.01)
+        v = self._drive(engine, reg, clock, 5, 5.0)
+        [verdict] = v
+        assert verdict["burn_fast"] >= 2.0
+        assert verdict["burn_slow"] < 2.0
+        assert not verdict["breached"]
+
+    def test_min_events_guards_low_traffic_classes(self):
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(
+            reg, clock, [latency_objective(min_events=10)])
+        # 2 slow events in the whole window: 100% bad, but unjudgeable
+        verdicts = []
+        for i in range(40):
+            clock.t += 1.0
+            if i in (20, 21):
+                reg.observe(LAT, 9.0, labels={"class": "rare"})
+            verdicts = engine.tick()
+        [v] = verdicts
+        assert v["burn_slow"] is None
+        assert not v["breached"]
+
+    def test_each_label_fans_out_per_class(self):
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        for _ in range(40):
+            clock.t += 1.0
+            reg.observe(LAT, 0.01, labels={"class": "serve"})
+            reg.observe(LAT, 5.0, labels={"class": "batch"})
+            verdicts = engine.tick()
+        by_class = {v["class"]: v for v in verdicts}
+        assert set(by_class) == {"serve", "batch"}
+        assert not by_class["serve"]["breached"]
+        assert by_class["batch"]["breached"]
+        assert by_class["batch"]["value"] > 1.0
+        assert by_class["serve"]["value"] < 0.1
+
+    def test_quantile_and_budget_fields_populated(self):
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        v = self._drive(engine, reg, clock, 40, 0.01)
+        [verdict] = v
+        assert verdict["value"] == pytest.approx(0.01, abs=0.02)
+        assert verdict["budget_remaining"] == pytest.approx(1.0)
+        assert verdict["burn_fast"] == 0.0
+
+
+class TestEngineGaugeAndRate:
+    def test_gauge_floor_breach(self):
+        clock = Clock()
+        reg = Registry()
+        obj = SLOObjective(name="util", kind=GAUGE_FLOOR,
+                           metric="nos_tpu_cluster_utilization",
+                           target=0.9, compliance=0.9)
+        engine = make_engine(reg, clock, [obj])
+        for _ in range(40):
+            clock.t += 1.0
+            reg.set("nos_tpu_cluster_utilization", 0.97)
+            verdicts = engine.tick()
+        [v] = verdicts
+        assert not v["breached"]
+        for _ in range(40):
+            clock.t += 1.0
+            reg.set("nos_tpu_cluster_utilization", 0.5)
+            verdicts = engine.tick()
+        [v] = verdicts
+        assert v["breached"]
+        assert v["value"] == 0.5
+
+    def test_rate_ceiling_breach(self):
+        clock = Clock()
+        reg = Registry()
+        obj = SLOObjective(name="rebind", kind=RATE_CEILING,
+                           metric="nos_tpu_drain_preemptions_total",
+                           target=0.5)
+        engine = make_engine(reg, clock, [obj])
+        for _ in range(40):
+            clock.t += 1.0
+            verdicts = engine.tick()
+        [v] = verdicts
+        assert not v["breached"] and v["value"] == 0.0
+        for _ in range(40):
+            clock.t += 1.0
+            reg.inc("nos_tpu_drain_preemptions_total", 2.0,
+                    labels={"gang": "ns/g"})
+            verdicts = engine.tick()
+        [v] = verdicts
+        assert v["breached"]
+        assert v["value"] == pytest.approx(2.0, rel=0.2)
+
+    def test_zero_target_rejected_no_infinity_in_json(self):
+        """A zero ceiling would make burn = inf, which json.dumps
+        renders as the non-JSON token Infinity — rejected up front."""
+        with pytest.raises(ValueError, match="target must be > 0"):
+            SLOObjective(name="evict", kind=RATE_CEILING,
+                         metric="nos_tpu_drain_preemptions_total",
+                         target=0.0)
+        # every verdict a real engine produces stays JSON-strict
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [SLOObjective(
+            name="evict", kind=RATE_CEILING,
+            metric="nos_tpu_drain_preemptions_total", target=0.001)])
+        for _ in range(40):
+            clock.t += 1.0
+            reg.inc("nos_tpu_drain_preemptions_total",
+                    labels={"gang": "g"})
+            engine.tick()
+        text = json.dumps(engine.report())
+        assert "Infinity" not in text and "NaN" not in text
+
+    def test_vanished_breached_class_recovers_instead_of_latching(self):
+        """A fanned-out class that breaches and then disappears from
+        the sampled series (registry reset) must close its episode:
+        SLO_RECOVERED journaled, latch cleared — not a breach that
+        silently drops out of report() forever."""
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        journal = DecisionJournal(maxlen=64, clock=clock)
+        with obs.scoped(journal=journal):
+            for _ in range(40):
+                clock.t += 1.0
+                reg.observe(LAT, 9.0, labels={"class": "doomed"})
+                engine.tick()
+            assert [r.category for r in journal.events()
+                    if r.category in (J.SLO_BREACH, J.SLO_RECOVERED)] \
+                == [J.SLO_BREACH]
+            reg.reset()     # the class's series vanish entirely
+            for _ in range(5):
+                clock.t += 1.0
+                verdicts = engine.tick()
+        cats = [r.category for r in journal.events()
+                if r.category in (J.SLO_BREACH, J.SLO_RECOVERED)]
+        assert cats == [J.SLO_BREACH, J.SLO_RECOVERED]
+        # ...and the closing verdict was visible in the report
+        assert not any(v["breached"] for v in verdicts)
+
+    def test_counter_reset_resyncs_instead_of_negative_delta(self):
+        clock = Clock()
+        reg = Registry()
+        obj = SLOObjective(name="rebind", kind=RATE_CEILING,
+                           metric="nos_tpu_drain_preemptions_total",
+                           target=1000.0)
+        engine = make_engine(reg, clock, [obj])
+        for _ in range(35):
+            clock.t += 1.0
+            reg.inc("nos_tpu_drain_preemptions_total", labels={"gang": "g"})
+            engine.tick()
+        reg.reset()     # process restart analog
+        reg.inc("nos_tpu_drain_preemptions_total", labels={"gang": "g"})
+        clock.t += 1.0
+        [v] = engine.tick()
+        assert v["value"] is not None and v["value"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# surfaces
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def _fed_engine(self, clock: Clock) -> SLOEngine:
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        for _ in range(40):
+            clock.t += 1.0
+            reg.observe(LAT, 0.01, labels={"class": "serve"})
+            engine.tick()
+        return engine
+
+    def test_flight_snapshot_includes_slo_block(self):
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        with obs.scoped(Tracer(clock=clock), DecisionJournal(clock=clock),
+                        engine=engine):
+            snap = obs.flight_snapshot()
+        assert snap["slo"]["verdicts"]
+        assert snap["slo"]["verdicts"][0]["class"] == "serve"
+
+    def test_debug_slo_endpoint_serves_report(self):
+        import urllib.request
+
+        from nos_tpu.cmd._runtime import Main
+
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        prev = obs.set_engine(engine)
+        main = Main("slo-test", health_addr="127.0.0.1:0")
+        main.start()
+        try:
+            url = f"http://{main.health_address}/debug/slo"
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                payload = json.load(resp)
+        finally:
+            main.shutdown()
+            obs.set_engine(prev)
+        assert payload["verdicts"][0]["objective"] == "lat"
+        assert payload["burn_threshold"] == 2.0
+
+    def test_debug_slo_404_without_engine(self):
+        import urllib.error
+        import urllib.request
+
+        from nos_tpu.cmd._runtime import Main
+
+        prev = obs.set_engine(None)
+        main = Main("slo-test", health_addr="127.0.0.1:0")
+        main.start()
+        try:
+            url = f"http://{main.health_address}/debug/slo"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(url, timeout=5.0)
+            assert exc.value.code == 404
+        finally:
+            main.shutdown()
+            obs.set_engine(prev)
+
+    def test_snapshot_endpoint_carries_slo_and_buckets(self):
+        import urllib.request
+
+        from nos_tpu.cmd._runtime import Main
+        from nos_tpu.kube.client import APIServer, KIND_NODE
+        from nos_tpu.testing.factory import make_tpu_node
+
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node("host-0", pod_id="pod-0"))
+        prev = obs.set_engine(engine)
+        main = Main("slo-test", health_addr="127.0.0.1:0", api=api)
+        main.start()
+        try:
+            url = f"http://{main.health_address}/snapshot"
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                payload = json.load(resp)
+        finally:
+            main.shutdown()
+            obs.set_engine(prev)
+        assert payload["slo"]["verdicts"]
+        # histogram buckets ride in the metrics series (metricsexporter
+        # payload contract): some _bucket series with an le= label
+        assert any(name.endswith("_bucket") and
+                   any("le=" in s for s in series)
+                   for name, series in payload["metrics"].items())
+
+    def test_metrics_endpoint_serves_per_class_bucket_series(self):
+        """Acceptance: /metrics serves
+        nos_tpu_schedule_latency_seconds_bucket{class=...,le=...}."""
+        import urllib.request
+
+        import nos_tpu.scheduler.scheduler  # noqa: F401 — the owning
+        # module's describe() pins the metric's bucket layout first
+        from nos_tpu.cmd._runtime import Main
+        from nos_tpu.exporter.metrics import REGISTRY as GLOBAL
+
+        GLOBAL.observe("nos_tpu_schedule_latency_seconds", 0.02,
+                       labels={"class": "slice-1x1"})
+        main = Main("slo-test", health_addr="127.0.0.1:0")
+        main.start()
+        try:
+            url = f"http://{main.health_address}/metrics"
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                text = resp.read().decode()
+        finally:
+            main.shutdown()
+        assert "# TYPE nos_tpu_schedule_latency_seconds histogram" in text
+        assert 'nos_tpu_schedule_latency_seconds_bucket{class="slice-1x1"' \
+            in text
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("nos_tpu_schedule_latency_seconds_"
+                                     "bucket")
+                    and 'class="slice-1x1"' in ln)
+        assert 'le="' in line
+
+    def test_attach_slo_ticks_the_engine(self):
+        import time as _time
+
+        from nos_tpu.cmd._runtime import Main
+
+        main = Main("slo-test")
+        main.attach_slo(interval_s=0.01)
+        engine = obs.get_engine()
+        assert engine is not None
+        main.start()
+        try:
+            deadline = _time.time() + 5.0
+            while _time.time() < deadline and len(engine.sampler) < 3:
+                _time.sleep(0.01)
+        finally:
+            main.shutdown()
+            obs.set_engine(None)
+        assert len(engine.sampler) >= 3
+
+    def test_sampler_eviction_counts_in_its_own_registry(self):
+        """A sampler over a private registry surfaces its truncation in
+        THAT registry's exposition, not the process-global one."""
+        clock = Clock()
+        reg = Registry()
+        sampler = TimeSeriesSampler(registry=reg, maxlen=2, clock=clock)
+        for _ in range(5):
+            clock.t += 1.0
+            sampler.tick()
+        snap = reg.snapshot()
+        assert snap["nos_tpu_timeseries_points_dropped_total"][""] == 3
+
+    def test_obs_slo_url_path_joins_journal_to_plugin(self, capsys):
+        """Live-URL acceptance: `obs slo --url` must print the
+        rejecting-plugin join, which requires fetching the flight
+        snapshot (report + journal), not the bare /debug/slo body."""
+        import urllib.request  # noqa: F401 — exercised via obs_main
+
+        from nos_tpu.cmd._runtime import Main
+
+        clock = Clock()
+        reg = Registry()
+        engine = make_engine(reg, clock, [latency_objective()])
+        journal = DecisionJournal(maxlen=64, clock=clock)
+        for _ in range(40):
+            clock.t += 1.0
+            reg.observe(LAT, 9.0, labels={"class": "slice-2x2"})
+            engine.tick()
+        journal.record(
+            J.POD_REJECTED, "default/stuck", reason="", message="no fit",
+            **{"class": "slice-2x2"},
+            reason_counts={"NodeResourcesFit: insufficient": 3})
+        prev_e = obs.set_engine(engine)
+        prev_j = obs.set_journal(journal)
+        main = Main("slo-test", health_addr="127.0.0.1:0")
+        main.start()
+        try:
+            rc = obs_main(["slo", "--url",
+                           f"http://{main.health_address}"])
+        finally:
+            main.shutdown()
+            obs.set_engine(prev_e)
+            obs.set_journal(prev_j)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "BREACH" in out
+        assert "rejecting plugin for class slice-2x2: NodeResourcesFit" \
+            in out
+
+    def test_obs_top_scoreboard(self, tmp_path, capsys):
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.kube.serialize import dump_state
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        api = APIServer()
+        for i in range(4):
+            api.create(KIND_NODE, make_tpu_node(
+                f"host-{i}", pod_id="pod-0", host_index=i))
+        bound = make_slice_pod("2x2", 1, name="bound")
+        bound.spec.node_name = "host-0"
+        api.create(KIND_POD, bound)
+        api.create(KIND_POD, make_slice_pod("2x4", 1, name="waiting"))
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        payload = {"state": dump_state(api), "metrics": {},
+                   "slo": engine.report()}
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(payload))
+        rc = obs_main(["top", "--snapshot", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pod-0" in out
+        assert "slice-2x4" in out               # pending by class
+        assert "utilization" in out
+        assert "budget remaining" in out.lower()
+
+    def test_obs_top_rejects_flightrecorder_payload(self, tmp_path,
+                                                    capsys):
+        path = tmp_path / "flight.json"
+        path.write_text(json.dumps({"spans": [], "journal": []}))
+        rc = obs_main(["top", "--snapshot", str(path)])
+        assert rc == 1
+        assert "/snapshot" in capsys.readouterr().err
+
+    def test_obs_slo_reports_from_bench_shaped_payload(self, tmp_path,
+                                                       capsys):
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        bench = {"utilization_pct": 0.97, "slo": engine.report()}
+        path = tmp_path / "bench.json"
+        path.write_text(json.dumps(bench))
+        rc = obs_main(["slo", "--snapshot", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "class=serve" in out
+        assert "budget remaining=1.00" in out
+        assert "0 breached / 1 verdict(s)" in out
+
+    def test_obs_slo_errors_without_slo_block(self, tmp_path, capsys):
+        path = tmp_path / "empty.json"
+        path.write_text(json.dumps({"spans": []}))
+        rc = obs_main(["slo", "--snapshot", str(path)])
+        assert rc == 1
+        assert "no SLO report" in capsys.readouterr().err
+
+    def test_metricsexporter_passes_slo_through(self, tmp_path, capsys):
+        from nos_tpu.cmd.metricsexporter import main as exporter_main
+        from nos_tpu.kube.client import APIServer
+        from nos_tpu.kube.serialize import dump_state
+
+        clock = Clock()
+        engine = self._fed_engine(clock)
+        src = tmp_path / "snap.json"
+        src.write_text(json.dumps({"state": dump_state(APIServer()),
+                                   "metrics": {"nos_tpu_x_total": {"": 1}},
+                                   "slo": engine.report()}))
+        out = tmp_path / "payload.json"
+        rc = exporter_main(["--source", str(src), "--out", str(out)])
+        assert rc == 0
+        payload = json.loads(out.read_text())
+        assert payload["metrics"] == {"nos_tpu_x_total": {"": 1}}
+        assert payload["slo"]["verdicts"][0]["class"] == "serve"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: injected latency regression → journaled breach → CLI names
+# the class and the rejecting plugin
+# ---------------------------------------------------------------------------
+
+class TestRegressionToExplainChain:
+    def test_injected_latency_regression_flips_breach_cli_names_plugin(
+            self, tmp_path, capsys):
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.scheduler.scheduler import Scheduler
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        clock = Clock(100.0)
+        reg = Registry()
+        # the scheduler emits into the process registry; sample THAT one
+        from nos_tpu.exporter.metrics import REGISTRY as GLOBAL
+
+        engine = SLOEngine(
+            TimeSeriesSampler(registry=GLOBAL, clock=clock),
+            [latency_objective(target=0.1, min_events=3)],
+            fast_window_s=5.0, slow_window_s=20.0, clock=clock)
+        journal = DecisionJournal(maxlen=256, clock=clock)
+        tracer = Tracer(clock=clock, ring=RingExporter(maxlen=256))
+        del reg
+
+        with obs.scoped(tracer, journal, engine=engine):
+            api = APIServer()
+            api.create(KIND_NODE, make_tpu_node(
+                "host-0", status_geometry={"free": {"2x2": 1}}))
+            sched = Scheduler(api, Framework(), clock=clock)
+            # one permanently-stuck pod of the SAME class: its per-cycle
+            # rejection is the journal's plugin provenance
+            api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name="stuck", creation_timestamp=1.0))
+
+            def drive(ticks: int, queue_wait: float) -> None:
+                # priority above the stuck pod: the driver pod takes the
+                # one free slice each cycle (observing its injected
+                # queue wait), the stuck pod re-rejects behind it
+                for i in range(ticks):
+                    clock.t += 1.0
+                    name = f"p-{clock.t:.0f}"
+                    api.create(KIND_POD, make_slice_pod(
+                        "2x2", 1, name=name, priority=10,
+                        creation_timestamp=clock.t - queue_wait))
+                    sched.run_cycle()
+                    engine.tick()
+                    api.delete(KIND_POD, name, "default")
+
+            drive(30, queue_wait=0.01)      # healthy: binds in ~10 ms
+            assert not [r for r in journal.events()
+                        if r.category == J.SLO_BREACH]
+            drive(30, queue_wait=30.0)      # regression: 30 s queue waits
+
+            breaches = [r for r in journal.events()
+                        if r.category == J.SLO_BREACH]
+            assert breaches, "latency regression did not flip SLO_BREACH"
+            assert breaches[0].attrs["slo_class"] == "slice-2x2"
+            assert breaches[0].trace_id       # linked into the trace tree
+            snap = obs.flight_snapshot()
+
+        # ... and the one-command join: obs slo names class AND plugin
+        path = tmp_path / "flight.json"
+        path.write_text(json.dumps(snap))
+        rc = obs_main(["slo", "--snapshot", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "BREACH" in out
+        assert "slice-2x2" in out
+        assert "NodeResourcesFit" in out
+        # the rejection chain itself is one more command away
+        rc = obs_main(["explain", "pod", "default/stuck",
+                       "--snapshot", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "NodeResourcesFit" in out
