@@ -31,6 +31,11 @@ logger = logging.getLogger("nos_tpu.cmd.train")
 
 REGISTRY.describe("nos_tpu_train_loss", "Last training step loss")
 REGISTRY.describe("nos_tpu_train_step", "Last completed training step")
+REGISTRY.describe("nos_tpu_train_tokens_per_s",
+                  "Training throughput over the last log interval")
+REGISTRY.describe("nos_tpu_train_mfu",
+                  "Model FLOPs utilization over the last log interval "
+                  "(analytic fwd+bwd FLOPs vs the device bf16 peak)")
 
 
 @dataclasses.dataclass
@@ -39,8 +44,10 @@ class TrainConfig(ManagerConfig):
     ManagerConfig embed, like every other main."""
 
     model: str = "bench350m"      # tiny | bench350m | llama3-8b
+    # defaults mirror models/llama.py BENCH_350M_TRAIN (the measured
+    # best: see docs/performance.md "Compute roofline")
     attn_impl: str = "flash"
-    remat_policy: str = "mats"
+    remat_policy: str = "rots"
     scan_layers: bool = True
     batch_size: int = 8
     seq_len: int = 2048
@@ -243,6 +250,19 @@ def train(cfg: TrainConfig, progress_cb=None) -> float | None:
             checkpointer.close()
         return None
     step_fn = trainer.train_step()
+    # MFU denominator, once: analytic step FLOPs over ALL participating
+    # chips' peak (an under-utilized big mesh must read low, not hide
+    # behind a single-chip peak).  The SLO plane can then hold a
+    # gauge_floor objective on nos_tpu_train_mfu
+    # (docs/observability.md, "SLO cookbook").
+    import jax
+
+    from nos_tpu.ops.roofline import model_flops_per_step, peak_for
+
+    step_flops = model_flops_per_step(trainer.cfg, cfg.batch_size,
+                                      cfg.seq_len)
+    fleet_peak = (peak_for(jax.devices()[0].device_kind)
+                  * trainer.mesh.size)
     loss = float("nan")
     t0 = time.perf_counter()
     logged_at = start_step
@@ -257,10 +277,13 @@ def train(cfg: TrainConfig, progress_cb=None) -> float | None:
             interval = step - logged_at
             tokens_s = (interval * cfg.batch_size * cfg.seq_len
                         / max(dt, 1e-9))
-            logger.info("step %d/%d loss %.4f (%.0f tokens/s)",
-                        step, cfg.steps, loss, tokens_s)
+            mfu = step_flops * interval / max(dt, 1e-9) / fleet_peak
+            logger.info("step %d/%d loss %.4f (%.0f tokens/s, mfu %.3f)",
+                        step, cfg.steps, loss, tokens_s, mfu)
             REGISTRY.set("nos_tpu_train_loss", loss)
             REGISTRY.set("nos_tpu_train_step", float(step))
+            REGISTRY.set("nos_tpu_train_tokens_per_s", tokens_s)
+            REGISTRY.set("nos_tpu_train_mfu", mfu)
             logged_at = step
             t0 = time.perf_counter()
         if checkpointer is not None and step % cfg.checkpoint_every == 0:
@@ -296,6 +319,12 @@ def main(argv=None) -> int:
     )
 
     apply_workload_env()
+    # ... and the collective-compute overlap flags BEFORE the first
+    # backend touch (XLA_FLAGS is read at backend creation; make_mesh
+    # inside build() would be too late — jax.devices() runs first)
+    from nos_tpu.parallel.mesh import enable_collective_overlap
+
+    enable_collective_overlap()
     maybe_init_distributed()
     # ... and after the backend is up, PROVE the confinement took: the
     # chip-numbering convention is asserted, not assumed

@@ -92,6 +92,22 @@ BENCH_350M = LlamaConfig(
     max_seq_len=2048,
 )
 
+# The measured-best BENCH_350M *training* configuration — the single
+# source of truth consumed by bench_compute.py, cmd/train.py's defaults
+# and docs/performance.md, so the flagship bench and the production
+# entrypoint cannot drift apart.  flash kernels (autotuned blocks),
+# "rots" selective remat (post-rope q/k + v + attention/MLP matmul
+# outputs saved: the backward recomputes neither the qkv projections nor
+# rope, the two dominant recompute costs the step breakdown attributed
+# to "mats"), scanned layers (one compiled block; rope rides through the
+# scan as an nn.broadcast input, see Llama.__call__).
+BENCH_350M_TRAIN = LlamaConfig(
+    vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+    num_layers=24, num_heads=8, num_kv_heads=4, head_dim=128,
+    max_seq_len=2048,
+    attn_impl="flash", remat_policy="rots", scan_layers=True,
+)
+
 
 # Lazy thunks: checkpoint_policies lookups stay cheap at import time and
 # save_only_these_names constructs a fresh policy per model build.
@@ -121,6 +137,20 @@ _REMAT_POLICIES = {
         "attn_q_rot", "attn_k_rot", "attn_v", "attn_qkv", "attn_out",
         "mlp_gate", "mlp_up", "mlp_gate_up"),
 }
+
+
+def stack_layer_params(params: dict, num_layers: int,
+                       prefix: str = "layer_") -> dict:
+    """Restack an UNROLLED model's per-layer param subtrees
+    (``layer_0`` ... ``layer_{n-1}``) into the scanned layout (one
+    ``layers`` subtree with a leading layer axis), so scan-vs-unrolled
+    equivalence can be checked at IDENTICAL parameters (bench_compute
+    --smoke and tests/test_compute.py).  Expects unboxed params."""
+    layers = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    out = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    out["layers"] = stacked
+    return out
 
 
 def rope_tables(positions: jax.Array, dim: int, theta: float):
@@ -334,13 +364,22 @@ class Llama(nn.Module):
                 Block, prevent_cse=not cfg.scan_layers,
                 policy=_REMAT_POLICIES[cfg.remat_policy]())
         if cfg.scan_layers:
+            # rope rides through the scan as an nn.broadcast input, NOT
+            # a closure capture: a captured traced array is lifted into
+            # the scan body as a per-iteration constant, which (with
+            # remat inside the scan) re-staged the cos/sin tables into
+            # every layer's forward AND its backward recompute and broke
+            # the carry's layout against the stacked params — the
+            # interaction that made the bench opt out of scan_layers.
+            # As a broadcast input XLA hoists one copy for all layers.
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, rope), None),
+                lambda mdl, carry, rope_b: (mdl(carry, rope_b), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
+                in_axes=nn.broadcast,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block(cfg, self.mesh, name="layers"), x, None)
+            )(block(cfg, self.mesh, name="layers"), x, rope)
         else:
             for i in range(cfg.num_layers):
                 x = block(cfg, self.mesh, name=f"layer_{i}")(x, rope)

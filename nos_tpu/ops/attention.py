@@ -57,12 +57,16 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from nos_tpu.ops import autotune as _autotune
 from nos_tpu.parallel.ring import dense_attention
 
 _NEG_INF = -1e30
 _LANES = 128
 
-# Hardware-tuned defaults (v5e sweep at S=2048; see module docstring).
+# Hardware-tuned defaults (v5e sweep at S=2048; see module docstring) —
+# the LAST fallback: block choice is normally a per-device autotune
+# lookup (nos_tpu/ops/autotune.py), consulted by the _plan call sites
+# when the caller passes no explicit blocks.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 # The backward prefers larger blocks than the forward (fewer grid steps
@@ -149,10 +153,16 @@ def _replicate_rows(x):
     return jnp.broadcast_to(x, (*x.shape[:2], _LANES))
 
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions
+# this repo runs against (0.4.x has only the old name); same fields.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
 def _grid_params(n):
     # Innermost dim carries scratch state ("arbitrary"); the rest are
     # disjoint-output parallel.
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=("parallel",) * (n - 1) + ("arbitrary",))
 
 
@@ -524,31 +534,54 @@ def _plan(q, k, causal, block_q, block_k) -> tuple[int, int] | None:
     return block_q, block_k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _resolve_plan(q, k, causal, block_q, block_k, which,
+                  default_q, default_k):
+    """Concrete (block_q, block_k) for one pass: explicit blocks win,
+    then the per-device autotune entry (validated — a tuned pick that
+    does not divide THESE shapes falls through rather than disabling
+    the kernel), then the hardcoded defaults."""
+    if block_q is None and block_k is None:
+        tuned = _autotune.lookup_for_arrays(q, k, which, causal)
+        if tuned is not None:
+            plan = _plan(q, k, causal, *tuned)
+            if plan is not None:
+                return plan
+    return _plan(q, k, causal, block_q or default_q, block_k or default_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int | None = None,
                     block_k: int | None = None,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    bwd_block_q: int | None = None,
+                    bwd_block_k: int | None = None):
     """Fused attention, [B, S, H, D], K/V already at full head count
     (repeat grouped KV heads first — see repeat_kv).  Falls back to the
     XLA implementation off-TPU or for unaligned shapes.
 
-    block_q/block_k None = hardware-tuned defaults, which differ between
-    the forward (DEFAULT_BLOCK_*) and backward (DEFAULT_BWD_BLOCK_*)
-    passes; explicit values are honored verbatim in BOTH passes."""
+    block_q/block_k None = the autotuned blocks for this device/shape
+    (nos_tpu/ops/autotune.py) when an entry exists, else the
+    hardware-tuned defaults — which differ between the forward
+    (DEFAULT_BLOCK_*) and backward (DEFAULT_BWD_BLOCK_*) passes.
+    Explicit block_q/block_k are honored verbatim in BOTH passes
+    (sweeps depend on that) unless bwd_block_q/bwd_block_k pin the
+    backward separately — the autotuner times backward candidates with
+    the forward held fixed through exactly that override."""
     on_tpu = jax.default_backend() == "tpu"
-    plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
-                 block_k or DEFAULT_BLOCK_K)
+    plan = _resolve_plan(q, k, causal, block_q, block_k, "fwd",
+                         DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     if (on_tpu or interpret) and plan is not None:
         out, _ = _flash_forward(q, k, v, causal, *plan, interpret)
         return _unfold(out, q.shape[0], q.shape[2])
     return _xla_attention(q, k, v, causal)
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret,
+         bwd_block_q, bwd_block_k):
     on_tpu = jax.default_backend() == "tpu"
-    plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
-                 block_k or DEFAULT_BLOCK_K)
+    plan = _resolve_plan(q, k, causal, block_q, block_k, "fwd",
+                         DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     if (on_tpu or interpret) and plan is not None:
         out, lse = _flash_forward(q, k, v, causal, *plan, interpret)
         out = _unfold(out, q.shape[0], q.shape[2])
@@ -556,16 +589,26 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return _xla_attention(q, k, v, causal), (q, k, v, None, None)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, bwd_block_q, bwd_block_k,
+         res, g):
     q, k, v, o, lse = res
     if lse is not None:
-        # None = the backward's own tuned defaults; explicit blocks are
-        # honored verbatim (sweeps depend on that)
-        plan = _plan(q, k, causal, block_q or DEFAULT_BWD_BLOCK_Q,
-                     block_k or DEFAULT_BWD_BLOCK_K)
+        # backward block precedence: explicit bwd blocks > explicit
+        # shared blocks > autotune "bwd" entry > backward defaults
+        bq = bwd_block_q if bwd_block_q is not None else block_q
+        bk = bwd_block_k if bwd_block_k is not None else block_k
+        plan = _resolve_plan(q, k, causal, bq, bk, "bwd",
+                             DEFAULT_BWD_BLOCK_Q, DEFAULT_BWD_BLOCK_K)
         if plan is None:    # bwd blocks unaligned for these shapes
-            plan = _plan(q, k, causal, block_q or DEFAULT_BLOCK_Q,
-                         block_k or DEFAULT_BLOCK_K)
+            plan = _plan(q, k, causal, bq or DEFAULT_BLOCK_Q,
+                         bk or DEFAULT_BLOCK_K)
+        if plan is None:
+            # the bwd-specific override itself cannot apply to these
+            # shapes: drop it and reuse the forward's blocks, which the
+            # forward pass just validated (lse is not None), so this
+            # plan is guaranteed concrete
+            plan = _resolve_plan(q, k, causal, block_q, block_k, "fwd",
+                                 DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
         batch, seq_q, heads, head_dim = q.shape
         partial_bytes = (batch * heads * (k.shape[1] // plan[1])
                          * seq_q * head_dim * q.dtype.itemsize)

@@ -1,0 +1,433 @@
+"""Flash-attention block autotuner: microbench search + persistent cache.
+
+The pallas kernels in ``nos_tpu/ops/attention.py`` are parameterized by
+``(block_q, block_k)``, and the best blocks are a property of the chip
+generation and the workload shape, not of the kernel: the v5e sweep that
+produced the old hardcoded 512/512 (fwd) and 512/1024 (bwd) defaults
+(scripts/sweep_attention.py / sweep_bwd.py) does not transfer to v5p or
+v6e VMEM sizes, and the forward and backward prefer different blocks on
+the SAME chip.  This module makes block choice a lookup instead of a
+constant:
+
+- **Keying.**  An entry is keyed by
+  ``(device class, pass, seq_len, head_dim, dtype, causal)`` where the
+  device class normalizes jax's ``device_kind`` strings ("TPU v5 lite",
+  "v5litepod-16", ...) into the generation families the blocks actually
+  depend on.  The forward and backward are independent entries.
+- **Sources, in precedence order.**  (1) the measured cache — a JSON
+  file (``NOS_TPU_AUTOTUNE_CACHE`` or
+  ``~/.cache/nos_tpu/flash_autotune.json``) written by ``search()`` runs
+  on real hardware; (2) the shipped ``PRETUNED`` tables for v5e/v5p/v6e
+  at the common training shapes; (3) nothing — the caller
+  (``attention._plan`` call sites) falls back to the hardcoded defaults.
+  Unknown devices (CPU interpret mode, future generations) therefore
+  degrade to exactly the pre-autotuner behavior.
+- **Search.**  ``search()`` microbenches every VMEM-feasible candidate
+  with the same chained-iteration slope method the bench uses (the
+  tunneled TPU platform does not block in ``block_until_ready``; the
+  per-iteration time is the slope between a small and a large chain
+  length, which cancels the tunnel round-trip).  Backward candidates are
+  timed through ``jax.grad`` with the forward pinned to its own best
+  blocks, so the ranking isolates the backward kernels.
+
+Every candidate the search can emit is validated by ``attention._plan``
+before use, and tests pin flash-vs-dense equivalence across the
+candidate space — an autotuner that picks a NEW block can never pick a
+WRONG one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+
+logger = logging.getLogger(__name__)
+
+#: Block sizes the search draws from; every value keeps the TPU lane
+#: width (128) as a divisor so candidates are kernel-legal by
+#: construction.
+BLOCK_CHOICES = (128, 256, 512, 1024)
+
+#: Rough per-grid-step VMEM budget (bytes) a candidate may claim.  v5-
+#: generation chips have ~16 MB/core; Mosaic double-buffers the streamed
+#: inputs and needs headroom for the score tile, so candidates are
+#: filtered against a deliberately conservative 12 MB.  v6e doubles the
+#: VMEM, which is what admits its pretuned (1024, 1024) backward blocks
+#: — the search's budget must agree or a tuning run on v6e would record
+#: a smaller-block winner that permanently outranks the better table
+#: entry (measured cache beats PRETUNED).
+VMEM_BUDGET = 12 << 20
+VMEM_BUDGET_BY_CLASS = {"v6e": 24 << 20}
+
+
+def vmem_budget(dev_class: str) -> int:
+    return VMEM_BUDGET_BY_CLASS.get(dev_class, VMEM_BUDGET)
+
+_CACHE_ENV = "NOS_TPU_AUTOTUNE_CACHE"
+_CACHE_VERSION = 1
+
+#: In-memory measured entries (string key -> [bq, bk]); lazily seeded
+#: from the cache file, updated by record().  None = not yet loaded.
+_cache_entries: dict[str, list[int]] | None = None
+
+
+def device_class(device_kind: str) -> str:
+    """Normalize a jax ``device_kind`` string to the generation family
+    block tuning actually depends on ("TPU v5 lite" / "v5litepod-16" ->
+    "v5e").  Unknown kinds pass through lowercased, so their cache
+    entries stay self-consistent without colliding with known families."""
+    kind = device_kind.lower()
+    for cls, needles in (
+        ("v6e", ("v6e", "trillium")),
+        ("v5p", ("v5p",)),
+        ("v5e", ("v5e", "v5litepod", "v5 lite")),
+        ("v4", ("v4",)),
+    ):
+        if any(n in kind for n in needles):
+            return cls
+    return kind.replace(" ", "_") or "unknown"
+
+
+def _key(dev_class: str, pass_: str, seq_len: int, head_dim: int,
+         dtype: str, causal: bool) -> str:
+    return (f"{dev_class}|{pass_}|s{seq_len}|d{head_dim}|{dtype}|"
+            f"{'causal' if causal else 'full'}")
+
+
+def _family_tables() -> dict[str, tuple[int, int]]:
+    """Shipped pre-tuned tables.  v5e fwd 512/512 and bwd 512/1024 are
+    the measured sweep optima (scripts/sweep_attention.py, sweep_bwd.py,
+    BENCH_r03/r04); v5p shares the v5e core geometry so it ships the
+    same blocks; v6e's doubled VMEM admits a wider k block per step.
+    Entries are seeds, not ceilings — a measured cache entry from
+    ``search()`` on the actual host always wins."""
+    table: dict[str, tuple[int, int]] = {}
+    families = (
+        ("v5e", (512, 512), (512, 1024)),
+        ("v5p", (512, 512), (512, 1024)),
+        ("v6e", (512, 1024), (1024, 1024)),
+    )
+    for dev, fwd_blocks, bwd_blocks in families:
+        for seq in (1024, 2048, 4096, 8192):
+            for causal in (True, False):
+                table[_key(dev, "fwd", seq, 128, "bfloat16", causal)] = \
+                    fwd_blocks
+                table[_key(dev, "bwd", seq, 128, "bfloat16", causal)] = \
+                    bwd_blocks
+    return table
+
+
+PRETUNED: dict[str, tuple[int, int]] = _family_tables()
+
+
+# -- persistent cache -------------------------------------------------------
+
+def cache_path() -> pathlib.Path:
+    override = os.environ.get(_CACHE_ENV, "")
+    if override:
+        return pathlib.Path(override)
+    return (pathlib.Path.home() / ".cache" / "nos_tpu"
+            / "flash_autotune.json")
+
+
+def _load_cache() -> dict[str, list[int]]:
+    global _cache_entries
+    if _cache_entries is not None:
+        return _cache_entries
+    path = cache_path()
+    entries: dict[str, list[int]] = {}
+    if path.is_file():
+        try:
+            raw = json.loads(path.read_text())
+            loaded = raw.get("entries") if isinstance(raw, dict) else {}
+            entries = {
+                k: [int(v[0]), int(v[1])]
+                for k, v in (loaded or {}).items()
+                if isinstance(v, (list, tuple)) and len(v) == 2
+            }
+        except (OSError, ValueError, TypeError, AttributeError):
+            # a corrupt cache (unparseable OR structurally wrong) must
+            # degrade to the pretuned tables, not take down the
+            # training job that consulted it
+            logger.warning("autotune cache %s unreadable; ignoring",
+                           path, exc_info=True)
+    _cache_entries = entries
+    return entries
+
+
+def reload_cache() -> None:
+    """Drop the in-memory cache so the next lookup re-reads the file
+    (tests point ``NOS_TPU_AUTOTUNE_CACHE`` at a tmp dir per case)."""
+    global _cache_entries
+    _cache_entries = None
+
+
+def _save_cache(entries: dict[str, list[int]]) -> bool:
+    path = cache_path()
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(
+            {"version": _CACHE_VERSION, "entries": entries},
+            indent=1, sort_keys=True))
+        tmp.replace(path)
+    except OSError:
+        # read-only HOME (hermetic CI): the in-memory entry still
+        # serves this process; only persistence is lost
+        logger.warning("autotune cache %s not writable", path,
+                       exc_info=True)
+        return False
+    return True
+
+
+def record(device_kind: str, pass_: str, seq_len: int, head_dim: int,
+           dtype: str, causal: bool, blocks: tuple[int, int],
+           persist: bool = True) -> str:
+    """Store a measured (block_q, block_k) for the key; returns the
+    cache key.  ``persist=False`` keeps it in-memory only."""
+    if pass_ not in ("fwd", "bwd"):
+        raise ValueError(f"pass_ must be 'fwd'/'bwd', got {pass_!r}")
+    entries = _load_cache()
+    key = _key(device_class(device_kind), pass_, seq_len, head_dim,
+               dtype, causal)
+    entries[key] = [int(blocks[0]), int(blocks[1])]
+    if persist:
+        _save_cache(entries)
+    return key
+
+
+def lookup(device_kind: str, pass_: str, seq_len: int, head_dim: int,
+           dtype: str, causal: bool) -> tuple[int, int] | None:
+    """Tuned (block_q, block_k) for the key, or None (caller falls back
+    to the hardcoded defaults).  Measured cache entries win over the
+    shipped PRETUNED tables."""
+    key = _key(device_class(device_kind), pass_, seq_len, head_dim,
+               dtype, causal)
+    entry = _load_cache().get(key)
+    if entry is None:
+        pre = PRETUNED.get(key)
+        return tuple(pre) if pre is not None else None
+    return (entry[0], entry[1])
+
+
+# -- candidate space --------------------------------------------------------
+
+def _vmem_estimate(pass_: str, block_q: int, block_k: int, head_dim: int,
+                   dtype_bytes: int) -> int:
+    """Conservative per-grid-step VMEM bytes for a candidate.  Streamed
+    inputs count twice (Mosaic double-buffers their DMAs); the score
+    tile and softmax stats are fp32."""
+    score_tile = block_q * block_k * 4
+    stats = 2 * block_q * 128 * 4                       # m, l (or lse, delta)
+    if pass_ == "fwd":
+        io = (2 * block_q + 2 * 2 * block_k) * head_dim * dtype_bytes
+        scratch = block_q * head_dim * 4                # acc
+        return io + scratch + 2 * score_tile + stats
+    # bwd (fused): q/do stream (x2 buffered), k/v resident, dk/dv scratch
+    io = (2 * 2 * block_q + 2 * block_k) * head_dim * dtype_bytes
+    scratch = 2 * block_k * head_dim * 4 + block_q * head_dim * dtype_bytes
+    return io + scratch + 4 * score_tile + stats
+
+
+def candidates(pass_: str, seq_q: int, seq_k: int, head_dim: int,
+               dtype_bytes: int = 2,
+               budget: int = VMEM_BUDGET) -> list[tuple[int, int]]:
+    """Kernel-legal, VMEM-feasible (block_q, block_k) candidates for the
+    shapes, largest-block-first (ties in the search resolve toward fewer
+    grid steps).  `budget` defaults to the v5-sized VMEM; the search
+    passes vmem_budget(device_class) so bigger-VMEM chips see their
+    bigger blocks."""
+    out = []
+    for bq in BLOCK_CHOICES:
+        if bq > seq_q or seq_q % bq:
+            continue
+        for bk in BLOCK_CHOICES:
+            if bk > seq_k or seq_k % bk or bk % 128:
+                continue
+            if _vmem_estimate(pass_, bq, bk, head_dim,
+                              dtype_bytes) > budget:
+                continue
+            out.append((bq, bk))
+    return sorted(out, key=lambda c: (-c[0] * c[1], -c[0]))
+
+
+# -- microbench search ------------------------------------------------------
+
+def _time_forward(q, k, v, causal, blocks, interpret, n1, n2, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops.attention import flash_attention
+    from nos_tpu.ops.roofline import slope as _slope
+
+    bq, bk = blocks
+
+    @jax.jit
+    def run(q, k, v, iters):
+        return jax.lax.fori_loop(
+            0, iters,
+            lambda i, acc: flash_attention(acc, k, v, causal, bq, bk,
+                                           interpret),
+            q)[0, 0, 0, 0]
+
+    def make(iters):
+        i = jnp.int32(iters)
+        return lambda: float(run(q, k, v, i))
+    return _slope(make, n1, n2, reps)
+
+
+def _time_backward(q, k, v, causal, fwd_blocks, bwd_blocks, interpret,
+                   n1, n2, reps):
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops.attention import flash_attention
+    from nos_tpu.ops.roofline import slope as _slope
+
+    fq, fk = fwd_blocks
+    bq, bk = bwd_blocks
+
+    def loss(qq, kk, vv):
+        out = flash_attention(qq, kk, vv, causal, fq, fk, interpret,
+                              bq, bk)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def gstep(qx):
+        gq, gk, gv = jax.grad(loss, (0, 1, 2))(qx, k, v)
+        return gq + gk + gv   # all backward kernels stay live
+
+    @jax.jit
+    def run(q, k, v, iters):
+        return jax.lax.fori_loop(
+            0, iters, lambda i, acc: gstep(acc), q)[0, 0, 0, 0]
+
+    def make(iters):
+        i = jnp.int32(iters)
+        return lambda: float(run(q, k, v, i))
+    return _slope(make, n1, n2, reps)
+
+
+def search(pass_: str, q, k, v, causal: bool = True, *,
+           interpret: bool = False, n1: int = 10, n2: int = 40,
+           reps: int = 3) -> tuple[tuple[int, int], dict]:
+    """Microbench every feasible candidate at these concrete arrays;
+    returns (best_blocks, {blocks: seconds}).  Backward candidates run
+    through jax.grad with the forward pinned (its tuned-or-default
+    blocks), so the constant forward cost cannot reorder the ranking."""
+    from nos_tpu.ops import attention as A
+
+    import jax
+
+    if pass_ not in ("fwd", "bwd"):
+        raise ValueError(f"pass_ must be 'fwd'/'bwd', got {pass_!r}")
+    seq_q, head_dim = q.shape[1], q.shape[3]
+    budget = vmem_budget(device_class(jax.devices()[0].device_kind))
+    cands = [c for c in candidates(pass_, seq_q, k.shape[1], head_dim,
+                                   q.dtype.itemsize, budget=budget)
+             if A._plan(q, k, causal, *c) == c]
+    if not cands:
+        raise ValueError(
+            f"no kernel-legal candidates for shapes q={q.shape} "
+            f"k={k.shape} causal={causal}")
+    if pass_ == "bwd":
+        fwd_blocks = (
+            lookup_for_arrays(q, k, "fwd", causal)
+            or (A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K))
+    timings: dict[tuple[int, int], float] = {}
+    for blocks in cands:
+        if pass_ == "fwd":
+            t = _time_forward(q, k, v, causal, blocks, interpret,
+                              n1, n2, reps)
+        else:
+            t = _time_backward(q, k, v, causal, fwd_blocks, blocks,
+                               interpret, n1, n2, reps)
+        timings[blocks] = t
+        logger.info("autotune %s %s: %.4f ms", pass_, blocks, t * 1e3)
+    best = min(timings, key=lambda c: timings[c])
+    return best, timings
+
+
+def lookup_for_arrays(q, k, pass_: str, causal: bool
+                      ) -> tuple[int, int] | None:
+    """lookup() keyed from concrete arrays on the current backend.  Self-
+    attention only — a decode rectangle (seq_q != seq_k) is not a tuned
+    shape (attention._plan routes causal rectangles to the fallback
+    anyway)."""
+    import jax
+
+    if q.shape[1] != k.shape[1]:
+        return None
+    devices = jax.devices()
+    if not devices:
+        return None
+    return lookup(devices[0].device_kind, pass_, int(q.shape[1]),
+                  int(q.shape[3]), str(q.dtype.name), causal)
+
+
+def tune_and_record(q, k, v, causal: bool = True, *,
+                    interpret: bool = False, persist: bool = True,
+                    n1: int = 10, n2: int = 40, reps: int = 3) -> dict:
+    """Search fwd then bwd at these arrays and record both winners;
+    returns {"fwd": blocks, "bwd": blocks, "timings_ms": {...}}."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    out: dict = {"device_class": device_class(kind), "timings_ms": {}}
+    for pass_ in ("fwd", "bwd"):
+        best, timings = search(pass_, q, k, v, causal,
+                               interpret=interpret, n1=n1, n2=n2,
+                               reps=reps)
+        record(kind, pass_, int(q.shape[1]), int(q.shape[3]),
+               str(q.dtype.name), causal, best, persist=persist)
+        out[pass_] = list(best)
+        out["timings_ms"][pass_] = {
+            f"{bq}x{bk}": round(t * 1e3, 4)
+            for (bq, bk), t in sorted(timings.items())}
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: tune the current backend at the given shapes and persist.
+
+        python -m nos_tpu.ops.autotune --seq 2048 --heads 8 --batch 8
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--no-causal", action="store_true")
+    ap.add_argument("--interpret", action="store_true",
+                    help="interpret-mode kernels (CPU; validates the "
+                    "search plumbing, not real timings)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    shape = (args.batch, args.seq, args.heads, args.head_dim)
+    dtype = jnp.dtype(args.dtype)
+    q, k, v = (jax.random.normal(kk, shape, dtype)
+               for kk in jax.random.split(key, 3))
+    # interpret-mode timings validate the plumbing, not the hardware:
+    # persisting them would poison the real cache (measured entries
+    # outrank PRETUNED) with CPU-interpret rankings
+    result = tune_and_record(q, k, v, not args.no_causal,
+                             interpret=args.interpret,
+                             persist=not args.interpret)
+    result["persisted"] = not args.interpret
+    result["cache"] = str(cache_path())
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Roofline accounting: peaks, analytic model FLOPs, slope timing.
+
+The single source of truth for "what fraction of the chip did we use":
+bench_compute.py, scripts/mfu_explore.py, scripts/diag_batch16.py, the
+autotuner and the cmd/train telemetry hook all judge MFU against THESE
+peaks, THIS FLOP count and (for the benches) THIS timing method — two
+of them disagreeing would make a regression gate unfalsifiable.  Peaks
+are the public Cloud TPU bf16 specs.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: Nominal bf16 peak FLOP/s per chip, matched by substring against the
+#: jax ``device_kind`` string.  Order matters: more specific needles
+#: ("v5e", "v5p") must precede the bare "v5" catch-all.
+PEAK_TFLOPS = {"v6e": 918e12, "trillium": 918e12,
+               "v5p": 459e12,
+               "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
+               "v5": 197e12,
+               "v4": 275e12}
+DEFAULT_PEAK = 197e12
+
+
+def peak_for(device_kind: str) -> float:
+    """Nominal bf16 peak FLOP/s for a jax device_kind string."""
+    kind = device_kind.lower()
+    return next((v for k, v in PEAK_TFLOPS.items() if k in kind),
+                DEFAULT_PEAK)
+
+
+def model_flops_per_step(cfg, batch: int, seq: int) -> float:
+    """Analytic Llama train-step FLOPs (fwd+bwd, no remat credit): 6*T per
+    matmul param + causal attention matmuls.  `cfg` is a LlamaConfig (duck
+    typed so this module needs no jax/flax import)."""
+    per_layer_mm = (
+        cfg.hidden_size * cfg.num_heads * cfg.head_dim          # q
+        + 2 * cfg.hidden_size * cfg.num_kv_heads * cfg.head_dim  # k, v
+        + cfg.num_heads * cfg.head_dim * cfg.hidden_size        # o
+        + 3 * cfg.hidden_size * cfg.intermediate_size           # mlp
+    )
+    n_mm = cfg.num_layers * per_layer_mm + cfg.vocab_size * cfg.hidden_size
+    tokens = batch * seq
+    matmul = 6 * n_mm * tokens
+    # QK^T and PV: 2 matmuls x 2 FLOPs x B*H*S^2*D, causal halves it,
+    # backward doubles it (fwd 1x + bwd 2x = 3x).
+    attn = 3 * cfg.num_layers * 2 * batch * cfg.num_heads * seq * seq \
+        * cfg.head_dim
+    return float(matmul + attn)
+
+
+def slope(fn_maker, n1: int = 20, n2: int = 80, reps: int = 5) -> float:
+    """Per-iteration device time = (t[n2] - t[n1]) / (n2 - n1) over
+    min-of-reps wall times: the chained-iteration slope method
+    (bench_compute.py module docstring) — the tunnel RTT cancels in the
+    difference, the min filters tunnel jitter.  `fn_maker(n)` returns a
+    thunk running an n-iteration chain to completion; both chain
+    lengths must share one compiled program (pass n as a traced
+    scalar).  Shared by bench_compute (which re-exports it as `_slope`
+    for the sweep scripts) and the flash block autotuner, so candidate
+    rankings and bench numbers come from ONE methodology."""
+    fa, fb = fn_maker(n1), fn_maker(n2)
+    fa(), fb()  # compile + warm
+    tsa, tsb = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fa()
+        tsa.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fb()
+        tsb.append(time.perf_counter() - t0)
+    return (min(tsb) - min(tsa)) / (n2 - n1)

@@ -18,13 +18,101 @@ let the compiler do the rest).
 
 from __future__ import annotations
 
+import glob
+import logging
+import os
+import sys
 from dataclasses import dataclass
+from typing import MutableMapping
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+logger = logging.getLogger(__name__)
+
 AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+#: XLA flags that let collectives run concurrently with compute on TPU:
+#: the latency-hiding scheduler reorders independent ops around
+#: collectives, async-collective fusion keeps all-gathers/
+#: reduce-scatters (the fsdp axis traffic) and collective-permutes (the
+#: sp ring's ppermute hops) split into start/done pairs with compute
+#: scheduled between them.  Applied by enable_collective_overlap();
+#: NOS_TPU_NO_OVERLAP=1 opts out.
+OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+)
+
+
+def _tpu_expected(env: MutableMapping[str, str]) -> bool:
+    """Will this process plausibly run a TPU backend?  Decided WITHOUT
+    importing/initializing jax (XLA_FLAGS is read at backend creation,
+    so asking jax directly would be self-defeating): the explicit
+    JAX_PLATFORMS pin wins; otherwise look for TPU device nodes or the
+    Cloud TPU multi-host env."""
+    platforms = env.get("JAX_PLATFORMS", "")
+    if platforms:
+        return "tpu" in platforms.lower()
+    return bool(glob.glob("/dev/accel*")) \
+        or "TPU_WORKER_HOSTNAMES" in env
+
+
+def _backend_initialized() -> bool:
+    bridge = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(bridge, "_backends", None))
+
+
+def enable_collective_overlap(
+        env: MutableMapping[str, str] | None = None,
+        initialized: bool | None = None) -> bool:
+    """Arrange XLA's latency-hiding scheduler + async-collective fusion
+    by appending OVERLAP_XLA_FLAGS to ``XLA_FLAGS`` (idempotent; flags
+    the user already pinned — either polarity — are left alone).
+    Returns whether the flags are in effect.
+
+    Skipped when ``NOS_TPU_NO_OVERLAP`` is set (the opt-out knob for
+    A/B timing or a scheduler-miscompile escape hatch), when no TPU
+    backend is expected (the flags are TPU-plugin-specific; a CPU test
+    process would fail XLA flag parsing), or — with a warning — when
+    the jax backend is already initialized and the env change can no
+    longer take effect.  make_mesh() calls this, but entrypoints should
+    call it BEFORE their first jax.devices()/default_backend() touch
+    (cmd/train.py and bench_compute.py do).  `initialized` overrides the
+    backend-liveness autodetection (tests)."""
+    env = os.environ if env is None else env
+    if initialized is None:
+        initialized = _backend_initialized()
+    if env.get("NOS_TPU_NO_OVERLAP", "") not in ("", "0"):
+        return False
+    if not _tpu_expected(env):
+        return False
+    flags = env.get("XLA_FLAGS", "")
+    # exact flag-NAME matching: a pinned longer sibling
+    # (--..._fusion_fuse_all_gather=false) must not mask its shorter
+    # base flag (--..._fusion) the way a substring test would
+    present = {tok.split("=")[0] for tok in flags.split()}
+    missing = [f for f in OVERLAP_XLA_FLAGS
+               if f.split("=")[0] not in present]
+    if not missing:
+        return True
+    if initialized:
+        logger.warning(
+            "enable_collective_overlap: jax backend already "
+            "initialized; XLA_FLAGS %s cannot take effect this "
+            "process — call earlier (before the first jax.devices())",
+            " ".join(missing))
+        return False
+    env["XLA_FLAGS"] = " ".join(([flags] if flags else []) + missing)
+    logger.info("collective-compute overlap flags enabled: %s",
+                " ".join(missing))
+    return True
 
 # Logical (model) axes -> mesh axes.  The flax logical-partitioning rules
 # used by all nos_tpu models (nos_tpu/models/).
@@ -99,7 +187,14 @@ def make_mesh(spec: MeshSpec | None = None,
               devices: list | None = None) -> Mesh:
     """Build the Mesh.  Device order follows jax.devices(), which on TPU
     enumerates in ICI-contiguous order, so the trailing mesh axis (`sp`,
-    the ring) lands on nearest neighbours."""
+    the ring) lands on nearest neighbours.
+
+    Also arranges collective-compute overlap (latency-hiding scheduler +
+    async collective fusion) via enable_collective_overlap() — a no-op
+    off-TPU, under NOS_TPU_NO_OVERLAP, or when the caller already
+    initialized the backend (entrypoints call it earlier for that
+    reason; here it is the safety net for direct make_mesh users)."""
+    enable_collective_overlap()
     devices = list(devices if devices is not None else jax.devices())
     if spec is None:
         spec = MeshSpec.for_device_count(len(devices))

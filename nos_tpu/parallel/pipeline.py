@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nos_tpu.parallel.ring import shard_map_unchecked
+
 
 
 
@@ -87,11 +89,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stage_params,
             jnp.where(idx == last, outbuf, jnp.zeros_like(outbuf)), axis)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    out = jax.shard_map(
+    # the psum-of-masked-outbuf replication is not inferable, so the
+    # replication check stays off (ring.shard_map_unchecked handles the
+    # check_rep/check_vma spelling across jax versions)
+    out = shard_map_unchecked(
         per_device, mesh=mesh,
         in_specs=(spec_params, P()), out_specs=P(),
-        # the psum-of-masked-outbuf replication is not inferable
-        check_vma=False,
     )(stage_params, micro)
     return out.reshape(batch, *x.shape[1:])
 

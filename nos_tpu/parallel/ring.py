@@ -15,6 +15,7 @@ transformers).
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +23,32 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30
+
+# jax moved shard_map out of experimental and renamed its replication
+# check (check_rep -> check_vma) across the versions this repo runs
+# against; resolve both at import so every caller sees one spelling.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:                                       # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+_CHECK_KW = ("check_vma" if "check_vma"
+             in inspect.signature(_shard_map).parameters else "check_rep")
+
+
+def shard_map_unchecked(body, mesh, in_specs, out_specs):
+    """`jax.shard_map` with the replication check off, under whichever
+    keyword this jax version spells it."""
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: False})
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static mapped-axis size inside shard_map: ``lax.axis_size`` where
+    it exists, else the 0.4.x axis frame (which is the bare size int)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
 
 
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -42,24 +69,41 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis_name: str = "sp",
-                         causal: bool = True) -> jax.Array:
+                         causal: bool = True,
+                         overlap: bool = True) -> jax.Array:
     """Per-device body: q/k/v are the local sequence shards [B, Sl, H, D].
 
     Maintains flash-style running (max, denom, out) while K/V shards rotate;
     causal masking uses *global* positions derived from each shard's origin
     in the ring, so the result equals dense attention on the gathered
     sequence.
+
+    ``overlap=True`` double-buffers the rotation: each step issues the
+    ppermute for the NEXT K/V shard *before* this shard's matmuls, so
+    under XLA's latency-hiding scheduler (mesh.enable_collective_overlap)
+    the ICI hop is in flight while the MXU works — the blockwise-parallel
+    overlap the Ring Attention line of work is built on.  The compute
+    consumes the pre-rotation block either way, so numerics are identical
+    to ``overlap=False`` (the knob exists for A/B timing and tests).
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, sl, h, d = q.shape
     scale = d ** -0.5
 
     qf = q.astype(jnp.float32) * scale
     q_pos = my * sl + lax.broadcasted_iota(jnp.int32, (sl, 1), 0)
+    # rotate k/v one hop: device i -> i+1, so after t steps we hold the
+    # shard originating at my - t.
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, step_idx):
         kb, vb, m, l, acc = carry
+        if overlap:
+            # next shard's hop first: independent of the matmuls below,
+            # so the scheduler may run DMA and MXU concurrently
+            kb_next = lax.ppermute(kb, axis_name, perm)
+            vb_next = lax.ppermute(vb, axis_name, perm)
         src = (my - step_idx) % n  # which shard this k/v block came from
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32),
                        preferred_element_type=jnp.float32)
@@ -76,12 +120,10 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         acc = acc * corr + jnp.einsum(
             "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
             preferred_element_type=jnp.float32)
-        # rotate k/v one hop: device i -> i+1, so after t steps we hold
-        # the shard originating at my - t.
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        return (kb, vb, m_new, l, acc), None
+        if not overlap:
+            kb_next = lax.ppermute(kb, axis_name, perm)
+            vb_next = lax.ppermute(vb, axis_name, perm)
+        return (kb_next, vb_next, m_new, l, acc), None
 
     m0 = jnp.full((b, h, sl, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
@@ -95,14 +137,14 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True,
                    batch_axes=("dp", "fsdp"), seq_axis: str = "sp",
-                   head_axis: str = "tp") -> jax.Array:
+                   head_axis: str = "tp", overlap: bool = True
+                   ) -> jax.Array:
     """shard_map wrapper: [B, S, H, D] arrays with batch over dp+fsdp,
     sequence over sp, heads over tp.  K/V must already have full (repeated)
     heads when using grouped-query attention."""
     spec = P(batch_axes, seq_axis, head_axis, None)
     body = functools.partial(ring_attention_local, axis_name=seq_axis,
-                             causal=causal)
-    return jax.shard_map(
+                             causal=causal, overlap=overlap)
+    return shard_map_unchecked(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

@@ -60,6 +60,13 @@ if ! env JAX_PLATFORMS=cpu python bench_utilization.py --smoke \
     rc=1
 fi
 
+echo "==> bench_compute.py --smoke (MFU gate: interpret-mode kernels + scan + ring overlap)"
+if ! env JAX_PLATFORMS=cpu python bench_compute.py --smoke \
+        --report "${COMPUTE_REPORT_PATH:-/tmp/nos_tpu_compute_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
 if [ "$FAST" -eq 0 ]; then
     echo "==> tier-1 pytest (-m 'not slow')"
     if ! env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

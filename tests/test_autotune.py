@@ -1,0 +1,250 @@
+"""Flash block autotuner tests: device-class normalization, candidate
+legality, cache roundtrip + precedence, and the attention._plan
+consultation path — interpret mode on CPU, so an autotuner that picks a
+new block can never pick a wrong one (the numerics checks run at tuned
+blocks, not just the defaults)."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.ops import attention as A
+from nos_tpu.ops import autotune
+from nos_tpu.parallel.ring import dense_attention
+
+
+@pytest.fixture(autouse=True)
+def tmp_cache(tmp_path, monkeypatch):
+    """Point the persistent cache at a per-test file and reset the
+    in-memory view on both sides.  Autouse: NO test in this module may
+    read the host's real ~/.cache entries — a single
+    `python -m nos_tpu.ops.autotune` run on the dev box would otherwise
+    change what PRETUNED-expectation tests observe."""
+    path = tmp_path / "flash_autotune.json"
+    monkeypatch.setenv(autotune._CACHE_ENV, str(path))
+    autotune.reload_cache()
+    yield path
+    autotune.reload_cache()
+
+
+@pytest.fixture
+def qkv():
+    key = jax.random.PRNGKey(1)
+    return tuple(
+        jax.random.normal(kk, (1, 256, 2, 128), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+
+
+class TestDeviceClass:
+    @pytest.mark.parametrize("kind,cls", [
+        ("TPU v5 lite", "v5e"),
+        ("v5litepod-16", "v5e"),
+        ("TPU v5e", "v5e"),
+        ("TPU v5p", "v5p"),
+        ("TPU v6e", "v6e"),
+        ("trillium", "v6e"),
+        ("TPU v4", "v4"),
+        ("cpu", "cpu"),
+        ("", "unknown"),
+    ])
+    def test_normalization(self, kind, cls):
+        assert autotune.device_class(kind) == cls
+
+
+class TestCandidates:
+    def test_all_candidates_kernel_legal(self):
+        for pass_ in ("fwd", "bwd"):
+            cands = autotune.candidates(pass_, 2048, 2048, 128, 2)
+            assert cands, pass_
+            for bq, bk in cands:
+                assert 2048 % bq == 0 and 2048 % bk == 0
+                assert bk % 128 == 0
+                assert autotune._vmem_estimate(
+                    pass_, bq, bk, 128, 2) <= autotune.VMEM_BUDGET
+
+    def test_short_sequences_shrink_the_space(self):
+        cands = autotune.candidates("fwd", 256, 256, 128, 4)
+        assert all(bq <= 256 and bk <= 256 for bq, bk in cands)
+        assert (256, 256) in cands
+
+    def test_vmem_budget_excludes_fat_bwd_blocks(self):
+        # the fused backward's 4 fp32 score-tile intermediates push
+        # 1024x1024 past the budget; the forward still admits it
+        assert (1024, 1024) not in autotune.candidates(
+            "bwd", 8192, 8192, 128, 2)
+        assert (1024, 1024) in autotune.candidates(
+            "fwd", 8192, 8192, 128, 2)
+
+    def test_v6e_budget_admits_its_pretuned_bwd_blocks(self):
+        """The search budget must agree with the shipped v6e table, or
+        a tuning run on v6e would record a smaller-block winner that
+        permanently outranks the better PRETUNED entry."""
+        for seq in (2048, 8192):
+            pretuned = autotune.lookup("v6e", "bwd", seq, 128,
+                                       "bfloat16", True)
+            assert pretuned in autotune.candidates(
+                "bwd", seq, seq, 128, 2,
+                budget=autotune.vmem_budget("v6e"))
+
+
+class TestPretuned:
+    def test_v5e_ships_the_measured_sweep_optima(self):
+        assert autotune.lookup("TPU v5 lite", "fwd", 2048, 128,
+                               "bfloat16", True) == (512, 512)
+        assert autotune.lookup("TPU v5 lite", "bwd", 2048, 128,
+                               "bfloat16", True) == (512, 1024)
+
+    def test_all_families_cover_the_training_shapes(self):
+        for dev in ("v5e", "v5p", "v6e"):
+            for seq in (1024, 2048, 4096, 8192):
+                for pass_ in ("fwd", "bwd"):
+                    blocks = autotune.lookup(dev, pass_, seq, 128,
+                                             "bfloat16", True)
+                    assert blocks is not None, (dev, pass_, seq)
+                    bq, bk = blocks
+                    assert seq % bq == 0 and seq % bk == 0, \
+                        (dev, pass_, seq, blocks)
+
+    def test_unknown_device_and_shape_miss(self):
+        assert autotune.lookup("cpu", "fwd", 2048, 128,
+                               "bfloat16", True) is None
+        assert autotune.lookup("TPU v5e", "fwd", 2048, 64,
+                               "bfloat16", True) is None
+
+
+class TestCache:
+    def test_record_roundtrip_through_the_file(self, tmp_cache):
+        key = autotune.record("TPU v5e", "fwd", 2048, 128, "bfloat16",
+                              True, (256, 512))
+        raw = json.loads(tmp_cache.read_text())
+        assert raw["entries"][key] == [256, 512]
+        autotune.reload_cache()   # force the file read path
+        assert autotune.lookup("TPU v5e", "fwd", 2048, 128, "bfloat16",
+                               True) == (256, 512)
+
+    def test_measured_beats_pretuned(self, tmp_cache):
+        assert autotune.lookup("TPU v5e", "fwd", 2048, 128, "bfloat16",
+                               True) == (512, 512)
+        autotune.record("TPU v5e", "fwd", 2048, 128, "bfloat16", True,
+                        (256, 1024))
+        assert autotune.lookup("TPU v5e", "fwd", 2048, 128, "bfloat16",
+                               True) == (256, 1024)
+
+    def test_corrupt_cache_degrades_to_pretuned(self, tmp_cache):
+        tmp_cache.write_text("{not json")
+        autotune.reload_cache()
+        assert autotune.lookup("TPU v5e", "fwd", 2048, 128, "bfloat16",
+                               True) == (512, 512)
+
+    def test_bad_pass_rejected(self, tmp_cache):
+        with pytest.raises(ValueError):
+            autotune.record("TPU v5e", "sideways", 2048, 128,
+                            "bfloat16", True, (128, 128))
+
+
+class TestPlanConsultation:
+    """A recorded entry must flow through attention._resolve_plan into
+    the kernel, and a bad entry must fall through to the defaults —
+    never disable the kernel or change the math."""
+
+    def test_tuned_blocks_drive_the_kernel(self, tmp_cache, qkv):
+        q, k, v = qkv
+        kind = jax.devices()[0].device_kind
+        autotune.record(kind, "fwd", 256, 128, "float32", True,
+                        (128, 256))
+        autotune.record(kind, "bwd", 256, 128, "float32", True,
+                        (256, 128))
+        ref = dense_attention(q, k, v, True)
+        out = A.flash_attention(q, k, v, True, None, None, True)
+        assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+        g = jax.grad(loss(lambda q, k, v: A.flash_attention(
+            q, k, v, True, None, None, True)), (0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(lambda q, k, v: dense_attention(
+            q, k, v, True)), (0, 1, 2))(q, k, v)
+        for got, want in zip(g, g_ref):
+            scale = float(jnp.max(jnp.abs(want))) + 1e-9
+            assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
+
+    def test_invalid_tuned_entry_falls_through_to_defaults(
+            self, tmp_cache, qkv):
+        q, k, v = qkv
+        kind = jax.devices()[0].device_kind
+        # 384 divides nothing here: _resolve_plan must reject it and
+        # use the defaults, NOT route to the XLA fallback
+        autotune.record(kind, "fwd", 256, 128, "float32", True,
+                        (384, 384))
+        plan = A._resolve_plan(q, k, True, None, None, "fwd",
+                               A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K)
+        assert plan == (min(A.DEFAULT_BLOCK_Q, 256),
+                        min(A.DEFAULT_BLOCK_K, 256))
+        out = A.flash_attention(q, k, v, True, None, None, True)
+        assert jnp.max(jnp.abs(out - dense_attention(q, k, v, True))) \
+            < 1e-4
+
+    def test_explicit_blocks_beat_the_cache(self, tmp_cache, qkv):
+        q, k, _ = qkv
+        kind = jax.devices()[0].device_kind
+        autotune.record(kind, "fwd", 256, 128, "float32", True,
+                        (128, 128))
+        plan = A._resolve_plan(q, k, True, 256, 256, "fwd",
+                               A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K)
+        assert plan == (256, 256)
+
+    def test_unaligned_bwd_override_drops_to_fwd_blocks(self):
+        """A bwd_block override that divides nothing at these shapes
+        (384 at seq 512) must fall back to the forward's validated
+        blocks, not crash the backward with plan=None."""
+        key = jax.random.PRNGKey(9)
+        q, k, v = (jax.random.normal(kk, (1, 512, 1, 128), jnp.float32)
+                   for kk in jax.random.split(key, 3))
+
+        def loss(q, k, v):
+            return (A.flash_attention(q, k, v, True, 128, 128, True,
+                                      384, 384) ** 2).sum()
+        g = jax.grad(loss, (0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: (dense_attention(
+            q, k, v, True) ** 2).sum(), (0, 1, 2))(q, k, v)
+        for got, want in zip(g, g_ref):
+            scale = float(jnp.max(jnp.abs(want))) + 1e-9
+            assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
+
+    def test_bwd_blocks_pin_the_backward_separately(self, qkv):
+        """bwd_block_q/bwd_block_k (the autotuner's isolation knob)
+        override the shared explicit blocks for the backward only."""
+        q, k, v = qkv
+
+        def loss(q, k, v):
+            return (A.flash_attention(q, k, v, True, 256, 256, True,
+                                      128, 128) ** 2).sum()
+        g = jax.grad(loss, (0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: (dense_attention(
+            q, k, v, True) ** 2).sum(), (0, 1, 2))(q, k, v)
+        for got, want in zip(g, g_ref):
+            scale = float(jnp.max(jnp.abs(want))) + 1e-9
+            assert float(jnp.max(jnp.abs(got - want))) / scale < 2e-2
+
+
+class TestSearch:
+    @pytest.mark.slow
+    def test_interpret_search_picks_a_legal_candidate(self, tmp_cache):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(kk, (1, 256, 1, 128), jnp.float32)
+                   for kk in jax.random.split(key, 3))
+        best, timings = autotune.search(
+            "fwd", q, k, v, True, interpret=True, n1=1, n2=2, reps=1)
+        assert best in timings
+        assert best in autotune.candidates("fwd", 256, 256, 128, 4)
+        assert all(t > 0 for t in timings.values())
+
+    def test_search_rejects_unknown_pass(self, qkv):
+        q, k, v = qkv
+        with pytest.raises(ValueError):
+            autotune.search("sideways", q, k, v)

@@ -56,6 +56,30 @@ class TestRingAttention:
         out = ring_attention(mesh, q, k, v, causal)
         assert jnp.max(jnp.abs(out - ref)) < 1e-5
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_overlap_rotation_is_numerically_identical(self, qkv, causal):
+        """The double-buffered rotation (ppermute issued before the
+        block's matmuls) must be a pure scheduling change: both
+        orderings equal dense, and each other bitwise."""
+        q, k, v = qkv
+        mesh = make_mesh(MeshSpec(1, 1, 1, 4), devices=jax.devices()[:4])
+        ref = dense_attention(q, k, v, causal)
+        outs = {ov: ring_attention(mesh, q, k, v, causal, overlap=ov)
+                for ov in (True, False)}
+        for ov, out in outs.items():
+            assert jnp.max(jnp.abs(out - ref)) < 1e-5, f"overlap={ov}"
+        assert jnp.array_equal(outs[True], outs[False])
+
+    def test_overlap_differentiable(self, qkv):
+        q, k, v = qkv
+        mesh = make_mesh(MeshSpec(1, 1, 1, 4), devices=jax.devices()[:4])
+        g = jax.grad(lambda q: ring_attention(
+            mesh, q, k, v, True, overlap=True).sum())(q)
+        g_ref = jax.grad(lambda q: ring_attention(
+            mesh, q, k, v, True, overlap=False).sum())(q)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        assert jnp.max(jnp.abs(g - g_ref)) < 1e-6
+
     def test_differentiable(self, qkv):
         q, k, v = qkv
         mesh = make_mesh(MeshSpec(1, 2, 1, 4))
@@ -329,6 +353,123 @@ class TestRematPolicies:
                 else (_ for _ in ()).throw(
                     AssertionError(f"grad mismatch under {policy}")),
                 ref_grads, grads)
+
+
+class TestScanLayers:
+    """scan_layers is a compile-strategy change, never a math change:
+    the scanned model at stacked params must reproduce the unrolled
+    model exactly, with remat on and off (the interaction that made the
+    bench opt out — rope captured into the scan body instead of riding
+    as an nn.broadcast input — is pinned here)."""
+
+    def _stacked_pair(self, remat):
+        import flax.linen as nn
+
+        from nos_tpu.models.llama import stack_layer_params
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(7), (2, 32), 0, TINY.vocab_size, jnp.int32)
+        cfg_u = dataclasses.replace(TINY, scan_layers=False, remat=remat,
+                                    remat_policy="rots")
+        cfg_s = dataclasses.replace(TINY, scan_layers=True, remat=remat,
+                                    remat_policy="rots")
+        model_u, model_s = Llama(cfg_u), Llama(cfg_s)
+        vs = model_u.init(jax.random.PRNGKey(0), tokens)
+        params = nn.meta.unbox(vs)["params"]
+        stacked = stack_layer_params(params, TINY.num_layers)
+        return model_u, model_s, params, stacked, tokens
+
+    @pytest.mark.parametrize("remat", [True, False])
+    def test_loss_matches_unrolled(self, remat):
+        model_u, model_s, params, stacked, tokens = self._stacked_pair(remat)
+        loss_u = model_u.apply({"params": params}, tokens, targets=tokens)
+        loss_s = model_s.apply({"params": stacked}, tokens, targets=tokens)
+        assert abs(float(loss_u) - float(loss_s)) < 1e-5
+
+    @pytest.mark.parametrize("remat", [True, False])
+    def test_grads_match_unrolled(self, remat):
+        from nos_tpu.models.llama import stack_layer_params
+
+        model_u, model_s, params, stacked, tokens = self._stacked_pair(remat)
+        g_u = jax.grad(lambda p: model_u.apply(
+            {"params": p}, tokens, targets=tokens))(params)
+        g_s = jax.grad(lambda p: model_s.apply(
+            {"params": p}, tokens, targets=tokens))(stacked)
+        g_u_stacked = stack_layer_params(g_u, TINY.num_layers)
+        for a, b in zip(jax.tree_util.tree_leaves(g_u_stacked),
+                        jax.tree_util.tree_leaves(g_s)):
+            scale = float(jnp.max(jnp.abs(a))) + 1e-9
+            assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
+
+
+class TestBenchTrainConfig:
+    def test_bench_350m_train_is_the_roofline_config(self):
+        """The single source of truth bench_compute/cmd.train consume:
+        scanned layers, flash kernels, 'rots' selective remat — and the
+        same architecture as BENCH_350M."""
+        from nos_tpu.models.llama import BENCH_350M, BENCH_350M_TRAIN
+
+        assert BENCH_350M_TRAIN.scan_layers is True
+        assert BENCH_350M_TRAIN.attn_impl == "flash"
+        assert BENCH_350M_TRAIN.remat_policy == "rots"
+        assert dataclasses.replace(
+            BENCH_350M_TRAIN, attn_impl=BENCH_350M.attn_impl,
+            remat_policy=BENCH_350M.remat_policy,
+            scan_layers=BENCH_350M.scan_layers) == BENCH_350M
+
+    def test_train_config_defaults_match(self):
+        from nos_tpu.cmd.train import TrainConfig
+        from nos_tpu.models.llama import BENCH_350M_TRAIN
+
+        cfg = TrainConfig()
+        assert cfg.attn_impl == BENCH_350M_TRAIN.attn_impl
+        assert cfg.remat_policy == BENCH_350M_TRAIN.remat_policy
+        assert cfg.scan_layers == BENCH_350M_TRAIN.scan_layers
+
+
+class TestCollectiveOverlapFlags:
+    def _env(self, **kw):
+        return dict(kw)
+
+    def test_applied_when_tpu_expected(self):
+        from nos_tpu.parallel.mesh import (
+            OVERLAP_XLA_FLAGS, enable_collective_overlap,
+        )
+
+        env = self._env(JAX_PLATFORMS="tpu")
+        assert enable_collective_overlap(env, initialized=False)
+        for flag in OVERLAP_XLA_FLAGS:
+            assert flag in env["XLA_FLAGS"]
+
+    def test_idempotent_and_preserves_user_flags(self):
+        from nos_tpu.parallel.mesh import enable_collective_overlap
+
+        env = self._env(JAX_PLATFORMS="tpu",
+                        XLA_FLAGS="--xla_foo=1 "
+                        "--xla_tpu_enable_latency_hiding_scheduler=false")
+        assert enable_collective_overlap(env, initialized=False)
+        first = env["XLA_FLAGS"]
+        # the user's explicit =false pin was NOT overridden
+        assert "--xla_tpu_enable_latency_hiding_scheduler=false" in first
+        assert first.count("latency_hiding_scheduler") == 1
+        assert enable_collective_overlap(env, initialized=False)
+        assert env["XLA_FLAGS"] == first
+
+    def test_opt_out_and_cpu_skip(self):
+        from nos_tpu.parallel.mesh import enable_collective_overlap
+
+        assert not enable_collective_overlap(
+            self._env(JAX_PLATFORMS="tpu", NOS_TPU_NO_OVERLAP="1"),
+            initialized=False)
+        assert not enable_collective_overlap(
+            self._env(JAX_PLATFORMS="cpu"), initialized=False)
+
+    def test_too_late_after_backend_init(self):
+        from nos_tpu.parallel.mesh import enable_collective_overlap
+
+        env = self._env(JAX_PLATFORMS="tpu")
+        assert not enable_collective_overlap(env, initialized=True)
+        assert "XLA_FLAGS" not in env
 
 
 class TestShardedTrainer:
