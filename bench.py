@@ -211,6 +211,20 @@ def run_plan_microbench() -> dict:
         return {"error": f"plan bench failed: {e}"}
 
 
+def run_serving_bench() -> dict:
+    """bench_serving.py: the inference tier — serving-class p99 in
+    milliseconds, zero serving preemptions, autoscaler tracking
+    (docs/serving.md)."""
+    try:
+        from bench_serving import run_seeds
+
+        out = run_seeds(range(2))
+        out.pop("per_seed", None)   # headline JSON stays skimmable
+        return out
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        return {"error": f"serving bench failed: {e}"}
+
+
 def run_fleet_bench() -> dict:
     """bench_fleet.py: the 1024-host multi-pool fleet — sharded plan
     wall, steady-state scheduler cycle, convergence utilization
@@ -233,6 +247,7 @@ def main() -> None:
     try:
         latency = run_scenario()
         utilization = run_utilization_bench()
+        serving = run_serving_bench()
         plan = run_plan_microbench()
         packer = run_packer_microbench()
         # fleet runs LAST among the in-process benches: its convergence
@@ -258,6 +273,7 @@ def main() -> None:
             "target_s": BASELINE_S,
             "vs_baseline": round(latency / BASELINE_S, 4),
         },
+        "serving": serving,
         "plan": plan,
         "fleet": fleet,
         "packer": packer,

@@ -1,0 +1,754 @@
+"""Serving bench: the latency-SLO inference tier under bursty diurnal
+load, with batch soaking every idle chip (ROADMAP item 2, docs/serving.md).
+
+Cluster: 12 slice hosts in one v5e ICI domain (pod-0) plus 2 timeshare
+hosts — 112 chips, 1792 GB HBM.  Two inference services run through the
+REAL control plane (scheduler built by cmd/assembly.build_scheduler,
+slice + timeshare partitioners, node agents, EQ reconcilers, the
+serving replica autoscaler):
+
+    chat    slice-1x1 replicas, band [2, 12], 8 requests-in-flight each
+    embed   tpu-8gb timeshare replicas, band [1, 8], 16 each
+
+Load is a deterministic bursty diurnal request stream
+(nos_tpu/serving/trace.py): each tick the trace's requests-in-flight is
+split across the live replicas and self-reported on the replica pods
+via the nos.tpu/serving-load annotation (retry-wrapped writes); the
+autoscaler reconciles against that signal with hysteresis + cooldown.
+
+Batch (namespace `batch`, tier label absent = batch) trains 2x4/2x2
+jobs inside its quota min; a best-effort FILLER namespace keeps a
+backlog of single-chip and 8gb time-share scavengers — sized exactly
+like the serving units — that soak every idle chip while running far
+over their small min.  Those fillers are permanently over-quota-labeled
+and first in the tier-ordered victim walk, so a serving burst always
+reclaims units of the right shape in milliseconds.  Quota mins sum to
+cluster HBM (borrowing redistributes real headroom); `serve`'s min is
+its guaranteed scale-out share, larger than its typical footprint:
+
+    serve       min  640  max  896
+    batch       min  768  max 1024
+    besteffort  min  384  max 1792
+
+Falsifiable serving invariants, judged by the PR 8 SLO engine plus
+direct counters:
+
+  - schedule_latency{class=serving} p99 < 100 ms (SLOObjective target
+    0.1 s, compliance 0.99) — a serving replica binds within 1-2
+    scheduler cycles because over-quota batch is preempted on its
+    behalf (tier-aware victim ordering) onto ALREADY-CARVED units;
+  - ZERO serving pods preempted: no preemption victim ever carries the
+    serving tier (capacityscheduling excludes them; the on_preempt
+    observer convicts any exception);
+  - autoscaler tracking: replicas follow clamp(ceil(load/target))
+    within one replica for >= 90% of post-warmup samples, without
+    flapping (cooldown-bounded scale events);
+  - utilization >= 0.95 held while all of the above holds.
+
+Time is virtual (0.04 s ticks — two scheduler cycles fit under the
+100 ms serving budget); the 240 s trace runs in well under a minute of
+wall clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import time
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.elasticquota import (
+    ElasticQuota, ElasticQuotaSpec, install_quota_webhooks,
+)
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.controllers.chipagent import ChipAgent
+from nos_tpu.controllers.elasticquota.controller import (
+    ElasticQuotaReconciler,
+)
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device import default_tpu_runtime
+from nos_tpu.device.fake import FakePodResources
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import (
+    APIServer, KIND_ELASTIC_QUOTA, KIND_NODE, KIND_POD, NotFound,
+)
+from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.obs.slo import GAUGE_FLOOR, LATENCY, SLOEngine, SLOObjective
+from nos_tpu.obs.timeseries import TimeSeriesSampler
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
+from nos_tpu.quota import TPUResourceCalculator
+from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+from nos_tpu.serving import DiurnalTrace, ReplicaAutoscaler, ServingService
+from nos_tpu.testing.factory import make_slice_pod, make_timeshare_pod, make_tpu_node
+from nos_tpu.topology import V5E
+from nos_tpu.topology.profile import extract_slice_requests, extract_timeshare_requests
+from nos_tpu.utils.pod_util import workload_tier
+from nos_tpu.utils.retry import retry_on_conflict
+
+SLICE_HOSTS = 12
+TS_HOSTS = 2
+CHIPS_PER_HOST = V5E.chips_per_host          # 8
+HBM_GB = 16
+TOTAL_CHIPS = (SLICE_HOSTS + TS_HOSTS) * CHIPS_PER_HOST
+
+TICK_S = 0.04
+WARMUP_S = 40.0
+TRACE_S = 240.0
+BATCH_IDLE_S = 0.5
+BATCH_TIMEOUT_S = 2.0
+STAMP_EVERY_TICKS = 5       # load-signal refresh period (0.2 s)
+UTILIZATION_TARGET = 0.95
+SERVING_P99_TARGET_S = 0.1
+
+# Quota layout: mins sum to cluster HBM capacity (the aggregate-min
+# PreFilter gate equals physical capacity, so borrowing redistributes
+# real headroom).  `serve`'s min is its guaranteed SCALE-OUT share —
+# deliberately larger than its typical footprint; the `besteffort`
+# FILLER namespace (tier best-effort, units sized like the serving
+# replicas) soaks everything idle while running far over its small min,
+# so its pods are PERMANENTLY over-quota-labeled: a serving burst
+# always finds reclaimable victims of the right shape, first in the
+# tier-ordered victim walk (the PAPER.md ElasticQuota borrow/reclaim
+# posture, pointed at a scavenger tier).  `batch` proper (2x4/2x2
+# training jobs) sits inside its min and is rarely touched.
+QUOTAS = {
+    "serve": {"min": 640.0, "max": 896.0},
+    "batch": {"min": 768.0, "max": 1024.0},
+    "besteffort": {"min": 384.0, "max": 1792.0},
+}
+
+SERVICES = (
+    ServingService(name="chat", namespace="serve", slice_shape="1x1",
+                   min_replicas=2, max_replicas=12,
+                   target_load_per_replica=8.0,
+                   scale_up_cooldown_s=0.2, scale_down_cooldown_s=10.0,
+                   down_hysteresis=0.2),
+    ServingService(name="embed", namespace="serve", timeshare_gb=8,
+                   min_replicas=1, max_replicas=8,
+                   target_load_per_replica=16.0,
+                   scale_up_cooldown_s=0.2, scale_down_cooldown_s=12.0,
+                   down_hysteresis=0.2),
+)
+
+
+def make_traces(seed: int) -> dict[str, DiurnalTrace]:
+    """Per-service load curves: compressed diurnal period, millions of
+    users at peak, seeded bursts (distinct sub-seeds so the services'
+    bursts are uncorrelated, like real fleets)."""
+    return {
+        "serve/chat": DiurnalTrace(
+            seed=seed * 7 + 1, period_s=120.0,
+            base_users=400_000.0, peak_users=3_200_000.0,
+            requests_per_user_per_s=2e-5, service_time_s=0.5,
+            burst_rate_per_s=1.0 / 40.0, burst_multiplier=3.0,
+            burst_duration_s=8.0),
+        "serve/embed": DiurnalTrace(
+            seed=seed * 7 + 2, period_s=150.0, phase_s=60.0,
+            base_users=800_000.0, peak_users=4_800_000.0,
+            requests_per_user_per_s=1e-5, service_time_s=1.0,
+            burst_rate_per_s=1.0 / 55.0, burst_multiplier=2.5,
+            burst_duration_s=10.0),
+    }
+
+
+# Workload mixes.  Batch proper trains on 2x4/2x2 slices inside its
+# quota min; the best-effort FILLERS are sized exactly like the serving
+# units (1x1 slices, 8gb time-share — ONE unit economy, so no
+# device-plugin re-provision ever sits on the serving hot path) and
+# their namespace runs far over its min: always labeled over-quota,
+# always reclaimable, first in the tier-ordered victim walk.
+BATCH_SLICE_MIX = [("2x4", 2.0), ("2x2", 2.0)]
+BESTEFFORT_MIX = [("1x1", 1.0)]
+BESTEFFORT_TS_MIX = [(8, 1.0)]
+BATCH_TARGET_CHIPS = 20.0       # pending batch chip-equivalents
+BESTEFFORT_TARGET = 28.0        # pending filler chip-equivalents
+BESTEFFORT_TS_TARGET = 8.0
+DURATION_S = {"batch": (20.0, 45.0), "besteffort": (8.0, 20.0)}
+TS_DURATION_S = (12.0, 30.0)
+
+SLO_FAST_WINDOW_S = 10.0
+SLO_SLOW_WINDOW_S = 40.0
+# the smoke run drops this to 1: its shortened trace sees only a
+# handful of serving binds per window, and the gate must judge a REAL
+# verdict (value populated), not a vacuous not-yet-observable one
+SERVING_MIN_EVENTS = 5
+
+
+def slo_objectives() -> list[SLOObjective]:
+    return [
+        # THE serving promise: p99 schedule latency in milliseconds.
+        SLOObjective(name="serving-schedule-latency", kind=LATENCY,
+                     metric="nos_tpu_schedule_latency_seconds",
+                     target=SERVING_P99_TARGET_S,
+                     labels={"class": "serving"},
+                     compliance=0.99, quantile=0.99,
+                     min_events=SERVING_MIN_EVENTS),
+        # batch classes keep their (much looser) per-class envelope
+        SLOObjective(name="schedule-latency", kind=LATENCY,
+                     metric="nos_tpu_schedule_latency_seconds",
+                     target=60.0, each_label="class", compliance=0.9,
+                     min_events=5),
+        SLOObjective(name="utilization-floor", kind=GAUGE_FLOOR,
+                     metric="nos_tpu_cluster_utilization",
+                     target=0.5, compliance=0.9),
+    ]
+
+
+def percentile(xs, q: float, digits: int):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+
+def chip_equiv(pod) -> float:
+    req = pod_request(pod)
+    chips = sum(min(s.chips, CHIPS_PER_HOST) * q
+                for s, q in extract_slice_requests(req).items())
+    gb = sum(g * q for g, q in extract_timeshare_requests(req).items())
+    return chips + gb / HBM_GB
+
+
+class Job:
+    def __init__(self, name: str, namespace: str, pod: str,
+                 duration: float, created: float) -> None:
+        self.name = name
+        self.namespace = namespace
+        self.pod = pod
+        self.duration = duration
+        self.created = created
+        self.bound_at: float | None = None
+
+
+class Sim:
+    def __init__(self, seed: int = 0) -> None:
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.now = [0.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        api = self.api = APIServer()
+        state = ClusterState()
+        install_quota_webhooks(api)
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        self.slice_ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock)
+        self.slice_ctl.bind()
+        self.ts_ctl = new_timeshare_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock)
+        self.ts_ctl.bind()
+
+        self.calculator = TPUResourceCalculator(
+            HBM_GB, chips_per_host=CHIPS_PER_HOST)
+        for ns, q in QUOTAS.items():
+            api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+                metadata=ObjectMeta(name=ns, namespace=ns),
+                spec=ElasticQuotaSpec(
+                    min={C.RESOURCE_TPU_MEMORY: q["min"]},
+                    max={C.RESOURCE_TPU_MEMORY: q["max"]})))
+        self.eq_reconciler = ElasticQuotaReconciler(api, self.calculator)
+
+        self.agents: dict[str, object] = {}
+        for h in range(SLICE_HOSTS):
+            name = f"host-{h}"
+            api.create(KIND_NODE, make_tpu_node(
+                name, pod_id="pod-0", host_index=h))
+            agent = SliceAgent(api, name, default_tpu_runtime(V5E),
+                               FakePodResources())
+            agent.start()
+            self.agents[name] = agent
+        for t in range(TS_HOSTS):
+            name = f"ts-{t}"
+            api.create(KIND_NODE, make_tpu_node(
+                name, partitioning="timeshare", pod_id="", host_index=t))
+            agent = ChipAgent(api, name)
+            agent.start()
+            self.agents[name] = agent
+
+        # preempt budget 4: a burst can ask for several replicas in one
+        # cycle, and each unschedulable replica spends one PostFilter
+        self.scheduler = build_scheduler(
+            api, HBM_GB, shard_chips_per_host=CHIPS_PER_HOST,
+            preempt_budget_per_cycle=4, clock=clock)
+        self.autoscaler = ReplicaAutoscaler(api, SERVICES, clock=clock)
+        self.traces = make_traces(seed)
+        self.slo_engine = SLOEngine(
+            TimeSeriesSampler(clock=clock, maxlen=4096),
+            slo_objectives(),
+            fast_window_s=SLO_FAST_WINDOW_S,
+            slow_window_s=SLO_SLOW_WINDOW_S, clock=clock)
+        self.capacity: CapacityScheduling = next(
+            p for p in self.scheduler._framework.plugins
+            if isinstance(p, CapacityScheduling))
+        self.capacity.on_preempt = self._on_preempt
+
+        self.jobs: dict[str, Job] = {}
+        self._job_seq = 0
+        self._pod_job: dict[str, Job] = {}
+        # serving bookkeeping
+        self.serving_latencies: list[float] = []
+        self._serving_seen: set[str] = set()
+        self.serving_preempted = 0
+        self.preemptions = 0
+        self.preempted_pods = 0
+        self.replica_series: dict[str, list[tuple[float, float, int, int]]] = {
+            svc.key: [] for svc in SERVICES}
+        self.batch_latencies: list[float] = []
+        self.cycle_wall_ms: list[float] = []
+        self._util_area = 0.0
+        self._util_time = 0.0
+        self._batch_util_area = 0.0
+        self.completed = 0
+        # every spawned batch pod's request, cached for honest requeue
+        self._job_requests: dict[str, dict] = {}
+        self.api.watch(KIND_POD, self._cache_request)
+
+    # -- observers ----------------------------------------------------------
+    def _on_preempt(self, preemptor, victims) -> None:
+        self.preemptions += 1
+        self.preempted_pods += len(victims)
+        for v in victims:
+            if workload_tier(v) == C.TIER_SERVING:
+                self.serving_preempted += 1
+
+    # -- batch trace --------------------------------------------------------
+    def _spawn_job(self, ns: str, kind: str, arg, lo: float, hi: float,
+                   tier: str = "") -> float:
+        self._job_seq += 1
+        name = f"{ns}-j{self._job_seq}"
+        labels = {C.LABEL_TIER: tier} if tier else None
+        if kind == "ts":
+            pod = make_timeshare_pod(arg, 1, name=name, namespace=ns,
+                                     labels=labels,
+                                     creation_timestamp=self.now[0])
+        else:
+            pod = make_slice_pod(arg, 1, name=name, namespace=ns,
+                                 labels=labels,
+                                 creation_timestamp=self.now[0])
+        self.api.create(KIND_POD, pod)
+        job = Job(name, ns, name, self.rng.uniform(lo, hi), self.now[0])
+        self.jobs[name] = job
+        self._pod_job[name] = job
+        return chip_equiv(pod)
+
+    def _spawn(self) -> None:
+        backlog = {"batch": 0.0, "besteffort": 0.0, "besteffort-ts": 0.0}
+        for p in self.api.list(KIND_POD):
+            if p.spec.node_name or p.metadata.namespace not in (
+                    "batch", "besteffort"):
+                continue
+            req = pod_request(p)
+            key = p.metadata.namespace
+            if key == "besteffort" and extract_timeshare_requests(req):
+                key = "besteffort-ts"
+            backlog[key] += chip_equiv(p)
+        lo, hi = DURATION_S["batch"]
+        while backlog["batch"] < BATCH_TARGET_CHIPS:
+            shape = self.rng.choices(
+                [m[0] for m in BATCH_SLICE_MIX],
+                [m[1] for m in BATCH_SLICE_MIX])[0]
+            backlog["batch"] += self._spawn_job(
+                "batch", "slice", shape, lo, hi)
+        be_lo, be_hi = DURATION_S["besteffort"]
+        while backlog["besteffort"] < BESTEFFORT_TARGET:
+            shape = self.rng.choices(
+                [m[0] for m in BESTEFFORT_MIX],
+                [m[1] for m in BESTEFFORT_MIX])[0]
+            backlog["besteffort"] += self._spawn_job(
+                "besteffort", "slice", shape, be_lo, be_hi,
+                tier=C.TIER_BEST_EFFORT)
+        ts_lo, ts_hi = TS_DURATION_S
+        while backlog["besteffort-ts"] < BESTEFFORT_TS_TARGET:
+            gb = self.rng.choices(
+                [m[0] for m in BESTEFFORT_TS_MIX],
+                [m[1] for m in BESTEFFORT_TS_MIX])[0]
+            backlog["besteffort-ts"] += self._spawn_job(
+                "besteffort", "ts", gb, ts_lo, ts_hi,
+                tier=C.TIER_BEST_EFFORT)
+
+    def _complete_finished(self) -> None:
+        for job in list(self.jobs.values()):
+            if job.bound_at is None \
+                    or self.now[0] < job.bound_at + job.duration:
+                continue
+            try:
+                self.api.delete(KIND_POD, job.pod, job.namespace)
+            except NotFound:
+                pass
+            self._pod_job.pop(job.pod, None)
+            del self.jobs[job.name]
+            self.completed += 1
+
+    def _requeue_evicted(self) -> None:
+        """Preempted batch/best-effort jobs requeue from scratch with
+        their ORIGINAL creation timestamps (honest latency accounting,
+        exactly as bench_utilization does)."""
+        live = {p.metadata.name for p in self.api.list(KIND_POD)}
+        for job in self.jobs.values():
+            if job.pod in live:
+                continue
+            job.bound_at = None
+            pod = self._requeued_pod(job)
+            if pod is not None:
+                self.api.create(KIND_POD, pod)
+
+    def _requeued_pod(self, job: Job):
+        """Rebuild a victim's pod from the request cached at spawn
+        (same name/namespace/ORIGINAL timestamp: its eventual latency
+        includes the wasted run)."""
+        req = self._job_requests.get(job.pod)
+        if req is None:
+            return None
+        from nos_tpu.kube.objects import Container, Pod, PodSpec, PodStatus
+
+        labels = ({C.LABEL_TIER: C.TIER_BEST_EFFORT}
+                  if job.namespace == "besteffort" else {})
+        return Pod(
+            metadata=ObjectMeta(name=job.pod, namespace=job.namespace,
+                                labels=labels,
+                                creation_timestamp=job.created),
+            spec=PodSpec(containers=[Container(resources=dict(req))]),
+            status=PodStatus(phase=PENDING))
+
+    # -- serving ------------------------------------------------------------
+    def _stamp_loads(self) -> None:
+        """Split each service's requests-in-flight across its live
+        replicas and self-report via the load annotation (retry-wrapped
+        writes — the downward-API pattern)."""
+        for svc in SERVICES:
+            demand = self.traces[svc.key].load_at(self.now[0])
+            replicas = self.api.list(
+                KIND_POD, namespace=svc.namespace,
+                label_selector={C.LABEL_SERVICE: svc.name},
+                filter_fn=lambda p: p.status.phase in (PENDING, RUNNING))
+            if not replicas:
+                continue
+            share = demand / len(replicas)
+
+            def mutate(p) -> None:
+                p.metadata.annotations[C.ANNOT_SERVING_LOAD] = \
+                    f"{share:.3f}"
+            for p in replicas:
+                try:
+                    retry_on_conflict(self.api, KIND_POD,
+                                      p.metadata.name, mutate,
+                                      p.metadata.namespace,
+                                      component="serving-load")
+                except NotFound:
+                    pass        # scaled down mid-stamp
+
+    def _record_serving_binds(self) -> None:
+        for svc in SERVICES:
+            for p in self.api.list(
+                    KIND_POD, namespace=svc.namespace,
+                    label_selector={C.LABEL_SERVICE: svc.name}):
+                if not p.spec.node_name \
+                        or p.metadata.name in self._serving_seen:
+                    continue
+                self._serving_seen.add(p.metadata.name)
+                if self.now[0] < WARMUP_S:
+                    # cold-start provisioning (the first carve of an
+                    # empty cluster) is not a serving-SLO event — the
+                    # SLO engine's windows start at warmup too
+                    continue
+                self.serving_latencies.append(
+                    self.now[0] - p.metadata.creation_timestamp)
+
+    def _record_batch_binds(self) -> None:
+        bound = {p.metadata.name for p in self.api.list(KIND_POD)
+                 if p.spec.node_name and p.status.phase == RUNNING}
+        for job in self.jobs.values():
+            if job.bound_at is None and job.pod in bound:
+                job.bound_at = self.now[0]
+                self.batch_latencies.append(self.now[0] - job.created)
+
+    def _track_replicas(self) -> None:
+        for svc in SERVICES:
+            load = self.traces[svc.key].load_at(self.now[0])
+            desired = min(svc.max_replicas, max(
+                svc.min_replicas,
+                math.ceil(load / svc.target_load_per_replica)))
+            live = len(self.api.list(
+                KIND_POD, namespace=svc.namespace,
+                label_selector={C.LABEL_SERVICE: svc.name},
+                filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
+            self.replica_series[svc.key].append(
+                (round(self.now[0], 2), round(load, 2), live, desired))
+
+    def _sample_utilization(self) -> None:
+        used = serving_used = 0.0
+        for p in self.api.list(KIND_POD):
+            if p.spec.node_name and p.status.phase == RUNNING:
+                eq = chip_equiv(p)
+                used += eq
+                if p.metadata.namespace == "serve":
+                    serving_used += eq
+        utilization = min(1.0, used / TOTAL_CHIPS)
+        REGISTRY.set("nos_tpu_cluster_utilization", utilization)
+        if self.now[0] < WARMUP_S:
+            return
+        self._util_area += utilization * TICK_S
+        self._batch_util_area += min(
+            1.0, (used - serving_used) / TOTAL_CHIPS) * TICK_S
+        self._util_time += TICK_S
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> dict:
+        tick = 0
+        while self.now[0] < TRACE_S:
+            tick += 1
+            self.now[0] += TICK_S
+            self._complete_finished()
+            self._spawn()
+            if tick % STAMP_EVERY_TICKS == 1:
+                self._stamp_loads()
+            self.autoscaler.reconcile()
+            t0 = time.perf_counter()
+            self.scheduler.run_cycle()
+            self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
+            self._requeue_evicted()
+            self.slice_ctl.process_if_ready()
+            self.ts_ctl.process_if_ready()
+            for a in list(self.agents.values()):
+                a.tick()
+            self.eq_reconciler.reconcile_all()
+            self._record_serving_binds()
+            self._record_batch_binds()
+            if tick % STAMP_EVERY_TICKS == 0:
+                self._track_replicas()
+            self._sample_utilization()
+            if self.now[0] >= WARMUP_S:
+                self.slo_engine.tick()
+        return self._report()
+
+    def _cache_request(self, event: str, pod) -> None:
+        if event == "ADDED" and pod.metadata.namespace in (
+                "batch", "besteffort"):
+            self._job_requests[pod.metadata.name] = pod_request(pod)
+
+    def _tracking_stats(self) -> dict:
+        out: dict[str, dict] = {}
+        for svc in SERVICES:
+            rows = [r for r in self.replica_series[svc.key]
+                    if r[0] >= WARMUP_S]
+            if not rows:
+                out[svc.key] = {"samples": 0}
+                continue
+            # "keeps up with demand": live >= desired - 1.  Running
+            # ABOVE desired is the scale-down cooldown doing its job
+            # (SLO-safe over-provision), not a tracking failure; the
+            # direction-change count guards flapping separately.
+            within = sum(1 for _, _, live, desired in rows
+                         if live >= desired - 1)
+            flips = 0
+            last_dir = 0
+            prev = rows[0][2]
+            for _, _, live, _ in rows[1:]:
+                d = (live > prev) - (live < prev)
+                if d and last_dir and d != last_dir:
+                    flips += 1
+                if d:
+                    last_dir = d
+                prev = live
+            out[svc.key] = {
+                "samples": len(rows),
+                "within_one": round(within / len(rows), 4),
+                "direction_changes": flips,
+                "replicas_min": min(r[2] for r in rows),
+                "replicas_max": max(r[2] for r in rows),
+                "load_min": min(r[1] for r in rows),
+                "load_max": max(r[1] for r in rows),
+            }
+        return out
+
+    def _report(self) -> dict:
+        pct = percentile
+        lat_ms = [x * 1e3 for x in self.serving_latencies]
+        return {
+            "seed": self.seed,
+            "trace_seconds": TRACE_S,
+            "utilization_pct": round(
+                self._util_area / self._util_time, 4)
+            if self._util_time else 0.0,
+            "batch_utilization_pct": round(
+                self._batch_util_area / self._util_time, 4)
+            if self._util_time else 0.0,
+            "serving": {
+                "binds": len(self.serving_latencies),
+                "p50_ms": pct(lat_ms, 0.50, 2),
+                "p99_ms": pct(lat_ms, 0.99, 2),
+                "max_ms": (round(max(lat_ms), 2) if lat_ms else None),
+                "preempted": self.serving_preempted,
+                "tracking": self._tracking_stats(),
+            },
+            "batch": {
+                "jobs_completed": self.completed,
+                "p50_schedule_latency_s": pct(self.batch_latencies,
+                                              0.50, 3),
+                "p90_schedule_latency_s": pct(self.batch_latencies,
+                                              0.90, 3),
+                "preemptions": self.preemptions,
+                "preempted_pods": self.preempted_pods,
+            },
+            "scheduler_cycle_wall_ms_p50": pct(self.cycle_wall_ms,
+                                               0.50, 2),
+            "scheduler_cycle_wall_ms_p99": pct(self.cycle_wall_ms,
+                                               0.99, 2),
+            "slo": self.slo_engine.report(),
+        }
+
+
+def run_seeds(seeds=range(3)) -> dict:
+    runs = [Sim(seed=s).run() for s in seeds]
+    lat_ms: list[float] = []
+    serving_binds = sum(r["serving"]["binds"] for r in runs)
+    utils = [r["utilization_pct"] for r in runs]
+    slo_verdicts = []
+    for r in runs:
+        for v in r["slo"]["verdicts"]:
+            slo_verdicts.append({**v, "seed": r["seed"]})
+    # pooled p99 across seeds from the per-seed p99s is wrong; keep the
+    # per-seed maxima honest instead
+    p99s = [r["serving"]["p99_ms"] for r in runs
+            if r["serving"]["p99_ms"] is not None]
+    first = runs[0]
+    return {
+        "seeds": [r["seed"] for r in runs],
+        "trace_seconds": first["trace_seconds"],
+        "utilization_pct": round(sum(utils) / len(utils), 4),
+        "utilization_min": round(min(utils), 4),
+        "vs_utilization_target": round(
+            (sum(utils) / len(utils)) / UTILIZATION_TARGET, 4),
+        "serving": {
+            "binds": serving_binds,
+            "p99_ms_per_seed": p99s,
+            "p99_ms_worst": max(p99s) if p99s else None,
+            "p99_target_ms": SERVING_P99_TARGET_S * 1e3,
+            "preempted": sum(r["serving"]["preempted"] for r in runs),
+            "tracking": {r["seed"]: r["serving"]["tracking"]
+                         for r in runs},
+        },
+        "batch": {
+            "jobs_completed": sum(r["batch"]["jobs_completed"]
+                                  for r in runs),
+            "preemptions": sum(r["batch"]["preemptions"] for r in runs),
+            "preempted_pods": sum(r["batch"]["preempted_pods"]
+                                  for r in runs),
+        },
+        "scheduler_cycle_wall_ms_p99": max(
+            r["scheduler_cycle_wall_ms_p99"] for r in runs),
+        "slo": {
+            "fast_window_s": first["slo"]["fast_window_s"],
+            "slow_window_s": first["slo"]["slow_window_s"],
+            "burn_threshold": first["slo"]["burn_threshold"],
+            "objectives": first["slo"]["objectives"],
+            "verdicts": slo_verdicts,
+            "breaches": sum(1 for v in slo_verdicts if v["breached"]),
+        },
+        "per_seed": runs,
+    }
+
+
+def run_smoke() -> dict:
+    """The serving regression gate (scripts/check.sh): one seed on a
+    shortened trace.  Asserts the serving plane END TO END — the
+    serving class's bucket series on /metrics, an SLO verdict for the
+    millisecond objective, ZERO serving preemption victims, the
+    autoscaler tracking its signal, and the wall bound.  Raises
+    AssertionError on regression."""
+    global TRACE_S, WARMUP_S, SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S, \
+        SERVING_MIN_EVENTS
+    prev = (TRACE_S, WARMUP_S, SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S,
+            SERVING_MIN_EVENTS)
+    TRACE_S, WARMUP_S = 90.0, 30.0
+    # windows wide (and min_events low) enough that the shortened
+    # trace's serving binds produce a JUDGED verdict with a real value
+    SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S = 15.0, 45.0
+    SERVING_MIN_EVENTS = 1
+    t0 = time.perf_counter()
+    try:
+        sim = Sim(seed=0)
+        result = sim.run()
+    finally:
+        (TRACE_S, WARMUP_S, SLO_FAST_WINDOW_S, SLO_SLOW_WINDOW_S,
+         SERVING_MIN_EVENTS) = prev
+    wall = time.perf_counter() - t0
+
+    serving = result["serving"]
+    assert serving["binds"] > 0, "no serving replicas ever bound"
+    assert serving["preempted"] == 0, \
+        f"{serving['preempted']} serving pod(s) were preemption victims"
+    assert serving["p99_ms"] is not None \
+        and serving["p99_ms"] < SERVING_P99_TARGET_S * 1e3, \
+        f"serving p99 {serving['p99_ms']} ms >= 100 ms"
+    render = REGISTRY.render()
+    assert 'nos_tpu_schedule_latency_seconds_bucket{class="serving"' \
+        in render, "/metrics missing the serving-class bucket series"
+    verdicts = result["slo"]["verdicts"]
+    ms_verdicts = [v for v in verdicts
+                   if v["objective"] == "serving-schedule-latency"]
+    assert ms_verdicts, "no verdict for the serving millisecond SLO"
+    for v in ms_verdicts:
+        for field in ("burn_fast", "burn_slow", "budget_remaining",
+                      "breached", "target"):
+            assert field in v, f"verdict missing {field}: {v}"
+        assert not v["breached"], f"serving SLO breached in smoke: {v}"
+    # the gate must judge REAL events: a verdict whose value never
+    # populated (windows unobservable) would make the breach assert
+    # above vacuously green no matter what the engine does
+    assert any(v["value"] is not None for v in ms_verdicts), \
+        f"serving SLO verdict never judged real events: {ms_verdicts[-1]}"
+    for svc_key, stats in serving["tracking"].items():
+        assert stats.get("samples", 0) > 0, f"no tracking samples: {svc_key}"
+        assert stats["within_one"] >= 0.9, \
+            f"{svc_key} tracked within one replica only " \
+            f"{stats['within_one']:.0%} of samples"
+    assert wall < 300.0, f"smoke trace took {wall:.1f}s (> 300s bound)"
+    return {
+        "smoke": "ok",
+        "wall_s": round(wall, 1),
+        "serving_binds": serving["binds"],
+        "serving_p99_ms": serving["p99_ms"],
+        "serving_preempted": serving["preempted"],
+        "utilization_pct": result["utilization_pct"],
+        "tracking": serving["tracking"],
+        "slo": result["slo"],
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="serving-tier SLO + autoscaler bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed shortened-trace serving gate")
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--serving-report", default="",
+                    help="also write the serving+SLO block to this "
+                         "file (CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_seeds(range(args.seeds))
+    if args.serving_report:
+        with open(args.serving_report, "w", encoding="utf-8") as fh:
+            json.dump({k: v for k, v in out.items()
+                       if k != "per_seed"}, fh, indent=2)
+        print(f"serving report written to {args.serving_report}",
+              file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

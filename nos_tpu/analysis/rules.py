@@ -123,9 +123,14 @@ class InjectableClock(Rule):
     title = "raw clock call in deterministic code (inject a clock)"
     # obs/ is in scope: span/journal timestamps must come from the
     # tracer's/journal's injectable clock or chaos seeds stop
-    # reproducing byte-identical flight recordings
+    # reproducing byte-identical flight recordings.  serving/ likewise:
+    # the autoscaler's cooldown clocks and the trace generator run
+    # under the virtual bench clock, and a raw time.time() would both
+    # break seed reproducibility and mis-measure cooldowns against
+    # pod creation timestamps stamped from the injected clock.
     scope = ("nos_tpu/controllers/", "nos_tpu/obs/",
-             "nos_tpu/partitioning/", "nos_tpu/scheduler/")
+             "nos_tpu/partitioning/", "nos_tpu/scheduler/",
+             "nos_tpu/serving/")
 
     BANNED_DOTTED = frozenset({
         "time.time", "time.time_ns", "time.sleep",

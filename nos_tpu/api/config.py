@@ -214,6 +214,39 @@ class OperatorConfig(ManagerConfig):
 
 
 @dataclasses.dataclass
+class AutoscalerConfig(ManagerConfig):
+    """serving replica-autoscaler main config (nos_tpu/serving).  The
+    `services` list holds one mapping per autoscaled inference service
+    (keys = ServingService fields: name, namespace, slice_shape |
+    timeshare_gb, min/max_replicas, target_load_per_replica, cooldowns,
+    down_hysteresis, priority); each entry is validated through
+    ServingService itself so chart/config and code cannot drift."""
+
+    reconcile_interval_s: float = 0.5
+    status_configmap: str = "nos-tpu-autoscaler-status"
+    status_namespace: str = "nos-tpu-system"
+    services: list = dataclasses.field(default_factory=list)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.reconcile_interval_s <= 0:
+            raise ConfigError("reconcile_interval_s must be positive")
+        if not self.status_configmap:
+            raise ConfigError("status_configmap is required")
+        if not isinstance(self.services, list):
+            raise ConfigError("services must be a list of mappings")
+        from nos_tpu.serving.autoscaler import ServingService
+
+        for i, raw in enumerate(self.services):
+            if not isinstance(raw, dict):
+                raise ConfigError(f"services[{i}] must be a mapping")
+            try:
+                ServingService.from_mapping(raw)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"services[{i}]: {e}") from e
+
+
+@dataclasses.dataclass
 class AgentConfig(ManagerConfig):
     """sliceagent / chipagent config (MigAgentConfig/GpuAgentConfig
     analog: report interval; node identity comes from the downward API in

@@ -63,6 +63,27 @@ LABEL_DEVICE_PLUGIN_CONFIG = f"{GROUP}/device-plugin.config"
 # all-or-nothing (new; no reference analog — SURVEY.md §2.8).
 LABEL_POD_GROUP = f"{GROUP}/pod-group"
 
+# Workload tier — the serving-plane contract (docs/serving.md).  Three
+# values; absent/unknown reads as "batch" (the historical default: every
+# pre-tier workload was batch/training-shaped):
+#   serving      latency-SLO inference traffic: scheduled FIRST each
+#                cycle, NEVER selected as a preemption victim;
+#   batch        training/batch jobs: may borrow idle quota over-min and
+#                be reclaimed (preempted) while over-quota;
+#   best-effort  scavenger work: scheduled last, first in the victim
+#                walk.
+# The ElasticQuota borrow/reclaim machinery (PAPER.md §ElasticQuota)
+# supplies the WHAT of reclamation; this label supplies the WHO-first.
+LABEL_TIER = f"{GROUP}/tier"
+TIER_SERVING = "serving"
+TIER_BATCH = "batch"
+TIER_BEST_EFFORT = "best-effort"
+
+# Serving service identity: every replica pod of one inference service
+# carries this label; the replica autoscaler (nos_tpu/serving) groups,
+# counts and scales by it.
+LABEL_SERVICE = f"{GROUP}/service"
+
 # ---------------------------------------------------------------------------
 # Annotations
 # ---------------------------------------------------------------------------
@@ -133,6 +154,14 @@ ANNOT_MESH = f"{GROUP}/mesh"
 # nothing — and spares near-done stragglers entirely (they drain the window
 # for free by completing).  Absent = 0 (nothing to lose).
 ANNOT_JOB_PROGRESS = f"{GROUP}/job-progress"
+
+# Requests-in-flight load signal for a serving replica, self-reported by
+# the replica (the downward-API annotation pattern ANNOT_JOB_PROGRESS
+# established: the workload stamps its own pod, the control plane reads).
+# The replica autoscaler sums the signal across a service's live replicas
+# and scales toward target_load_per_replica (nos_tpu/serving/autoscaler).
+# Absent/garbage = 0 (an unreporting replica claims no load).
+ANNOT_SERVING_LOAD = f"{GROUP}/serving-load"
 
 # Reported device-plugin generation for timeshare nodes: replaces the
 # reference's blind time.Sleep(devicePluginDelaySeconds)

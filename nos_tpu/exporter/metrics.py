@@ -229,6 +229,20 @@ class Registry:
         """with REGISTRY.time("nos_tpu_plan_seconds"): ..."""
         return _Timer(self, name, labels)
 
+    def gauge_label_values(self, name: str, key: str) -> list[str]:
+        """Distinct values of label ``key`` across ``name``'s EXISTING
+        gauge series.  Publishers that derive per-label gauges from live
+        state (the scheduler's pending-by-class gauges) use this at
+        observe time to find series that must reset to 0 because their
+        label value vanished from the live set — in-memory bookkeeping
+        of "classes I once published" goes stale across publisher
+        restarts and skipped publishes, while the registry's own series
+        list cannot."""
+        with self._lock:
+            values = {dict(labels).get(key)
+                      for (n, labels) in self._gauges if n == name}
+        return sorted(v for v in values if v is not None)
+
     def reset_window(self) -> None:
         """Start a new max window: zero every histogram's windowed max
         (the `<name>_max` gauge semantics — see the module docstring).

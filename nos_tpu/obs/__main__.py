@@ -131,6 +131,42 @@ def cmd_slo(payload: dict) -> int:
     return 0
 
 
+def _print_tier_rows(pending: dict, block: dict | None) -> None:
+    """Per-tier scoreboard rows (serving / batch / best-effort):
+    pending pods, worst schedule-latency p99 and the smallest SLO
+    budget remaining among the tier's classes — the one-shot view that
+    answers "is the protected tier healthy and who is waiting behind
+    it" (docs/serving.md)."""
+    from nos_tpu.utils.pod_util import class_tier
+
+    pend_by_tier: dict[str, int] = {}
+    for cls, n in pending.items():
+        tier = class_tier(cls)
+        pend_by_tier[tier] = pend_by_tier.get(tier, 0) + n
+    p99: dict[str, float | None] = {}
+    budget: dict[str, float | None] = {}
+    breached: dict[str, bool] = {}
+    for v in (block or {}).get("verdicts", []):
+        if v.get("metric") != "nos_tpu_schedule_latency_seconds":
+            continue
+        tier = class_tier(str(v.get("class") or ""))
+        val, rem = v.get("value"), v.get("budget_remaining")
+        if val is not None and (p99.get(tier) is None
+                                or val > p99[tier]):
+            p99[tier] = val
+        if rem is not None and (budget.get(tier) is None
+                                or rem < budget[tier]):
+            budget[tier] = rem
+        breached[tier] = breached.get(tier, False) \
+            or bool(v.get("breached"))
+    print("tier           pending  p99(s)  budget")
+    for tier in ("serving", "batch", "best-effort"):
+        state = " [BREACH]" if breached.get(tier) else ""
+        print(f"  {tier:<12} {pend_by_tier.get(tier, 0):>7} "
+              f"{_fmt(p99.get(tier), 3):>7} "
+              f"{_fmt(budget.get(tier)):>7}{state}")
+
+
 def cmd_top(payload: dict) -> int:
     """One-shot fleet scoreboard from a /snapshot payload: utilization,
     per-pool fragmentation, pending-by-class, SLO budget remaining."""
@@ -203,6 +239,7 @@ def cmd_top(payload: dict) -> int:
     else:
         print("pending by class: none")
     block = _find_slo_block(payload)
+    _print_tier_rows(pending, block)
     if block is not None and block.get("verdicts"):
         print("SLO budget remaining:")
         for v in block["verdicts"]:

@@ -73,6 +73,7 @@ QUARANTINE_RELEASED = "quarantine-released"
 HANDSHAKE_WAIT = "handshake-wait"
 SLO_BREACH = "slo-breach"
 SLO_RECOVERED = "slo-recovered"
+AUTOSCALE = "autoscale"
 
 
 class DecisionRecord:

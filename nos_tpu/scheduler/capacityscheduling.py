@@ -39,7 +39,7 @@ from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalcul
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status,
 )
-from nos_tpu.utils.pod_util import is_over_quota
+from nos_tpu.utils.pod_util import is_over_quota, tier_rank, workload_tier
 
 logger = logging.getLogger(__name__)
 
@@ -476,10 +476,26 @@ class CapacityScheduling:
             self.add_pod(wstate, pod, p, ni)
 
         potential: list[Pod] = []
-        # Walk victims lowest-priority first (reference sorts ascending :516).
+        # Tier-aware victim ordering (docs/serving.md): IN-QUOTA
+        # serving pods are never victims — the tier's latency promise
+        # would be worthless if an over-quota borrow could reclaim a
+        # live inference replica.  A serving pod whose namespace is
+        # itself borrowing beyond its min (over-quota label) stays
+        # reclaimable like any other borrower: the quota guarantee
+        # outranks the tier shield, or a self-applied tier label would
+        # capture a lender's min forever (the band-fits-in-min posture
+        # in docs/serving.md is what keeps real replicas in-quota).
+        # Among the preemptible pods the walk takes best-effort
+        # scavengers before batch before (over-quota) serving, then
+        # lowest priority first (reference sorts ascending :516).
+        # Excluding in-quota serving only NARROWS selection, so
+        # victim_prescreen's superset contract is untouched.
         node_pods = sorted(
-            ni.pods, key=lambda p: (p.spec.priority,
-                                    -p.metadata.creation_timestamp))
+            (p for p in ni.pods
+             if workload_tier(p) != C.TIER_SERVING
+             or is_over_quota(p)),
+            key=lambda p: (-tier_rank(p), p.spec.priority,
+                           -p.metadata.creation_timestamp))
         if preemptor_info is not None:
             more_than_min = preemptor_info.used_over_min_with(nominated_in_eq)
             for pv in node_pods:
@@ -565,7 +581,13 @@ class CapacityScheduling:
                 return False
             return True
 
-        by_prio = lambda p: (-p.spec.priority,  # noqa: E731
+        # Reprieve order is the WALK order inverted: candidates from
+        # the most-protected remaining tier (batch before best-effort)
+        # and highest priority get their capacity back first, so the
+        # victims that actually die are the scavengers — without the
+        # tier key here the reprieve pass silently undoes the
+        # tier-ordered walk above.
+        by_prio = lambda p: (tier_rank(p), -p.spec.priority,  # noqa: E731
                              p.metadata.creation_timestamp)
         for pv in sorted(violating, key=by_prio):
             if not reprieve(pv):

@@ -26,6 +26,7 @@ from nos_tpu.api.constants import (
     LABEL_POD_ID as C_LABEL_POD_ID,
     LABEL_UNSCHEDULABLE_CLASS as C_LABEL_UNSCHEDULABLE_CLASS,
     RESOURCE_TPU,
+    TIER_SERVING as C_TIER_SERVING,
 )
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod, fast_deepcopy
@@ -45,7 +46,7 @@ from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
 from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
-from nos_tpu.utils.pod_util import workload_class
+from nos_tpu.utils.pod_util import tier_rank, workload_class, workload_tier
 from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
@@ -243,9 +244,6 @@ class Scheduler:
         # per cycle at fleet scale.  Lives and dies with the cycle
         # snapshot; assume() marks the bound host busy in place.
         self._busy_map_cache: dict[tuple[str, int], bool] | None = None
-        # Workload classes with a live pending gauge (so a drained
-        # class's gauges reset to 0 instead of freezing)
-        self._pending_classes: set[str] = set()
         # True while run_cycle drives the entry points: the cycle
         # snapshot is shared across its pods.  Direct schedule_one/
         # schedule_gang calls (public entry points) drop it on exit so
@@ -342,12 +340,40 @@ class Scheduler:
         if seeded:
             obs_bump("prescreen_fails", seeded)
 
-    def _schedule_one(self, pod: Pod) -> str | None:
-        obs_bump("schedule_one")
+    def _preempt_then_retry(self, state: CycleState, pod: Pod,
+                            lister: SharedLister) -> tuple[bool, str | None]:
+        """PostFilter, then — on success — ONE immediate re-placement
+        attempt.  On the in-memory substrate evictions are synchronous
+        deletes, so the victims' capacity is genuinely free right now;
+        without the retry the preemptor leaves the cycle merely
+        *nominated* and lower-tier pods later in the SAME cycle bind
+        into the space it just cleared (the PodNominator race — a
+        serving replica could preempt every cycle forever while batch
+        fillers ate each freed unit).  Against a real apiserver victims
+        terminate gracefully, the retry finds no fit, and behavior
+        falls back to plain nomination.  Returns (handled, node):
+        handled=False means no preemption happened and the caller
+        proceeds to its unschedulable path."""
+        nominated, post = self._post_filter_budgeted(state, pod, lister)
+        if not (post.is_success and nominated):
+            return False, None
+        placed = self._schedule_one(pod, allow_preempt=False)
+        if placed is None:
+            self._nominate(pod, nominated)
+        return True, placed
+
+    def _schedule_one(self, pod: Pod,
+                      allow_preempt: bool = True) -> str | None:
+        if allow_preempt:
+            # the post-preemption retry is the SAME scheduling attempt:
+            # it must not double the trace counter or re-journal
+            obs_bump("schedule_one")
         lister = self._cycle_lister()
         state = CycleState()
         status = self._framework.run_pre_filter_plugins(state, pod, lister)
         if not status.is_success:
+            if not allow_preempt:
+                return None     # post-preemption retry: caller nominates
             if status.reason == "quota":
                 self._record_quota_hol(pod)
             # An unschedulable PreFilter verdict still gets a preemption
@@ -355,11 +381,10 @@ class Scheduler:
             # resolved by evicting over-quota borrowers (reference
             # capacity_scheduling.go:323-341).
             if status.code == UNSCHEDULABLE:
-                nominated, post = self._post_filter_budgeted(
+                handled, placed = self._preempt_then_retry(
                     state, pod, lister)
-                if post.is_success and nominated:
-                    self._nominate(pod, nominated)
-                    return None
+                if handled:
+                    return placed
             self._mark_unschedulable(pod, status)
             return None
         equiv = self._filter_equiv_key(pod)
@@ -394,15 +419,16 @@ class Scheduler:
                 self._class_scan_cache[equiv] = scan
         feasible, rejections = scan[0], scan[1]
         if not feasible:
-            nominated, post = self._post_filter_budgeted(state, pod, lister)
-            if post.is_success and nominated:
-                self._nominate(pod, nominated)
-            else:
-                if scan[2] is None:
-                    scan[2] = self._node_reason_attrs(rejections)
-                self._mark_unschedulable(
-                    pod, Status.unschedulable("no fit"),
-                    node_attrs=scan[2])
+            if not allow_preempt:
+                return None     # post-preemption retry: caller nominates
+            handled, placed = self._preempt_then_retry(state, pod, lister)
+            if handled:
+                return placed
+            if scan[2] is None:
+                scan[2] = self._node_reason_attrs(rejections)
+            self._mark_unschedulable(
+                pod, Status.unschedulable("no fit"),
+                node_attrs=scan[2])
             return None
         chosen = min(feasible, key=self._score_key(pod, lister))
         status = self._framework.run_reserve_plugins(state, pod, chosen.name)
@@ -532,7 +558,13 @@ class Scheduler:
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
         ]
-        pods.sort(key=lambda p: (-p.spec.priority,
+        # Tiered admission queue (docs/serving.md): serving pods are
+        # picked FIRST every cycle — before any batch gang, whatever
+        # its PriorityClass — then batch, then best-effort; priority
+        # and FIFO order break ties within a tier.  This is also what
+        # routes the per-cycle preemption budget to the serving tier
+        # under contention: serving pods spend it before batch can.
+        pods.sort(key=lambda p: (tier_rank(p), -p.spec.priority,
                                  p.metadata.creation_timestamp, p.key))
         # Release the window lease once its gang is no longer waiting;
         # a still-stuck gang re-earns (and may move) it this cycle.
@@ -621,6 +653,14 @@ class Scheduler:
     def _quota_hol_defers(self, pod: Pod) -> bool:
         blocker = self._quota_hol.get(pod.metadata.namespace)
         if blocker is None or pod.spec.priority >= blocker:
+            return False
+        if workload_tier(pod) == C_TIER_SERVING:
+            # The serving tier never queues behind a batch gang's ledger
+            # claim: its latency SLO is milliseconds, the claimant's
+            # wait is minutes.  A serving pod that genuinely lacks
+            # headroom is rejected by PreFilter itself; the HOL rule
+            # exists to stop SMALL BATCH pods from eating a gang's
+            # accumulating quota, not to starve the protected tier.
             return False
         self._mark_unschedulable(pod, Status.unschedulable(
             f"waiting behind a higher-priority quota claim in namespace "
@@ -862,11 +902,16 @@ class Scheduler:
         from nos_tpu.topology.profile import free_chip_equivalents
 
         hosts = self._reserved_hosts
+        # Serving-tier stragglers are never drain-evicted: the tier
+        # contract (docs/serving.md) is that NO mechanism preempts a
+        # serving pod for batch progress — the autoscaler shrinks
+        # replicas when load drops, which drains the window honestly.
         stragglers = [
             p for p in self._api.list(KIND_POD)
             if p.spec.node_name in hosts
             and p.status.phase in (PENDING, RUNNING)
-            and (p.metadata.namespace, gang_name(p)) != gang]
+            and (p.metadata.namespace, gang_name(p)) != gang
+            and workload_tier(p) != C_TIER_SERVING]
         if not stragglers:
             return
         capacity = 0.0
@@ -1075,6 +1120,14 @@ class Scheduler:
             more_than_min = preemptor_info.used_over_min_with(total_req)
 
         def directly_evictable(p: Pod) -> bool:
+            if workload_tier(p) == C_TIER_SERVING \
+                    and not is_over_quota(p):
+                # mirrors _select_victims_on_node: in-quota serving is
+                # never a victim (over-quota serving borrowers stay
+                # reclaimable — the quota guarantee outranks the tier
+                # shield), so a domain only "opens up" here if it opens
+                # without touching protected serving pods
+                return False
             if preemptor_info is None:
                 # classic priority preemption among quota-less pods
                 if infos is not None \
@@ -1301,9 +1354,15 @@ class Scheduler:
         """Per-class pending-pod gauges after a cycle: how many pods of
         each workload class are still waiting and the oldest one's age —
         the scoreboard's pending-by-class column and the SLO engine's
-        leading breach indicator.  Classes that drained set 0 (a gauge
-        that silently freezes at its last value reads as a live
-        backlog)."""
+        leading breach indicator.  BOTH gauges are recomputed from live
+        queue membership at observe time, and the reset set comes from
+        the REGISTRY'S OWN series list rather than an in-memory
+        "classes I last published" note: that note goes stale across a
+        scheduler replacement/restart (the registry is process-global,
+        the note was per-instance) and across a publish skipped by a
+        raising cycle — either way a class that momentarily emptied
+        could keep reporting its last (stale, maximal) age as a live
+        backlog forever.  Classes with no pending pod read 0."""
         now = self._clock()
         count: dict[str, int] = {}
         oldest: dict[str, float] = {}
@@ -1315,7 +1374,11 @@ class Scheduler:
             ts = p.metadata.creation_timestamp
             if 0.0 < ts <= now:
                 oldest[cls] = max(oldest.get(cls, 0.0), now - ts)
-        for cls in self._pending_classes - set(count):
+        published = set(REGISTRY.gauge_label_values(
+            "nos_tpu_schedule_pending_pods", "class"))
+        published.update(REGISTRY.gauge_label_values(
+            "nos_tpu_schedule_pending_age_seconds", "class"))
+        for cls in published - set(count):
             REGISTRY.set("nos_tpu_schedule_pending_pods", 0.0,
                          labels={"class": cls})
             REGISTRY.set("nos_tpu_schedule_pending_age_seconds", 0.0,
@@ -1325,7 +1388,6 @@ class Scheduler:
                          labels={"class": cls})
             REGISTRY.set("nos_tpu_schedule_pending_age_seconds",
                          oldest.get(cls, 0.0), labels={"class": cls})
-        self._pending_classes = set(count)
 
     def _bind(self, pod: Pod, node_name: str) -> bool:
         # Binding only (the /binding subresource against a real substrate).

@@ -1,0 +1,19 @@
+"""Serving plane: the latency-SLO inference workload class.
+
+Three pieces open the serving tier end to end (docs/serving.md):
+
+- the ``nos.tpu/tier`` contract (api/constants.py) read by
+  ``utils.pod_util.workload_tier`` — serving pods are scheduled first
+  every cycle and are never preemption victims;
+- the replica autoscaler (``serving.autoscaler``) — watches each
+  service's requests-in-flight annotation signal and scales replica
+  pods with hysteresis + cooldown inside a min/max band;
+- the request-stream generator (``serving.trace``) — a deterministic
+  bursty, diurnal, millions-of-users load model ``bench_serving.py``
+  drives through the real control plane.
+"""
+
+from .autoscaler import ReplicaAutoscaler, ServingService
+from .trace import DiurnalTrace
+
+__all__ = ["ReplicaAutoscaler", "ServingService", "DiurnalTrace"]

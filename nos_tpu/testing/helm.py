@@ -100,6 +100,7 @@ CONFIG_KINDS = {
     "nos-tpu-partitioner-config": "PartitionerConfig",
     "nos-tpu-sliceagent-config": "AgentConfig",
     "nos-tpu-chipagent-config": "AgentConfig",
+    "nos-tpu-autoscaler-config": "AutoscalerConfig",
 }
 
 

@@ -21,6 +21,28 @@ def extra_resources_could_help_scheduling(pod: Pod) -> bool:
     )
 
 
+def workload_tier(pod: Pod) -> str:
+    """The pod's workload tier under the ``nos.tpu/tier`` contract
+    (docs/serving.md): ``serving`` | ``batch`` | ``best-effort``.
+    Absent or unrecognized values read as ``batch`` — every pre-tier
+    workload was batch/training-shaped, and a typo in the label must
+    degrade to the preemptible default, never silently grant the
+    protected serving tier."""
+    tier = pod.metadata.labels.get(C.LABEL_TIER, "")
+    if tier in (C.TIER_SERVING, C.TIER_BATCH, C.TIER_BEST_EFFORT):
+        return tier
+    return C.TIER_BATCH
+
+
+def tier_rank(pod: Pod) -> int:
+    """Admission-queue rank of the pod's tier: serving first (0), batch
+    (1), best-effort last (2).  The scheduler sorts each cycle's queue
+    by this BEFORE priority, so a serving replica is always picked ahead
+    of any batch gang regardless of PriorityClass arithmetic."""
+    return {C.TIER_SERVING: 0, C.TIER_BATCH: 1,
+            C.TIER_BEST_EFFORT: 2}[workload_tier(pod)]
+
+
 def workload_class(pod: Pod) -> str:
     """Telemetry workload class: the machine class / time-share unit the
     pod consumes, the `class=` label of every per-class SLO series
@@ -28,24 +50,48 @@ def workload_class(pod: Pod) -> str:
     docs/observability.md).  Mirrors the bench trace taxonomy:
     ``gang-<shape>`` for pod-group members, ``slice-<shape>`` for
     single slice consumers, ``ts-<gb>`` for time-share units,
-    ``other`` for anything else.  Classes must stay LOW-cardinality:
-    they come from the finite profile table, never from pod names."""
+    ``other`` for anything else.  Tier refinements: every serving-tier
+    pod is class ``serving`` (ONE protected class — the tier's
+    millisecond SLO is a promise about the tier, not about each slice
+    shape), and best-effort pods carry a ``be-`` prefix so the
+    scoreboard can split tiers without a second label.  Classes must
+    stay LOW-cardinality: they come from the finite profile table,
+    never from pod names."""
     from nos_tpu.kube.resources import pod_request
     from nos_tpu.topology.profile import (
         extract_slice_requests, extract_timeshare_requests,
     )
 
+    tier = workload_tier(pod)
+    if tier == C.TIER_SERVING:
+        return "serving"
     req = pod_request(pod)
+    base = "other"
     slices = extract_slice_requests(req)
     if slices:
         shape = max(slices, key=lambda s: (s.chips, str(s)))
         kind = ("gang" if pod.metadata.labels.get(C.LABEL_POD_GROUP)
                 else "slice")
-        return f"{kind}-{shape}"
-    timeshare = extract_timeshare_requests(req)
-    if timeshare:
-        return f"ts-{max(timeshare)}"
-    return "other"
+        base = f"{kind}-{shape}"
+    else:
+        timeshare = extract_timeshare_requests(req)
+        if timeshare:
+            base = f"ts-{max(timeshare)}"
+    if tier == C.TIER_BEST_EFFORT:
+        return f"be-{base}"
+    return base
+
+
+def class_tier(cls: str) -> str:
+    """Tier of a telemetry workload class (the inverse mapping the
+    scoreboard uses to fold per-class series into per-tier rows):
+    ``serving`` -> serving, ``be-*`` -> best-effort, everything else ->
+    batch."""
+    if cls == "serving":
+        return C.TIER_SERVING
+    if cls.startswith("be-"):
+        return C.TIER_BEST_EFFORT
+    return C.TIER_BATCH
 
 
 def is_over_quota(pod: Pod) -> bool:

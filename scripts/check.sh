@@ -24,12 +24,12 @@ if ! python -m nos_tpu.analysis; then
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/)"
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/)"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
             nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
-            nos_tpu/scheduler nos_tpu/obs; then
+            nos_tpu/scheduler nos_tpu/obs nos_tpu/serving; then
         rc=1
     fi
 else
@@ -56,6 +56,13 @@ fi
 echo "==> bench_utilization.py --smoke (SLO telemetry gate: per-class histograms + verdicts)"
 if ! env JAX_PLATFORMS=cpu python bench_utilization.py --smoke \
         --slo-report "${SLO_REPORT_PATH:-/tmp/nos_tpu_slo_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
+echo "==> bench_serving.py --smoke (serving gate: class=serving buckets, zero serving preemptions, p99 < 100 ms)"
+if ! env JAX_PLATFORMS=cpu python bench_serving.py --smoke \
+        --serving-report "${SERVING_REPORT_PATH:-/tmp/nos_tpu_serving_report.json}" \
         > /dev/null; then
     rc=1
 fi

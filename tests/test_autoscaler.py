@@ -1,0 +1,335 @@
+"""Serving replica-autoscaler unit suite (nos_tpu/serving/autoscaler.py):
+scale-up/down hysteresis, cooldowns, band clamps, victim choice on
+scale-down, config validation, status publication, retry-on-conflict
+under the chaos substrate, leader handoff, and a seeded chaos round
+under lockcheck with the autoscaler's @guarded_by contract enforced.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.config import AutoscalerConfig, ConfigError
+from nos_tpu.kube.client import (
+    APIServer, KIND_CONFIGMAP, KIND_POD,
+)
+from nos_tpu.serving.autoscaler import (
+    ReplicaAutoscaler, ServingService, replica_load,
+)
+from nos_tpu.utils import retry as retry_mod
+
+
+@pytest.fixture(autouse=True)
+def fast_retry(monkeypatch):
+    monkeypatch.setattr(retry_mod, "sleep", lambda s: None)
+
+
+def make_service(**kw) -> ServingService:
+    defaults = dict(name="chat", namespace="serve", slice_shape="1x1",
+                    min_replicas=1, max_replicas=8,
+                    target_load_per_replica=10.0,
+                    scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.0,
+                    down_hysteresis=0.2)
+    defaults.update(kw)
+    return ServingService(**defaults)
+
+
+class Harness:
+    def __init__(self, svc: ServingService | None = None,
+                 api: APIServer | None = None) -> None:
+        self.now = [0.0]
+        self.api = api or APIServer()
+        self.svc = svc or make_service()
+        self.autoscaler = ReplicaAutoscaler(
+            self.api, [self.svc], clock=lambda: self.now[0])
+
+    def replicas(self) -> list:
+        return self.api.list(
+            KIND_POD, namespace=self.svc.namespace,
+            label_selector={C.LABEL_SERVICE: self.svc.name})
+
+    def stamp(self, total_load: float) -> None:
+        pods = self.replicas()
+        assert pods, "stamp() needs at least one replica"
+        share = total_load / len(pods)
+        for p in pods:
+            # retry-wrapped: the chaos harness injects conflicts on
+            # patch, and the stamp is test plumbing, not the subject
+            retry_mod.retry_on_conflict(
+                self.api, KIND_POD, p.metadata.name,
+                lambda q: q.metadata.annotations.__setitem__(
+                    C.ANNOT_SERVING_LOAD, str(share)),
+                p.metadata.namespace, component="test-stamp")
+
+
+class TestScaling:
+    def test_min_floor_is_enforced_immediately(self):
+        h = Harness(make_service(min_replicas=3))
+        out = h.autoscaler.reconcile()
+        assert len(h.replicas()) == 3
+        assert out["serve/chat"]["scaled"] == 3
+
+    def test_scale_up_follows_load(self):
+        h = Harness()
+        h.autoscaler.reconcile()
+        h.stamp(35.0)                      # ceil(35/10) = 4
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 4
+
+    def test_max_clamp(self):
+        h = Harness(make_service(max_replicas=5))
+        h.autoscaler.reconcile()
+        h.stamp(1000.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 5
+
+    def test_replica_pods_carry_the_tier_contract(self):
+        h = Harness()
+        h.autoscaler.reconcile()
+        pod = h.replicas()[0]
+        assert pod.metadata.labels[C.LABEL_TIER] == C.TIER_SERVING
+        assert pod.metadata.labels[C.LABEL_SERVICE] == "chat"
+        assert C.ANNOT_SERVING_LOAD in pod.metadata.annotations
+        assert pod.metadata.creation_timestamp == 0.0
+        assert "nos.tpu/slice-1x1" in \
+            pod.spec.containers[0].resources
+
+    def test_scale_down_hysteresis_blocks_the_boundary(self):
+        """Load just under the shrunk fleet's capacity must NOT scale
+        down: without the headroom requirement the boundary load
+        re-adds the replica next tick (flap)."""
+        h = Harness()
+        h.autoscaler.reconcile()
+        h.stamp(35.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 4
+        # desired at 29 is ceil(29/10)=3, but 29 > 3*10*(1-0.2)=24:
+        # the shrunk fleet would lack headroom — stay at 4
+        h.stamp(29.0)
+        h.now[0] = 2.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 4
+        # desired at 22 is still 3, and 22 <= 24: the shrink is safe
+        h.stamp(22.0)
+        h.now[0] = 3.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 3
+
+    def test_scale_up_cooldown_defers_the_second_burst(self):
+        h = Harness(make_service(scale_up_cooldown_s=10.0))
+        h.autoscaler.reconcile()   # min floor: arms the up clock at t=0
+        h.stamp(25.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 1      # deferred: inside cooldown
+        h.now[0] = 10.5
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 3      # cooldown passed (re-arms)
+        h.stamp(60.0)
+        h.now[0] = 11.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 3      # second burst deferred
+        h.now[0] = 21.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 6
+
+    def test_scale_down_cooldown(self):
+        h = Harness(make_service(scale_down_cooldown_s=30.0))
+        h.autoscaler.reconcile()
+        h.stamp(35.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 4
+        h.stamp(15.0)                      # desired 2, headroom ok
+        h.now[0] = 2.0
+        h.autoscaler.reconcile()           # first down: clock arms
+        assert len(h.replicas()) == 2
+        h.stamp(5.0)                       # desired 1
+        h.now[0] = 3.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 2      # inside down cooldown
+        h.now[0] = 40.0
+        h.autoscaler.reconcile()
+        assert len(h.replicas()) == 1      # cooldown passed, min floor
+
+    def test_scale_down_prefers_pending_then_least_loaded(self):
+        h = Harness()
+        h.autoscaler.reconcile()
+        h.stamp(35.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        pods = h.replicas()
+        assert len(pods) == 4
+        # mark one RUNNING+loaded, one RUNNING+idle; two stay PENDING
+        from nos_tpu.kube.objects import RUNNING
+
+        def mark(name, load):
+            def mutate(p):
+                p.status.phase = RUNNING
+                p.spec.node_name = "host-0"
+                p.metadata.annotations[C.ANNOT_SERVING_LOAD] = str(load)
+            h.api.patch(KIND_POD, name, "serve", mutate=mutate)
+        names = sorted(p.metadata.name for p in pods)
+        mark(names[0], 6.0)
+        mark(names[1], 1.0)
+        for p in h.replicas():      # drop the signal so desired = 1
+            if p.metadata.name not in names[:2]:
+                h.api.patch(
+                    KIND_POD, p.metadata.name, "serve",
+                    mutate=lambda q: q.metadata.annotations.
+                    __setitem__(C.ANNOT_SERVING_LOAD, "0"))
+        h.now[0] = 2.0
+        h.autoscaler.reconcile()
+        left = {p.metadata.name for p in h.replicas()}
+        # survivors: the loaded running replica is shed LAST
+        assert names[0] in left
+        assert len(left) == 1
+
+    def test_status_configmap_published(self):
+        h = Harness()
+        h.autoscaler.reconcile()
+        cm = h.api.get(KIND_CONFIGMAP, "nos-tpu-autoscaler-status",
+                       "nos-tpu-system")
+        assert "serve/chat" in cm.data
+
+    def test_replica_load_parses_garbage_as_zero(self):
+        from nos_tpu.testing.factory import make_pod
+
+        assert replica_load(make_pod(
+            annotations={C.ANNOT_SERVING_LOAD: "nan"})) == 0.0
+        assert replica_load(make_pod(
+            annotations={C.ANNOT_SERVING_LOAD: "-3"})) == 0.0
+        assert replica_load(make_pod()) == 0.0
+        assert replica_load(make_pod(
+            annotations={C.ANNOT_SERVING_LOAD: "7.5"})) == 7.5
+
+
+class TestServiceSpec:
+    def test_exactly_one_shape(self):
+        with pytest.raises(ValueError):
+            ServingService(name="x", slice_shape="1x1", timeshare_gb=8)
+        with pytest.raises(ValueError):
+            ServingService(name="x")
+
+    def test_band_and_knob_validation(self):
+        with pytest.raises(ValueError):
+            make_service(min_replicas=5, max_replicas=2)
+        with pytest.raises(ValueError):
+            make_service(target_load_per_replica=0.0)
+        with pytest.raises(ValueError):
+            make_service(down_hysteresis=1.0)
+
+    def test_from_mapping_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown"):
+            ServingService.from_mapping(
+                {"name": "x", "slice_shape": "1x1", "cooldown": 1})
+
+    def test_autoscaler_config_validates_services(self):
+        cfg = AutoscalerConfig(services=[
+            {"name": "chat", "slice_shape": "1x1"}])
+        cfg.validate()
+        bad = AutoscalerConfig(services=[{"name": "chat"}])
+        with pytest.raises(ConfigError):
+            bad.validate()
+
+
+class TestChaos:
+    def test_status_write_retries_on_conflict(self):
+        from nos_tpu.exporter.metrics import REGISTRY
+        from nos_tpu.testing.chaos import ChaosAPIServer
+
+        api = ChaosAPIServer(7, conflict_rate=0.5, transient_rate=0.2)
+        h = Harness(api=api)
+        before = REGISTRY.snapshot().get("nos_tpu_retry_total", {}).get(
+            "component=autoscaler-status", 0.0)
+        for i in range(30):
+            h.now[0] = float(i)
+            h.autoscaler.reconcile()
+        cm = api.get(KIND_CONFIGMAP, "nos-tpu-autoscaler-status",
+                     "nos-tpu-system")
+        assert "serve/chat" in cm.data
+        after = REGISTRY.snapshot().get("nos_tpu_retry_total", {}).get(
+            "component=autoscaler-status", 0.0)
+        assert after > before, "chaos injected no retried status write"
+
+    @pytest.mark.usefixtures("lock_discipline")
+    def test_seeded_chaos_round_under_lockcheck(self, lock_discipline):
+        """One seeded chaos round with the @guarded_by contract
+        enforced at runtime: reconcile through injected conflicts and
+        transient write errors while the load signal swings; any write
+        to declared shared state without the lock, or a lock-order
+        inversion against the API store lock, fails at teardown."""
+        from nos_tpu.testing.chaos import ChaosAPIServer
+        from nos_tpu.testing.lockcheck import guard_state
+
+        api = ChaosAPIServer(11, conflict_rate=0.3, transient_rate=0.1)
+        h = Harness(api=api)
+        guard_state(h.autoscaler, lock_discipline, name="autoscaler")
+        loads = [0.0, 30.0, 75.0, 75.0, 20.0, 5.0, 90.0, 0.0]
+        h.autoscaler.reconcile()
+        for i, load in enumerate(loads):
+            h.now[0] = float(i + 1)
+            h.stamp(load)
+            h.autoscaler.reconcile()
+        assert 1 <= len(h.replicas()) <= h.svc.max_replicas
+
+
+class TestLeaderHandoff:
+    def test_standby_takes_over_the_reconcile_loop(self):
+        """Two autoscaler mains on one substrate: the standby must not
+        scale while blocked, and must take over after the leader
+        releases the lease (the cmd/autoscaler wiring, with fast lease
+        timings)."""
+        from nos_tpu.cmd._runtime import Main
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        api = APIServer()
+        svc = make_service(min_replicas=2)
+        mains: list[Main] = []
+        scalers = []
+        for ident in ("a", "b"):
+            autoscaler = ReplicaAutoscaler(api, [svc])
+            scalers.append(autoscaler)
+            m = Main(f"autoscaler-{ident}")
+            m.attach_leader_election(LeaderElector(
+                api, "nos-tpu-autoscaler-leader", identity=ident,
+                lease_duration_s=0.6, renew_s=0.05, retry_s=0.05))
+            m.add_loop("autoscaler", autoscaler.reconcile, 0.02)
+            mains.append(m)
+        try:
+            mains[0].start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not api.list(
+                    KIND_POD, namespace="serve"):
+                time.sleep(0.01)
+            assert len(api.list(KIND_POD, namespace="serve")) == 2
+            mains[1].start()
+            time.sleep(0.2)     # standby must stay gated
+            assert not mains[1]._elector.is_leader.is_set()
+            mains[0].shutdown()     # releases the lease
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and not mains[1]._elector.is_leader.is_set():
+                time.sleep(0.01)
+            assert mains[1]._elector.is_leader.is_set(), \
+                "standby never acquired the released lease"
+            # the standby's loop now reconciles: scale-up lands
+            for p in api.list(KIND_POD, namespace="serve"):
+                api.patch(KIND_POD, p.metadata.name, "serve",
+                          mutate=lambda q: q.metadata.annotations.
+                          __setitem__(C.ANNOT_SERVING_LOAD, "40"))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(api.list(
+                    KIND_POD, namespace="serve")) < 4:
+                time.sleep(0.01)
+            assert len(api.list(KIND_POD, namespace="serve")) >= 4
+        finally:
+            for m in mains:
+                m.shutdown()

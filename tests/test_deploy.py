@@ -18,8 +18,8 @@ import pytest
 import yaml
 
 from nos_tpu.api.config import (
-    AgentConfig, OperatorConfig, PartitionerConfig, SchedulerConfig,
-    load_config,
+    AgentConfig, AutoscalerConfig, OperatorConfig, PartitionerConfig,
+    SchedulerConfig, load_config,
 )
 from nos_tpu.testing.helm import default_context, render
 
@@ -49,7 +49,7 @@ class TestDevClusterHarness:
                                  / "hack/render-chart.py")],
             capture_output=True, text=True, timeout=60)
         assert proc.returncode == 0, proc.stderr
-        assert "validated 5 ConfigMaps" in proc.stdout
+        assert "validated 6 ConfigMaps" in proc.stdout
         assert "3 CRDs" in proc.stdout
 
 
@@ -139,12 +139,13 @@ class TestRenderedConfigsLoad:
         config that nothing validates."""
         from nos_tpu.testing.helm import render_chart, validate_configmaps
 
-        assert validate_configmaps(render_chart(CHART, ctx)) == 5
+        assert validate_configmaps(render_chart(CHART, ctx)) == 6
 
     @pytest.mark.parametrize("component,cls", [
         ("partitioner", PartitionerConfig),
         ("operator", OperatorConfig),
         ("scheduler", SchedulerConfig),
+        ("autoscaler", AutoscalerConfig),
     ])
     def test_component_config(self, ctx, tmp_path, component, cls):
         out = render(
@@ -175,7 +176,8 @@ class TestRenderedConfigsLoad:
 class TestDockerfiles:
     def test_one_dockerfile_per_component(self):
         components = {"operator", "partitioner", "scheduler", "sliceagent",
-                      "chipagent", "metricsexporter", "train"}
+                      "chipagent", "metricsexporter", "train",
+                      "autoscaler"}
         found = {p.parent.name for p in BUILD.glob("*/Dockerfile")}
         assert found == components
         assert (BUILD / "Dockerfile.base").exists()

@@ -1,0 +1,475 @@
+"""Serving-plane tests: the nos.tpu/tier contract, tiered admission
+ordering, serving-never-a-victim preemption semantics, the burst-trace
+e2e (zero serving preemptions + autoscaler tracking through the real
+scheduler), the pending-age gauge regression, and the obs scoreboard's
+per-tier rows.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.elasticquota import (
+    ElasticQuota, ElasticQuotaSpec, install_quota_webhooks,
+)
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import (
+    APIServer, KIND_ELASTIC_QUOTA, KIND_NODE, KIND_POD,
+)
+from nos_tpu.kube.objects import ObjectMeta, RUNNING
+from nos_tpu.serving import DiurnalTrace, ReplicaAutoscaler, ServingService
+from nos_tpu.testing.factory import (
+    admit_all, make_pod, make_slice_pod, make_tpu_node,
+)
+from nos_tpu.utils.pod_util import (
+    class_tier, tier_rank, workload_class, workload_tier,
+)
+
+
+def serving_labels(extra: dict | None = None) -> dict:
+    labels = {C.LABEL_TIER: C.TIER_SERVING}
+    labels.update(extra or {})
+    return labels
+
+
+class TestTierContract:
+    def test_workload_tier_defaults_to_batch(self):
+        assert workload_tier(make_pod()) == C.TIER_BATCH
+        assert workload_tier(make_pod(
+            labels={C.LABEL_TIER: "gold"})) == C.TIER_BATCH
+
+    def test_workload_tier_reads_the_label(self):
+        assert workload_tier(make_pod(
+            labels={C.LABEL_TIER: C.TIER_SERVING})) == C.TIER_SERVING
+        assert workload_tier(make_pod(
+            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT})) \
+            == C.TIER_BEST_EFFORT
+
+    def test_tier_rank_orders_serving_first(self):
+        ranks = [tier_rank(make_pod(labels={C.LABEL_TIER: t}))
+                 for t in (C.TIER_SERVING, C.TIER_BATCH,
+                           C.TIER_BEST_EFFORT)]
+        assert ranks == sorted(ranks) and len(set(ranks)) == 3
+
+    def test_workload_class_tiers(self):
+        serving = make_slice_pod("1x1", 1, labels=serving_labels())
+        assert workload_class(serving) == "serving"
+        be = make_slice_pod("2x2", 1,
+                            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT})
+        assert workload_class(be) == "be-slice-2x2"
+        assert workload_class(make_slice_pod("2x2", 1)) == "slice-2x2"
+
+    def test_class_tier_inverse(self):
+        assert class_tier("serving") == C.TIER_SERVING
+        assert class_tier("be-slice-2x2") == C.TIER_BEST_EFFORT
+        assert class_tier("slice-2x2") == C.TIER_BATCH
+        assert class_tier("ts-8") == C.TIER_BATCH
+
+
+def carved_node(name: str, units: int = 8):
+    """A host with `units` pre-carved 1x1 slices (no agents needed)."""
+    return make_tpu_node(name, pod_id="pod-0", host_index=0,
+                         status_geometry={"free": {"1x1": units}})
+
+
+class TestTieredScheduling:
+    def test_serving_scheduled_first_under_contention(self):
+        """Three pods, one per tier, equal priority, two free units:
+        serving and batch bind; best-effort waits — regardless of
+        creation order."""
+        api = APIServer()
+        api.create(KIND_NODE, carved_node("host-0", units=2))
+        scheduler = build_scheduler(api)
+        # created WORST tier first: creation order must not win
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="be",
+            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT},
+            creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="batch", creation_timestamp=2.0))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="serve", labels=serving_labels(),
+            creation_timestamp=3.0))
+        assert scheduler.run_cycle() == 2
+        bound = {p.metadata.name: bool(p.spec.node_name)
+                 for p in api.list(KIND_POD)}
+        assert bound == {"serve": True, "batch": True, "be": False}
+
+    def test_serving_outranks_higher_priority_batch(self):
+        api = APIServer()
+        api.create(KIND_NODE, carved_node("host-0", units=1))
+        scheduler = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="batch", priority=100,
+            creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="serve", labels=serving_labels(),
+            creation_timestamp=2.0))
+        scheduler.run_cycle()
+        serve = next(p for p in api.list(KIND_POD)
+                     if p.metadata.name == "serve")
+        assert serve.spec.node_name
+
+
+def quota(api, ns: str, min_gb: float, max_gb: float) -> None:
+    api.create(KIND_ELASTIC_QUOTA, ElasticQuota(
+        metadata=ObjectMeta(name=ns, namespace=ns),
+        spec=ElasticQuotaSpec(
+            min={C.RESOURCE_TPU_MEMORY: min_gb},
+            max={C.RESOURCE_TPU_MEMORY: max_gb})))
+
+
+class TestServingNeverVictim:
+    def _cluster(self):
+        api = APIServer()
+        install_quota_webhooks(api)
+        api.create(KIND_NODE, carved_node("host-0", units=2))
+        quota(api, "serve", 16.0, 64.0)
+        quota(api, "batch", 16.0, 64.0)
+        scheduler = build_scheduler(api)
+        return api, scheduler
+
+    def test_over_quota_batch_is_preempted_for_serving(self):
+        api, scheduler = self._cluster()
+        # both units held by batch; one borrowing over its 1-chip min
+        for i, cap in enumerate([C.CAPACITY_IN_QUOTA,
+                                 C.CAPACITY_OVER_QUOTA]):
+            api.create(KIND_POD, make_slice_pod(
+                "1x1", 1, name=f"b{i}", namespace="batch",
+                node_name="host-0", phase=RUNNING,
+                labels={C.LABEL_CAPACITY: cap},
+                creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="replica", namespace="serve",
+            labels=serving_labels(), creation_timestamp=2.0))
+        scheduler.run_cycle()
+        names = {p.metadata.name for p in api.list(KIND_POD)}
+        assert "b1" not in names, "over-quota borrower not reclaimed"
+        assert "b0" in names
+        # same cycle: the replica bound into the synchronously freed
+        # unit (post-preemption retry) — no nomination window for a
+        # lower tier to race into
+        replica = next(p for p in api.list(KIND_POD)
+                       if p.metadata.name == "replica")
+        assert replica.spec.node_name == "host-0"
+
+    def test_in_quota_serving_is_never_selected_as_victim(self):
+        """A high-priority batch preemptor in the same namespace could
+        take any lower-priority pod under pre-tier semantics; in-quota
+        serving pods are excluded from every victim branch."""
+        api = APIServer()
+        install_quota_webhooks(api)
+        api.create(KIND_NODE, carved_node("host-0", units=1))
+        quota(api, "team", 8.0, 64.0)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="replica", namespace="team",
+            node_name="host-0", phase=RUNNING, priority=0,
+            labels=serving_labels(
+                {C.LABEL_CAPACITY: C.CAPACITY_IN_QUOTA}),
+            creation_timestamp=1.0))
+        scheduler = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="train", namespace="team", priority=100,
+            creation_timestamp=2.0))
+        scheduler.run_cycle()
+        names = {p.metadata.name for p in api.list(KIND_POD)}
+        assert "replica" in names, "serving pod was evicted"
+        train = next(p for p in api.list(KIND_POD)
+                     if p.metadata.name == "train")
+        assert not train.spec.node_name
+
+    def test_over_quota_serving_borrower_is_still_reclaimable(self):
+        """The quota guarantee outranks the tier shield: a serving
+        namespace borrowing beyond its min can be reclaimed by a
+        lender claiming its own min — otherwise a self-applied tier
+        label would capture borrowed capacity forever."""
+        api = APIServer()
+        install_quota_webhooks(api)
+        api.create(KIND_NODE, carved_node("host-0", units=1))
+        quota(api, "serve", 8.0, 64.0)       # min < the replica's 16GB
+        quota(api, "lender", 16.0, 64.0)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="replica", namespace="serve",
+            node_name="host-0", phase=RUNNING,
+            labels=serving_labels(
+                {C.LABEL_CAPACITY: C.CAPACITY_OVER_QUOTA}),
+            creation_timestamp=1.0))
+        scheduler = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="claim", namespace="lender",
+            creation_timestamp=2.0))
+        scheduler.run_cycle()
+        names = {p.metadata.name for p in api.list(KIND_POD)}
+        assert "replica" not in names, \
+            "over-quota serving borrower was not reclaimable"
+        claim = next(p for p in api.list(KIND_POD)
+                     if p.metadata.name == "claim")
+        assert claim.spec.node_name == "host-0"
+
+    def test_best_effort_victims_go_before_batch(self):
+        """Tier-ordered victim walk: with a best-effort and a batch
+        borrower both evictable, the scavenger dies first."""
+        api = APIServer()
+        install_quota_webhooks(api)
+        api.create(KIND_NODE, carved_node("host-0", units=2))
+        quota(api, "serve", 16.0, 64.0)
+        quota(api, "batch", 8.0, 64.0)
+        quota(api, "scrap", 8.0, 64.0)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="batchpod", namespace="batch",
+            node_name="host-0", phase=RUNNING,
+            labels={C.LABEL_CAPACITY: C.CAPACITY_OVER_QUOTA},
+            creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="scrappod", namespace="scrap",
+            node_name="host-0", phase=RUNNING,
+            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT,
+                    C.LABEL_CAPACITY: C.CAPACITY_OVER_QUOTA},
+            creation_timestamp=1.0))
+        scheduler = build_scheduler(api)
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="replica", namespace="serve",
+            labels=serving_labels(), creation_timestamp=2.0))
+        scheduler.run_cycle()
+        names = {p.metadata.name for p in api.list(KIND_POD)}
+        assert "scrappod" not in names, "best-effort spared over batch"
+        assert "batchpod" in names
+
+
+class TestBurstE2E:
+    @pytest.mark.usefixtures("lock_discipline")
+    def test_burst_trace_zero_serving_preemptions(self, lock_discipline):
+        """Mini end-to-end burst: batch soaks 16 pre-carved units
+        over-quota, a burst scales the service 2 -> 6 replicas through
+        the REAL scheduler; every scale-up binds by preempting batch
+        borrowers, no serving pod is ever a victim, and tier ordering
+        holds (best-effort stays pending throughout)."""
+        from nos_tpu.controllers.elasticquota.controller import (
+            ElasticQuotaReconciler,
+        )
+        from nos_tpu.quota import TPUResourceCalculator
+        from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+        from nos_tpu.testing.lockcheck import guard_state
+
+        now = [0.0]
+        api = APIServer()
+        install_quota_webhooks(api)
+        for h in range(2):
+            api.create(KIND_NODE, carved_node(f"host-{h}", units=8))
+        # serve's guaranteed min covers the full band; batch's min sits
+        # below its steady-state usage so its fillers run over-quota
+        quota(api, "serve", 96.0, 128.0)
+        quota(api, "batch", 144.0, 256.0)
+        quota(api, "scrap", 16.0, 256.0)
+        calc = TPUResourceCalculator(16, chips_per_host=8)
+        reconciler = ElasticQuotaReconciler(api, calc)
+        scheduler = build_scheduler(api, 16, shard_chips_per_host=8,
+                                    preempt_budget_per_cycle=4,
+                                    clock=lambda: now[0])
+        svc = ServingService(name="chat", namespace="serve",
+                             slice_shape="1x1", min_replicas=2,
+                             max_replicas=6,
+                             target_load_per_replica=8.0,
+                             scale_up_cooldown_s=0.0,
+                             scale_down_cooldown_s=5.0)
+        autoscaler = ReplicaAutoscaler(api, [svc],
+                                       clock=lambda: now[0])
+        guard_state(autoscaler, lock_discipline, name="autoscaler")
+        capacity = next(p for p in scheduler._framework.plugins
+                        if isinstance(p, CapacityScheduling))
+        victims_by_tier: dict[str, int] = {}
+
+        def on_preempt(preemptor, victims):
+            for v in victims:
+                t = workload_tier(v)
+                victims_by_tier[t] = victims_by_tier.get(t, 0) + 1
+        capacity.on_preempt = on_preempt
+
+        # batch fills every unit; two best-effort scavengers — ONE unit
+        # of guaranteed min between them (tier ordering governs the
+        # queue and the victim walk; a namespace's guaranteed quota min
+        # is still honored, so exactly one may claim capacity)
+        for i in range(16):
+            api.create(KIND_POD, make_slice_pod(
+                "1x1", 1, name=f"fill-{i}", namespace="batch",
+                creation_timestamp=0.0))
+        for i in range(2):
+            api.create(KIND_POD, make_slice_pod(
+                "1x1", 1, name=f"scavenge-{i}", namespace="scrap",
+                labels={C.LABEL_TIER: C.TIER_BEST_EFFORT},
+                creation_timestamp=0.0))
+
+        def load(t: float) -> float:
+            return 40.0 if 1.0 <= t < 2.0 else 10.0
+
+        serving_latencies = []
+        seen: set[str] = set()
+        for tick in range(60):
+            now[0] += 0.05
+            for p in api.list(KIND_POD, namespace="serve"):
+                api.patch(KIND_POD, p.metadata.name, "serve",
+                          mutate=lambda q, t=load(now[0]): q.metadata.
+                          annotations.__setitem__(
+                              C.ANNOT_SERVING_LOAD, str(t / max(
+                                  1, len(api.list(
+                                      KIND_POD, namespace="serve"))))))
+            autoscaler.reconcile()
+            scheduler.run_cycle()
+            admit_all(api)      # kubelet-phase sim: bound -> Running
+            reconciler.reconcile_all()
+            for p in api.list(KIND_POD, namespace="serve"):
+                if p.spec.node_name and p.metadata.name not in seen:
+                    seen.add(p.metadata.name)
+                    serving_latencies.append(
+                        now[0] - p.metadata.creation_timestamp)
+
+        assert victims_by_tier.get(C.TIER_SERVING, 0) == 0, \
+            f"serving pods preempted: {victims_by_tier}"
+        assert victims_by_tier, "burst never exercised preemption"
+        replicas = [p for p in api.list(KIND_POD, namespace="serve")
+                    if p.spec.node_name]
+        assert len(seen) >= 5, f"burst never scaled up: {len(seen)}"
+        assert len(replicas) >= 2
+        # every post-burst scale-up bound within two cycles (100 ms)
+        assert serving_latencies and max(serving_latencies) <= 0.101, \
+            f"serving bind latencies: {sorted(serving_latencies)[-3:]}"
+
+
+class TestPendingAgeGauge:
+    def _gauges(self):
+        snap = REGISTRY.snapshot()
+        return (snap.get("nos_tpu_schedule_pending_pods", {}),
+                snap.get("nos_tpu_schedule_pending_age_seconds", {}))
+
+    def test_restarted_scheduler_resets_stale_class_gauges(self):
+        """Regression: the reset set must come from the registry's own
+        series, not per-instance memory — a class published by a PRIOR
+        scheduler (or before a publish skipped by a raising cycle) that
+        is empty now must read 0, not its stale max age."""
+        REGISTRY.set("nos_tpu_schedule_pending_pods", 3.0,
+                     labels={"class": "slice-stale-test"})
+        REGISTRY.set("nos_tpu_schedule_pending_age_seconds", 37.5,
+                     labels={"class": "slice-stale-test"})
+        api = APIServer()
+        api.create(KIND_NODE, carved_node("host-0"))
+        scheduler = build_scheduler(api, clock=lambda: 100.0)
+        scheduler.run_cycle()       # fresh instance, empty queue
+        pods, age = self._gauges()
+        assert pods["class=slice-stale-test"] == 0.0
+        assert age["class=slice-stale-test"] == 0.0
+
+    def test_empty_and_refill_within_one_cycle_reports_live_age(self):
+        """A class that drains and refills inside one cycle must report
+        the LIVE queue's age (the fresh pod's), never carry the drained
+        pod's larger age forward."""
+        now = [100.0]
+        api = APIServer()
+        api.create(KIND_NODE, carved_node("host-0", units=1))
+        scheduler = build_scheduler(api, clock=lambda: now[0])
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="old", creation_timestamp=40.0))
+        scheduler.run_cycle()       # old (age 60) binds...
+        old = next(p for p in api.list(KIND_POD)
+                   if p.metadata.name == "old")
+        assert old.spec.node_name
+        # ...and a FRESH pod of the same class arrives before the next
+        # cycle's publish
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="fresh", creation_timestamp=99.0))
+        now[0] = 101.0
+        scheduler.run_cycle()
+        _, age = self._gauges()
+        assert age["class=slice-1x1"] == pytest.approx(2.0)
+
+
+class TestObsTierSurfaces:
+    def _payload(self):
+        from nos_tpu.kube.serialize import dump_state
+
+        api = APIServer()
+        api.create(KIND_NODE, carved_node("host-0", units=1))
+        api.create(KIND_POD, make_slice_pod(
+            "1x1", 1, name="r0", namespace="serve",
+            labels=serving_labels()))
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="b0"))
+        return {
+            "state": dump_state(api),
+            "slo": {
+                "fast_window_s": 10.0, "slow_window_s": 40.0,
+                "burn_threshold": 2.0, "objectives": [],
+                "verdicts": [
+                    {"objective": "serving-schedule-latency",
+                     "metric": "nos_tpu_schedule_latency_seconds",
+                     "class": "serving", "target": 0.1, "value": 0.19,
+                     "burn_fast": 9.0, "burn_slow": 8.0,
+                     "budget_remaining": -7.0, "breached": True},
+                    {"objective": "schedule-latency",
+                     "metric": "nos_tpu_schedule_latency_seconds",
+                     "class": "slice-2x2", "target": 60.0,
+                     "value": 12.0, "burn_fast": 0.1,
+                     "burn_slow": 0.1, "budget_remaining": 0.9,
+                     "breached": False},
+                ],
+            },
+            "journal": [
+                {"category": "pod-rejected", "subject": "serve/r0",
+                 "attrs": {"class": "serving",
+                           "plugin": "NodeResourcesFit",
+                           "reason": "", "message": "no fit"}},
+            ],
+        }
+
+    def test_top_prints_per_tier_rows(self, capsys):
+        from nos_tpu.obs.__main__ import cmd_top
+
+        assert cmd_top(self._payload()) == 0
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        header = next(i for i, line in enumerate(lines)
+                      if line.startswith("tier"))
+        tier_rows = {line.split()[0]: line
+                     for line in lines[header + 1:header + 4]}
+        assert set(tier_rows) == {"serving", "batch", "best-effort"}
+        assert "1" in tier_rows["serving"]   # one pending serving pod
+        assert "BREACH" in tier_rows["serving"]
+        assert "0.190" in tier_rows["serving"]      # p99 value
+        assert "0.9" in tier_rows["batch"]          # budget remaining
+        assert "BREACH" not in tier_rows["batch"]
+
+    def test_slo_joins_serving_breach_to_rejecting_plugin(self, capsys):
+        from nos_tpu.obs.__main__ import cmd_slo
+
+        assert cmd_slo(self._payload()) == 0
+        out = capsys.readouterr().out
+        assert "rejecting plugin for class serving: NodeResourcesFit" \
+            in out
+
+
+class TestTrace:
+    def test_same_seed_same_curve(self):
+        a = DiurnalTrace(seed=3)
+        b = DiurnalTrace(seed=3)
+        assert [a.load_at(t * 0.5) for t in range(200)] \
+            == [b.load_at(t * 0.5) for t in range(200)]
+
+    def test_diurnal_swing_and_bursts(self):
+        t = DiurnalTrace(seed=1, base_users=100_000.0,
+                         peak_users=1_000_000.0, period_s=100.0,
+                         burst_rate_per_s=0.0)
+        loads = [t.load_at(x) for x in range(0, 100)]
+        assert max(loads) > 5 * min(loads)      # real diurnal swing
+        assert all(x > 0 for x in loads)
+        bursty = DiurnalTrace(seed=1, burst_rate_per_s=0.5,
+                              burst_multiplier=4.0)
+        assert any(bursty.burst_multiplier_at(float(x)) > 1.0
+                   for x in range(60))
+        assert all(bursty.burst_multiplier_at(float(x)) >= 1.0
+                   for x in range(60))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DiurnalTrace(peak_users=1.0, base_users=2.0)
+        with pytest.raises(ValueError):
+            DiurnalTrace(burst_multiplier=0.5)
