@@ -92,6 +92,8 @@ from nos_tpu.kube.client import (
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
 from nos_tpu.kube.resources import pod_request
+from nos_tpu.obs import scoped as obs_scoped
+from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
 from nos_tpu.obs.slo import (
     GAUGE_FLOOR, LATENCY, RATE_CEILING, SLOEngine, SLOObjective,
 )
@@ -378,6 +380,10 @@ class Sim:
             api, HBM_GB, drain_preempt_after_cycles=40,
             drain_preempt_progress_fn=self._pod_progress,
             shard_chips_per_host=CHIPS_PER_HOST, clock=clock, **extra)
+        # Chip-second waste ledger on the virtual clock: a fresh one per
+        # seed (scoped in during run()) so per-seed conservation is
+        # checkable and seeds never cross-accrue.
+        self.ledger = ChipSecondLedger(clock=clock)
         # SLO plane: sampler + engine on the virtual clock (one tick per
         # sim tick), judging the module-level objectives over the same
         # registry the scheduler's histograms land in.
@@ -717,29 +723,41 @@ class Sim:
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> dict:
-        while self.now[0] < TRACE_S:
-            self.now[0] += TICK_S
-            self._maybe_kill_restore()
-            self._complete_finished()
-            self._spawn()
-            t0 = time.perf_counter()
-            self.scheduler.run_cycle()
-            self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
-            self._requeue_evicted()
-            self.slice_ctl.process_if_ready()
-            self.ts_ctl.process_if_ready()
-            for a in list(self.agents.values()):
-                a.tick()
-            self.eq_reconciler.reconcile_all()
-            self.ceq_reconciler.reconcile_all()
-            self._record_binds()
-            self._check_recovered()
-            self._sample_utilization()
-            if self.now[0] >= WARMUP_S:
-                # SLO judgement starts with utilization sampling: the
-                # fill ramp from an empty cluster is not an SLO event
-                self.slo_engine.tick()
-            self._check_invariants()
+        with obs_scoped(ledger=self.ledger):
+            while self.now[0] < TRACE_S:
+                self.now[0] += TICK_S
+                self._maybe_kill_restore()
+                self._complete_finished()
+                self._spawn()
+                t0 = time.perf_counter()
+                self.scheduler.run_cycle()
+                self.cycle_wall_ms.append(
+                    (time.perf_counter() - t0) * 1e3)
+                self._requeue_evicted()
+                self.slice_ctl.process_if_ready()
+                self.ts_ctl.process_if_ready()
+                for a in list(self.agents.values()):
+                    a.tick()
+                self.eq_reconciler.reconcile_all()
+                self.ceq_reconciler.reconcile_all()
+                self._record_binds()
+                self._check_recovered()
+                self._sample_utilization()
+                if self.now[0] >= WARMUP_S:
+                    # SLO judgement starts with utilization sampling:
+                    # the fill ramp from an empty cluster is not an SLO
+                    # event
+                    self.slo_engine.tick()
+                self._check_invariants()
+
+        # the waste waterfall: per-pool chip-second attribution with the
+        # conservation verdict — gated PER SEED (a violation is a code
+        # bug in the attribution, never a load artifact)
+        waste = self.ledger.report()
+        assert conservation_ok(waste), (
+            "chip-second conservation violated: "
+            + str({p: v["conservation_delta"]
+                   for p, v in waste["pools"].items()}))
 
         lat = self.latencies
         cyc = self.cycle_wall_ms
@@ -766,6 +784,7 @@ class Sim:
                 "invariant_violations": dict(self.invariant_violations),
             },
             "slo": self.slo_engine.report(),
+            "waste": waste,
             "node_loss": {
                 "killed": list(KILL_NODES),
                 "kill_t_s": NODE_KILL_T,
@@ -781,6 +800,60 @@ class Sim:
                 "lost_chip_seconds": round(self.lost_chip_seconds, 1),
             },
         }
+
+
+def merge_waste(blocks: list[dict]) -> dict:
+    """Pool per-seed waste blocks: chip-seconds and capacity integrals
+    sum, fractions recompute over the pooled capacity, evidence keeps
+    the first seed's culprit per category (each seed's is equally
+    valid — the join targets the journal of the seed that produced it).
+    The pooled block keeps the `pools` shape `obs waste` renders."""
+    pools: dict[str, dict] = {}
+    for block in blocks:
+        for pool, p in block.get("pools", {}).items():
+            agg = pools.setdefault(pool, {
+                "capacity_chips": p.get("capacity_chips", 0.0),
+                "elapsed_s": 0.0, "capacity_chip_seconds": 0.0,
+                "chip_seconds": {}, "conservation_delta": 0.0,
+                "evidence": {}})
+            agg["elapsed_s"] += p.get("elapsed_s", 0.0)
+            agg["capacity_chip_seconds"] += \
+                p.get("capacity_chip_seconds", 0.0)
+            agg["conservation_delta"] += p.get("conservation_delta", 0.0)
+            for cat, v in p.get("chip_seconds", {}).items():
+                agg["chip_seconds"][cat] = \
+                    agg["chip_seconds"].get(cat, 0.0) + v
+            for cat, ev in p.get("evidence", {}).items():
+                agg["evidence"].setdefault(cat, ev)
+    fleet_totals: dict[str, float] = {}
+    fleet_cap = 0.0
+    for agg in pools.values():
+        cap_s = agg["capacity_chip_seconds"]
+        fleet_cap += cap_s
+        agg["fractions"] = {
+            cat: (v / cap_s if cap_s else 0.0)
+            for cat, v in agg["chip_seconds"].items()}
+        for cat, v in agg["chip_seconds"].items():
+            fleet_totals[cat] = fleet_totals.get(cat, 0.0) + v
+    return {
+        "categories": blocks[0].get("categories", []) if blocks else [],
+        "pools": pools,
+        "fleet": {
+            "capacity_chip_seconds": fleet_cap,
+            "chip_seconds": fleet_totals,
+            "fractions": {cat: (v / fleet_cap if fleet_cap else 0.0)
+                          for cat, v in fleet_totals.items()},
+            "conservation_delta":
+                sum(fleet_totals.values()) - fleet_cap,
+        },
+        "overcommit_events": sum(
+            b.get("overcommit_events", 0) for b in blocks),
+        "quota_last_flip": next(
+            (b["quota_last_flip"] for b in blocks
+             if b.get("quota_last_flip")), None),
+        "conservation_ok_per_seed": [
+            conservation_ok(b) for b in blocks],
+    }
 
 
 def run_seeds(seeds=range(5)) -> dict:
@@ -842,6 +915,7 @@ def run_seeds(seeds=range(5)) -> dict:
         "p90_schedule_latency_s": pct(lat, 0.90, 3),
         "schedule_latency_by_class": latency_summary(by_class),
         "slo": slo_block,
+        "waste": merge_waste([r["waste"] for r in runs.values()]),
         "scheduler_cycle_wall_ms_p50": pct(cyc, 0.50, 2),
         "scheduler_cycle_wall_ms_p99": pct(cyc, 0.99, 2),
         "drain_evicted_pods": sum(s_.drain_evictions for s_ in sims),
@@ -917,6 +991,21 @@ def run_smoke() -> dict:
             assert field in v, f"verdict missing {field}: {v}"
     assert {v["class"] for v in latency_verdicts} <= \
         set(by_class) | {""}, "verdict classes disagree with the trace"
+    # Waste waterfall gate: the ledger observed every pool, attribution
+    # is non-null (productive accrued; the trace keeps the cluster
+    # saturated so at least one waste category must be non-zero too),
+    # and the conservation invariant holds per pool.
+    waste = result["waste"]
+    assert waste["pools"], "waste ledger observed no pools"
+    assert conservation_ok(waste), (
+        "waste conservation violated: "
+        + str({p: v["conservation_delta"]
+               for p, v in waste["pools"].items()}))
+    fleet = waste["fleet"]["chip_seconds"]
+    assert fleet.get("productive", 0.0) > 0.0, \
+        f"waste block has no productive chip-seconds: {fleet}"
+    assert any(v > 0.0 for c, v in fleet.items() if c != "productive"), \
+        f"waste block attributed nothing beyond productive: {fleet}"
     assert wall < 300.0, f"smoke trace took {wall:.1f}s (> 300s bound)"
     return {
         "smoke": "ok",
@@ -925,6 +1014,7 @@ def run_smoke() -> dict:
         "verdicts": len(verdicts),
         "breaches": sum(1 for v in verdicts if v["breached"]),
         "slo": result["slo"],
+        "waste": waste,
     }
 
 
@@ -935,6 +1025,10 @@ def main(argv=None) -> None:
     ap.add_argument("--slo-report", default="",
                     help="also write the SLO verdict block to this file "
                          "(CI uploads it as an artifact)")
+    ap.add_argument("--waste-report", default="",
+                    help="also write the chip-second waste block to "
+                         "this file (CI uploads it next to the SLO "
+                         "report; `obs waste --snapshot` renders it)")
     args = ap.parse_args(argv)
     if args.smoke:
         out = run_smoke()
@@ -946,6 +1040,11 @@ def main(argv=None) -> None:
         with open(args.slo_report, "w", encoding="utf-8") as fh:
             json.dump(out.get("slo", {}), fh, indent=2)
         print(f"slo report written to {args.slo_report}", file=sys.stderr)
+    if args.waste_report:
+        with open(args.waste_report, "w", encoding="utf-8") as fh:
+            json.dump({"waste": out.get("waste", {})}, fh, indent=2)
+        print(f"waste report written to {args.waste_report}",
+              file=sys.stderr)
     print(json.dumps(out))
 
 

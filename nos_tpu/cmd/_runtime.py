@@ -112,10 +112,15 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
             import json
 
             from nos_tpu.kube.serialize import dump_state
+            from nos_tpu.obs.ledger import get_ledger
             from nos_tpu.obs.slo import get_engine
 
             payload = {"state": dump_state(self.main.api),
-                       "metrics": REGISTRY.snapshot()}
+                       "metrics": REGISTRY.snapshot(),
+                       # the chip-second waterfall: `obs top` renders
+                       # the live waste row from it (docs/observability
+                       # .md, "The waterfall")
+                       "waste": get_ledger().report()}
             engine = get_engine()
             if engine is not None:
                 payload["slo"] = engine.report()

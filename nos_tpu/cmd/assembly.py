@@ -113,4 +113,5 @@ def build_scheduler(api: APIServer,
         preempt_budget_per_cycle=preempt_budget_per_cycle,
         backfill_remaining_fn=backfill_remaining_fn,
         backfill_duration_fn=backfill_duration_fn,
+        hbm_gb_per_chip=float(tpu_memory_gb_per_chip),
         **kwargs)

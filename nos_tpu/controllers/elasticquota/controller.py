@@ -25,6 +25,7 @@ from nos_tpu.kube.objects import RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.obs.ledger import get_ledger
 from nos_tpu.quota import TPUResourceCalculator
 from nos_tpu.utils.retry import retry_on_conflict
 
@@ -134,11 +135,18 @@ class _PodsReconciler:
         # was reclaimed back within min.  The FIRST labeling of a fresh
         # pod is not a flip — an in-quota pod that never borrowed must
         # not journal a spurious reclaim (over-quota from the start IS
-        # a borrow decision, so that one is recorded).
+        # a borrow decision, so that one is recorded).  The same flip
+        # feeds the chip-second ledger's quota_stranded join hint: the
+        # newest borrow/reclaim names the team whose borrowing last
+        # moved (obs/ledger.py).
         if desired == C.CAPACITY_OVER_QUOTA:
+            get_ledger().note_quota_flip(
+                pod.key, pod.metadata.namespace, borrowed=True)
             journal_record(J.QUOTA_BORROW, pod.key,
                            namespace=pod.metadata.namespace)
         elif prev is not None:
+            get_ledger().note_quota_flip(
+                pod.key, pod.metadata.namespace, borrowed=False)
             journal_record(J.QUOTA_RECLAIM, pod.key,
                            namespace=pod.metadata.namespace)
 

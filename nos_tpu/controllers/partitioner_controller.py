@@ -31,6 +31,7 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.kube.objects import PENDING, Pod
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.ledger import ACTUATION as LEDGER_ACTUATION, get_ledger
 from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.partitioning.core import (
     Actuator, Planner, QuarantineList, REASON_ACTUATION,
@@ -234,6 +235,12 @@ class PartitionerController:
             entry = self._actuation_started.get(name)
             if entry is None or entry[0] != spec_id:
                 self._actuation_started[name] = (spec_id, now)
+                # the same stamp marks the node's repartition window in
+                # the chip-second ledger: free chips there are actuation
+                # downtime until the status catches up (obs/ledger.py)
+                get_ledger().set_hold(name, LEDGER_ACTUATION,
+                                      owner=self._kind, kind=self._kind,
+                                      plan_id=spec_id)
 
     def _observe_landed_actuations(self) -> None:
         """Resolve in-flight actuation clocks: a node whose status plan
@@ -249,13 +256,21 @@ class PartitionerController:
             node = nodes.get(name)
             if node is None or not self._my_kind(node):
                 del self._actuation_started[name]
+                get_ledger().clear_hold(name, LEDGER_ACTUATION,
+                                        owner=self._kind)
                 continue
             annots = node.metadata.annotations
             if spec_plan_id(annots, family=self._kind) != plan_id:
                 del self._actuation_started[name]     # superseded
+                # _start_actuation_clocks re-stamps (clock and hold)
+                # for the new plan on the same poll's plan cycle
+                get_ledger().clear_hold(name, LEDGER_ACTUATION,
+                                        owner=self._kind)
                 continue
             if status_plan_id(annots, family=self._kind) == plan_id:
                 del self._actuation_started[name]
+                get_ledger().clear_hold(name, LEDGER_ACTUATION,
+                                        owner=self._kind)
                 pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
                 REGISTRY.observe(
                     "nos_tpu_actuation_latency_seconds",

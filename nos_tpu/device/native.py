@@ -147,6 +147,11 @@ def _bind(so: pathlib.Path):
         ctypes.POINTER(ctypes.c_double),
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64)]
+    # two-party GIL-release handshake (tests/test_native.py): binding it
+    # here also makes a stale prebuilt .so missing the symbol rebuild
+    lib.nos_gil_handshake.restype = ctypes.c_int
+    lib.nos_gil_handshake.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_double]
     return lib
 
 

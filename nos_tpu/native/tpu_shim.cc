@@ -21,6 +21,8 @@
 // exhaustive search is both exact and fast.
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -29,6 +31,7 @@
 #include <set>
 #include <sstream>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -402,6 +405,31 @@ int nos_fit_batch(const double* free_m, const double* req_m,
     }
   }
   return 0;
+}
+
+// Two-party GIL-release handshake backing the test suite's overlap
+// check (tests/test_native.py).  The caller allocates `cell` zeroed and
+// starts two threads, each calling this through the ctypes CDLL
+// binding.  Each participant atomically increments the cell, then
+// spin-waits until it reads >= 2 or the deadline passes.  Both return 1
+// IFF both threads were inside this function at once — possible only
+// when the binding releases the GIL for the call's duration (CDLL
+// semantics).  A binding that held the GIL (PyDLL) deadlocks the
+// second thread outside, the first times out, and the handshake
+// reports 0 — an event-based proof of the GIL-released property with
+// no wall-clock speedup threshold for machine noise to flake on.
+int nos_gil_handshake(long long* cell, double timeout_s) {
+  if (!cell || timeout_s < 0) return -3;
+  using steady = std::chrono::steady_clock;
+  const auto deadline =
+      steady::now() + std::chrono::duration_cast<steady::duration>(
+                          std::chrono::duration<double>(timeout_s));
+  __atomic_fetch_add(cell, 1, __ATOMIC_SEQ_CST);
+  while (__atomic_load_n(cell, __ATOMIC_SEQ_CST) < 2) {
+    if (steady::now() >= deadline) return 0;
+    std::this_thread::yield();
+  }
+  return 1;
 }
 
 int nos_runtime_delete_slice(void* h, const char* id) {

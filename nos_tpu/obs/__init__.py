@@ -25,6 +25,9 @@ from .explain import explain_plan, explain_pod
 from .journal import (
     DecisionJournal, DecisionRecord, get_journal, record, set_journal,
 )
+from .ledger import (
+    ChipSecondLedger, get_ledger, set_ledger,
+)
 from .slo import (
     SLOEngine, SLOObjective, get_engine, set_engine,
 )
@@ -35,11 +38,13 @@ from .trace import (
 )
 
 __all__ = [
-    "DecisionJournal", "DecisionRecord", "RingExporter", "SLOEngine",
-    "SLOObjective", "Span", "TimeSeriesSampler", "Tracer",
+    "ChipSecondLedger", "DecisionJournal", "DecisionRecord",
+    "RingExporter", "SLOEngine", "SLOObjective", "Span",
+    "TimeSeriesSampler", "Tracer",
     "bump", "current_span", "detail_span", "explain_plan", "explain_pod",
-    "flight_snapshot", "get_engine", "get_journal", "get_tracer", "record",
-    "scoped", "set_engine", "set_journal", "set_tracer", "span",
+    "flight_snapshot", "get_engine", "get_journal", "get_ledger",
+    "get_tracer", "record", "scoped", "set_engine", "set_journal",
+    "set_ledger", "set_tracer", "span",
 ]
 
 
@@ -59,20 +64,27 @@ def flight_snapshot() -> dict:
     engine = get_engine()
     if engine is not None:
         snapshot["slo"] = engine.report()
+    # the chip-second waterfall rides in the SAME payload as the
+    # journal, so `obs waste`'s culprit→journal join works from one
+    # fetch (the explain/slo workflow, docs/observability.md)
+    snapshot["waste"] = get_ledger().report()
     return snapshot
 
 
 @contextlib.contextmanager
 def scoped(tracer: Tracer | None = None,
            journal: DecisionJournal | None = None,
-           engine: SLOEngine | None = None) -> Iterator[None]:
-    """Install a tracer/journal (and optionally an SLO engine) for the
-    duration of the block and restore the previous set on exit — how
-    tests (and the lockcheck-instrumented chaos soak) observe an
-    isolated run without leaking state into the process globals."""
+           engine: SLOEngine | None = None,
+           ledger: ChipSecondLedger | None = None) -> Iterator[None]:
+    """Install a tracer/journal (and optionally an SLO engine and a
+    chip-second ledger) for the duration of the block and restore the
+    previous set on exit — how tests (and the lockcheck-instrumented
+    chaos soak) observe an isolated run without leaking state into the
+    process globals."""
     prev_tracer = set_tracer(tracer) if tracer is not None else None
     prev_journal = set_journal(journal) if journal is not None else None
     prev_engine = set_engine(engine) if engine is not None else None
+    prev_ledger = set_ledger(ledger) if ledger is not None else None
     try:
         yield
     finally:
@@ -82,3 +94,5 @@ def scoped(tracer: Tracer | None = None,
             set_journal(prev_journal)
         if engine is not None:
             set_engine(prev_engine)
+        if prev_ledger is not None:
+            set_ledger(prev_ledger)

@@ -1,11 +1,12 @@
 """CLI: `python -m nos_tpu.obs` — explain pods/plans, report SLO
-verdicts, render the fleet scoreboard, dump the recorder, or self-test
-the subsystem.
+verdicts, render the fleet scoreboard or the chip-second waste
+waterfall, dump the recorder, or self-test the subsystem.
 
     python -m nos_tpu.obs explain pod <ns>/<name> --snapshot flight.json
     python -m nos_tpu.obs explain plan [--kind slice] --url http://host:8080
     python -m nos_tpu.obs slo --snapshot bench.json
-    python -m nos_tpu.obs top --url http://host:8080
+    python -m nos_tpu.obs top --url http://host:8080 [--watch 5]
+    python -m nos_tpu.obs waste --url http://host:8080
     python -m nos_tpu.obs dump --url http://host:8080
     python -m nos_tpu.obs --selftest
 
@@ -25,6 +26,7 @@ import json
 import sys
 
 from . import explain_plan, explain_pod
+from . import journal as J
 
 
 def _load_snapshot(args: argparse.Namespace,
@@ -69,6 +71,18 @@ def _fmt(v: object, digits: int = 2) -> str:
     if isinstance(v, float):
         return f"{v:.{digits}f}"
     return str(v)
+
+
+def _find_waste_block(payload: dict) -> dict | None:
+    """The chip-second waterfall inside any payload shape we serve: a
+    flight/state snapshot carrying "waste", a bench_utilization result
+    (top level), or bench.py's single JSON nesting the utilization
+    block."""
+    for holder in (payload, payload.get("utilization", {})):
+        block = holder.get("waste") if isinstance(holder, dict) else None
+        if isinstance(block, dict) and "pools" in block:
+            return block
+    return None
 
 
 def _rejecting_plugin(journal: list[dict], slo_class: str) -> str:
@@ -183,18 +197,25 @@ def cmd_top(payload: dict) -> int:
     from nos_tpu.topology.profile import free_chip_equivalents
     from nos_tpu.utils.pod_util import workload_class
 
+    from .ledger import stranded_fraction
+
     api = load_state(state)
     pools: dict[str, dict] = {}
+    node_pool: dict[str, str] = {}
+    cap_by_node: dict[str, float] = {}
     for node in api.list(KIND_NODE):
         pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
+        node_pool[node.metadata.name] = pool
         agg = pools.setdefault(pool, {"hosts": 0, "chips": 0.0,
-                                      "used": 0.0, "busy_hosts": 0})
+                                      "used": 0.0})
         agg["hosts"] += 1
         try:
-            agg["chips"] += float(
+            cap = float(
                 node.metadata.labels.get(C.LABEL_CHIP_COUNT, "0") or 0)
         except ValueError:
-            pass
+            cap = 0.0
+        cap_by_node[node.metadata.name] = cap
+        agg["chips"] += cap
     pending: dict[str, int] = {}
     used_by_node: dict[str, float] = {}
     for pod in api.list(KIND_POD):
@@ -205,12 +226,21 @@ def cmd_top(payload: dict) -> int:
         used_by_node.setdefault(pod.spec.node_name, 0.0)
         used_by_node[pod.spec.node_name] += \
             free_chip_equivalents(pod_request(pod))
-    for node in api.list(KIND_NODE):
-        pool = node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
-        used = used_by_node.get(node.metadata.name, 0.0)
+    # per-pool free-by-host + the offline stranded set (hosts already
+    # running something — free capacity a whole-host/aligned-window
+    # demand cannot use without a re-carve).  The ARITHMETIC is the
+    # ledger's shared stranded-free helper; the live scheduler derives
+    # its stranded set from rejection verdicts instead
+    # (docs/observability.md, "The waterfall").
+    free_by_pool: dict[str, dict[str, float]] = {}
+    busy_by_pool: dict[str, set[str]] = {}
+    for name, pool in node_pool.items():
+        used = used_by_node.get(name, 0.0)
         pools[pool]["used"] += used
+        free_by_pool.setdefault(pool, {})[name] = \
+            max(0.0, cap_by_node.get(name, 0.0) - used)
         if used > 0:
-            pools[pool]["busy_hosts"] += 1
+            busy_by_pool.setdefault(pool, set()).add(name)
 
     total_chips = sum(p["chips"] for p in pools.values())
     total_used = sum(p["used"] for p in pools.values())
@@ -222,16 +252,25 @@ def cmd_top(payload: dict) -> int:
         p = pools[pool]
         free = max(0.0, p["chips"] - p["used"])
         putil = p["used"] / p["chips"] if p["chips"] else 0.0
-        # fragmentation: the fraction of free chips stranded on hosts
-        # that already run something — free capacity a whole-host (or
-        # aligned-window) gang cannot use without a re-carve
-        idle_hosts = p["hosts"] - p["busy_hosts"]
-        chips_per_host = p["chips"] / p["hosts"] if p["hosts"] else 0.0
-        whole_free = idle_hosts * chips_per_host
-        frag = 1.0 - (whole_free / free) if free > 0 else 0.0
+        frag = stranded_fraction(free_by_pool.get(pool, {}),
+                                 busy_by_pool.get(pool, set()))
         print(f"{pool:<16} {p['hosts']:>5} {p['chips']:>6g} "
               f"{p['used']:>6.1f} {free:>6.1f} {putil:>5.2f} "
               f"{max(0.0, frag):>5.2f}")
+    waste = _find_waste_block(payload)
+    if waste is not None and waste.get("pools"):
+        print("waste waterfall (chip-seconds, share of capacity):")
+        for pool in sorted(waste["pools"]):
+            wp = waste["pools"][pool]
+            fr = wp.get("fractions", {})
+            top = sorted(((c, f) for c, f in fr.items()
+                          if c != "productive" and f > 0.0),
+                         key=lambda kv: -kv[1])[:3]
+            steps = "  ".join(f"{c}={f * 100:.1f}%" for c, f in top) \
+                or "no waste recorded"
+            print(f"  {pool:<14} productive="
+                  f"{fr.get('productive', 0.0) * 100:.1f}%  {steps}")
+        print("  (`obs waste` ranks the sources and names culprits)")
     if pending:
         print("pending by class:")
         for cls in sorted(pending):
@@ -247,6 +286,143 @@ def cmd_top(payload: dict) -> int:
             print(f"  {v.get('objective')}/{v.get('class') or '-':<16} "
                   f"{_fmt(v.get('budget_remaining'))} [{state_s}]")
     return 0
+
+
+def _newest(journal: list[dict], category: str,
+            subject: str | None = None,
+            attr_match: dict | None = None) -> dict | None:
+    """Newest journal record of `category` matching subject/attrs."""
+    for rec in reversed(journal):
+        if rec.get("category") != category:
+            continue
+        if subject is not None and rec.get("subject") != subject:
+            continue
+        attrs = rec.get("attrs", {})
+        if attr_match and any(attrs.get(k) != v
+                              for k, v in attr_match.items()):
+            continue
+        return rec
+    return None
+
+
+def _waste_culprit(journal: list[dict], category: str,
+                   evidence: dict) -> list[str]:
+    """Join one waste category's culprit evidence to its journal
+    record — the same flight-recorder-first workflow as `explain`/`slo`
+    (each category's evidence keys are written by its owning call
+    site)."""
+    lines: list[str] = []
+    if category == "frag_stranded" and evidence.get("class"):
+        cls = str(evidence["class"])
+        lines.append(f"culprit class {cls}: rejected on "
+                     f"{evidence.get('rejected_nodes', '?')} node(s)")
+        rec = _newest(journal, J.POD_REJECTED, attr_match={"class": cls})
+        if rec is not None:
+            attrs = rec.get("attrs", {})
+            counts = attrs.get("reason_counts") or {}
+            why = (max(counts.items(), key=lambda kv: kv[1])[0]
+                   if counts else attrs.get("message", ""))
+            lines.append(f"newest rejection ({rec['subject']}): {why}")
+            lines.append(f"next: `obs explain pod {rec['subject']}`")
+    elif category in ("gang_wait", "drain") and evidence.get("gang"):
+        gang = str(evidence["gang"])
+        verb = ("assembly stalled" if category == "gang_wait"
+                else "window bought by drain eviction")
+        lines.append(f"culprit gang {gang}: {verb}")
+        rec = _newest(journal, J.GANG_REJECTED, subject=gang)
+        if rec is not None:
+            attrs = rec.get("attrs", {})
+            lines.append(
+                f"newest gang verdict: {attrs.get('message', '?')} "
+                f"(members: {attrs.get('members_total', '?')})")
+    elif category == "actuation":
+        kind = str(evidence.get("kind", "") or "?")
+        lines.append(f"culprit plan: kind={kind} "
+                     f"plan_id={evidence.get('plan_id', '?')} "
+                     f"(node {evidence.get('node', '?')})")
+        rec = _newest(journal, J.PLAN_CYCLE, subject=kind)
+        if rec is not None:
+            attrs = rec.get("attrs", {})
+            lines.append(f"newest plan cycle: pods={attrs.get('pods')} "
+                         f"actuated={attrs.get('actuated')} — "
+                         "`obs explain plan` for the budget breakdown")
+    elif category == "quarantine" and evidence.get("node"):
+        node = str(evidence["node"])
+        lines.append(f"culprit node {node}: "
+                     f"{evidence.get('reason', '?')}")
+        rec = _newest(journal, J.QUARANTINED, subject=node)
+        if rec is not None:
+            lines.append(f"quarantined (seq {rec['seq']}): "
+                         f"{rec.get('attrs', {}).get('reason', '?')}")
+    elif category == "quota_stranded" and evidence.get("class"):
+        cls = str(evidence["class"])
+        lines.append(f"culprit class {cls}: "
+                     f"{evidence.get('blocked_chips', '?')} chip(s) of "
+                     "demand blocked by borrowing limits")
+        rec = _newest(journal, J.QUOTA_HOL_CLAIM) or _newest(
+            journal, J.POD_REJECTED, attr_match={"class": cls})
+        if rec is not None:
+            lines.append(f"newest quota decision ({rec['category']}): "
+                         f"{rec['subject']}")
+    return lines
+
+
+def cmd_waste(payload: dict) -> int:
+    """Render the chip-second waste waterfall: per-pool category
+    breakdown with the conservation verdict, then the fleet's top waste
+    sources each joined to its journal evidence."""
+    from .ledger import conservation_ok, waste_ranking
+
+    block = _find_waste_block(payload)
+    if block is None:
+        print("payload carries no waste waterfall — fetch "
+              "/debug/flightrecorder (or /snapshot) from a live main, "
+              "or pass a bench_utilization/bench.py result JSON",
+              file=sys.stderr)
+        return 1
+    journal = payload.get("journal", [])
+    pools = block.get("pools", {})
+    if not pools:
+        print("waste waterfall: no pools observed yet (has a scheduler "
+              "cycle run?)")
+        return 0
+    conserved = conservation_ok(block)
+    print("chip-second waste waterfall "
+          f"(conservation: {'ok' if conserved else 'VIOLATED'}):")
+    for pool in sorted(pools):
+        p = pools[pool]
+        cap_s = p.get("capacity_chip_seconds", 0.0)
+        print(f"pool {pool}: {_fmt(p.get('capacity_chips'), 0)} chips x "
+              f"{_fmt(p.get('elapsed_s'), 1)}s = {cap_s:.1f} chip-s "
+              f"(delta {p.get('conservation_delta', 0.0):+.2e})")
+        rows = sorted(p.get("chip_seconds", {}).items(),
+                      key=lambda kv: -kv[1])
+        for cat, secs in rows:
+            frac = p.get("fractions", {}).get(cat, 0.0)
+            print(f"  {cat:<16} {secs:>12.1f}  {frac * 100:>5.1f}%")
+    ranked = waste_ranking(block)
+    if not ranked:
+        print("no waste recorded — every chip-second was productive")
+        return 0
+    print("top waste sources (fleet):")
+    for i, row in enumerate(ranked, 1):
+        cat = str(row["category"])
+        print(f"  {i}. {cat:<16} {row['chip_seconds']:>12.1f} chip-s "
+              f"{row['fraction'] * 100:>5.1f}%")
+        evidence: dict = {}
+        for pool in pools.values():
+            ev = pool.get("evidence", {}).get(cat)
+            if ev:
+                evidence = ev
+                break
+        for line in _waste_culprit(journal, cat, evidence):
+            print(f"     {line}")
+    flip = block.get("quota_last_flip")
+    if flip:
+        print(f"newest quota flip: {flip.get('pod')} "
+              f"({'borrow' if flip.get('borrowed') else 'reclaim'}, "
+              f"namespace {flip.get('namespace')})")
+    return 0 if conserved else 1
 
 
 def selftest() -> int:
@@ -370,13 +546,81 @@ def selftest() -> int:
     if q99 is None:
         failures.append("registry quantile returned None with samples")
 
+    # chip-second ledger: exact conservation under category churn, hold
+    # lifecycle bounded, stranded-free helper arithmetic
+    from .ledger import (
+        ChipSecondLedger, conservation_ok, stranded_free, waste_ranking,
+    )
+
+    led_now = [0.0]
+    ledger = ChipSecondLedger(clock=lambda: led_now[0])
+    ledger.set_hold("host-0", "quarantine", owner="slice", reason="test")
+    ledger.observe({"pod-0": {"capacity": 16.0,
+                              "categories": {"productive": 12.0,
+                                             "frag_stranded": 4.0}}})
+    led_now[0] += 10.0
+    ledger.observe({"pod-0": {"capacity": 16.0,
+                              "categories": {"productive": 16.0}}})
+    led_now[0] += 5.0
+    ledger.observe({"pod-0": {"capacity": 16.0, "categories": {}}})
+    ledger.clear_hold("host-0", "quarantine", owner="slice")
+    report = ledger.report()
+    pool = report["pools"]["pod-0"]
+    if pool["chip_seconds"].get("productive") != 12.0 * 10 + 16.0 * 5:
+        failures.append(f"ledger productive accrual wrong: {pool}")
+    if not conservation_ok(report):
+        failures.append(f"ledger conservation violated: {pool}")
+    if ledger.hold_count() != 0:
+        failures.append("ledger hold lifecycle leaked")
+    if waste_ranking(report)[0]["category"] != "frag_stranded":
+        failures.append("waste ranking did not rank the frag step first")
+    if stranded_free({"a": 3.0, "b": 5.0}, {"b"}) != 5.0:
+        failures.append("stranded_free arithmetic broken")
+
     if failures:
         print("obs selftest: FAIL", file=sys.stderr)
         for f in failures:
             print(f"  - {f}", file=sys.stderr)
         return 1
-    print("obs selftest: ok (spans, journal, explain, timeseries, slo)")
+    print("obs selftest: ok (spans, journal, explain, timeseries, slo, "
+          "ledger)")
     return 0
+
+
+def _watch_top(args: argparse.Namespace, endpoint: str,
+               sleep=None) -> int:
+    """`obs top --watch N`: periodic scoreboard refresh from a live
+    snapshot source, clearing the screen between frames (one-shot
+    behavior is unchanged without --watch).  `--frames K` bounds the
+    loop for tests/scripts; ^C exits cleanly either way."""
+    if sleep is None:
+        import time as _time
+
+        # interactive CLI pacing, not decision-plane code: the frames
+        # themselves come from the live endpoint, nothing here feeds a
+        # deterministic seed
+        sleep = _time.sleep  # noslint: N002 — operator-facing watch loop, not deterministic code
+    frame = 0
+    rc = 0
+    try:
+        while True:
+            try:
+                snapshot = _load_snapshot(args, endpoint=endpoint)
+            except (OSError, json.JSONDecodeError) as exc:
+                print(f"cannot read snapshot: {exc}", file=sys.stderr)
+                return 1
+            frame += 1
+            # ANSI clear + home, like watch(1); a dumb pipe just sees
+            # frames separated by the escape (harmless in logs)
+            print("\x1b[2J\x1b[H", end="")
+            print(f"obs top --watch {args.watch:g} "
+                  f"(frame {frame}{f'/{args.frames}' if args.frames else ''})")
+            rc = cmd_top(snapshot)
+            if args.frames and frame >= args.frames:
+                return rc
+            sleep(args.watch)
+    except KeyboardInterrupt:
+        return rc
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -399,8 +643,19 @@ def main(argv: list[str] | None = None) -> int:
         "slo", help="SLO verdicts: per-class p99, burn rates, budget")
     p_top = sub.add_parser(
         "top", help="one-shot fleet scoreboard (utilization, "
-                    "fragmentation, pending, budget)")
-    for p in (p_pod, p_plan, p_dump, p_slo, p_top):
+                    "fragmentation, waste waterfall, pending, budget)")
+    p_top.add_argument(
+        "--watch", type=float, default=0.0, metavar="N",
+        help="refresh every N seconds from --url (clears the screen "
+             "between frames; one-shot without it)")
+    p_top.add_argument(
+        "--frames", type=int, default=0, metavar="K",
+        help="with --watch: stop after K frames (0 = until ^C; "
+             "tests/scripts use it)")
+    p_waste = sub.add_parser(
+        "waste", help="chip-second waste waterfall: per-pool category "
+                      "breakdown, conservation verdict, ranked culprits")
+    for p in (p_pod, p_plan, p_dump, p_slo, p_top, p_waste):
         p.add_argument("--snapshot", default="",
                        help="saved snapshot JSON ('-'=stdin)")
         p.add_argument("--url", default="",
@@ -412,11 +667,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.command is None:
         parser.print_help()
         return 2
-    # `slo` fetches the FLIGHT snapshot, not /debug/slo: the flight
-    # payload embeds the engine report AND the journal, so the
-    # breach→rejecting-plugin join works on the live-URL path too
+    # `slo` and `waste` fetch the FLIGHT snapshot, not their dedicated
+    # blocks: the flight payload embeds the report AND the journal, so
+    # the breach→rejecting-plugin and waste→culprit joins work on the
+    # live-URL path too
     endpoint = {"top": "/snapshot"}.get(
         args.command, "/debug/flightrecorder")
+    if args.command == "top" and args.watch > 0.0:
+        return _watch_top(args, endpoint)
     try:
         snapshot = _load_snapshot(args, endpoint=endpoint)
     except json.JSONDecodeError as exc:
@@ -436,6 +694,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_slo(snapshot)
     if args.command == "top":
         return cmd_top(snapshot)
+    if args.command == "waste":
+        return cmd_waste(snapshot)
     if args.what == "pod":
         if "/" not in args.key:
             print("pod key must be <namespace>/<name>", file=sys.stderr)

@@ -35,6 +35,7 @@ from typing import Callable
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.obs.ledger import QUARANTINE as LEDGER_QUARANTINE, get_ledger
 from nos_tpu.utils.guards import guarded_by
 
 logger = logging.getLogger(__name__)
@@ -82,7 +83,16 @@ class QuarantineList:
                 return False
             self._quarantined[node] = (reason, self._clock())
             self._set_gauge_locked()
-        # outside the lock: the journal is a leaf lock by contract
+            # the ledger hold (quarantine waste in the chip-second
+            # waterfall, obs/ledger.py) is stamped UNDER this lock:
+            # it mirrors keyed membership state, and an interleaved
+            # quarantine/release pair stamping out of order would leave
+            # a stale hold forever.  The ledger is a leaf lock by
+            # contract, so nesting it here adds no orderable edge.
+            get_ledger().set_hold(node, LEDGER_QUARANTINE,
+                                  owner=self.kind, kind=self.kind,
+                                  reason=reason)
+        # outside the lock: the journal append is order-insensitive
         journal_record(J.QUARANTINED, node, kind=self.kind, reason=reason)
         logger.warning("quarantine[%s]: node %s quarantined (%s)",
                        self.kind, node, reason)
@@ -96,6 +106,8 @@ class QuarantineList:
             self._streaks.pop(node, None)
             self._probe_until.pop(node, None)
             self._set_gauge_locked()
+            get_ledger().clear_hold(node, LEDGER_QUARANTINE,
+                                    owner=self.kind)
         journal_record(J.QUARANTINE_RELEASED, node, kind=self.kind,
                        was=entry[0])
         logger.info("quarantine[%s]: node %s released (was: %s)",
@@ -119,6 +131,8 @@ class QuarantineList:
             self._streaks.pop(node, None)
             self._probe_until[node] = self._clock() + window_s
             self._set_gauge_locked()
+            get_ledger().clear_hold(node, LEDGER_QUARANTINE,
+                                    owner=self.kind)
         journal_record(J.QUARANTINE_RELEASED, node, kind=self.kind,
                        was=entry[0], probe=True)
         logger.info("quarantine[%s]: node %s released for half-open "

@@ -122,7 +122,8 @@ class Scheduler:
                      [Pod], float | None] | None = None,
                  backfill_duration_fn: Callable[
                      [Pod], float | None] | None = None,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 hbm_gb_per_chip: float = 16.0) -> None:
         self._api = api
         self._framework = framework
         self.name = name
@@ -249,6 +250,27 @@ class Scheduler:
         # schedule_gang calls (public entry points) drop it on exit so
         # external mutations between calls are seen (ADVICE round 5).
         self._in_cycle = False
+        # Chip-second waste attribution (obs/ledger.py): the cycle's
+        # OWN rejection verdicts, collected as they are made, feed the
+        # cycle-end waterfall — frag_stranded is derived from what the
+        # Filter pipeline actually said, never from a re-scan.
+        # nodes every pending class that scanned them rejected (a node
+        # some class FIT binds the pod and never lands here)
+        self._waste_rejected_nodes: set[str] = set()
+        # pending class -> rejection node-count (frag culprit evidence)
+        self._waste_frag_counts: dict[str, int] = {}
+        # pending class -> chip demand blocked by quota (PreFilter
+        # quota rejections + head-of-line deferrals); Σ bounds the
+        # quota_stranded bucket — stranding cannot exceed the demand
+        self._waste_quota_blocked: dict[str, float] = {}
+        # stuck gang -> its members' chip demand; Σ bounds the
+        # gang_wait attributed OUTSIDE the leased window
+        self._waste_pending_gangs: dict[str, float] = {}
+        # hosts whose free chips were bought by drain preemption this
+        # lease period (DRAIN holds, cleared when the lease resolves)
+        self._drain_hold_hosts: frozenset[str] = frozenset()
+        # timeshare-GB -> chips conversion for productive accounting
+        self._hbm_gb_per_chip = hbm_gb_per_chip
 
     def close(self) -> None:
         """Detach the incremental cache's watch subscriptions.  A
@@ -376,6 +398,7 @@ class Scheduler:
                 return None     # post-preemption retry: caller nominates
             if status.reason == "quota":
                 self._record_quota_hol(pod)
+                self._note_quota_blocked(pod)
             # An unschedulable PreFilter verdict still gets a preemption
             # attempt, exactly like kube-scheduler: quota rejections are
             # resolved by evicting over-quota borrowers (reference
@@ -426,6 +449,7 @@ class Scheduler:
                 return placed
             if scan[2] is None:
                 scan[2] = self._node_reason_attrs(rejections)
+            self._note_no_fit(pod, rejections)
             self._mark_unschedulable(
                 pod, Status.unschedulable("no fit"),
                 node_attrs=scan[2])
@@ -554,6 +578,10 @@ class Scheduler:
         self._quota_hol: dict[str, int] = {}
         self._cycle_lister_cache = None     # fresh snapshot per cycle
         self._busy_map_cache = None
+        self._waste_rejected_nodes = set()
+        self._waste_frag_counts = {}
+        self._waste_quota_blocked = {}
+        self._waste_pending_gangs = {}
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
@@ -573,6 +601,7 @@ class Scheduler:
         if self._lease is not None and self._lease[0] not in pending_gangs:
             self._lease = None
             self._sync_lease_annotations(frozenset())
+            self._clear_drain_holds()
         elif not self._lease_healed and self._lease is None:
             # Startup: a predecessor may have died holding a lease whose
             # annotations would otherwise skew partitioning forever.
@@ -600,12 +629,15 @@ class Scheduler:
             if key not in seen_gangs:
                 seen_gangs.add(key)
                 bound += self.schedule_gang(gangs[key])
+        pending_counts = self._publish_pending_gauges()
+        # waste waterfall BEFORE the snapshot drops: attribution reads
+        # the post-bind cycle view plus this cycle's rejection verdicts
+        self._observe_waste(pending_counts)
         # drop the cycle snapshot on exit: schedule_one/schedule_gang are
         # public entry points and must see fresh state when driven
         # outside run_cycle (they rebuild lazily)
         self._cycle_lister_cache = None
         self._busy_map_cache = None
-        self._publish_pending_gauges()
         return bound
 
     # -- quota head-of-line -------------------------------------------------
@@ -662,6 +694,7 @@ class Scheduler:
             # exists to stop SMALL BATCH pods from eating a gang's
             # accumulating quota, not to starve the protected tier.
             return False
+        self._note_quota_blocked(pod)
         self._mark_unschedulable(pod, Status.unschedulable(
             f"waiting behind a higher-priority quota claim in namespace "
             f"{pod.metadata.namespace}", reason="quota-hol"))
@@ -706,6 +739,7 @@ class Scheduler:
             label_selector={C_LABEL_POD_GROUP: gang},
             filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
         if alive < min_member:
+            self._note_stuck_gang(members)
             self._gang_journal(
                 members, False,
                 f"pod group waiting for members ({alive}/{min_member})")
@@ -786,6 +820,7 @@ class Scheduler:
             msg = "gang does not fit as a whole"
             if preempted:
                 msg += " (evicted over-quota victims, retrying)"
+            self._note_stuck_gang(members)
             self._gang_journal(members, False, msg)
             self._reserve_gang_window(
                 (first.metadata.namespace, gang), windows, base)
@@ -984,7 +1019,17 @@ class Scheduler:
             doomed_keys.update(m.key for m in members)
             evicted += len(evict_gang(self._api, pod))
         if evicted:
+            # the freed chips were BOUGHT by eviction: until the leased
+            # window resolves, their idle time is `drain` waste, not
+            # natural gang-assembly wait (obs/ledger.py)
+            from nos_tpu.obs.ledger import DRAIN, get_ledger
 
+            ledger = get_ledger()
+            for host in hosts:
+                ledger.set_hold(host, DRAIN, owner=self.name,
+                                gang=f"{gang[0]}/{gang[1]}",
+                                evicted=evicted)
+            self._drain_hold_hosts = frozenset(hosts)
             REGISTRY.inc("nos_tpu_drain_preemptions_total",
                          labels={"gang": f"{gang[0]}/{gang[1]}"},
                          value=evicted)
@@ -1207,6 +1252,9 @@ class Scheduler:
             if best is None or drained > best[0]:
                 best = (drained, frozenset(hosts))
         if best is not None:
+            if best[1] != self._reserved_hosts:
+                # the lease moved: drain holds belong to the old window
+                self._clear_drain_holds()
             self._lease = (gang_key, best[1])
             self._reserved_hosts = best[1]
             self._window_eta = None     # new window: stale ETA must die
@@ -1350,7 +1398,174 @@ class Scheduler:
         REGISTRY.observe("nos_tpu_schedule_latency_seconds", latency,
                          labels={"class": workload_class(pods[0])})
 
-    def _publish_pending_gauges(self) -> None:
+    # -- chip-second waste attribution (obs/ledger.py) ----------------------
+    def _clear_drain_holds(self) -> None:
+        if not self._drain_hold_hosts:
+            return
+        from nos_tpu.obs.ledger import DRAIN, get_ledger
+
+        ledger = get_ledger()
+        for host in self._drain_hold_hosts:
+            ledger.clear_hold(host, DRAIN, owner=self.name)
+        self._drain_hold_hosts = frozenset()
+
+    def _note_quota_blocked(self, pod: Pod) -> None:
+        """A pod rejected by the quota gates (PreFilter quota verdict or
+        head-of-line deferral): its class's demand is quota-blocked this
+        cycle — free chips it could physically use read quota_stranded,
+        not idle."""
+        from nos_tpu.kube.resources import pod_request as _pod_request
+        from nos_tpu.obs.ledger import pod_chip_equiv
+
+        cls = workload_class(pod)
+        shard = float(getattr(getattr(self._capacity, "calculator", None),
+                              "chips_per_host", 0) or 0) or 8.0
+        chips = pod_chip_equiv(_pod_request(pod), shard,
+                               self._hbm_gb_per_chip)
+        self._waste_quota_blocked[cls] = max(
+            self._waste_quota_blocked.get(cls, 0.0), chips)
+
+    def _note_no_fit(self, pod: Pod, rejections: dict[str, str]) -> None:
+        """The Filter pipeline rejected this pending pod on every node:
+        those verdicts ARE the frag_stranded derivation — a node every
+        pending class rejected holds free chips no pending demand can
+        use (idempotent per class; the class scan cache replays the
+        identical verdict set for class-mates)."""
+        self._waste_rejected_nodes.update(rejections)
+        cls = workload_class(pod)
+        self._waste_frag_counts[cls] = max(
+            self._waste_frag_counts.get(cls, 0), len(rejections))
+
+    def _note_stuck_gang(self, members: list[Pod]) -> None:
+        """A gang that failed admission this cycle: remember it with its
+        members' chip demand — the cap on gang_wait attributed outside
+        the leased window (free chips far beyond what the gang could
+        consume are idle, not gang wait)."""
+        from nos_tpu.kube.resources import pod_request as _pod_request
+        from nos_tpu.obs.ledger import pod_chip_equiv
+
+        first = members[0]
+        key = f"{first.metadata.namespace}/{gang_name(first)}"
+        shard = float(getattr(getattr(self._capacity, "calculator", None),
+                              "chips_per_host", 0) or 0) or 8.0
+        chips = sum(pod_chip_equiv(_pod_request(m), shard,
+                                   self._hbm_gb_per_chip)
+                    for m in members)
+        self._waste_pending_gangs[key] = max(
+            self._waste_pending_gangs.get(key, 0.0), chips)
+
+    def _observe_waste(self, pending_by_class: dict[str, int]) -> None:
+        """Cycle end: attribute every chip in the cycle snapshot to ONE
+        waterfall category and hand the per-pool breakdown to the
+        chip-second ledger.  Free chips on a node are attributed with
+        this precedence (docs/observability.md, "The waterfall"):
+        quarantine > actuation > drain holds (owning subsystems stamp
+        those), then the gang window lease (gang_wait), then this
+        cycle's own verdicts — rejected-by-every-scanned-class reads
+        frag_stranded; quota-blocked (and off-lease gang) demand reads
+        quota_stranded/gang_wait, each CAPPED at the demand's own chip
+        size (stranding cannot exceed what the blocked pods could
+        consume — one 8-chip quota rejection must not paint a
+        1000-chip pool) — and idle_no_demand absorbs the rest.
+        Conservation (Σ == capacity) is structural: each chip lands in
+        exactly one bucket."""
+        from nos_tpu.obs import ledger as L
+        from nos_tpu.obs.ledger import get_ledger, pod_chip_equiv
+
+        lister = self._cycle_lister()
+        holds = get_ledger().holds()
+        demand = bool(pending_by_class) or bool(self._waste_pending_gangs)
+        # fallback budgets (module docstring): free chips attributed to
+        # blocked-demand categories are bounded by the demand itself
+        quota_budget = sum(self._waste_quota_blocked.values())
+        gang_budget = sum(self._waste_pending_gangs.values())
+        frag_ev: dict[str, object] | None = None
+        if self._waste_frag_counts:
+            top = max(self._waste_frag_counts.items(),
+                      key=lambda kv: kv[1])
+            frag_ev = {"class": top[0], "rejected_nodes": top[1]}
+        quota_ev: dict[str, object] | None = None
+        if self._waste_quota_blocked:
+            top_q = max(self._waste_quota_blocked.items(),
+                        key=lambda kv: kv[1])
+            quota_ev = {"class": top_q[0],
+                        "blocked_chips": round(top_q[1], 2)}
+        gang_ev: dict[str, object] | None = None
+        if self._lease is not None:
+            gang_ev = {"gang": f"{self._lease[0][0]}/{self._lease[0][1]}"}
+        elif self._waste_pending_gangs:
+            top_g = max(self._waste_pending_gangs.items(),
+                        key=lambda kv: kv[1])
+            gang_ev = {"gang": top_g[0]}
+
+        pools: dict[str, dict[str, object]] = {}
+        for ni in lister.list():
+            labels = ni.node.metadata.labels
+            try:
+                cap = float(labels.get(C_LABEL_CHIP_COUNT, "0") or 0.0)
+            except ValueError:
+                cap = 0.0
+            if cap <= 0.0:
+                continue        # not a TPU host: outside the ledger
+            pool = labels.get(C_LABEL_POD_ID, "") or "-"
+            entry = pools.setdefault(
+                pool, {"capacity": 0.0, "categories": {}, "evidence": {}})
+            entry["capacity"] = float(entry["capacity"]) + cap  # type: ignore[arg-type]
+            cats: dict[str, float] = entry["categories"]  # type: ignore[assignment]
+            used = min(cap, pod_chip_equiv(ni.requested, cap,
+                                           self._hbm_gb_per_chip))
+            free = cap - used
+            if used > 0.0:
+                cats[L.PRODUCTIVE] = cats.get(L.PRODUCTIVE, 0.0) + used
+            if free <= 0.0:
+                continue
+            name = ni.name
+            hold = holds.get(name)
+            evidence: dict[str, object] | None = None
+            take = free
+            if hold is not None and L.QUARANTINE in hold:
+                cat = L.QUARANTINE
+                evidence = {"node": name, **hold[L.QUARANTINE]}
+            elif hold is not None and L.ACTUATION in hold:
+                cat = L.ACTUATION
+                evidence = {"node": name, **hold[L.ACTUATION]}
+            elif hold is not None and L.DRAIN in hold:
+                cat = L.DRAIN
+                evidence = {"node": name, **hold[L.DRAIN]}
+            elif name in self._reserved_hosts:
+                cat = L.GANG_WAIT
+                evidence = gang_ev
+            elif not demand:
+                cat = L.IDLE_NO_DEMAND
+            elif name in self._waste_rejected_nodes:
+                cat = L.FRAG_STRANDED
+                evidence = frag_ev
+            elif quota_budget > 0.0:
+                # pending demand rejected at the quota gates BEFORE any
+                # geometry scan: the free chips the over-quota pod could
+                # use — capped at the blocked demand itself, remainder
+                # is idle (one small rejection must not paint the pool)
+                cat = L.QUOTA_STRANDED
+                evidence = quota_ev
+                take = min(free, quota_budget)
+                quota_budget -= take
+            elif gang_budget > 0.0:
+                cat = L.GANG_WAIT
+                evidence = gang_ev
+                take = min(free, gang_budget)
+                gang_budget -= take
+            else:
+                cat = L.IDLE_NO_DEMAND
+            cats[cat] = cats.get(cat, 0.0) + take
+            if take < free:
+                cats[L.IDLE_NO_DEMAND] = \
+                    cats.get(L.IDLE_NO_DEMAND, 0.0) + (free - take)
+            if evidence:
+                ev: dict[str, dict[str, object]] = entry["evidence"]  # type: ignore[assignment]
+                ev.setdefault(cat, dict(evidence))
+        get_ledger().observe(pools)
+
+    def _publish_pending_gauges(self) -> dict[str, int]:
         """Per-class pending-pod gauges after a cycle: how many pods of
         each workload class are still waiting and the oldest one's age —
         the scoreboard's pending-by-class column and the SLO engine's
@@ -1362,7 +1577,9 @@ class Scheduler:
         the note was per-instance) and across a publish skipped by a
         raising cycle — either way a class that momentarily emptied
         could keep reporting its last (stale, maximal) age as a live
-        backlog forever.  Classes with no pending pod read 0."""
+        backlog forever.  Classes with no pending pod read 0.  Returns
+        the per-class pending counts — the waste waterfall's
+        is-there-demand signal (_observe_waste)."""
         now = self._clock()
         count: dict[str, int] = {}
         oldest: dict[str, float] = {}
@@ -1388,6 +1605,7 @@ class Scheduler:
                          labels={"class": cls})
             REGISTRY.set("nos_tpu_schedule_pending_age_seconds",
                          oldest.get(cls, 0.0), labels={"class": cls})
+        return count
 
     def _bind(self, pod: Pod, node_name: str) -> bool:
         # Binding only (the /binding subresource against a real substrate).

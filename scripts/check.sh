@@ -53,9 +53,10 @@ if ! env JAX_PLATFORMS=cpu python bench_fleet.py --smoke; then
     rc=1
 fi
 
-echo "==> bench_utilization.py --smoke (SLO telemetry gate: per-class histograms + verdicts)"
+echo "==> bench_utilization.py --smoke (SLO telemetry gate + chip-second waste conservation)"
 if ! env JAX_PLATFORMS=cpu python bench_utilization.py --smoke \
         --slo-report "${SLO_REPORT_PATH:-/tmp/nos_tpu_slo_report.json}" \
+        --waste-report "${WASTE_REPORT_PATH:-/tmp/nos_tpu_waste_report.json}" \
         > /dev/null; then
     rc=1
 fi

@@ -148,6 +148,12 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             slow_window_s=3 * BATCH_TIMEOUT_S,
             clock=lambda: clock[0])
         guard_state(sampler, lock_graph, name="obs.TimeSeriesSampler")
+        # Chip-second ledger under the same window: its leaf-lock
+        # contract (holds/observe touch only its own lock) is verified
+        # like the sampler's, and every seed asserts the conservation
+        # invariant over the chaotic run afterwards.
+        ledger = obs.ChipSecondLedger(clock=lambda: clock[0])
+        guard_state(ledger, lock_graph, name="obs.ChipSecondLedger")
 
     # 2x2 pods: hosts*2 fit, demand stays below capacity so convergence
     # is always feasible
@@ -164,7 +170,7 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             for n in api.list(KIND_NODE))
 
     done = False
-    with obs.scoped(tracer, journal, engine=slo_engine):
+    with obs.scoped(tracer, journal, engine=slo_engine, ledger=ledger):
         for round_no in range(max_rounds):
             clock[0] += BATCH_TIMEOUT_S + 1.0
             tick("scheduler", scheduler.run_cycle)
@@ -176,12 +182,19 @@ def run_slice_soak(seed: int, hosts: int = 2, pods: int = 3,
             if converged():
                 done = True
                 break
+        # one more cycle after convergence: the ledger accrues between
+        # observes, so the final (all-productive) waterfall needs a
+        # successor observation or the converged interval never lands
+        # in the integrals the conservation assert reads
+        clock[0] += BATCH_TIMEOUT_S + 1.0
+        tick("scheduler-final", scheduler.run_cycle)
     return SimpleNamespace(api=api, errors=errors, converged=done,
-                           rounds=round_no + 1, seed=seed,
+                           rounds=round_no + 1, seed=seed, hosts=hosts,
                            quarantined=partitioner.quarantine.names(),
                            lock_graph=lock_graph,
                            tracer=tracer, journal=journal,
-                           sampler=sampler, slo_engine=slo_engine)
+                           sampler=sampler, slo_engine=slo_engine,
+                           ledger=ledger)
 
 
 def _assert_soak_ok(result) -> None:
@@ -219,6 +232,20 @@ def _assert_soak_ok(result) -> None:
     assert len(result.sampler) <= result.sampler.maxlen, repro
     assert len(result.sampler) == min(result.rounds,
                                       result.sampler.maxlen), repro
+    # Chip-second ledger invariants under chaos: the scheduler observed
+    # the fleet every cycle, per-pool conservation holds over the whole
+    # chaotic run (Σ categories == ∫ capacity dt within ε), and the
+    # hold map stays bounded by the cluster (two planes x a few hold
+    # kinds per node, never growth with rounds).
+    from nos_tpu.obs.ledger import conservation_ok
+    waste = result.ledger.report()
+    assert waste["pools"], ("ledger observed no pools", repro)
+    assert conservation_ok(waste), (
+        {p: v["conservation_delta"]
+         for p, v in waste["pools"].items()}, repro)
+    assert waste["fleet"]["chip_seconds"].get("productive", 0.0) > 0.0, \
+        (waste["fleet"], repro)
+    assert result.ledger.hold_count() <= result.hosts * 6, repro
 
 
 class TestChaosSoak:
