@@ -1,0 +1,605 @@
+"""Defragmentation benchmark: a fragmentation-adversarial churn trace.
+
+The waste ledger (PR 12) put numbers on the two sinks this bench
+attacks: frag_stranded (free chips no pending class can use because
+admission-time placement pinned the carving) and gang_wait.  The trace
+is built to MANUFACTURE that regime, then measures whether the defrag
+plane (malleable gangs + the background repartitioner) reclaims it:
+
+- **Phase 1 (fill)**: a high backlog of small 1x1/1x2 fillers packs the
+  v5e pod; completions then pock every host with pinned survivors.
+- **Phase 2 (frag)**: filler pressure drops and whole-host 2x4 demand
+  arrives — aggregate free chips abound, but every host holds a filler,
+  so no carve can serve the class.  Without defrag this demand pends
+  forever and the utilization floor collapses.
+- **Phase 3 (burst + gang)**: a 2-host 4x4 gang and a burst of
+  higher-priority 1x2 singles join: the gang needs a window only
+  migration can empty, and the burst exercises shrink-before-evict
+  against the elastic sponge gang.
+
+An **elastic dp gang** (`nos.tpu/elastic: "dp"`, min 2 / max replicas
+sized to the pod) runs the whole trace as a utilization sponge: the
+scheduler's grow pass feeds it spare chips, and preemption's shrink
+rung reclaims them for the burst without killing the job.
+
+Everything runs through the REAL control plane (cmd/assembly wiring:
+scheduler + slice partitioner controller + node agents on a virtual
+clock); the defragmenter runs inside the partitioner controller exactly
+as in production.
+
+Gates (the ISSUE 14 acceptance criteria, asserted per seed):
+- utilization_min >= 0.95 with defrag on (the no-defrag floor on this
+  trace sits far below);
+- frag_stranded chip-seconds <= 50% of the no-defrag baseline on the
+  SAME trace and seed;
+- migration churn bounded: <= MAX_MIGRATIONS_PER_JOB defrag evictions
+  per job over the trace (and a global cap), enforced by the proposer's
+  demand cooldown;
+- defrag disabled is byte-identical to a propose-only run (payback
+  threshold = inf): the what-if forks leak nothing into decisions —
+  the journals match record for record once DEFRAG_* lines are removed;
+- chip-second conservation holds in every configuration (the ledger's
+  invariant survives drain holds appearing and resolving mid-trace).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device import default_tpu_runtime
+from nos_tpu.device.fake import FakePodResources
+from nos_tpu.kube.client import (
+    APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP, NotFound,
+)
+from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
+from nos_tpu.obs import journal as J, scoped as obs_scoped
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import (
+    new_slice_partitioner_controller,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+
+HOSTS = 24
+CHIPS_PER_HOST = V5E.chips_per_host          # 8
+TOTAL_CHIPS = HOSTS * CHIPS_PER_HOST         # 192
+
+TICK_S = 0.25
+WARMUP_S = 60.0
+TRACE_S = 300.0
+BATCH_IDLE_S = 0.5
+BATCH_TIMEOUT_S = 2.0
+
+# Defrag knobs under test (PartitionerConfig analogs)
+DEFRAG_INTERVAL_S = 6.0
+DEFRAG_PAYBACK_MIN = 1.2
+DEFRAG_DRAIN_TIMEOUT_S = 30.0
+
+UTILIZATION_MIN_TARGET = 0.95
+FRAG_HALVING_TARGET = 0.50
+MAX_MIGRATIONS_PER_JOB = 2
+MAX_TOTAL_MIGRATIONS = 40
+
+# Elastic sponge gang: dp members each consuming a 1x2 slice.  The
+# sponge soaks spare chips (grow) and is the defragmenter's cheapest
+# victim (shrink) when a blocked class needs its window back.
+ELASTIC_MIN, ELASTIC_MAX = 2, 60
+SPONGES = ("sponge-a", "sponge-b")
+
+FILLER_DURATION = (120.0, 240.0)    # long-lived pins: the frag source
+BIG_DURATION = (50.0, 90.0)
+BURST_DURATION = (10.0, 20.0)
+GANG_DURATION = (60.0, 100.0)
+
+# phase start -> {class: backlog target in chip-equivalents}
+PHASES = [
+    (0.0, {"filler": 150.0, "big": 0.0, "burst": 0.0, "gang": 0.0}),
+    (40.0, {"filler": 0.0, "big": 56.0, "burst": 0.0, "gang": 0.0}),
+    (190.0, {"filler": 0.0, "big": 40.0, "burst": 12.0, "gang": 16.0}),
+]
+
+CLASS_SPECS = {
+    "filler": (("1x2",), 1, 0, FILLER_DURATION),
+    "big": (("2x4",), 1, 5, BIG_DURATION),
+    "burst": (("1x2",), 1, 10, BURST_DURATION),
+    "gang": (("4x4",), 2, 10, GANG_DURATION),
+}
+
+# Utilization floor is judged on a short rolling mean: per-0.25s-tick
+# instantaneous samples punish the 1-2 tick rebind gap of every
+# completion/migration handoff, which no fleet operator would call
+# waste; 3 s windows keep genuine stranding visible.
+UTIL_WINDOW_TICKS = 20
+
+
+def percentile(xs, q, digits=3):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+
+def chip_equiv(pod) -> float:
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.topology.profile import extract_slice_requests
+
+    return sum(min(s.chips, CHIPS_PER_HOST) * q
+               for s, q in extract_slice_requests(
+                   pod_request(pod)).items())
+
+
+class Job:
+    def __init__(self, name, kind, pods, duration, created,
+                 shape="1x1", priority=0):
+        self.name = name
+        self.kind = kind
+        self.pods = pods
+        self.duration = duration
+        self.created = created
+        self.shape = shape
+        self.priority = priority
+        self.bound_at = None
+
+
+class Sim:
+    """One trace run.  `defrag` enables the proposer; `elastic_grow`
+    (default: follows `defrag`) enables the scheduler's grow pass — the
+    no-defrag baseline runs BOTH off, i.e. the pre-PR control plane, so
+    the comparison prices the whole malleable-gang + defrag plane."""
+
+    def __init__(self, seed=0, defrag=True, payback_min=None,
+                 elastic_grow=None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.now = [0.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        api = self.api = APIServer()
+        state = ClusterState()
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        self.ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock,
+            defrag_enabled=defrag,
+            defrag_payback_min=(payback_min if payback_min is not None
+                                else DEFRAG_PAYBACK_MIN),
+            defrag_interval_s=DEFRAG_INTERVAL_S,
+            defrag_drain_timeout_s=DEFRAG_DRAIN_TIMEOUT_S,
+            defrag_progress_fn=self._pod_progress)
+        self.ctl.bind()
+        self.agents = {}
+        for i in range(HOSTS):
+            name = f"host-{i}"
+            api.create(KIND_NODE, make_tpu_node(
+                name, pod_id="pod-0", host_index=i))
+            agent = SliceAgent(api, name, default_tpu_runtime(V5E),
+                               FakePodResources())
+            agent.start()
+            self.agents[name] = agent
+        grow = defrag if elastic_grow is None else elastic_grow
+        self.scheduler = build_scheduler(
+            api, 16, drain_preempt_after_cycles=40,
+            drain_preempt_progress_fn=self._pod_progress,
+            shard_chips_per_host=CHIPS_PER_HOST,
+            elastic_grow_budget_per_cycle=1 if grow else 0, clock=clock)
+        self.ledger = ChipSecondLedger(clock=clock)
+        self.journal = DecisionJournal(maxlen=300_000, clock=clock)
+        self.jobs: dict[str, Job] = {}
+        self._job_seq = 0
+        self._pod_job: dict[str, Job] = {}
+        self.latencies: list[float] = []
+        self._util_samples: list[float] = []
+        self._util_raw: list[float] = []
+        self.completed = 0
+        self.defrag_migrated_pods = 0
+        self._spawn_elastic()
+
+    # -- workload ------------------------------------------------------------
+    def _spawn_elastic(self):
+        """The utilization sponges: two elastic dp gangs, alive for the
+        whole trace, grown/shrunk by the control plane (two gangs so
+        the grow pass — one outstanding clone per gang — soaks holes
+        at twice the rate)."""
+        for name in SPONGES:
+            self.api.create(KIND_POD_GROUP, PodGroup(
+                metadata=ObjectMeta(name=name, namespace="work"),
+                spec=PodGroupSpec(min_member=ELASTIC_MIN)))
+            job = Job(name, "elastic", [], TRACE_S * 2, 0.0)
+            for i in range(ELASTIC_MIN):
+                pod = self._make_sponge_pod(name, f"{name}-{i}")
+                self.api.create(KIND_POD, pod)
+                job.pods.append(pod.metadata.name)
+                self._pod_job[pod.metadata.name] = job
+            self.jobs[name] = job
+
+    @staticmethod
+    def _make_sponge_pod(gang, pod_name):
+        return make_slice_pod(
+            "1x2", 1, name=pod_name, namespace="work",
+            labels={C.LABEL_POD_GROUP: gang},
+            annotations={C.ANNOT_ELASTIC: C.ELASTIC_DP,
+                         C.ANNOT_MIN_REPLICAS: str(ELASTIC_MIN),
+                         C.ANNOT_MAX_REPLICAS: str(ELASTIC_MAX)},
+            creation_timestamp=0.0)
+
+    def _phase_targets(self):
+        current = PHASES[0][1]
+        for start, targets in PHASES:
+            if self.now[0] >= start:
+                current = targets
+        return current
+
+    def _spawn(self):
+        # Footprint targets: each class is held at a total in-flight
+        # chip footprint (pending + running).  A PENDING-only backlog
+        # would keep a standing queue of small jobs that instantly eats
+        # every hole — starving whole-host demand by queueing, which is
+        # a different disease than the fragmentation this trace is
+        # built to manufacture.
+        targets = self._phase_targets()
+        footprint = {cls: 0.0 for cls in targets}
+        for p in self.api.list(KIND_POD):
+            job = self._pod_job.get(p.metadata.name)
+            if job is not None and job.kind in footprint:
+                footprint[job.kind] += chip_equiv(p)
+        for cls, target in targets.items():
+            while footprint[cls] < target:
+                footprint[cls] += self._spawn_job(cls)
+
+    def _spawn_job(self, cls):
+        shapes, members, priority, (lo, hi) = CLASS_SPECS[cls]
+        shape = self.rng.choice(shapes)
+        self._job_seq += 1
+        name = f"{cls}-{self._job_seq}"
+        duration = self.rng.uniform(lo, hi)
+        job = Job(name, cls, [], duration, self.now[0],
+                  shape=shape, priority=priority)
+        if members > 1:
+            self.api.create(KIND_POD_GROUP, PodGroup(
+                metadata=ObjectMeta(name=name, namespace="work"),
+                spec=PodGroupSpec(min_member=members)))
+        spawned = 0.0
+        for i in range(members):
+            pod = self._make_pod(job, f"{name}-{i}")
+            self.api.create(KIND_POD, pod)
+            job.pods.append(pod.metadata.name)
+            self._pod_job[pod.metadata.name] = job
+            spawned += chip_equiv(pod)
+        self.jobs[name] = job
+        return spawned
+
+    def _make_pod(self, job, pod_name):
+        members = CLASS_SPECS[job.kind][1]
+        return make_slice_pod(
+            job.shape, 1, name=pod_name, namespace="work",
+            labels=({C.LABEL_POD_GROUP: job.name} if members > 1
+                    else None),
+            priority=job.priority, creation_timestamp=job.created)
+
+    def _pod_progress(self, pod):
+        job = self._pod_job.get(pod.metadata.name)
+        if job is None or job.bound_at is None or job.duration <= 0:
+            return 0.0
+        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+                            / job.duration))
+
+    def _complete_finished(self):
+        for job in list(self.jobs.values()):
+            if job.bound_at is None \
+                    or self.now[0] < job.bound_at + job.duration:
+                continue
+            # delete by gang label too: elastic growth added members the
+            # job table never saw
+            doomed = set(job.pods)
+            doomed.update(
+                p.metadata.name for p in self.api.list(
+                    KIND_POD, namespace="work",
+                    label_selector={C.LABEL_POD_GROUP: job.name}))
+            for pname in doomed:
+                try:
+                    self.api.delete(KIND_POD, pname, "work")
+                except NotFound:
+                    pass
+                self._pod_job.pop(pname, None)
+            try:
+                self.api.delete(KIND_POD_GROUP, job.name, "work")
+            except NotFound:
+                pass
+            del self.jobs[job.name]
+            self.completed += 1
+
+    def _requeue_evicted(self):
+        """Drain-then-rebind semantics: a migrated/preempted job loses
+        its progress and requeues with its ORIGINAL creation timestamp.
+        Elastic members are NOT requeued — losing one IS the shrink
+        contract (the grow pass re-adds capacity when it frees up)."""
+        live = {p.metadata.name for p in self.api.list(KIND_POD)}
+        for job in self.jobs.values():
+            if job.kind == "elastic":
+                # the elastic workload controller's one duty: keep the
+                # gang at >= min replicas (shrink took it no lower by
+                # contract, but whole-gang eviction may have)
+                alive = len(self.api.list(
+                    KIND_POD, namespace="work",
+                    label_selector={C.LABEL_POD_GROUP: job.name}))
+                for pname in job.pods:
+                    if alive >= ELASTIC_MIN:
+                        break
+                    if pname not in live:
+                        pod = self._make_sponge_pod(job.name, pname)
+                        self.api.create(KIND_POD, pod)
+                        self._pod_job[pname] = job
+                        alive += 1
+                        job.bound_at = None
+                continue
+            missing = [n for n in job.pods if n not in live]
+            if not missing:
+                continue
+            job.bound_at = None
+            for pname in missing:
+                pod = self._make_pod(job, pname)
+                self.api.create(KIND_POD, pod)
+                self._pod_job[pname] = job
+
+    def _record_binds(self):
+        bound = {p.metadata.name for p in self.api.list(KIND_POD)
+                 if p.spec.node_name and p.status.phase == RUNNING}
+        for job in self.jobs.values():
+            if job.kind == "elastic":
+                if job.bound_at is None \
+                        and all(n in bound for n in job.pods):
+                    job.bound_at = self.now[0]
+                continue
+            if job.bound_at is None and all(n in bound for n in job.pods):
+                job.bound_at = self.now[0]
+                self.latencies.append(self.now[0] - job.created)
+
+    def _sample_utilization(self):
+        used = sum(chip_equiv(p) for p in self.api.list(KIND_POD)
+                   if p.spec.node_name and p.status.phase == RUNNING)
+        u = min(1.0, used / TOTAL_CHIPS)
+        self._util_raw.append(u)
+        if self.now[0] >= WARMUP_S:
+            window = self._util_raw[-UTIL_WINDOW_TICKS:]
+            self._util_samples.append(sum(window) / len(window))
+
+    # -- main loop -----------------------------------------------------------
+    def run(self):
+        with obs_scoped(journal=self.journal, ledger=self.ledger):
+            while self.now[0] < TRACE_S:
+                self.now[0] += TICK_S
+                self._complete_finished()
+                self._spawn()
+                self.scheduler.run_cycle()
+                self._requeue_evicted()
+                self.ctl.process_if_ready()
+                for a in self.agents.values():
+                    a.tick()
+                self._record_binds()
+                self._sample_utilization()
+        waste = self.ledger.report()
+        assert conservation_ok(waste), (
+            "chip-second conservation violated: "
+            + str({p: v["conservation_delta"]
+                   for p, v in waste["pools"].items()}))
+        self._account_migrations()
+        utils = self._util_samples
+        return {
+            "utilization_mean": round(sum(utils) / len(utils), 4)
+            if utils else 0.0,
+            "utilization_min": round(min(utils), 4) if utils else 0.0,
+            "jobs_completed": self.completed,
+            "jobs_bound": len(self.latencies),
+            "p50_schedule_latency_s": percentile(self.latencies, 0.5),
+            "p90_schedule_latency_s": percentile(self.latencies, 0.9),
+            "frag_stranded_chip_seconds": round(
+                waste["fleet"]["chip_seconds"].get("frag_stranded", 0.0),
+                1),
+            "drain_chip_seconds": round(
+                waste["fleet"]["chip_seconds"].get("drain", 0.0), 1),
+            "defrag": self._defrag_summary(),
+            "elastic": self._elastic_summary(),
+            "waste": waste,
+        }
+
+    def _account_migrations(self):
+        """Per-job migration counts from the journal's `moved` lists
+        (shrink evictions are resizes, not migrations — counted in the
+        elastic summary instead)."""
+        self.migrations_by_job: dict[str, int] = {}
+        for rec in self.journal.events(category=J.DEFRAG_APPLIED):
+            moved = rec.attrs.get("moved", [])
+            self.defrag_migrated_pods += len(moved)
+            for key in moved:
+                pod_name = key.split("/", 1)[-1]
+                job_name = pod_name.rsplit("-", 1)[0]
+                self.migrations_by_job[job_name] = \
+                    self.migrations_by_job.get(job_name, 0) + 1
+
+    def _defrag_summary(self):
+        return {
+            "proposed": len(self.journal.events(
+                category=J.DEFRAG_PROPOSED)),
+            "applied": len(self.journal.events(
+                category=J.DEFRAG_APPLIED)),
+            "rejected": len(self.journal.events(
+                category=J.DEFRAG_REJECTED)),
+            "migrated_pods": self.defrag_migrated_pods,
+            "migrations_by_job_max": max(
+                self.migrations_by_job.values(), default=0),
+        }
+
+    def _elastic_summary(self):
+        resizes = self.journal.events(category=J.GANG_RESIZED)
+        live = sum(len(self.api.list(
+            KIND_POD, namespace="work",
+            label_selector={C.LABEL_POD_GROUP: name},
+            filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
+            for name in SPONGES)
+        return {
+            "grows": sum(1 for r in resizes
+                         if r.attrs.get("direction") == "grow"),
+            "shrinks": sum(1 for r in resizes
+                           if r.attrs.get("direction") == "shrink"),
+            "final_replicas": live,
+        }
+
+    def decision_trace(self):
+        """(category, subject, attrs) sequence with defrag's own
+        records removed and run-unique identifiers (uuid plan ids)
+        normalized — the byte-identity comparison basis."""
+        skip = {J.DEFRAG_PROPOSED, J.DEFRAG_APPLIED, J.DEFRAG_REJECTED}
+        return [(r.category, r.subject, tuple(sorted(
+            (k, str(v)) for k, v in r.attrs.items()
+            if k != "plan_id")))
+            for r in self.journal.events() if r.category not in skip]
+
+
+def run_seed(seed, defrag=True, payback_min=None):
+    return Sim(seed=seed, defrag=defrag, payback_min=payback_min).run()
+
+
+def assert_gates(seed, on, off):
+    failures = []
+    if on["utilization_min"] < UTILIZATION_MIN_TARGET:
+        failures.append(
+            f"seed {seed}: utilization_min {on['utilization_min']} "
+            f"< {UTILIZATION_MIN_TARGET}")
+    frag_on = on["frag_stranded_chip_seconds"]
+    frag_off = off["frag_stranded_chip_seconds"]
+    if frag_off > 0 and frag_on > FRAG_HALVING_TARGET * frag_off:
+        failures.append(
+            f"seed {seed}: frag_stranded {frag_on} > "
+            f"{FRAG_HALVING_TARGET} x no-defrag baseline {frag_off}")
+    churn = on["defrag"]["migrations_by_job_max"]
+    if churn > MAX_MIGRATIONS_PER_JOB:
+        failures.append(
+            f"seed {seed}: {churn} migrations for one job "
+            f"(bound {MAX_MIGRATIONS_PER_JOB})")
+    if on["defrag"]["migrated_pods"] > MAX_TOTAL_MIGRATIONS:
+        failures.append(
+            f"seed {seed}: {on['defrag']['migrated_pods']} total "
+            f"migrations (bound {MAX_TOTAL_MIGRATIONS})")
+    if on["defrag"]["applied"] < 1:
+        failures.append(f"seed {seed}: defrag never applied a proposal")
+    return failures
+
+
+def check_byte_identity(disabled_sim):
+    """Defrag disabled vs propose-only (payback = inf, grow off): the
+    proposer's what-if forks and journal records must leak NOTHING into
+    decisions.  Reuses the already-run disabled sim (same seed).
+    Returns (identical, detail)."""
+    propose_only = Sim(seed=disabled_sim.seed, defrag=True,
+                       payback_min=float("inf"), elastic_grow=False)
+    propose_only.run()
+    a = disabled_sim.decision_trace()
+    b = propose_only.decision_trace()
+    if a == b:
+        return True, f"{len(a)} records identical"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if ra != rb:
+            return False, f"first divergence at record {i}: {ra} vs {rb}"
+    return False, f"length mismatch: {len(a)} vs {len(b)}"
+
+
+def run_bench(seeds):
+    per_seed = {}
+    failures = []
+    first_disabled = None
+    for seed in seeds:
+        on = run_seed(seed, defrag=True)
+        off_sim = Sim(seed=seed, defrag=False)
+        off = off_sim.run()
+        if first_disabled is None:
+            first_disabled = off_sim
+        failures.extend(assert_gates(seed, on, off))
+        per_seed[str(seed)] = {
+            "defrag_on": {k: v for k, v in on.items() if k != "waste"},
+            "no_defrag": {
+                "utilization_min": off["utilization_min"],
+                "utilization_mean": off["utilization_mean"],
+                "frag_stranded_chip_seconds":
+                    off["frag_stranded_chip_seconds"],
+            },
+        }
+    identical, detail = check_byte_identity(first_disabled)
+    if not identical:
+        failures.append(f"defrag-disabled not byte-identical: {detail}")
+    utils = [per_seed[s]["defrag_on"]["utilization_min"]
+             for s in per_seed]
+    return {
+        "hosts": HOSTS,
+        "total_chips": TOTAL_CHIPS,
+        "trace_seconds": TRACE_S,
+        "utilization_min": min(utils) if utils else 0.0,
+        "per_seed": per_seed,
+        "byte_identity": {"ok": identical, "detail": detail},
+        "gates": {
+            "utilization_min_target": UTILIZATION_MIN_TARGET,
+            "frag_halving_target": FRAG_HALVING_TARGET,
+            "max_migrations_per_job": MAX_MIGRATIONS_PER_JOB,
+            "failures": failures,
+        },
+        "ok": not failures,
+    }
+
+
+def run_smoke():
+    """CI gate (scripts/check.sh): one seed, the full churn trace, all
+    four defrag gates asserted — utilization floor, frag halving,
+    churn bound, byte-identity — plus conservation (asserted inside
+    every run).  Three trace runs total (defrag-on, disabled baseline,
+    propose-only; identity reuses the baseline).  Raises AssertionError
+    on regression."""
+    t0 = time.perf_counter()
+    out = run_bench([0])
+    out["smoke"] = "ok" if out["ok"] else "FAILED"
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    assert out["ok"], "defrag gates failed: " + "; ".join(
+        out["gates"]["failures"])
+    assert out["wall_s"] < 420.0, \
+        f"defrag smoke took {out['wall_s']}s (> 420s bound)"
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="defragmentation + malleable-gang bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed defrag gate (CI)")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="seeds for the full run")
+    ap.add_argument("--defrag-report", default="",
+                    help="also write the result JSON to this file "
+                         "(CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_bench(list(range(args.seeds)))
+    if args.defrag_report:
+        with open(args.defrag_report, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=2)
+        print(f"defrag report written to {args.defrag_report}",
+              file=sys.stderr)
+    print(json.dumps(out))
+    if not out.get("ok", True):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,18 @@ class PartitionerConfig(ManagerConfig):
     plan_shard_min_hosts: int = 128
     # Plan shard worker threads; 0 = auto (bounded by CPU count).
     plan_workers: int = 0
+    # Background defragmentation (partitioning/core/defrag.py):
+    # disabled by default — enabled, the proposer runs on the replan
+    # epoch and migrates movable pods off fragmented windows when the
+    # unlocked-chips / restart-cost payback clears defrag_payback_min.
+    # Disabled builds are byte-identical to builds without the plane
+    # (docs/performance.md, "Defragmentation").
+    defrag_enabled: bool = False
+    defrag_payback_min: float = 1.5
+    # 0 = the replan epoch cadence.
+    defrag_interval_s: float = 0.0
+    # Deadline after which a stuck drain is aborted and healed.
+    defrag_drain_timeout_s: float = 120.0
     # Geometry-override file (SetKnownGeometries analog, reference
     # known_configs.go:144-150 wired at cmd/gpupartitioner/:370-380).
     known_geometries_file: str = ""
@@ -124,6 +136,12 @@ class PartitionerConfig(ManagerConfig):
             raise ConfigError("plan_shard_min_hosts must be >= 0")
         if self.plan_workers < 0:
             raise ConfigError("plan_workers must be >= 0")
+        if self.defrag_payback_min <= 0:
+            raise ConfigError("defrag_payback_min must be positive")
+        if self.defrag_interval_s < 0:
+            raise ConfigError("defrag_interval_s must be >= 0")
+        if self.defrag_drain_timeout_s <= 0:
+            raise ConfigError("defrag_drain_timeout_s must be positive")
         if self.known_geometries_file and \
                 not pathlib.Path(self.known_geometries_file).is_file():
             raise ConfigError(
@@ -159,6 +177,12 @@ class SchedulerConfig(ManagerConfig):
     # the victim-search cost when many pods are unschedulable at once;
     # unserved pods retry next cycle (scheduler.py).
     preempt_budget_per_cycle: int = 2
+    # Elastic-gang grow pass budget: at most this many dp replica
+    # clones created per cycle across all gangs carrying the
+    # `nos.tpu/elastic: "dp"` contract (scheduler/elastic.py); 0
+    # disables growth (shrink — a preemption rung — is always on, but
+    # only ever fires for annotated gangs).
+    elastic_grow_budget_per_cycle: int = 1
 
     def validate(self) -> None:
         super().validate()
@@ -178,6 +202,9 @@ class SchedulerConfig(ManagerConfig):
             raise ConfigError("shard_chips_per_host must be >= 0")
         if self.preempt_budget_per_cycle < 1:
             raise ConfigError("preempt_budget_per_cycle must be >= 1")
+        if self.elastic_grow_budget_per_cycle < 0:
+            raise ConfigError(
+                "elastic_grow_budget_per_cycle must be >= 0")
 
 
 @dataclasses.dataclass

@@ -135,6 +135,37 @@ def status_plan_annotation(family: str = "slice") -> str:
     return f"{ANNOT_STATUS_PLAN_PREFIX}.{family}"
 
 
+# -- elasticity contract (malleable gangs; docs/performance.md) -------------
+# A gang whose members carry `nos.tpu/elastic: "dp"` declares its
+# data-parallel axis malleable: the control plane may GROW the gang by
+# creating extra replica pods (scheduler cycle-end pass, up to
+# max-replicas) when chips free up in its pool, and SHRINK it by
+# evicting single members (down to min-replicas) when quota reclaims or
+# a higher-tier pod needs the space — shrink-before-evict is a cheaper
+# preemption rung than killing a whole rigid gang.  The replica bounds
+# ride on the same pods; absent/garbage bounds disable elasticity
+# (a malformed contract must degrade to rigid, never to unbounded).
+ANNOT_ELASTIC = f"{GROUP}/elastic"
+ELASTIC_DP = "dp"
+ANNOT_MIN_REPLICAS = f"{GROUP}/min-replicas"
+ANNOT_MAX_REPLICAS = f"{GROUP}/max-replicas"
+
+# Desired dp replica count after a resize, stamped by the grow/shrink
+# machinery on every surviving member.  cmd/train.py reads it back at
+# each checkpoint (the job-progress hook's sibling): a running worker
+# that sees a desired dp different from its boot-time world size exits
+# cleanly at the checkpoint so the restart picks up the new mesh.
+ANNOT_DP_RESIZE = f"{GROUP}/dp-resize"
+
+# Defragmentation drain: stamped by the background defragmenter
+# (partitioning/core/defrag.py) on every host an applied proposal is
+# emptying (value = the proposal id).  The scheduler's score key avoids
+# drained hosts whenever any alternative fits, and the planner's
+# candidate order visits them last — mirroring ANNOT_GANG_LEASE, so the
+# freed window stays whole for the fragmentation-blocked demand instead
+# of being refilled by the very pods just migrated off it.
+ANNOT_DEFRAG_DRAIN = f"{GROUP}/defrag-drain"
+
 # Gang window lease: stamped by the scheduler on every host of the aligned
 # window a stuck multi-host gang is draining toward (value "<ns>/<gang>").
 # The partitioner reads it — the per-node loop re-carves leased hosts last

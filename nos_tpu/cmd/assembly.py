@@ -52,7 +52,11 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
                 plan_deadline_s=plan_deadline,
                 replan_epoch_s=replan_epoch,
                 plan_shard_min_hosts=cfg.plan_shard_min_hosts,
-                plan_workers=cfg.plan_workers)
+                plan_workers=cfg.plan_workers,
+                defrag_enabled=cfg.defrag_enabled,
+                defrag_payback_min=cfg.defrag_payback_min,
+                defrag_interval_s=cfg.defrag_interval_s or None,
+                defrag_drain_timeout_s=cfg.defrag_drain_timeout_s)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-slice", ctl.process_if_ready,
@@ -93,6 +97,7 @@ def build_scheduler(api: APIServer,
                     preempt_budget_per_cycle: int = 2,
                     backfill_remaining_fn=None,
                     backfill_duration_fn=None,
+                    elastic_grow_budget_per_cycle: int = 1,
                     clock=None) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     topology + capacity plugins, quota ledger attached to the API."""
@@ -113,5 +118,6 @@ def build_scheduler(api: APIServer,
         preempt_budget_per_cycle=preempt_budget_per_cycle,
         backfill_remaining_fn=backfill_remaining_fn,
         backfill_duration_fn=backfill_duration_fn,
+        elastic_grow_budget_per_cycle=elastic_grow_budget_per_cycle,
         hbm_gb_per_chip=float(tpu_memory_gb_per_chip),
         **kwargs)

@@ -146,6 +146,66 @@ def report_job_progress(api, name: str, namespace: str,
     return True
 
 
+def boot_world_size(environ=None) -> int:
+    """Worker count this process booted with (the Cloud TPU env the
+    mesh was derived from); 1 for single-host runs."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    hosts = [h for h in
+             env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    return max(1, len(hosts))
+
+
+def read_resize_signal(api, name: str, namespace: str) -> int | None:
+    """The `nos.tpu/dp-resize` annotation on this workload's own Pod —
+    stamped by the elastic grow/shrink machinery (scheduler/elastic.py)
+    with the gang's NEW dp replica count.  None when absent/garbage
+    (no resize requested, or the contract is malformed — either way the
+    job keeps training).  Best-effort like the progress write: a read
+    failure must never kill a training step."""
+    from nos_tpu.api.constants import ANNOT_DP_RESIZE
+    from nos_tpu.kube.client import KIND_POD
+
+    try:
+        pod = api.try_get(KIND_POD, name, namespace)
+    except Exception:  # noqa: BLE001 — advisory read
+        logger.warning("dp-resize read failed for %s/%s",
+                       namespace, name, exc_info=True)
+        return None
+    if pod is None:
+        return None
+    raw = pod.metadata.annotations.get(ANNOT_DP_RESIZE, "")
+    try:
+        value = int(raw)
+    except ValueError:
+        return None
+    return value if value >= 1 else None
+
+
+def resize_checker(cfg: TrainConfig, environ=None):
+    """Build the per-checkpoint resize probe, or None when pod identity
+    / cluster access is unavailable (same downward-API contract as
+    progress_reporter — the two hooks ride the same checkpoint)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    name = env.get("POD_NAME", "")
+    namespace = env.get("POD_NAMESPACE", "")
+    if not name or not namespace or not cfg.kubeconfig:
+        return None
+    from nos_tpu.cmd._runtime import build_api
+
+    try:
+        api = build_api(cfg)
+    except Exception:  # noqa: BLE001 — advisory hook, like the
+        # progress reporter: the job just never sees resize requests
+        logger.warning("resize checker disabled: kubeconfig %s "
+                       "unusable", cfg.kubeconfig, exc_info=True)
+        return None
+    return lambda: read_resize_signal(api, name, namespace)
+
+
 def progress_reporter(cfg: TrainConfig, environ=None):
     """Build the per-checkpoint progress callback, or None when the pod
     identity is unavailable.  Identity comes from the downward API
@@ -234,14 +294,26 @@ def build(cfg: TrainConfig):
     return trainer, loader, checkpointer, state, start_step
 
 
-def train(cfg: TrainConfig, progress_cb=None) -> float | None:
+def train(cfg: TrainConfig, progress_cb=None,
+          resize_cb=None) -> float | None:
     """Run the loop; returns the final loss, or None when the checkpoint
     already covers every requested step (nothing to do).  `progress_cb`
     (fraction in [0, 1], called after each landed checkpoint) defaults
-    to the downward-API pod annotation reporter when available."""
+    to the downward-API pod annotation reporter when available.
+
+    `resize_cb` (no args -> desired dp replica count or None, probed
+    after each landed checkpoint) defaults to the dp-resize annotation
+    reader: when the elastic machinery resized this job's gang, the
+    loop exits cleanly AT THE CHECKPOINT — the restart re-derives its
+    mesh from the new worker set and resumes, so a resize costs one
+    checkpoint restart and zero lost steps (docs/performance.md,
+    "Malleable gangs")."""
 
     if progress_cb is None:
         progress_cb = progress_reporter(cfg)
+    if resize_cb is None:
+        resize_cb = resize_checker(cfg)
+    world = boot_world_size()
     trainer, loader, checkpointer, state, start_step = build(cfg)
     if start_step >= cfg.steps:
         logger.info("checkpoint step %d >= steps %d: training already "
@@ -287,10 +359,25 @@ def train(cfg: TrainConfig, progress_cb=None) -> float | None:
             logged_at = step
             t0 = time.perf_counter()
         if checkpointer is not None and step % cfg.checkpoint_every == 0:
-            if checkpointer.save(step, state) and progress_cb is not None:
-                # progress is only as durable as the checkpoint backing
-                # it: report AFTER the save lands, never before
-                progress_cb(step / cfg.steps)
+            if checkpointer.save(step, state):
+                if progress_cb is not None:
+                    # progress is only as durable as the checkpoint
+                    # backing it: report AFTER the save lands, never
+                    # before
+                    progress_cb(step / cfg.steps)
+                if resize_cb is not None:
+                    desired = resize_cb()
+                    if desired is not None and desired != world:
+                        # honor the elastic resize at the durable point:
+                        # exit cleanly, the restart re-meshes from the
+                        # new worker set and resumes this checkpoint
+                        logger.info(
+                            "dp resize requested (%d -> %d workers): "
+                            "exiting at checkpoint step %d for re-mesh",
+                            world, desired, step)
+                        loss = float(loss_arr)
+                        checkpointer.close()
+                        return loss
     if checkpointer is not None:
         if cfg.steps % cfg.checkpoint_every:
             if checkpointer.save(cfg.steps, state) \

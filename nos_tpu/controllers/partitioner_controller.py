@@ -70,6 +70,7 @@ class PartitionerController:
                  plan_deadline_s: float | None = None,
                  rescan_interval_s: float | None = None,
                  replan_epoch_s: float | None = None,
+                 defrag=None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._api = api
         self._state = cluster_state
@@ -97,6 +98,12 @@ class PartitionerController:
         # drain's last add anyway).
         self._replan_epoch_s = (replan_epoch_s if replan_epoch_s is not None
                                 else batcher.idle_s)
+        # Background defragmenter (partitioning/core/defrag.py): driven
+        # at the end of each plan cycle, self-rate-limited to its own
+        # interval (default: the replan epoch).  None (the default)
+        # disables the plane entirely — decisions byte-identical to a
+        # build without it.
+        self._defrag = defrag
         self._clock = clock
         self._last_scan = clock()
         # first plan is never deferred: the epoch starts one period ago
@@ -213,6 +220,13 @@ class PartitionerController:
         REGISTRY.set("nos_tpu_plan_pending_pods",
                      float(len(pods)), labels={"kind": self._kind})
         self._start_actuation_clocks()
+        if self._defrag is not None:
+            # replan-epoch defrag step: the plan above is the carve-only
+            # answer; demand still fragmentation-blocked after it (and
+            # after the defragmenter's own persistence gate) is what the
+            # proposer may move pods for.  The snapshot is the cycle's
+            # unmutated current state (the planner ran on a clone).
+            self._defrag.step(snapshot, pods)
         return True
 
     # -- actuation-landed latency -------------------------------------------

@@ -313,9 +313,23 @@ def _waste_culprit(journal: list[dict], category: str,
     site)."""
     lines: list[str] = []
     if category == "frag_stranded" and evidence.get("class"):
-        cls = str(evidence["class"])
+        # Ranked culprits: when several classes strand the same pool the
+        # evidence carries them ordered by stranded chip-seconds (the
+        # scheduler's per-class integral) — the class that has waited
+        # with the most blocked chips the longest leads, NOT whichever
+        # rejection happens to be newest.  Old snapshots without the
+        # ranking degrade to the single-class join.
+        ranked = evidence.get("classes") or [
+            {"class": evidence["class"],
+             "rejected_nodes": evidence.get("rejected_nodes", "?")}]
+        cls = str(ranked[0].get("class", evidence["class"]))
         lines.append(f"culprit class {cls}: rejected on "
                      f"{evidence.get('rejected_nodes', '?')} node(s)")
+        for row in ranked[1:]:
+            lines.append(
+                f"also stranding: class {row.get('class', '?')} "
+                f"({row.get('stranded_chip_seconds', '?')} stranded "
+                "chip-s)")
         rec = _newest(journal, J.POD_REJECTED, attr_match={"class": cls})
         if rec is not None:
             attrs = rec.get("attrs", {})
@@ -324,6 +338,28 @@ def _waste_culprit(journal: list[dict], category: str,
                    if counts else attrs.get("message", ""))
             lines.append(f"newest rejection ({rec['subject']}): {why}")
             lines.append(f"next: `obs explain pod {rec['subject']}`")
+        # Join to the defrag plane: the proposal that would (or did)
+        # unlock this frag source, so the operator's next move is
+        # named instead of implied.
+        prop = _newest(journal, J.DEFRAG_APPLIED,
+                       attr_match={"demand_class": cls}) \
+            or _newest(journal, J.DEFRAG_APPLIED) \
+            or _newest(journal, J.DEFRAG_PROPOSED) \
+            or _newest(journal, J.DEFRAG_REJECTED)
+        if prop is not None:
+            attrs = prop.get("attrs", {})
+            verb = {J.DEFRAG_APPLIED: "applied",
+                    J.DEFRAG_PROPOSED: "proposed",
+                    J.DEFRAG_REJECTED: "rejected"}[prop["category"]]
+            lines.append(
+                f"defrag: proposal {prop['subject']} ({verb}) — "
+                f"hosts {attrs.get('hosts', '?')}, "
+                f"{attrs.get('unlocked_chips', '?')} chips unlocked, "
+                f"payback {attrs.get('payback', attrs.get('reason', '?'))}")
+        else:
+            lines.append("defrag: no proposal on record — enable "
+                         "defrag_enabled (PartitionerConfig) to reclaim "
+                         "this automatically")
     elif category in ("gang_wait", "drain") and evidence.get("gang"):
         gang = str(evidence["gang"])
         verb = ("assembly stalled" if category == "gang_wait"

@@ -74,6 +74,15 @@ HANDSHAKE_WAIT = "handshake-wait"
 SLO_BREACH = "slo-breach"
 SLO_RECOVERED = "slo-recovered"
 AUTOSCALE = "autoscale"
+# Defragmentation plane (partitioning/core/defrag.py): a proposal is
+# PROPOSED when the what-if fork proves every victim relocatable,
+# APPLIED when it clears the payback threshold and its evictions fire,
+# REJECTED when it fails payback / PDB allowance / drains past its
+# deadline.  GANG_RESIZED records an elastic gang's dp grow/shrink.
+DEFRAG_PROPOSED = "defrag-proposed"
+DEFRAG_APPLIED = "defrag-applied"
+DEFRAG_REJECTED = "defrag-rejected"
+GANG_RESIZED = "gang-resized"
 
 
 class DecisionRecord:

@@ -12,6 +12,7 @@ from .planner import GeometryPlanner
 from .parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from .pools import PlanPool, partition_pools, split_pods
 from .actuator import GeometryActuator, new_plan_id
+from .defrag import DefragProposer
 from .quarantine import (
     QuarantineList, REASON_ACTUATION, REASON_PLAN_DEADLINE,
 )
@@ -21,7 +22,7 @@ __all__ = [
     "Partitioner", "Planner", "ProfileRequest", "SliceCalculator",
     "SliceFilter", "SnapshotTaker", "Sorter",
     "ClusterSnapshot", "SnapshotError", "SliceTracker", "ProfileAwareSorter",
-    "GeometryPlanner", "GeometryActuator", "new_plan_id",
+    "DefragProposer", "GeometryPlanner", "GeometryActuator", "new_plan_id",
     "ParallelGeometryPlanner", "PLAN_SHARD_MIN_HOSTS",
     "PlanPool", "partition_pools", "split_pods",
     "QuarantineList", "REASON_ACTUATION", "REASON_PLAN_DEADLINE",

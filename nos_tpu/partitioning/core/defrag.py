@@ -1,0 +1,804 @@
+"""Background defragmentation: propose re-carves + migrations that turn
+stranded free chips back into placeable capacity.
+
+The waste ledger (obs/ledger.py) names fragmentation precisely: free
+chips on hosts whose free geometry fits no pending class.  Jobs keep
+their admission-time placement for life, so that capacity is only
+recoverable by *moving* something — and the COW snapshot (snapshot.py)
+makes the "what if we moved it?" question cheap to ask.  The proposer
+runs from the PartitionerController on the replan epoch:
+
+1. **Find frag-blocked demand** — pending pods whose
+   ``get_lacking_slices`` verdict is EMPTY (aggregate free capacity
+   covers the request — exactly the verdict class the ledger's
+   frag_stranded attribution keys on) yet still unschedulable, and not
+   quota-blocked.  Demand must persist across two consecutive steps so
+   a pod the plan cycle just rescued is never migrated for.
+2. **Propose** — for the stuck unit's host-window size, enumerate
+   aligned candidate windows (the shard-adjacency convention,
+   topology/windows.py) whose resident pods are all movable, cheapest
+   first.  Feasibility is proved on a **fork of a snapshot subset**:
+   every victim must first-fit (or re-carve-then-fit) onto a host
+   outside the window; the fork is reverted — the proposal actuates
+   through evictions, never through hypothetical geometry writes.
+3. **Score** — ``payback = unlocked stranded chips / migration cost``;
+   cost is the restart-cost signal (``nos.tpu/job-progress`` x the
+   pod's chips: chip-progress the victim re-earns) plus a constant
+   per-move overhead.  Proposals below the configurable threshold are
+   journaled DEFRAG_REJECTED and nothing moves.
+4. **Actuate** — stamp ``nos.tpu/defrag-drain`` on the window hosts
+   (scheduler and planner then avoid refilling them), stamp DRAIN holds
+   on the chip-second ledger (the emptied chips are bought downtime,
+   never frag_stranded), and evict the victims through the gang
+   machinery (whole-gang amplified) — drain-then-rebind: the workload
+   controller recreates them and the scheduler repacks them elsewhere.
+
+Never touched: serving-tier pods (the tier contract — no mechanism
+preempts serving for batch-side optimization, quota shield or not),
+pods past the spare-progress threshold (near-done jobs free capacity
+fastest by finishing), and pods whose PodDisruptionBudget has no
+allowance.
+
+Rate limits: one applied proposal in flight at a time, at most one
+step per ``interval_s`` (default: the controller's replan epoch), and
+a drain deadline after which a stuck proposal is aborted and its
+annotations healed.  Disabled (the factory default) the proposer is
+never constructed and every decision is byte-identical to a build
+without it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
+from nos_tpu.kube.objects import PENDING, Pod, RUNNING
+from nos_tpu.kube.resources import pod_request
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.ledger import DRAIN as LEDGER_DRAIN, get_ledger
+from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+from nos_tpu.topology.profile import extract_slice_requests
+from nos_tpu.topology.windows import aligned_index_windows
+from nos_tpu.utils.retry import retry_on_conflict
+
+from .interfaces import SliceCalculator
+from .snapshot import ClusterSnapshot, SnapshotError
+
+
+def _shape_of(resource: str) -> Any:
+    from nos_tpu.topology.profile import shape_from_resource
+
+    return shape_from_resource(resource)
+
+logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_defrag_proposals_total",
+                  "Defragmentation proposals by verdict "
+                  "(proposed/applied/rejected)")
+REGISTRY.describe("nos_tpu_defrag_migrated_pods_total",
+                  "Pods evicted for an applied defrag proposal")
+REGISTRY.describe("nos_tpu_defrag_unlocked_chips_total",
+                  "Stranded free chips unlocked by applied proposals")
+
+#: Constant per-move overhead (chips) added to each victim's restart
+#: cost: many tiny moves are not free even at zero progress, and the
+#: payback ratio needs a finite denominator.
+MOVE_OVERHEAD_CHIPS = 0.25
+
+
+def _annotation_progress(pod: Pod) -> float:
+    """Default restart-cost signal: the workload-reported
+    ANNOT_JOB_PROGRESS fraction (absent/garbage = 0: nothing to lose).
+    The scheduler's drain preemption reads the same annotation."""
+    import math
+
+    raw = pod.metadata.annotations.get(C.ANNOT_JOB_PROGRESS, "")
+    try:
+        value = float(raw)
+    except ValueError:
+        return 0.0
+    if not math.isfinite(value):
+        return 0.0
+    return min(1.0, max(0.0, value))
+
+
+class _Proposal:
+    """One scored migration plan: empty `hosts` by evicting `victims`.
+    `shrink_uids` marks the victims that are elastic dp members dying
+    by SHRINK (alone, within their gang's min bound, no relocation
+    required); the rest must relocate (drain-then-rebind)."""
+
+    __slots__ = ("proposal_id", "hosts", "victims", "unlocked_chips",
+                 "cost_chips", "payback", "demand", "demand_class",
+                 "shrink_uids")
+
+    def __init__(self, proposal_id: str, hosts: tuple[str, ...],
+                 victims: list[Pod], unlocked_chips: float,
+                 cost_chips: float, demand: str, demand_class: str,
+                 shrink_uids: frozenset[str] = frozenset()) -> None:
+        self.proposal_id = proposal_id
+        self.hosts = hosts
+        self.victims = victims
+        self.unlocked_chips = unlocked_chips
+        self.cost_chips = cost_chips
+        self.payback = unlocked_chips / cost_chips if cost_chips > 0 \
+            else float("inf")
+        self.demand = demand
+        self.demand_class = demand_class
+        self.shrink_uids = shrink_uids
+
+
+class DefragProposer:
+    """The rate-limited background defragmenter (module docstring).
+
+    Owned by one PartitionerController; ``step()`` runs at the end of
+    each plan cycle and self-limits to ``interval_s``.
+    """
+
+    def __init__(self, api: APIServer, kind: str,
+                 calculator: SliceCalculator, *,
+                 payback_min: float = 1.5,
+                 interval_s: float = 10.0,
+                 drain_timeout_s: float = 120.0,
+                 demand_cooldown_s: float | None = None,
+                 spare_progress: float = 0.75,
+                 progress_fn: Callable[[Pod], float] | None = None,
+                 registry: TopologyRegistry = DEFAULT_REGISTRY,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._api = api
+        self._kind = kind
+        self._calculator = calculator
+        self._payback_min = payback_min
+        self._interval_s = interval_s
+        self._drain_timeout_s = drain_timeout_s
+        # Per-demand cooldown: once a proposal was applied for a demand
+        # unit, no further proposal may target it until the cooldown
+        # passes — the planner gets its chance to carve the freed
+        # window, and per-job migration churn is bounded (one move per
+        # unit per cooldown window) however long the demand pends.
+        self._demand_cooldown_s = (
+            demand_cooldown_s if demand_cooldown_s is not None
+            else max(drain_timeout_s, 3.0 * interval_s))
+        self._spare_progress = spare_progress
+        self._progress = progress_fn or _annotation_progress
+        self._registry = registry
+        self._clock = clock
+        self._owner = f"defrag-{kind}"
+        self._seq = 0
+        # first step is never deferred
+        self._last_step = clock() - interval_s
+        # proposal id -> (hosts, drain deadline): the one in-flight drain
+        self._active: dict[str, tuple[tuple[str, ...], float]] = {}
+        # demand unit keys seen frag-blocked on the previous step: a
+        # unit must persist two epoch-spaced steps before anything moves
+        self._stuck_seen: frozenset[str] = frozenset()
+        # demand unit -> last applied-proposal time (cooldown bound)
+        self._demand_last: dict[str, float] = {}
+        # victim pod key -> eviction time: a pod migrated once is
+        # untouchable for a full cooldown — per-JOB churn is bounded
+        # structurally, not just per demand
+        self._moved_recent: dict[str, float] = {}
+        # applied proposals joined by `obs waste` (newest per demand
+        # class); bounded by class cardinality
+        self.last_applied: dict[str, dict[str, object]] = {}
+        # one startup sweep heals drain annotations a predecessor died
+        # holding (the in-memory _active map does not survive restarts)
+        self._healed = False
+
+    # -- driver --------------------------------------------------------------
+    def step(self, snapshot: ClusterSnapshot,
+             pending: list[Pod]) -> str | None:
+        """One defrag opportunity check; returns the applied proposal id
+        (None when nothing moved).  Never raises: a defrag failure must
+        not take the plan cycle down with it."""
+        try:
+            return self._step(snapshot, pending)
+        except SnapshotError:
+            # forked/odd snapshot handed in: skip this epoch
+            logger.warning("defrag[%s]: snapshot unusable this step",
+                           self._kind, exc_info=True)
+            return None
+        except Exception:  # noqa: BLE001 — the defragmenter is a
+            # background optimization: an API hiccup (transient list
+            # failure, retries exhausted past the advisory stamp
+            # helpers) must skip the epoch, never abort the plan cycle
+            # it rides on
+            logger.warning("defrag[%s]: step failed, skipping epoch",
+                           self._kind, exc_info=True)
+            return None
+
+    def _step(self, snapshot: ClusterSnapshot,
+              pending: list[Pod]) -> str | None:
+        self._heal_stray_drains()
+        self._cleanup()
+        now = self._clock()
+        if now - self._last_step < self._interval_s:
+            return None
+        self._last_step = now
+        if self._active:
+            return None         # one drain in flight at a time
+        elastic = self._elastic_headroom()
+        units = self._frag_units(snapshot, pending, elastic)
+        for key in [k for k, t in self._demand_last.items()
+                    if now - t >= self._demand_cooldown_s]:
+            del self._demand_last[key]
+        for key in [k for k, t in self._moved_recent.items()
+                    if now - t >= self._demand_cooldown_s]:
+            del self._moved_recent[key]
+        persistent = [u for u in units
+                      if u[0] in self._stuck_seen
+                      and u[0] not in self._demand_last]
+        self._stuck_seen = frozenset(key for key, _, _ in units)
+        if not persistent:
+            return None
+        # hardest demand first: the largest window is the scarcest
+        persistent.sort(key=lambda u: (-u[2], u[0]))
+        for key, pods, hosts_needed in persistent:
+            proposal = self._propose(snapshot, key, pods, hosts_needed,
+                                     elastic)
+            if proposal is None:
+                continue
+            if proposal.payback < self._payback_min:
+                REGISTRY.inc("nos_tpu_defrag_proposals_total",
+                             labels={"kind": self._kind,
+                                     "verdict": "rejected"})
+                journal_record(
+                    J.DEFRAG_REJECTED, proposal.proposal_id,
+                    reason="payback", demand=proposal.demand,
+                    hosts=list(proposal.hosts)[:MAX_JOURNAL_NODES],
+                    unlocked_chips=round(proposal.unlocked_chips, 2),
+                    cost_chips=round(proposal.cost_chips, 2),
+                    payback=round(proposal.payback, 3),
+                    threshold=self._payback_min)
+                continue
+            if self._actuate(proposal):
+                self._demand_last[key] = now
+                return proposal.proposal_id
+        return None
+
+    # -- demand --------------------------------------------------------------
+    def _elastic_headroom(self) -> dict[tuple[str, str], int]:
+        """(namespace, gang) -> members the gang may lose before its
+        declared min (the malleable-gang contract, scheduler/elastic.py)
+        — defrag's second lever: a window squatted by elastic dp
+        members can be emptied by SHRINKING them (they die alone, no
+        relocation needed), not just by migration."""
+        from nos_tpu.utils.pod_util import elastic_replica_bounds
+
+        out: dict[tuple[str, str], int] = {}
+        for pod in self._api.list(KIND_POD):
+            gang = pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+            if not gang:
+                continue
+            key = (pod.metadata.namespace, gang)
+            if key in out:
+                continue
+            bounds = elastic_replica_bounds(pod)
+            if bounds is None:
+                continue
+            members = self._api.list(
+                KIND_POD, namespace=pod.metadata.namespace,
+                label_selector={C.LABEL_POD_GROUP: gang},
+                filter_fn=lambda p: p.status.phase in (PENDING,
+                                                       RUNNING))
+            out[key] = max(0, len(members) - bounds[0])
+        return out
+
+    def _elastic_slack_chips(
+            self, elastic: dict[tuple[str, str], int]) -> float:
+        """Chips reclaimable by shrinking every elastic gang to its
+        min — counted as available in the frag screen (the space a
+        higher-value blocked class may take from the sponge)."""
+        slack = 0.0
+        for (ns, gang), headroom in elastic.items():
+            if headroom <= 0:
+                continue
+            members = self._api.list(
+                KIND_POD, namespace=ns,
+                label_selector={C.LABEL_POD_GROUP: gang},
+                filter_fn=lambda p: p.status.phase in (PENDING,
+                                                       RUNNING))
+            if members:
+                slack += headroom * self._shard_chips(members[0])
+        return slack
+
+    def _frag_units(self, snapshot: ClusterSnapshot, pending: list[Pod],
+                    elastic: dict[tuple[str, str], int] | None = None
+                    ) -> list[tuple[str, list[Pod], int]]:
+        """Fragmentation-blocked demand units: (key, pods, hosts needed).
+
+        A unit qualifies when the cluster's free SLICE chips (raw
+        chip-equivalents, profile-blind) cover its chip demand yet it
+        is still unschedulable and not quota-blocked: enough chips
+        exist, carved or pinned wrong — the exact regime where only
+        moving something helps (the planner already spent its carve-only
+        answer this cycle; a genuinely SHORT unit is left to quota or
+        autoscaling).  Gang members aggregate into one unit keyed by
+        the gang, demand in the host-shard currency (each member owns
+        its shard of a multi-host shape)."""
+        free_chips = 0.0
+        for pn in snapshot.nodes().values():
+            ni = pn.node_info()
+            free_chips += self._node_slice_free(
+                ni, self._chips_per_host(ni.node.metadata.labels))
+        if elastic:
+            free_chips += self._elastic_slack_chips(elastic)
+        units: dict[str, list[Pod]] = {}
+        for pod in pending:
+            cls = pod.metadata.labels.get(C.LABEL_UNSCHEDULABLE_CLASS, "")
+            if cls.startswith("quota"):
+                continue
+            if not self._calculator.requested_profiles(pod):
+                continue
+            gang = pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+            if gang and elastic is not None \
+                    and (pod.metadata.namespace, gang) in elastic:
+                # an elastic gang's own pending (grow) member is the
+                # SPONGE, not demand worth migrating anything for
+                continue
+            key = (f"{pod.metadata.namespace}/{gang}" if gang
+                   else pod.key)
+            units.setdefault(key, []).append(pod)
+        out: list[tuple[str, list[Pod], int]] = []
+        for key, pods in sorted(units.items()):
+            demand = sum(self._shard_chips(p) for p in pods)
+            if demand <= 0 or demand > free_chips:
+                continue        # genuinely short: not a frag problem
+            hosts_needed = self._hosts_needed(snapshot, pods)
+            if hosts_needed > 0:
+                out.append((key, pods, hosts_needed))
+        return out
+
+    @staticmethod
+    def _node_slice_free(ni: Any, chips_per_host: float) -> float:
+        """Free SLICE chip-equivalents on one node (shard-capped; the
+        whole-chip resource a host also advertises would double-count
+        its capacity — same rule as pools._slice_free)."""
+        total = 0.0
+        for res, qty in ni.free().items():
+            if qty <= 0:
+                continue
+            shape = _shape_of(res)
+            if shape is not None:
+                total += min(float(shape.chips), chips_per_host) * qty
+        return total
+
+    def _shard_chips(self, pod: Pod) -> float:
+        """The pod's chip demand in the host-shard currency (a member
+        of an N-host slice owns chips_per_host of it, not the whole
+        shape)."""
+        chips = 0.0
+        for shape, qty in extract_slice_requests(pod_request(pod)).items():
+            chips += min(float(shape.chips), self._max_chips_per_host) * qty
+        return chips
+
+    @property
+    def _max_chips_per_host(self) -> float:
+        best = 0.0
+        for gen in self._registry.generations.values():
+            best = max(best, float(gen.chips_per_host))
+        return best or 8.0
+
+    def _hosts_needed(self, snapshot: ClusterSnapshot,
+                      pods: list[Pod]) -> int:
+        """Aligned-window size (hosts) the unit's largest shape spans on
+        the snapshot's generations; 0 when no generation present can
+        serve the shape (migration cannot invent a geometry)."""
+        shapes = set()
+        for pod in pods:
+            shapes.update(extract_slice_requests(pod_request(pod)))
+        if not shapes:
+            return 0
+        best = 0
+        for node in snapshot.nodes().values():
+            labels = node.node_info().node.metadata.labels
+            gen = self._registry.generations.get(
+                labels.get(C.LABEL_ACCELERATOR, ""))
+            if gen is None:
+                continue
+            try:
+                needed = max(max(gen.hosts_for(s) for s in shapes), 1)
+            except ValueError:
+                continue        # shape not carvable on this generation
+            best = needed if best == 0 else min(best, needed)
+        return best
+
+    # -- proposal ------------------------------------------------------------
+    def _propose(self, snapshot: ClusterSnapshot, demand: str,
+                 demand_pods: list[Pod], hosts_needed: int,
+                 elastic: dict[tuple[str, str], int] | None = None
+                 ) -> _Proposal | None:
+        """Best candidate window for the demand unit, by payback.
+        Elastic dp members on the window shrink (die alone, up to their
+        gang's headroom); everything else must relocate, proved on a
+        forked snapshot subset."""
+        elastic = elastic or {}
+        nodes = snapshot.nodes()
+        by_pool: dict[str, dict[int, str]] = {}
+        immovable: set[str] = set()
+        cost: dict[str, float] = {}
+        stranded: dict[str, float] = {}
+        victims: dict[str, list[Pod]] = {}
+        for name, pn in nodes.items():
+            ni = pn.node_info()
+            labels = ni.node.metadata.labels
+            annots = ni.node.metadata.annotations
+            if annots.get(C.ANNOT_GANG_LEASE) \
+                    or annots.get(C.ANNOT_DEFRAG_DRAIN):
+                immovable.add(name)     # already draining toward something
+            pool = labels.get(C.LABEL_POD_ID, "")
+            try:
+                idx = int(labels.get(C.LABEL_HOST_INDEX, "0"))
+            except ValueError:
+                continue
+            by_pool.setdefault(pool, {})[idx] = name
+            chips_per_host = self._chips_per_host(labels)
+            node_cost = 0.0
+            node_victims: list[Pod] = []
+            for pod in ni.pods:
+                move = self._move_cost(pod, chips_per_host)
+                if move is None:
+                    immovable.add(name)
+                    break
+                node_cost += move
+                node_victims.append(pod)
+            cost[name] = node_cost
+            victims[name] = node_victims
+            stranded[name] = self._node_slice_free(ni, chips_per_host)
+        best: _Proposal | None = None
+        for pool in sorted(by_pool):
+            hosts = by_pool[pool]
+            if not pool and hosts_needed > 1:
+                continue        # unlabeled hosts form no aligned windows
+            windows = (aligned_index_windows(hosts, hosts_needed)
+                       if hosts_needed > 1
+                       else [[i] for i in sorted(hosts)])
+            candidates: list[tuple[float, tuple[str, ...]]] = []
+            for window in windows:
+                names = tuple(hosts[i] for i in window)
+                if any(n in immovable for n in names):
+                    continue
+                n_victims = sum(len(victims[n]) for n in names)
+                if n_victims == 0:
+                    continue    # already whole: nothing to unlock here
+                candidates.append(
+                    (sum(cost[n] for n in names), names))
+            # cheapest feasible window wins within the pool
+            for window_cost, names in sorted(candidates):
+                window_victims = [p for n in names for p in victims[n]]
+                split = self._split_shrink(window_victims, elastic)
+                if split is None:
+                    continue
+                shrink_uids, movers = split
+                if not self._relocatable(snapshot, names, movers):
+                    continue
+                unlocked = sum(stranded[n] for n in names) + sum(
+                    self._shard_chips(p) for p in window_victims
+                    if p.metadata.uid in shrink_uids)
+                self._seq += 1
+                proposal = _Proposal(
+                    f"dfrg-{self._kind}-{self._seq}", names,
+                    window_victims, unlocked, window_cost, demand,
+                    self._demand_class(demand_pods),
+                    shrink_uids=shrink_uids)
+                REGISTRY.inc("nos_tpu_defrag_proposals_total",
+                             labels={"kind": self._kind,
+                                     "verdict": "proposed"})
+                journal_record(
+                    J.DEFRAG_PROPOSED, proposal.proposal_id,
+                    demand=demand, hosts=list(names)[:MAX_JOURNAL_NODES],
+                    victims=[p.key for p in
+                             window_victims[:MAX_JOURNAL_NODES]],
+                    victim_count=len(window_victims),
+                    unlocked_chips=round(unlocked, 2),
+                    cost_chips=round(window_cost, 2),
+                    payback=round(proposal.payback, 3),
+                    demand_class=proposal.demand_class)
+                if best is None or proposal.payback > best.payback:
+                    best = proposal
+                break           # one scored proposal per pool per step
+        return best
+
+    def _split_shrink(self, window_victims: list[Pod],
+                      elastic: dict[tuple[str, str], int]
+                      ) -> tuple[frozenset[str], list[Pod]] | None:
+        """Partition the window's victims: elastic dp members shrink
+        (up to their gang's headroom, no relocation needed); the rest
+        must relocate.  None when the window holds an elastic member
+        its gang cannot spare — shrinking below min would break the
+        contract, and the replica count belongs to the gang's own
+        controller, so "relocating" it is not defrag's to do."""
+        shrink: set[str] = set()
+        movers: list[Pod] = []
+        budget = dict(elastic)
+        for pod in sorted(window_victims, key=lambda p: p.key):
+            gang = pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+            key = (pod.metadata.namespace, gang)
+            if gang and key in elastic:
+                if budget.get(key, 0) <= 0:
+                    return None
+                budget[key] -= 1
+                shrink.add(pod.metadata.uid)
+            else:
+                movers.append(pod)
+        return frozenset(shrink), movers
+
+    def _move_cost(self, pod: Pod,
+                   chips_per_host: float) -> float | None:
+        """Restart cost (chips of re-earned progress + overhead) of
+        migrating `pod`, or None when the pod is untouchable: serving
+        tier (the tier contract shields it from every batch-side
+        optimization, in or over quota), past the spare-progress
+        threshold (it frees capacity fastest by finishing), or a RIGID
+        gang member — re-admitting a gang needs co-placement (one ICI
+        domain, aligned windows for multi-host shapes) that the per-pod
+        first-fit what-if cannot prove, so evicting one would risk an
+        unrecoverable whole-gang kill; elastic members are handled by
+        the shrink path instead (_split_shrink)."""
+        from nos_tpu.utils.pod_util import (
+            elastic_replica_bounds, workload_tier,
+        )
+
+        if workload_tier(pod) == C.TIER_SERVING:
+            return None
+        if pod.metadata.labels.get(C.LABEL_POD_GROUP, "") \
+                and elastic_replica_bounds(pod) is None:
+            return None         # rigid gang: never migrated piecemeal
+        if pod.key in self._moved_recent:
+            return None         # churn bound: one move per cooldown
+        progress = self._progress(pod)
+        if progress >= self._spare_progress:
+            return None
+        chips = sum(min(float(s.chips), chips_per_host) * q
+                    for s, q in extract_slice_requests(
+                        pod_request(pod)).items())
+        return progress * chips + MOVE_OVERHEAD_CHIPS
+
+    def _chips_per_host(self, labels: dict[str, str]) -> float:
+        gen = self._registry.generations.get(
+            labels.get(C.LABEL_ACCELERATOR, ""))
+        if gen is not None:
+            return float(gen.chips_per_host)
+        try:
+            return float(labels.get(C.LABEL_CHIP_COUNT, "0") or 0.0)
+        except ValueError:
+            return 0.0
+
+    @staticmethod
+    def _demand_class(pods: list[Pod]) -> str:
+        from nos_tpu.utils.pod_util import workload_class
+
+        return workload_class(pods[0]) if pods else ""
+
+    def _relocatable(self, snapshot: ClusterSnapshot,
+                     window: tuple[str, ...],
+                     window_victims: list[Pod]) -> bool:
+        """Would every victim fit somewhere OUTSIDE the window?  Proved
+        on a fork of the snapshot subset so successive placements see
+        each other's consumption; always reverted — the what-if commits
+        nothing (the proposal actuates through evictions)."""
+        if not window_victims:
+            return True         # pure-shrink window: nothing to place
+        others = [n for n in snapshot.nodes() if n not in window]
+        if not others:
+            return False
+        sub = snapshot.subset(others)
+        sub.fork()
+        try:
+            ordered = sorted(
+                window_victims,
+                key=lambda p: (-self._victim_chips(p), p.key))
+            for pod in ordered:
+                if not self._place_one(sub, pod):
+                    return False
+            return True
+        finally:
+            sub.revert()
+
+    def _place_one(self, sub: ClusterSnapshot, pod: Pod) -> bool:
+        profiles = self._calculator.requested_profiles(pod)
+        for cand in sub.get_candidate_nodes():
+            annots = cand.node_info().node.metadata.annotations
+            if annots.get(C.ANNOT_GANG_LEASE) \
+                    or annots.get(C.ANNOT_DEFRAG_DRAIN):
+                continue        # never refill a draining window
+            node = sub.get_node_for_write(cand.name)
+            if node.add_pod(pod):
+                return True
+            if node.update_geometry_for(dict(profiles)) \
+                    and node.add_pod(pod):
+                return True
+        return False
+
+    @staticmethod
+    def _victim_chips(pod: Pod) -> float:
+        return sum(float(s.chips) * q for s, q in
+                   extract_slice_requests(pod_request(pod)).items())
+
+    # -- actuation -----------------------------------------------------------
+    def _actuate(self, proposal: _Proposal) -> bool:
+        """Stamp the drain (annotations + ledger holds), then evict the
+        victims whole-gang.  PDB allowance is re-checked against live
+        budgets at this moment; a refusal journals DEFRAG_REJECTED.
+        Returns whether the proposal was applied."""
+        if not self._pdb_allows(proposal.victims):
+            REGISTRY.inc("nos_tpu_defrag_proposals_total",
+                         labels={"kind": self._kind,
+                                 "verdict": "rejected"})
+            journal_record(J.DEFRAG_REJECTED, proposal.proposal_id,
+                           reason="pdb", demand=proposal.demand,
+                           hosts=list(proposal.hosts)[:MAX_JOURNAL_NODES])
+            return False
+        ledger = get_ledger()
+        for host in proposal.hosts:
+            self._stamp_drain(host, proposal.proposal_id)
+            ledger.set_hold(host, LEDGER_DRAIN, owner=self._owner,
+                            proposal=proposal.proposal_id,
+                            demand=proposal.demand)
+        from nos_tpu.scheduler.elastic import record_shrink
+        from nos_tpu.scheduler.gang import evict_gang, gang_name
+
+        evicted = 0
+        evicted_gangs: set[tuple[str, str]] = set()
+        shrunk: dict[tuple[str, str], int] = {}
+        for pod in proposal.victims:
+            gang = gang_name(pod)
+            if pod.metadata.uid in proposal.shrink_uids and gang:
+                # elastic shrink: the member dies alone, within the
+                # gang's declared min (scheduler/elastic.py)
+                try:
+                    self._api.delete(KIND_POD, pod.metadata.name,
+                                     pod.metadata.namespace)
+                except NotFound:
+                    continue
+                gkey = (pod.metadata.namespace, gang)
+                shrunk[gkey] = shrunk.get(gkey, 0) + 1
+                evicted += 1
+                continue
+            if gang:
+                gkey = (pod.metadata.namespace, gang)
+                if gkey in evicted_gangs:
+                    continue
+                evicted_gangs.add(gkey)
+            evicted += len(evict_gang(self._api, pod))
+        now = self._clock()
+        for pod in proposal.victims:
+            if pod.metadata.uid not in proposal.shrink_uids:
+                self._moved_recent[pod.key] = now
+        for (ns, gang), n in sorted(shrunk.items()):
+            record_shrink(self._api, ns, gang, n,
+                          proposal=proposal.proposal_id)
+        self._active[proposal.proposal_id] = (
+            proposal.hosts, self._clock() + self._drain_timeout_s)
+        REGISTRY.inc("nos_tpu_defrag_proposals_total",
+                     labels={"kind": self._kind, "verdict": "applied"})
+        REGISTRY.inc("nos_tpu_defrag_migrated_pods_total", float(evicted),
+                     labels={"kind": self._kind})
+        REGISTRY.inc("nos_tpu_defrag_unlocked_chips_total",
+                     proposal.unlocked_chips,
+                     labels={"kind": self._kind})
+        applied = {
+            "proposal": proposal.proposal_id, "demand": proposal.demand,
+            "hosts": list(proposal.hosts)[:MAX_JOURNAL_NODES],
+            "unlocked_chips": round(proposal.unlocked_chips, 2),
+            "migrated_pods": evicted,
+        }
+        self.last_applied[proposal.demand_class or ""] = applied
+        journal_record(
+            J.DEFRAG_APPLIED, proposal.proposal_id,
+            demand=proposal.demand, demand_class=proposal.demand_class,
+            hosts=list(proposal.hosts)[:MAX_JOURNAL_NODES],
+            victims=[p.key for p in
+                     proposal.victims[:MAX_JOURNAL_NODES]],
+            victim_count=len(proposal.victims), migrated=evicted,
+            shrunk=sum(shrunk.values()),
+            moved=[p.key for p in proposal.victims
+                   if p.metadata.uid not in
+                   proposal.shrink_uids][:MAX_JOURNAL_NODES],
+            unlocked_chips=round(proposal.unlocked_chips, 2),
+            cost_chips=round(proposal.cost_chips, 2),
+            payback=round(proposal.payback, 3))
+        logger.info(
+            "defrag[%s]: applied %s — emptied %s (%d victim(s), "
+            "%.1f chips unlocked, payback %.2f) for %s",
+            self._kind, proposal.proposal_id, sorted(proposal.hosts),
+            evicted, proposal.unlocked_chips, proposal.payback,
+            proposal.demand)
+        return True
+
+    def _pdb_allows(self, victims: list[Pod]) -> bool:
+        from nos_tpu.api.pdb import (
+            KIND_POD_DISRUPTION_BUDGET, refresh_pdb_status,
+        )
+
+        pdbs = [refresh_pdb_status(self._api, pdb)
+                for pdb in self._api.list(KIND_POD_DISRUPTION_BUDGET)]
+        if not pdbs:
+            return True
+        needed: dict[int, int] = {}
+        for pod in victims:
+            if pod.status.phase != RUNNING:
+                continue
+            for i, pdb in enumerate(pdbs):
+                if pdb.matches(pod):
+                    needed[i] = needed.get(i, 0) + 1
+        return all(pdbs[i].status.disruptions_allowed >= n
+                   for i, n in needed.items())
+
+    def _stamp_drain(self, host: str, proposal_id: str) -> None:
+        def mutate(node: Any) -> None:
+            node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] = proposal_id
+
+        try:
+            retry_on_conflict(self._api, KIND_NODE, host, mutate,
+                              component=self._owner)
+        except Exception:  # noqa: BLE001 — advisory: a half-stamped
+            # drain only weakens refill avoidance; cleanup() heals it
+            logger.debug("defrag drain stamp failed for %s", host)
+
+    def _clear_drain(self, host: str) -> None:
+        def mutate(node: Any) -> None:
+            node.metadata.annotations.pop(C.ANNOT_DEFRAG_DRAIN, None)
+
+        try:
+            retry_on_conflict(self._api, KIND_NODE, host, mutate,
+                              component=self._owner)
+        except NotFound:
+            pass                # host left the cluster: nothing to heal
+        except Exception:  # noqa: BLE001 — retried next cleanup sweep
+            logger.debug("defrag drain clear failed for %s", host)
+
+    def _heal_stray_drains(self) -> None:
+        """Startup sweep: clear any ANNOT_DEFRAG_DRAIN no proposal of
+        THIS proposer owns — a predecessor that died mid-drain must not
+        deprioritize those hosts forever (the scheduler's score key and
+        the planner's candidate order both read the annotation)."""
+        if self._healed:
+            return
+        self._healed = True
+        owned = {pid for pid in self._active}
+        for node in self._api.list(KIND_NODE):
+            value = node.metadata.annotations.get(C.ANNOT_DEFRAG_DRAIN)
+            if value and value not in owned:
+                logger.info("defrag[%s]: healing stray drain %s on %s",
+                            self._kind, value, node.metadata.name)
+                self._clear_drain(node.metadata.name)
+                get_ledger().clear_hold(node.metadata.name,
+                                        LEDGER_DRAIN, owner=self._owner)
+
+    def _cleanup(self) -> None:
+        """Resolve in-flight drains: a window whose hosts emptied is
+        released (annotations + holds cleared — the whole hosts are now
+        the planner's to carve); one stuck past its deadline is aborted
+        and journaled, so a PDB-blocked or wedged eviction can never
+        pin the drain annotations forever."""
+        if not self._active:
+            return
+        now = self._clock()
+        ledger = get_ledger()
+        live_by_host: dict[str, int] = {}
+        for pod in self._api.list(KIND_POD):
+            if pod.spec.node_name and pod.status.phase in (PENDING,
+                                                           RUNNING):
+                live_by_host[pod.spec.node_name] = \
+                    live_by_host.get(pod.spec.node_name, 0) + 1
+        for pid, (hosts, deadline) in list(self._active.items()):
+            drained = all(live_by_host.get(h, 0) == 0 for h in hosts)
+            if not drained and now < deadline:
+                continue
+            for host in hosts:
+                self._clear_drain(host)
+                ledger.clear_hold(host, LEDGER_DRAIN, owner=self._owner)
+            del self._active[pid]
+            if not drained:
+                REGISTRY.inc("nos_tpu_defrag_proposals_total",
+                             labels={"kind": self._kind,
+                                     "verdict": "rejected"})
+                journal_record(J.DEFRAG_REJECTED, pid,
+                               reason="drain-timeout",
+                               hosts=list(hosts)[:MAX_JOURNAL_NODES])

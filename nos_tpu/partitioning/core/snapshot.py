@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import Iterable, Mapping
 
-from nos_tpu.api.constants import ANNOT_GANG_LEASE
+from nos_tpu.api.constants import ANNOT_DEFRAG_DRAIN, ANNOT_GANG_LEASE
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.resources import (
     negatives_only, pod_request, subtract, sum_resources,
@@ -91,7 +91,15 @@ class ClusterSnapshot:
     def clone(self) -> "ClusterSnapshot":
         """Independent copy — the controller plans on a clone so the actuator
         can diff desired against the unmutated current state (reference
-        partitioner_controller.go:178-193 planning on snapshot.Clone())."""
+        partitioner_controller.go:178-193 planning on snapshot.Clone()).
+
+        Refused while forked, exactly like subset(): a clone taken
+        mid-fork would capture half-applied hypothetical state with no
+        dirty set to revert it — the defragmenter's what-if forks made
+        this reachable (the first caller to interleave forks with the
+        controller's clone/subset lifecycle)."""
+        if self._forked is not None:
+            raise SnapshotError("cannot clone a forked snapshot")
         return ClusterSnapshot(
             {n: pn.clone() for n, pn in self._nodes.items()}, self._filter
         )
@@ -102,7 +110,10 @@ class ClusterSnapshot:
 
         Each subset carries its own fork/dirty/generation state, so a
         shard's COW fork clones into the shard's own node map and never
-        writes through to this snapshot's entries.  In-place mutations
+        writes through to this snapshot's entries — the parent's dirty
+        set and the subset's are disjoint objects by construction, and
+        a fork taken on the subset (the defragmenter's what-if path)
+        commits/reverts entirely within the subset.  In-place mutations
         (the group pass's deliberate out-of-fork carves) DO write
         through — concurrent subsets are therefore safe exactly when
         their name sets are disjoint, which the pool partitioner
@@ -180,7 +191,10 @@ class ClusterSnapshot:
         new demand lands now decides real utilization.  Hosts carrying
         the scheduler's gang-window lease (ANNOT_GANG_LEASE) go last:
         they are draining toward a stuck multi-host gang and re-carving
-        them for other demand would re-fragment the window.
+        them for other demand would re-fragment the window.  Hosts a
+        defrag proposal is emptying (ANNOT_DEFRAG_DRAIN) rank the same
+        way for the same reason: the migration bought that window for
+        the fragmentation-blocked class, not for whatever is pending.
 
         The computed order is memoised on the mutation epoch: repeated
         calls with no intervening write return the cached order instead
@@ -192,8 +206,9 @@ class ClusterSnapshot:
         for name in sorted(self._nodes):
             ni = self._nodes[name].node_info()
             if any(v > 0 for v in ni.free().values()):
-                leased = bool(ni.node.metadata.annotations.get(
-                    ANNOT_GANG_LEASE))
+                annots = ni.node.metadata.annotations
+                leased = bool(annots.get(ANNOT_GANG_LEASE)) \
+                    or bool(annots.get(ANNOT_DEFRAG_DRAIN))
                 out.append((leased, free_chip_equivalents(ni.free()),
                             name, self._nodes[name]))
         out.sort(key=lambda t: (t[0], t[1], t[2]))

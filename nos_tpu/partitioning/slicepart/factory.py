@@ -9,7 +9,7 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import GeometryActuator, QuarantineList
+from ..core import DefragProposer, GeometryActuator, QuarantineList
 from ..core.parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from ..state import ClusterState
 from .calculators import SlicePartitionCalculator, SliceProfileCalculator
@@ -26,6 +26,11 @@ def new_slice_partitioner_controller(
     replan_epoch_s: float | None = None,
     plan_shard_min_hosts: int = PLAN_SHARD_MIN_HOSTS,
     plan_workers: int = 0,
+    defrag_enabled: bool = False,
+    defrag_payback_min: float = 1.5,
+    defrag_interval_s: float | None = None,
+    defrag_drain_timeout_s: float = 120.0,
+    defrag_progress_fn=None,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
@@ -55,12 +60,25 @@ def new_slice_partitioner_controller(
     actuator = GeometryActuator(SlicePartitioner(api), partition_calculator,
                                 quarantine=quarantine)
     batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+    # Background defragmenter (partitioning/core/defrag.py): opt-in —
+    # disabled it is never constructed, so every decision stays
+    # byte-identical to a build without the plane.  Its step interval
+    # defaults to the controller's replan epoch cadence.
+    defrag = None
+    if defrag_enabled:
+        defrag = DefragProposer(
+            api, SLICE_KIND, SliceProfileCalculator(),
+            payback_min=defrag_payback_min,
+            interval_s=(defrag_interval_s if defrag_interval_s is not None
+                        else (replan_epoch_s or batch_idle_s)),
+            drain_timeout_s=defrag_drain_timeout_s,
+            progress_fn=defrag_progress_fn, **kwargs)
     return PartitionerController(
         api=api, cluster_state=cluster_state, kind=SLICE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=SliceSnapshotTaker(), batcher=batcher,
         quarantine=quarantine, plan_deadline_s=plan_deadline_s,
-        replan_epoch_s=replan_epoch_s, **kwargs,
+        replan_epoch_s=replan_epoch_s, defrag=defrag, **kwargs,
     )
 
 

@@ -30,6 +30,7 @@ from nos_tpu.api import constants as C
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.client import (
     APIServer, KIND_COMPOSITE_ELASTIC_QUOTA, KIND_ELASTIC_QUOTA, KIND_POD,
+    NotFound,
 )
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
 from nos_tpu.kube.resources import ResourceList, sum_resources
@@ -39,7 +40,9 @@ from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalcul
 from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status,
 )
-from nos_tpu.utils.pod_util import is_over_quota, tier_rank, workload_tier
+from nos_tpu.utils.pod_util import (
+    elastic_replica_bounds, is_over_quota, tier_rank, workload_tier,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -368,25 +371,29 @@ class CapacityScheduling:
         # once per PostFilter and share the cache across candidate nodes.
         gang_cache: dict[tuple[str, str], list[Pod]] = {}
 
-        candidates: list[tuple[str, list[Pod], int]] = []
+        candidates: list[tuple[str, list[Pod], int, set[str]]] = []
         for ni in nodes.list():
+            shrink_uids: set[str] = set()
             victims, num_violating, st = self._select_victims_on_node(
-                state, pod, ni, pdbs, gang_cache)
+                state, pod, ni, pdbs, gang_cache, shrink_out=shrink_uids)
             if st.is_success and victims:
                 # Score and account the TRUE eviction set: gang eviction
-                # amplifies cluster-wide, not just on this node.
-                full = self._expand_eviction(victims, gang_cache)
-                candidates.append((ni.name, full, num_violating))
+                # amplifies cluster-wide, not just on this node — except
+                # for elastic SHRINK victims, which die alone by contract.
+                full = self._expand_eviction(victims, gang_cache,
+                                             shrink_uids)
+                candidates.append((ni.name, full, num_violating,
+                                   shrink_uids))
         if not candidates:
             journal_record(J.PREEMPTION_NONE, pod.key,
                            message="preemption found no candidates")
             return "", Status.unschedulable("preemption found no candidates")
 
-        best = min(candidates, key=self._candidate_key)
-        node_name, victims, _ = best
+        best = min(candidates, key=lambda c: self._candidate_key(c[:3]))
+        node_name, victims, _, shrink_uids = best
         if self.on_preempt is not None:
             self.on_preempt(pod, victims)
-        self._evict_all(victims)
+        self._evict_all(victims, shrink_uids)
 
         REGISTRY.inc("nos_tpu_preemptions_total")
         REGISTRY.inc("nos_tpu_preemption_victims_total", len(victims))
@@ -398,13 +405,15 @@ class CapacityScheduling:
         return node_name, Status.ok()
 
     def _expand_eviction(self, victims: list[Pod],
-                         gang_cache: dict | None = None) -> list[Pod]:
+                         gang_cache: dict | None = None,
+                         shrink_uids: set[str] | None = None) -> list[Pod]:
         """Deduplicated cluster-wide eviction set for a victim list: every
-        gang-mate of a victim dies with it (evict_gang)."""
+        gang-mate of a victim dies with it (evict_gang) — except shrink
+        victims, which leave their gang running one replica smaller."""
         out: list[Pod] = []
         seen: set[str] = set()
         for v in victims:
-            for m in self._eviction_set(v, gang_cache):
+            for m in self._eviction_set(v, gang_cache, shrink_uids):
                 if m.metadata.uid not in seen:
                     seen.add(m.metadata.uid)
                     out.append(m)
@@ -421,30 +430,62 @@ class CapacityScheduling:
         return (num_violating, max(priorities), sum(priorities),
                 len(victims), name)
 
-    def _evict_all(self, victims: list[Pod]) -> None:
+    def _evict_all(self, victims: list[Pod],
+                   shrink_uids: set[str] | None = None) -> None:
         """Evict each gang once: the victim list is already gang-expanded
         (_expand_eviction), and evict_gang deletes every member of a
         victim's group, so per-member calls would re-list and re-delete
-        each gang N times."""
+        each gang N times.  Shrink victims (elastic dp members above
+        their min) are deleted ALONE and the surviving members get the
+        dp-resize stamp — the cheaper rung that loses one replica, not
+        the run."""
         if self._api is None:
             return
+        from nos_tpu.scheduler.elastic import record_shrink
         from nos_tpu.scheduler.gang import evict_gang, gang_name
         evicted_gangs: set[tuple[str, str]] = set()
+        shrunk: dict[tuple[str, str], int] = {}
         for v in victims:
             gang = gang_name(v)
+            if shrink_uids and v.metadata.uid in shrink_uids and gang:
+                key = (v.metadata.namespace, gang)
+                try:
+                    self._api.delete(KIND_POD, v.metadata.name,
+                                     v.metadata.namespace)
+                except NotFound:
+                    pass
+                shrunk[key] = shrunk.get(key, 0) + 1
+                continue
             if gang:
                 key = (v.metadata.namespace, gang)
                 if key in evicted_gangs:
                     continue
                 evicted_gangs.add(key)
             evict_gang(self._api, v)
+        for (ns, gang), n in sorted(shrunk.items()):
+            # a gang BOTH shrunk and whole-evicted in one walk died
+            # whole; record_shrink's no-survivors guard keeps the
+            # phantom "shrink to 0" out of the journal/metric
+            record_shrink(self._api, ns, gang, n)
 
     def _select_victims_on_node(
             self, state: CycleState, pod: Pod, node_info: NodeInfo,
             pdbs: list | None = None,
-            gang_cache: dict | None = None) -> tuple[list[Pod], int, Status]:
+            gang_cache: dict | None = None,
+            shrink_out: set[str] | None = None
+    ) -> tuple[list[Pod], int, Status]:
         """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675),
-        run against clones so failed candidates leave no trace."""
+        run against clones so failed candidates leave no trace.
+
+        Shrink-before-evict (scheduler/elastic.py): members of an
+        elastic dp gang above its declared min are the CHEAPEST rung of
+        the walk — ordered before even best-effort eviction, and their
+        eviction does not amplify to the gang.  Eligibility branches
+        are untouched (shrink changes order and amplification only), so
+        victim_prescreen's superset contract is preserved.  Selected
+        shrink victims' uids are reported through `shrink_out`; at most
+        (live members - min) members of one gang shrink per walk, the
+        rest fall back to normal whole-gang eviction."""
         base_snapshot: ElasticQuotaInfos = state[ELASTIC_QUOTA_SNAPSHOT_KEY]
         pfs: PreFilterState = state[PRE_FILTER_STATE_KEY]
 
@@ -485,17 +526,57 @@ class CapacityScheduling:
         # outranks the tier shield, or a self-applied tier label would
         # capture a lender's min forever (the band-fits-in-min posture
         # in docs/serving.md is what keeps real replicas in-quota).
-        # Among the preemptible pods the walk takes best-effort
-        # scavengers before batch before (over-quota) serving, then
-        # lowest priority first (reference sorts ascending :516).
-        # Excluding in-quota serving only NARROWS selection, so
-        # victim_prescreen's superset contract is untouched.
+        # Among the preemptible pods the walk takes shrinkable elastic
+        # members first (the cheapest rung: one dp replica, not a run),
+        # then best-effort scavengers before batch before (over-quota)
+        # serving, then lowest priority first (reference sorts
+        # ascending :516).  Excluding in-quota serving only NARROWS
+        # selection, so victim_prescreen's superset contract is
+        # untouched.
+        shrink_left: dict[tuple[str, str], int] = {}
+
+        def _shrink_headroom(pv: Pod) -> int:
+            from nos_tpu.scheduler.gang import gang_name as _gname
+
+            g = _gname(pv)
+            if not g or elastic_replica_bounds(pv) is None:
+                return 0
+            key = (pv.metadata.namespace, g)
+            if key not in shrink_left:
+                from nos_tpu.scheduler.elastic import shrink_headroom
+                members = self._eviction_set(pv, gang_cache)
+                shrink_left[key] = shrink_headroom(
+                    [m for m in members
+                     if m.status.phase in (PENDING, RUNNING)])
+            return shrink_left[key]
+
+        def _take_shrink(pv: Pod) -> bool:
+            """Consume one unit of the victim's gang shrink budget."""
+            if _shrink_headroom(pv) <= 0:
+                return False
+            shrink_left[(pv.metadata.namespace,
+                         gang_name(pv))] -= 1
+            if shrink_out is not None:
+                shrink_out.add(pv.metadata.uid)
+            return True
+
+        from nos_tpu.scheduler.gang import gang_name
+
         node_pods = sorted(
             (p for p in ni.pods
              if workload_tier(p) != C.TIER_SERVING
              or is_over_quota(p)),
-            key=lambda p: (-tier_rank(p), p.spec.priority,
+            key=lambda p: (0 if _shrink_headroom(p) > 0 else 1,
+                           -tier_rank(p), p.spec.priority,
                            -p.metadata.creation_timestamp))
+        def select(pv: Pod) -> None:
+            """Take `pv` as a potential victim, consuming its gang's
+            shrink budget when available (the uid lands in shrink_out
+            so eviction will not gang-amplify it)."""
+            _take_shrink(pv)
+            potential.append(pv)
+            remove(pv)
+
         if preemptor_info is not None:
             more_than_min = preemptor_info.used_over_min_with(nominated_in_eq)
             for pv in node_pods:
@@ -507,8 +588,7 @@ class CapacityScheduling:
                     # lower-priority victims...
                     if pv.metadata.namespace == pod.metadata.namespace:
                         if pv.spec.priority < pod.spec.priority:
-                            potential.append(pv)
-                            remove(pv)
+                            select(pv)
                         continue
                     # ...or cross-namespace over-quota pods, but only while
                     # the preemptor stays within min + its guaranteed share
@@ -523,16 +603,14 @@ class CapacityScheduling:
                             pv.metadata.namespace)
                         pv_min_plus_g = sum_resources(pv_g, pv_info.min)
                         if pv_info.used_over(pv_min_plus_g):
-                            potential.append(pv)
-                            remove(pv)
+                            select(pv)
                 else:
                     # Preemptor within min: its guaranteed quota is borrowed
                     # elsewhere — only cross-namespace over-quota-labelled
                     # pods from borrowing quotas are eligible (:566-581).
                     if pv.metadata.namespace != pod.metadata.namespace \
                             and pv_info.used_over_min() and is_over_quota(pv):
-                        potential.append(pv)
-                        remove(pv)
+                        select(pv)
         else:
             # Preemptor not governed by any quota: classic priority
             # preemption among quota-less pods (:583-596).
@@ -540,8 +618,7 @@ class CapacityScheduling:
                 if snapshot.get(pv.metadata.namespace) is not None:
                     continue
                 if pv.spec.priority < pod.spec.priority:
-                    potential.append(pv)
-                    remove(pv)
+                    select(pv)
 
         if not potential:
             return [], 0, Status.unschedulable("no victims found")
@@ -564,7 +641,7 @@ class CapacityScheduling:
         # walk, minimising PDB violations); victims that stay despite
         # violating a budget are counted for the node-choice tiebreak.
         violating, non_violating = self._split_pdb_violation(
-            potential, pdbs, gang_cache)
+            potential, pdbs, gang_cache, shrink_out)
         victims: list[Pod] = []
         num_violating = 0
 
@@ -586,8 +663,12 @@ class CapacityScheduling:
         # and highest priority get their capacity back first, so the
         # victims that actually die are the scavengers — without the
         # tier key here the reprieve pass silently undoes the
-        # tier-ordered walk above.
-        by_prio = lambda p: (tier_rank(p), -p.spec.priority,  # noqa: E731
+        # tier-ordered walk above.  Shrink victims reprieve LAST for
+        # the same reason: they are the cheapest rung, so they must be
+        # the last deaths undone.
+        _shrunk = shrink_out or set()
+        by_prio = lambda p: (p.metadata.uid in _shrunk,  # noqa: E731
+                             tier_rank(p), -p.spec.priority,
                              p.metadata.creation_timestamp)
         for pv in sorted(violating, key=by_prio):
             if not reprieve(pv):
@@ -598,11 +679,12 @@ class CapacityScheduling:
         # Gang coherence: a reprieved candidate whose gang-mate stayed a
         # victim dies anyway at eviction time (evict_gang is all-or-nothing)
         # — fold it back into the victim set so the PDB-violation count and
-        # the node-choice key reflect the true eviction set.
-        from nos_tpu.scheduler.gang import gang_name
-
+        # the node-choice key reflect the true eviction set.  SHRINK
+        # victims never doom their gang (they die alone by contract), so
+        # they contribute nothing here.
         doomed_gangs = {(v.metadata.namespace, gang_name(v))
-                        for v in victims if gang_name(v)}
+                        for v in victims
+                        if gang_name(v) and v.metadata.uid not in _shrunk}
         if doomed_gangs:
             victim_uids = {v.metadata.uid for v in victims}
             violating_uids = {p.metadata.uid for p in violating}
@@ -619,15 +701,20 @@ class CapacityScheduling:
         return victims, num_violating, Status.ok()
 
     def _eviction_set(self, victim: Pod,
-                      cache: dict | None = None) -> list[Pod]:
+                      cache: dict | None = None,
+                      shrink_uids: set[str] | None = None) -> list[Pod]:
         """The amplification set of evicting `victim`: gang eviction is
         all-or-nothing (gang.evict_gang deletes every member), so the whole
-        group is disrupted, wherever its members run.  `cache` memoises the
-        O(namespace pods) membership list per (namespace, gang)."""
+        group is disrupted, wherever its members run — EXCEPT a shrink
+        victim (elastic dp member above min), which is disrupted alone.
+        `cache` memoises the O(namespace pods) membership list per
+        (namespace, gang)."""
         from nos_tpu.scheduler.gang import gang_name
 
         g = gang_name(victim)
-        if not g or self._api is None:
+        if not g or self._api is None \
+                or (shrink_uids is not None
+                    and victim.metadata.uid in shrink_uids):
             return [victim]
         key = (victim.metadata.namespace, g)
         members = cache.get(key) if cache is not None else None
@@ -643,7 +730,8 @@ class CapacityScheduling:
 
     def _split_pdb_violation(
             self, pods: list[Pod], pdbs: list | None,
-            gang_cache: dict | None = None
+            gang_cache: dict | None = None,
+            shrink_uids: set[str] | None = None
     ) -> tuple[list[Pod], list[Pod]]:
         """filterPodsWithPDBViolation analog, gang-aware: evicting a gang
         member evicts its whole group, so budget accounting charges every
@@ -671,7 +759,7 @@ class CapacityScheduling:
         non_violating: list[Pod] = []
         for pod in pods:
             needed: dict[int, list[str]] = {}
-            for m in self._eviction_set(pod, gang_cache):
+            for m in self._eviction_set(pod, gang_cache, shrink_uids):
                 if m.status.phase != RUNNING:
                     continue  # only healthy pods consume disruption budget
                 for i, pdb in enumerate(pdbs):

@@ -1,0 +1,211 @@
+"""Malleable gangs: the control-plane side of the dp-elasticity contract.
+
+A gang whose members carry ``nos.tpu/elastic: "dp"`` plus replica
+bounds (api/constants.py) trades a fixed world size for utilization:
+
+- **grow** — a scheduler cycle-end pass (`maybe_grow`, called from
+  Scheduler.run_cycle) clones one extra member for each fully-running
+  elastic gang below its max whose pinned ICI domain still fits the
+  member, up to a per-cycle budget.  The clone rides the normal queue
+  next cycle, so admission, quota and topology all apply unchanged.
+- **shrink** — capacityscheduling's victim walk treats members of a
+  gang above its min as *shrinkable*: the cheapest preemption rung
+  (walked before even best-effort eviction) whose eviction does NOT
+  amplify to the whole gang — the job loses one dp replica, not its
+  run.  Eligibility branches are untouched; only amplification and
+  walk order change, so victim_prescreen's superset contract holds.
+
+Both directions stamp ``nos.tpu/dp-resize`` (the new member count) on
+every surviving member; cmd/train.py reads it back at each checkpoint
+and exits cleanly for a restart with the new mesh (the job-progress
+hook's sibling — resize costs one checkpoint restart, never lost work).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+from nos_tpu.api import constants as C
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import APIServer, KIND_POD, NotFound
+from nos_tpu.kube.objects import PENDING, Pod, RUNNING, fast_deepcopy, new_uid
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import record as journal_record
+from nos_tpu.utils.pod_util import elastic_replica_bounds
+from nos_tpu.utils.retry import retry_on_conflict
+
+logger = logging.getLogger(__name__)
+
+REGISTRY.describe("nos_tpu_gang_resize_total",
+                  "Elastic gang dp resizes by direction (grow/shrink)")
+
+
+def live_gang_members(api: APIServer, namespace: str,
+                      gang: str) -> list[Pod]:
+    return api.list(
+        KIND_POD, namespace=namespace,
+        label_selector={C.LABEL_POD_GROUP: gang},
+        filter_fn=lambda p: p.status.phase in (PENDING, RUNNING))
+
+
+def shrink_headroom(members: list[Pod]) -> int:
+    """How many members the gang may lose before hitting its declared
+    min (0 = rigid or already at the floor).  Bounds come from any
+    member — the contract rides on every pod identically."""
+    if not members:
+        return 0
+    bounds = elastic_replica_bounds(members[0])
+    if bounds is None:
+        return 0
+    return max(0, len(members) - bounds[0])
+
+
+def stamp_resize(api: APIServer, members: list[Pod],
+                 new_count: int) -> None:
+    """Publish the post-resize dp replica count on every surviving
+    member (ANNOT_DP_RESIZE) — the signal cmd/train.py's checkpoint
+    hook reads to restart with the new mesh.  Advisory: a failed stamp
+    only delays the workload's re-mesh by one resync."""
+    value = str(new_count)
+
+    def mutate(p: Pod) -> None:
+        p.metadata.annotations[C.ANNOT_DP_RESIZE] = value
+
+    for member in members:
+        try:
+            retry_on_conflict(api, KIND_POD, member.metadata.name, mutate,
+                              member.metadata.namespace,
+                              component="elastic-resize")
+        except NotFound:
+            continue            # the evicted member itself
+        except Exception:  # noqa: BLE001 — advisory annotation
+            logger.debug("dp-resize stamp failed for %s", member.key)
+
+
+def record_shrink(api: APIServer, namespace: str, gang: str,
+                  evicted: int, **attrs: object) -> None:
+    """Post-shrink bookkeeping shared by every shrink call site
+    (capacityscheduling's victim walk, drain preemption, the
+    defragmenter): stamp the survivors' dp-resize annotation, bump the
+    resize counter, journal GANG_RESIZED.  A gang with NO survivors was
+    not shrunk — it died whole (evict_gang) — so nothing is recorded;
+    a phantom 'shrink to 0 replicas' would mislead every obs join."""
+    survivors = live_gang_members(api, namespace, gang)
+    if not survivors:
+        return
+    stamp_resize(api, survivors, len(survivors))
+    REGISTRY.inc("nos_tpu_gang_resize_total", float(evicted),
+                 labels={"direction": "shrink"})
+    journal_record(J.GANG_RESIZED, f"{namespace}/{gang}",
+                   direction="shrink", evicted=evicted,
+                   replicas=len(survivors), **attrs)
+
+
+def clone_member_for_grow(template: Pod, name: str,
+                          created: float) -> Pod:
+    """A fresh pending replica cloned from a live member: same request,
+    labels and elasticity contract; identity, binding and status reset
+    so it rides the normal admission queue."""
+    pod = fast_deepcopy(template)
+    pod.metadata.name = name
+    pod.metadata.uid = new_uid()
+    pod.metadata.creation_timestamp = created
+    pod.metadata.resource_version = 0
+    pod.metadata.labels.pop(C.LABEL_UNSCHEDULABLE_CLASS, None)
+    pod.metadata.annotations.pop(C.ANNOT_JOB_PROGRESS, None)
+    pod.metadata.annotations.pop(C.ANNOT_DP_RESIZE, None)
+    pod.spec.node_name = ""
+    pod.status.phase = PENDING
+    pod.status.conditions = []
+    pod.status.nominated_node_name = ""
+    return pod
+
+
+def maybe_grow(api: APIServer, framework: Any, lister: Any,
+               budget: int = 1,
+               clock: Callable[[], float] = time.time) -> int:
+    """The cycle-end grow pass: for each fully-RUNNING elastic gang
+    below max-replicas, verify one more member fits its pinned ICI
+    domain (the real PreFilter+Filter pipeline against the post-bind
+    cycle view) and create the clone.  Returns members created.
+
+    Gangs with any pending member are skipped — a gang still
+    assembling (or whose previous grow has not bound yet) must finish
+    before growing again, which also rate-limits growth to one member
+    per gang per bind."""
+    if budget <= 0:
+        return 0
+    from nos_tpu.scheduler.framework import CycleState
+    from nos_tpu.scheduler.gang import GANG_POD_ID_KEY
+
+    gangs: dict[tuple[str, str], list[Pod]] = {}
+    blocked: set[tuple[str, str]] = set()
+    for pod in api.list(KIND_POD):
+        gang = pod.metadata.labels.get(C.LABEL_POD_GROUP, "")
+        if not gang:
+            continue
+        key = (pod.metadata.namespace, gang)
+        if pod.status.phase == PENDING:
+            blocked.add(key)
+        elif pod.status.phase == RUNNING and pod.spec.node_name:
+            gangs.setdefault(key, []).append(pod)
+    created = 0
+    for key in sorted(gangs):
+        if created >= budget:
+            break
+        if key in blocked:
+            continue
+        members = gangs[key]
+        bounds = elastic_replica_bounds(members[0])
+        if bounds is None or len(members) >= bounds[1]:
+            continue
+        template = min(members, key=lambda p: p.metadata.name)
+        ni = lister.get(template.spec.node_name)
+        pin = (ni.node.metadata.labels.get(C.LABEL_POD_ID, "")
+               if ni is not None else "")
+        state = CycleState({GANG_POD_ID_KEY: pin})
+        probe = clone_member_for_grow(
+            template, f"{template.metadata.name}-probe", clock())
+        if not framework.run_pre_filter_plugins(
+                state, probe, lister).is_success:
+            continue
+        feasible = [n for n in lister.list()
+                    if framework.run_filter_plugins(
+                        state, probe, n).is_success]
+        if not feasible:
+            continue
+        ns, gang = key
+        name = _grow_name(api, ns, gang, members)
+        pod = clone_member_for_grow(template, name, clock())
+        try:
+            api.create(KIND_POD, pod)
+        except Exception:  # noqa: BLE001 — name collision/admission:
+            # nothing created, the gang retries next cycle
+            logger.debug("elastic grow create failed for %s/%s", ns, gang)
+            continue
+        created += 1
+        new_count = len(members) + 1
+        stamp_resize(api, members, new_count)
+        REGISTRY.inc("nos_tpu_gang_resize_total",
+                     labels={"direction": "grow"})
+        journal_record(J.GANG_RESIZED, f"{ns}/{gang}",
+                       direction="grow", replicas=new_count,
+                       member=pod.key)
+        logger.info("elastic gang %s/%s grew to %d replicas (%s)",
+                    ns, gang, new_count, name)
+    return created
+
+
+def _grow_name(api: APIServer, namespace: str, gang: str,
+               members: list[Pod]) -> str:
+    """A fresh member name: "<gang>-e<N>" with the first unused N —
+    deterministic and collision-checked against the live store."""
+    taken = {p.metadata.name for p in api.list(
+        KIND_POD, namespace=namespace,
+        label_selector={C.LABEL_POD_GROUP: gang})}
+    n = len(members)
+    while f"{gang}-e{n}" in taken:
+        n += 1
+    return f"{gang}-e{n}"

@@ -18,6 +18,7 @@ from collections import Counter
 from typing import Any, Callable
 
 from nos_tpu.api.constants import (
+    ANNOT_DEFRAG_DRAIN as C_ANNOT_DEFRAG_DRAIN,
     ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
     LABEL_ACCELERATOR as C_LABEL_ACCELERATOR,
     LABEL_CHIP_COUNT as C_LABEL_CHIP_COUNT,
@@ -91,6 +92,54 @@ def _free_chip_equiv(ni: NodeInfo) -> float:
     return free_chip_equivalents(ni.free())
 
 
+def attribute_free_chips(
+        free: float, hold: dict | None, reserved: bool, demand: bool,
+        rejected: bool, quota_budget: float, gang_budget: float,
+) -> tuple[str, float, float, float]:
+    """Attribute ONE node's free chips to exactly one waterfall category
+    (docs/observability.md, "The waterfall"): hold precedence first
+    (quarantine > actuation > drain — including defrag drains, so
+    chip-seconds spent emptying a window for a re-carve land in `drain`
+    and are never double-counted with `frag_stranded`), then the gang
+    window lease, then this cycle's own verdicts, with the demand-capped
+    quota/gang budgets consumed in node order.  Returns
+    (category, chips taken, remaining quota budget, remaining gang
+    budget); the caller books `free - take` as idle_no_demand.  Factored
+    out of the cycle-end loop so the conservation property — every chip
+    in exactly one bucket, whatever the hold/verdict combination — is
+    directly testable (tests/test_defrag.py randomizes it)."""
+    from nos_tpu.obs import ledger as L
+
+    take = free
+    if hold is not None and L.QUARANTINE in hold:
+        cat = L.QUARANTINE
+    elif hold is not None and L.ACTUATION in hold:
+        cat = L.ACTUATION
+    elif hold is not None and L.DRAIN in hold:
+        cat = L.DRAIN
+    elif reserved:
+        cat = L.GANG_WAIT
+    elif not demand:
+        cat = L.IDLE_NO_DEMAND
+    elif rejected:
+        cat = L.FRAG_STRANDED
+    elif quota_budget > 0.0:
+        # pending demand rejected at the quota gates BEFORE any
+        # geometry scan: the free chips the over-quota pod could
+        # use — capped at the blocked demand itself, remainder
+        # is idle (one small rejection must not paint the pool)
+        cat = L.QUOTA_STRANDED
+        take = min(free, quota_budget)
+        quota_budget -= take
+    elif gang_budget > 0.0:
+        cat = L.GANG_WAIT
+        take = min(free, gang_budget)
+        gang_budget -= take
+    else:
+        cat = L.IDLE_NO_DEMAND
+    return cat, take, quota_budget, gang_budget
+
+
 def _annotation_progress(pod: Pod) -> float:
     """Default drain-preemption progress source: the workload-reported
     ANNOT_JOB_PROGRESS fraction (absent/garbage/non-finite = 0: nothing
@@ -122,6 +171,7 @@ class Scheduler:
                      [Pod], float | None] | None = None,
                  backfill_duration_fn: Callable[
                      [Pod], float | None] | None = None,
+                 elastic_grow_budget_per_cycle: int = 1,
                  clock: Callable[[], float] = time.time,
                  hbm_gb_per_chip: float = 16.0) -> None:
         self._api = api
@@ -259,6 +309,19 @@ class Scheduler:
         self._waste_rejected_nodes: set[str] = set()
         # pending class -> rejection node-count (frag culprit evidence)
         self._waste_frag_counts: dict[str, int] = {}
+        # pending class -> frag-blocked chip demand this cycle, and the
+        # persistent per-class stranded chip-second integral the frag
+        # culprit ranking keys on: when several classes strand the same
+        # pool, the one that has waited with the most blocked chips the
+        # longest is the culprit — NOT whichever rejection is newest.
+        self._waste_frag_chips: dict[str, float] = {}
+        self._frag_class_chip_seconds: dict[str, float] = {}
+        self._last_waste_t: float | None = None
+        # Elastic grow pass (scheduler/elastic.py): at most this many
+        # replica clones created per cycle across all dp-elastic gangs.
+        # Gated entirely on the workloads' own annotations — a cluster
+        # with no elastic gangs sees identical decisions at any budget.
+        self._elastic_grow_budget = elastic_grow_budget_per_cycle
         # pending class -> chip demand blocked by quota (PreFilter
         # quota rejections + head-of-line deferrals); Σ bounds the
         # quota_stranded bucket — stranding cannot exceed the demand
@@ -580,6 +643,7 @@ class Scheduler:
         self._busy_map_cache = None
         self._waste_rejected_nodes = set()
         self._waste_frag_counts = {}
+        self._waste_frag_chips = {}
         self._waste_quota_blocked = {}
         self._waste_pending_gangs = {}
         pods = [
@@ -633,6 +697,15 @@ class Scheduler:
         # waste waterfall BEFORE the snapshot drops: attribution reads
         # the post-bind cycle view plus this cycle's rejection verdicts
         self._observe_waste(pending_counts)
+        # elastic grow pass LAST: clones created here are next cycle's
+        # demand and must not perturb this cycle's waste attribution
+        # or pending gauges (scheduler/elastic.py)
+        if self._elastic_grow_budget > 0:
+            from nos_tpu.scheduler.elastic import maybe_grow
+
+            maybe_grow(self._api, self._framework, self._cycle_lister(),
+                       budget=self._elastic_grow_budget,
+                       clock=self._clock)
         # drop the cycle snapshot on exit: schedule_one/schedule_gang are
         # public entry points and must see fresh state when driven
         # outside run_cycle (they rebuild lazily)
@@ -998,6 +1071,7 @@ class Scheduler:
             (p for p in stragglers
              if progress(p) < self._drain_spare_progress),
             key=progress)
+        shrunk_gangs: dict[tuple[str, str], int] = {}
         for pod in stragglers:
             if pod.key in doomed_keys:
                 continue
@@ -1005,6 +1079,29 @@ class Scheduler:
             members = [pod] if not g else self._api.list(
                 KIND_POD, namespace=pod.metadata.namespace,
                 label_selector={C_LABEL_POD_GROUP: g})
+            # Shrink-before-evict (scheduler/elastic.py): an elastic dp
+            # straggler loses only its WINDOW-RESIDENT members, within
+            # the gang's min bound — evicting a 60-replica sponge whole
+            # to clear a 2-host window is exactly the waste the
+            # malleable-gang contract exists to avoid.
+            shrink = False
+            if g:
+                from nos_tpu.utils.pod_util import elastic_replica_bounds
+
+                bounds = elastic_replica_bounds(pod)
+                if bounds is not None:
+                    live_members = [
+                        m for m in members
+                        if m.status.phase in (PENDING, RUNNING)]
+                    headroom = max(0, len(live_members) - bounds[0])
+                    on_window = [
+                        m for m in live_members
+                        if m.spec.node_name in hosts
+                        and m.key not in doomed_keys]
+                    members = on_window[:headroom]
+                    if not members:
+                        continue    # at min: nothing to shrink
+                    shrink = True
             needed: dict[int, int] = {}
             for m in members:
                 if m.status.phase != RUNNING or m.key in doomed_keys:
@@ -1017,7 +1114,24 @@ class Scheduler:
             for i, n in needed.items():
                 allowed[i] -= n
             doomed_keys.update(m.key for m in members)
-            evicted += len(evict_gang(self._api, pod))
+            if shrink:
+                for m in members:
+                    try:
+                        self._api.delete(KIND_POD, m.metadata.name,
+                                         m.metadata.namespace)
+                        evicted += 1
+                    except NotFound:
+                        pass
+                shrunk_gangs[(pod.metadata.namespace, g)] = \
+                    shrunk_gangs.get((pod.metadata.namespace, g), 0) \
+                    + len(members)
+            else:
+                evicted += len(evict_gang(self._api, pod))
+        if shrunk_gangs:
+            from nos_tpu.scheduler.elastic import record_shrink
+
+            for (ns, g), n in sorted(shrunk_gangs.items()):
+                record_shrink(self._api, ns, g, n)
         if evicted:
             # the freed chips were BOUGHT by eviction: until the leased
             # window resolves, their idle time is `drain` waste, not
@@ -1046,18 +1160,28 @@ class Scheduler:
 
     def _order_gang_windows(self, windows: list) -> list:
         """Order candidate windows so the FIRST one that fits is also the
-        best citizen: windows overlapping the drain lease come last (a
-        smaller gang binding into the window a stuck larger gang is
-        draining would reset its drain clock), original adjacency order
-        otherwise.  Fragmentation-aware ordering (prefer breaking already
-        -busy super-windows) was measured as well and LOST on the
-        v5e-256 trace (seed-0 utilization -5 points) — see
-        scripts/diag_gang.py for the experiment harness."""
+        best citizen: windows overlapping the drain lease OR a window a
+        defrag proposal is emptying come last (a smaller gang binding
+        into either would reset the larger drain's clock — for defrag,
+        refill the very hosts whose residents were just migrated off),
+        original adjacency order otherwise.  Fragmentation-aware
+        ordering (prefer breaking already-busy super-windows) was
+        measured as well and LOST on the v5e-256 trace (seed-0
+        utilization -5 points) — see scripts/diag_gang.py for the
+        experiment harness."""
+        avoid = set(self._reserved_hosts)
+        lister = self._cycle_lister_cache
+        if lister is not None:
+            for ni in lister.list():
+                if ni.node.metadata.annotations.get(
+                        C_ANNOT_DEFRAG_DRAIN):
+                    avoid.add(ni.name)
+
         def key(item: tuple) -> int:
             _, hosts = item
             if hosts is None:
                 return 0
-            return len(frozenset(hosts) & self._reserved_hosts)
+            return len(frozenset(hosts) & avoid)
 
         return sorted(windows, key=key)
 
@@ -1361,7 +1485,14 @@ class Scheduler:
                 idx = 0
             # Reserved-window avoidance dominates: a stuck gang's chosen
             # window must drain, so singles go anywhere else that fits.
-            return (ni.name in self._reserved_hosts, headroom,
+            # Hosts a defrag proposal is emptying (ANNOT_DEFRAG_DRAIN)
+            # are avoided the same way — the migration bought that
+            # window for the fragmentation-blocked class, and refilling
+            # it with the very pods just moved off would undo the move.
+            avoided = (ni.name in self._reserved_hosts
+                       or bool(ni.node.metadata.annotations.get(
+                           C_ANNOT_DEFRAG_DRAIN)))
+            return (avoided, headroom,
                     window_penalty(ni), idx, ni.name)
 
         return key
@@ -1431,10 +1562,19 @@ class Scheduler:
         pending class rejected holds free chips no pending demand can
         use (idempotent per class; the class scan cache replays the
         identical verdict set for class-mates)."""
+        from nos_tpu.kube.resources import pod_request as _pod_request
+        from nos_tpu.obs.ledger import pod_chip_equiv
+
         self._waste_rejected_nodes.update(rejections)
         cls = workload_class(pod)
         self._waste_frag_counts[cls] = max(
             self._waste_frag_counts.get(cls, 0), len(rejections))
+        shard = float(getattr(getattr(self._capacity, "calculator", None),
+                              "chips_per_host", 0) or 0) or 8.0
+        chips = pod_chip_equiv(_pod_request(pod), shard,
+                               self._hbm_gb_per_chip)
+        self._waste_frag_chips[cls] = max(
+            self._waste_frag_chips.get(cls, 0.0), chips)
 
     def _note_stuck_gang(self, members: list[Pod]) -> None:
         """A gang that failed admission this cycle: remember it with its
@@ -1479,11 +1619,35 @@ class Scheduler:
         # blocked-demand categories are bounded by the demand itself
         quota_budget = sum(self._waste_quota_blocked.values())
         gang_budget = sum(self._waste_pending_gangs.values())
+        # Per-class stranded chip-second integral: every cycle a class
+        # stays frag-blocked, its blocked demand accrues over the cycle
+        # interval — the culprit ranking (several classes stranding one
+        # pool) keys on this, NOT on rejection recency.
+        now = self._clock()
+        dt = (max(0.0, now - self._last_waste_t)
+              if self._last_waste_t is not None else 0.0)
+        self._last_waste_t = now
+        for cls, chips in self._waste_frag_chips.items():
+            self._frag_class_chip_seconds[cls] = \
+                self._frag_class_chip_seconds.get(cls, 0.0) + chips * dt
         frag_ev: dict[str, object] | None = None
         if self._waste_frag_counts:
-            top = max(self._waste_frag_counts.items(),
-                      key=lambda kv: kv[1])
-            frag_ev = {"class": top[0], "rejected_nodes": top[1]}
+            ranked = sorted(
+                self._waste_frag_counts,
+                key=lambda c: (-self._frag_class_chip_seconds.get(c, 0.0),
+                               -self._waste_frag_counts[c], c))
+            top = ranked[0]
+            frag_ev = {
+                "class": top,
+                "rejected_nodes": self._waste_frag_counts[top],
+                "classes": [
+                    {"class": c,
+                     "stranded_chip_seconds": round(
+                         self._frag_class_chip_seconds.get(c, 0.0), 1),
+                     "blocked_chips": round(
+                         self._waste_frag_chips.get(c, 0.0), 2)}
+                    for c in ranked[:3]],
+            }
         quota_ev: dict[str, object] | None = None
         if self._waste_quota_blocked:
             top_q = max(self._waste_quota_blocked.items(),
@@ -1521,41 +1685,23 @@ class Scheduler:
                 continue
             name = ni.name
             hold = holds.get(name)
+            cat, take, quota_budget, gang_budget = attribute_free_chips(
+                free, hold, name in self._reserved_hosts, demand,
+                name in self._waste_rejected_nodes,
+                quota_budget, gang_budget)
             evidence: dict[str, object] | None = None
-            take = free
-            if hold is not None and L.QUARANTINE in hold:
-                cat = L.QUARANTINE
-                evidence = {"node": name, **hold[L.QUARANTINE]}
-            elif hold is not None and L.ACTUATION in hold:
-                cat = L.ACTUATION
-                evidence = {"node": name, **hold[L.ACTUATION]}
-            elif hold is not None and L.DRAIN in hold:
-                cat = L.DRAIN
-                evidence = {"node": name, **hold[L.DRAIN]}
-            elif name in self._reserved_hosts:
-                cat = L.GANG_WAIT
+            if cat == L.QUARANTINE:
+                evidence = {"node": name, **(hold or {})[L.QUARANTINE]}
+            elif cat == L.ACTUATION:
+                evidence = {"node": name, **(hold or {})[L.ACTUATION]}
+            elif cat == L.DRAIN:
+                evidence = {"node": name, **(hold or {})[L.DRAIN]}
+            elif cat == L.GANG_WAIT:
                 evidence = gang_ev
-            elif not demand:
-                cat = L.IDLE_NO_DEMAND
-            elif name in self._waste_rejected_nodes:
-                cat = L.FRAG_STRANDED
+            elif cat == L.FRAG_STRANDED:
                 evidence = frag_ev
-            elif quota_budget > 0.0:
-                # pending demand rejected at the quota gates BEFORE any
-                # geometry scan: the free chips the over-quota pod could
-                # use — capped at the blocked demand itself, remainder
-                # is idle (one small rejection must not paint the pool)
-                cat = L.QUOTA_STRANDED
+            elif cat == L.QUOTA_STRANDED:
                 evidence = quota_ev
-                take = min(free, quota_budget)
-                quota_budget -= take
-            elif gang_budget > 0.0:
-                cat = L.GANG_WAIT
-                evidence = gang_ev
-                take = min(free, gang_budget)
-                gang_budget -= take
-            else:
-                cat = L.IDLE_NO_DEMAND
             cats[cat] = cats.get(cat, 0.0) + take
             if take < free:
                 cats[L.IDLE_NO_DEMAND] = \

@@ -94,6 +94,35 @@ def class_tier(cls: str) -> str:
     return C.TIER_BATCH
 
 
+def is_elastic_dp(pod: Pod) -> bool:
+    """True when the pod participates in the malleable-gang contract
+    (``nos.tpu/elastic: "dp"`` AND a pod-group label): the control plane
+    may grow/shrink its gang's dp axis within the replica bounds.  A
+    bare elastic annotation without a gang is meaningless and reads
+    rigid."""
+    return (pod.metadata.annotations.get(C.ANNOT_ELASTIC, "")
+            == C.ELASTIC_DP
+            and bool(pod.metadata.labels.get(C.LABEL_POD_GROUP, "")))
+
+
+def elastic_replica_bounds(pod: Pod) -> tuple[int, int] | None:
+    """(min_replicas, max_replicas) of an elastic-dp member, or None
+    when the pod is not elastic or its bounds are absent/garbage/
+    inverted — a malformed contract degrades to rigid (no resize),
+    never to unbounded."""
+    if not is_elastic_dp(pod):
+        return None
+    annots = pod.metadata.annotations
+    try:
+        lo = int(annots.get(C.ANNOT_MIN_REPLICAS, ""))
+        hi = int(annots.get(C.ANNOT_MAX_REPLICAS, ""))
+    except ValueError:
+        return None
+    if lo < 1 or hi < lo:
+        return None
+    return lo, hi
+
+
 def is_over_quota(pod: Pod) -> bool:
     return pod.metadata.labels.get(C.LABEL_CAPACITY) == C.CAPACITY_OVER_QUOTA
 

@@ -68,6 +68,13 @@ if ! env JAX_PLATFORMS=cpu python bench_serving.py --smoke \
     rc=1
 fi
 
+echo "==> bench_defrag.py --smoke (defrag gate: utilization floor, frag halving, churn bound, disabled byte-identity)"
+if ! env JAX_PLATFORMS=cpu python bench_defrag.py --smoke \
+        --defrag-report "${DEFRAG_REPORT_PATH:-/tmp/nos_tpu_defrag_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
 echo "==> bench_compute.py --smoke (MFU gate: interpret-mode kernels + scan + ring overlap)"
 if ! env JAX_PLATFORMS=cpu python bench_compute.py --smoke \
         --report "${COMPUTE_REPORT_PATH:-/tmp/nos_tpu_compute_report.json}" \

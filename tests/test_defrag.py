@@ -1,0 +1,605 @@
+"""Defragmentation plane + malleable gangs.
+
+Covers the PR-14 tentpole and satellites:
+- subset()/fork() dirty-set independence (the defragmenter is the first
+  caller to fork a subset; refused-forked-parent both ways);
+- the DefragProposer lifecycle: frag detection, what-if relocation,
+  payback scoring, actuation (annotations + ledger holds + evictions),
+  drain cleanup, serving-tier shields and rate limiting;
+- randomized conservation of the waste attribution during defrag
+  actuation: chip-seconds spent draining land in drain/actuation,
+  never double-counted with frag_stranded;
+- elastic dp gangs: grow pass, shrink-before-evict rung, dp-resize
+  stamp and the cmd/train checkpoint hook;
+- the `obs waste` frag culprit ranking by stranded chip-seconds.
+"""
+
+import random
+
+import pytest
+
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.obs import journal as J, scoped as obs_scoped
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import (
+    ChipSecondLedger, conservation_ok,
+)
+from nos_tpu.partitioning.core import DefragProposer, SnapshotError
+from nos_tpu.partitioning.slicepart import (
+    SliceProfileCalculator, SliceSnapshotTaker,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+
+def snapshot_for(nodes_with_pods):
+    state = ClusterState()
+    for node, pods in nodes_with_pods:
+        state.update_node(node, pods)
+    return SliceSnapshotTaker().take_snapshot(state)
+
+
+def fragged_host(name, idx, used=1, pod_ns="default", progress=None):
+    """A v5e host carved into 1x1s with `used` of them occupied by a
+    movable filler pod.  Returns (node, pods)."""
+    node = make_tpu_node(
+        name, host_index=idx,
+        status_geometry={"free": {"1x1": 8 - used}, "used": {"1x1": used}})
+    pods = []
+    for i in range(used):
+        annotations = {}
+        if progress is not None:
+            annotations[C.ANNOT_JOB_PROGRESS] = str(progress)
+        pods.append(make_slice_pod(
+            "1x1", 1, name=f"{name}-filler-{i}", namespace=pod_ns,
+            node_name=name, phase="Running", annotations=annotations))
+    return node, pods
+
+
+class TestSubsetForkIsolation:
+    """Satellite: subset() + defrag what-if forks must not share
+    dirty-set state with the live controller snapshot."""
+
+    def _snap(self):
+        return snapshot_for([
+            (make_tpu_node(f"n{i}", host_index=i,
+                           status_geometry={"free": {"2x4": 1}}), [])
+            for i in range(3)
+        ])
+
+    def test_forked_parent_refuses_subset_and_clone(self):
+        snap = self._snap()
+        snap.fork()
+        with pytest.raises(SnapshotError):
+            snap.subset(["n0"])
+        with pytest.raises(SnapshotError):
+            snap.clone()
+        snap.revert()
+        assert snap.subset(["n0"]).nodes().keys() == {"n0"}
+
+    def test_forked_subset_refuses_further_subset(self):
+        sub = self._snap().subset(["n0", "n1"])
+        sub.fork()
+        with pytest.raises(SnapshotError):
+            sub.subset(["n0"])
+
+    def test_subset_fork_never_leaks_into_parent(self):
+        snap = self._snap()
+        sub = snap.subset(["n0", "n1"])
+        sub.fork()
+        # COW mutation inside the subset's fork
+        assert sub.get_node_for_write("n0").update_geometry_for(
+            {"2x2": 2})
+        assert sub.cow_clones == 1
+        # the parent saw nothing: no dirty set, no clones, original
+        # object and geometry untouched
+        assert not snap.forked
+        assert snap.cow_clones == 0
+        assert snap.get_node("n0").geometries() == {0: {"2x4": 1}}
+        # ... and the parent can fork independently while the subset's
+        # fork is live (disjoint dirty sets by construction)
+        snap.fork()
+        snap.get_node_for_write("n2").update_geometry_for({"2x2": 2})
+        snap.revert()
+        assert snap.get_node("n2").geometries() == {0: {"2x4": 1}}
+        # subset revert restores the subset's own view from ITS dirty
+        # set — and the restored object IS the shared pristine one
+        sub.revert()
+        assert sub.get_node("n0") is snap.get_node("n0")
+        assert sub.get_node("n0").geometries() == {0: {"2x4": 1}}
+
+    def test_subset_commit_stays_in_subset(self):
+        snap = self._snap()
+        sub = snap.subset(["n0"])
+        sub.fork()
+        sub.get_node_for_write("n0").update_geometry_for({"2x2": 2})
+        sub.commit()
+        assert sub.get_node("n0").geometries() == {0: {"2x2": 2}}
+        # a committed COW clone belongs to the subset alone
+        assert snap.get_node("n0").geometries() == {0: {"2x4": 1}}
+
+
+class DefragHarness:
+    """3 fragmented hosts + 1 busy host, one pending whole-host (2x4)
+    pod that no carve can place: the canonical frag regime."""
+
+    def __init__(self, n_fragged=3, progress=0.2, pending_shape="2x4",
+                 serving_on=None):
+        self.api = APIServer()
+        self.clock_now = [0.0]
+        self.nodes_with_pods = []
+        for i in range(n_fragged):
+            node, pods = fragged_host(f"h{i}", i, progress=progress)
+            if serving_on == f"h{i}":
+                pods[0].metadata.labels[C.LABEL_TIER] = C.TIER_SERVING
+            self.nodes_with_pods.append((node, pods))
+            self.api.create(KIND_NODE, node)
+            for p in pods:
+                self.api.create(KIND_POD, p)
+        self.pending = make_slice_pod(pending_shape, 1, name="big",
+                                      namespace="default")
+        self.pending.mark_unschedulable("no fit")
+        self.api.create(KIND_POD, self.pending)
+        self.ledger = ChipSecondLedger(clock=lambda: self.clock_now[0])
+        self.journal = DecisionJournal(clock=lambda: self.clock_now[0])
+
+    def snapshot(self):
+        return snapshot_for(self.nodes_with_pods)
+
+    def proposer(self, **kw):
+        kw.setdefault("interval_s", 5.0)
+        kw.setdefault("payback_min", 1.0)
+        return DefragProposer(
+            self.api, "slice", SliceProfileCalculator(),
+            clock=lambda: self.clock_now[0], **kw)
+
+    def run_steps(self, proposer, steps=2, dt=10.0):
+        applied = []
+        with obs_scoped(journal=self.journal, ledger=self.ledger):
+            for _ in range(steps):
+                self.clock_now[0] += dt
+                applied.append(
+                    proposer.step(self.snapshot(), [self.pending]))
+        return [a for a in applied if a]
+
+
+class TestDefragProposer:
+    def test_persistence_gate_then_apply(self):
+        h = DefragHarness()
+        p = h.proposer()
+        with obs_scoped(journal=h.journal, ledger=h.ledger):
+            h.clock_now[0] += 10.0
+            # first sight: the unit is remembered, nothing moves
+            assert p.step(h.snapshot(), [h.pending]) is None
+            assert h.journal.events(category=J.DEFRAG_APPLIED) == []
+            h.clock_now[0] += 10.0
+            pid = p.step(h.snapshot(), [h.pending])
+        assert pid is not None
+        applied = h.journal.events(category=J.DEFRAG_APPLIED)
+        assert len(applied) == 1
+        rec = applied[0]
+        assert rec.subject == pid
+        hosts = rec.attrs["hosts"]
+        assert len(hosts) == 1
+        # the window host was annotated, its filler evicted, and the
+        # ledger carries a DRAIN hold (never frag_stranded)
+        node = h.api.get(KIND_NODE, hosts[0])
+        assert node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] == pid
+        assert h.ledger.holds()[hosts[0]]["drain"]["proposal"] == pid
+        live = {pod.metadata.name for pod in h.api.list(KIND_POD)}
+        assert f"{hosts[0]}-filler-0" not in live
+        assert "big" in live            # the demand itself is untouched
+
+    def test_cleanup_releases_drained_window(self):
+        h = DefragHarness()
+        p = h.proposer()
+        (pid,) = h.run_steps(p)
+        rec = h.journal.events(category=J.DEFRAG_APPLIED)[0]
+        host = rec.attrs["hosts"][0]
+        # the victim is gone from the store already (synchronous
+        # delete), so the next step's cleanup releases the window
+        with obs_scoped(journal=h.journal, ledger=h.ledger):
+            h.clock_now[0] += 10.0
+            p.step(h.snapshot(), [h.pending])
+        node = h.api.get(KIND_NODE, host)
+        assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+        assert host not in h.ledger.holds()
+
+    def test_payback_threshold_rejects(self):
+        h = DefragHarness()
+        p = h.proposer(payback_min=1e9)
+        assert h.run_steps(p) == []
+        assert h.journal.events(category=J.DEFRAG_APPLIED) == []
+        rejected = h.journal.events(category=J.DEFRAG_REJECTED)
+        assert rejected and rejected[0].attrs["reason"] == "payback"
+        # propose-only mode moved NOTHING: store intact, no annotations
+        assert len(h.api.list(KIND_POD)) == 4
+        for node in h.api.list(KIND_NODE):
+            assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+        assert h.ledger.holds() == {}
+
+    def test_serving_tier_is_never_touched(self):
+        # every fragged host carries a serving pod: no window is
+        # drainable, nothing is proposed
+        h = DefragHarness(n_fragged=1, serving_on="h0")
+        p = h.proposer()
+        assert h.run_steps(p) == []
+        assert h.journal.events(category=J.DEFRAG_PROPOSED) == []
+        assert len(h.api.list(KIND_POD)) == 2
+
+    def test_near_done_pods_pin_their_host(self):
+        h = DefragHarness(n_fragged=1, progress=0.9)  # past spare 0.75
+        p = h.proposer()
+        assert h.run_steps(p) == []
+        assert len(h.api.list(KIND_POD)) == 2
+
+    def test_no_proposal_when_demand_exceeds_free(self):
+        # a genuinely short cluster (pending 2x4 but only 1 host with
+        # 7 fragged free chips + nothing else) is not a frag problem
+        h = DefragHarness(n_fragged=1)
+        p = h.proposer()
+        assert h.run_steps(p) == []
+
+    def test_rate_limit_one_in_flight(self):
+        h = DefragHarness()
+        # second pending whole-host pod: only one proposal may fly
+        second = make_slice_pod("2x4", 1, name="big2",
+                                namespace="default")
+        second.mark_unschedulable("no fit")
+        h.api.create(KIND_POD, second)
+        p = h.proposer()
+        with obs_scoped(journal=h.journal, ledger=h.ledger):
+            h.clock_now[0] += 10.0
+            p.step(h.snapshot(), [h.pending, second])
+            h.clock_now[0] += 10.0
+            first = p.step(h.snapshot(), [h.pending, second])
+            # keep the drain outstanding: re-bind a pod onto the drained
+            # host so cleanup cannot release it
+            host = h.journal.events(
+                category=J.DEFRAG_APPLIED)[0].attrs["hosts"][0]
+            squatter = make_slice_pod("1x1", 1, name="squat",
+                                      node_name=host, phase="Running")
+            h.api.create(KIND_POD, squatter)
+            h.clock_now[0] += 10.0
+            again = p.step(h.snapshot(), [h.pending, second])
+        assert first is not None and again is None
+        assert len(h.journal.events(category=J.DEFRAG_APPLIED)) == 1
+
+    def test_drain_timeout_aborts_and_heals(self):
+        h = DefragHarness()
+        p = h.proposer(drain_timeout_s=15.0, demand_cooldown_s=1000.0)
+        (pid,) = h.run_steps(p)
+        host = h.journal.events(
+            category=J.DEFRAG_APPLIED)[0].attrs["hosts"][0]
+        squatter = make_slice_pod("1x1", 1, name="squat",
+                                  node_name=host, phase="Running")
+        h.api.create(KIND_POD, squatter)
+        with obs_scoped(journal=h.journal, ledger=h.ledger):
+            h.clock_now[0] += 30.0      # past the drain deadline
+            p.step(h.snapshot(), [h.pending])
+        node = h.api.get(KIND_NODE, host)
+        assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+        assert host not in h.ledger.holds()
+        rejected = h.journal.events(category=J.DEFRAG_REJECTED)
+        assert any(r.attrs.get("reason") == "drain-timeout"
+                   and r.subject == pid for r in rejected)
+
+
+class TestConservationDuringDefrag:
+    """Satellite: randomized conservation property — chip-seconds spent
+    draining for a re-carve land in drain/actuation, never
+    double-counted with frag_stranded."""
+
+    def test_attribution_is_exclusive_and_bounded(self):
+        from nos_tpu.scheduler.scheduler import attribute_free_chips
+
+        rng = random.Random(1405)
+        for _ in range(500):
+            free = rng.uniform(0.0, 16.0)
+            hold: dict | None = None
+            if rng.random() < 0.5:
+                hold = {k: {} for k in
+                        rng.sample(["quarantine", "actuation", "drain"],
+                                   rng.randint(1, 3))}
+            reserved = rng.random() < 0.3
+            demand = rng.random() < 0.7
+            rejected = rng.random() < 0.5
+            qb = rng.choice([0.0, rng.uniform(0.0, 20.0)])
+            gb = rng.choice([0.0, rng.uniform(0.0, 20.0)])
+            cat, take, qb2, gb2 = attribute_free_chips(
+                free, hold, reserved, demand, rejected, qb, gb)
+            # exactly one category, bounded take, budgets only shrink
+            assert 0.0 <= take <= free + 1e-12
+            assert 0.0 <= qb2 <= qb and 0.0 <= gb2 <= gb
+            spent = (qb - qb2) + (gb - gb2)
+            if cat == "quota_stranded":
+                assert qb - qb2 == pytest.approx(take)
+                assert gb2 == gb
+            elif cat == "gang_wait" and hold is None and not reserved:
+                assert gb - gb2 == pytest.approx(take)
+                assert qb2 == qb
+            else:
+                assert spent == pytest.approx(0.0)
+            # a defrag/drain hold can NEVER read frag_stranded —
+            # the double-count the ledger's invariant forbids
+            if hold is not None:
+                assert cat in ("quarantine", "actuation", "drain")
+                assert cat != "frag_stranded"
+                if "drain" in hold and "quarantine" not in hold \
+                        and "actuation" not in hold:
+                    assert cat == "drain"
+                assert take == pytest.approx(free)
+
+    def test_randomized_ledger_conservation_with_drain_churn(self):
+        """Drive the real ledger through randomized defrag-shaped
+        waterfalls — holds toggling mid-trace, frag/drain flipping on
+        the same nodes — and assert exact per-pool conservation."""
+        from nos_tpu.scheduler.scheduler import attribute_free_chips
+
+        rng = random.Random(77)
+        now = [0.0]
+        ledger = ChipSecondLedger(clock=lambda: now[0])
+        nodes = [f"n{i}" for i in range(6)]
+        cap = {n: 8.0 for n in nodes}
+        for _ in range(200):
+            now[0] += rng.uniform(0.1, 2.0)
+            # defrag actuation churn: drain holds appear and resolve
+            for n in nodes:
+                if rng.random() < 0.2:
+                    ledger.set_hold(n, "drain", owner="defrag-slice",
+                                    proposal="dfrg-x")
+                elif rng.random() < 0.2:
+                    ledger.clear_hold(n, "drain", owner="defrag-slice")
+            holds = ledger.holds()
+            cats: dict[str, float] = {}
+            qb = rng.uniform(0.0, 10.0)
+            gb = rng.uniform(0.0, 10.0)
+            used_total = 0.0
+            for n in nodes:
+                used = rng.uniform(0.0, cap[n])
+                used_total += used
+                free = cap[n] - used
+                cat, take, qb, gb = attribute_free_chips(
+                    free, holds.get(n), rng.random() < 0.2, True,
+                    rng.random() < 0.5, qb, gb)
+                cats[cat] = cats.get(cat, 0.0) + take
+                if take < free:
+                    cats["idle_no_demand"] = \
+                        cats.get("idle_no_demand", 0.0) + (free - take)
+            cats["productive"] = used_total
+            ledger.observe({"pool-0": {
+                "capacity": sum(cap.values()), "categories": cats}})
+        now[0] += 1.0
+        ledger.observe({})      # final accrual
+        report = ledger.report()
+        assert conservation_ok(report)
+        assert report["overcommit_events"] == 0
+
+
+class TestElasticGangs:
+    def _gang_pod(self, name, gang="eg", node_name="", lo=1, hi=4,
+                  namespace="default", phase="Pending"):
+        return make_slice_pod(
+            "1x2", 1, name=name, namespace=namespace,
+            node_name=node_name, phase=phase,
+            labels={C.LABEL_POD_GROUP: gang},
+            annotations={C.ANNOT_ELASTIC: C.ELASTIC_DP,
+                         C.ANNOT_MIN_REPLICAS: str(lo),
+                         C.ANNOT_MAX_REPLICAS: str(hi)})
+
+    def test_replica_bounds_parse_and_degrade(self):
+        from nos_tpu.utils.pod_util import (
+            elastic_replica_bounds, is_elastic_dp,
+        )
+
+        pod = self._gang_pod("m0")
+        assert is_elastic_dp(pod)
+        assert elastic_replica_bounds(pod) == (1, 4)
+        pod.metadata.annotations[C.ANNOT_MAX_REPLICAS] = "garbage"
+        assert elastic_replica_bounds(pod) is None      # rigid, not inf
+        bare = make_slice_pod("1x2", 1, annotations={
+            C.ANNOT_ELASTIC: C.ELASTIC_DP})
+        assert not is_elastic_dp(bare)                  # no gang: rigid
+
+    def test_grow_creates_one_member_and_stamps_resize(self):
+        from nos_tpu.scheduler.elastic import maybe_grow
+        from nos_tpu.scheduler.framework import (
+            Framework, NodeInfo, NodeResourcesFit, SharedLister,
+        )
+
+        api = APIServer()
+        node = make_tpu_node("g0", status_geometry={"free": {"1x2": 4}})
+        api.create(KIND_NODE, node)
+        members = [self._gang_pod(f"m{i}", node_name="g0",
+                                  phase="Running") for i in range(2)]
+        for m in members:
+            api.create(KIND_POD, m)
+        ni = NodeInfo(node=node)
+        for m in members:
+            ni.add_pod(m)
+        lister = SharedLister([ni])
+        journal = DecisionJournal()
+        with obs_scoped(journal=journal):
+            created = maybe_grow(api, Framework([NodeResourcesFit()]),
+                                 lister, budget=1, clock=lambda: 42.0)
+        assert created == 1
+        clones = [p for p in api.list(KIND_POD)
+                  if p.metadata.name.startswith("eg-e")]
+        assert len(clones) == 1
+        clone = clones[0]
+        assert clone.status.phase == "Pending"
+        assert not clone.spec.node_name
+        assert clone.metadata.creation_timestamp == 42.0
+        assert clone.metadata.labels[C.LABEL_POD_GROUP] == "eg"
+        # survivors carry the dp-resize stamp with the NEW count
+        for m in members:
+            live = api.get(KIND_POD, m.metadata.name, "default")
+            assert live.metadata.annotations[C.ANNOT_DP_RESIZE] == "3"
+        recs = journal.events(category=J.GANG_RESIZED)
+        assert recs and recs[0].attrs["direction"] == "grow"
+        # at max: no further growth
+        with obs_scoped(journal=journal):
+            grown = maybe_grow(api, Framework([NodeResourcesFit()]),
+                               lister, budget=5, clock=lambda: 43.0)
+        assert grown == 0       # pending clone blocks regrowth
+
+    def test_grow_respects_max_and_full_nodes(self):
+        from nos_tpu.scheduler.elastic import maybe_grow
+        from nos_tpu.scheduler.framework import (
+            Framework, NodeInfo, NodeResourcesFit, SharedLister,
+        )
+
+        api = APIServer()
+        node = make_tpu_node("g0", status_geometry={"used": {"1x2": 4}})
+        api.create(KIND_NODE, node)
+        members = [self._gang_pod(f"m{i}", node_name="g0", hi=2,
+                                  phase="Running") for i in range(2)]
+        for m in members:
+            api.create(KIND_POD, m)
+        lister = SharedLister([NodeInfo(node=node)])
+        assert maybe_grow(api, Framework([NodeResourcesFit()]),
+                          lister, budget=3) == 0
+
+    def test_shrink_rung_in_victim_walk(self):
+        """An elastic member above min is selected BEFORE a best-effort
+        single, dies alone (no gang amplification), and the survivors
+        get the resize stamp."""
+        from nos_tpu.quota import TPUResourceCalculator
+        from nos_tpu.scheduler.capacityscheduling import (
+            CapacityScheduling, ELASTIC_QUOTA_SNAPSHOT_KEY,
+            PRE_FILTER_STATE_KEY, PreFilterState,
+        )
+        from nos_tpu.quota import ElasticQuotaInfos
+        from nos_tpu.scheduler.framework import (
+            CycleState, Framework, NodeInfo, NodeResourcesFit,
+        )
+
+        api = APIServer()
+        node = make_tpu_node("h0", status_geometry={"free": {"1x2": 4}})
+        api.create(KIND_NODE, node)
+        ni = NodeInfo(node=node)
+        members = [self._gang_pod(f"m{i}", node_name="h0", lo=2, hi=4,
+                                  phase="Running") for i in range(3)]
+        be = make_slice_pod(
+            "1x2", 1, name="scav", node_name="h0", phase="Running",
+            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT})
+        for p in [*members, be]:
+            api.create(KIND_POD, p)
+            ni.add_pod(p)
+        calc = TPUResourceCalculator()
+        cs = CapacityScheduling(calc)
+        cs.set_framework(Framework([NodeResourcesFit()]))
+        cs._api = api
+        preemptor = make_slice_pod("1x2", 1, name="pree", priority=10)
+        state = CycleState()
+        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = ElasticQuotaInfos()
+        state[PRE_FILTER_STATE_KEY] = PreFilterState(
+            calc.compute_pod_request(preemptor))
+        shrink: set[str] = set()
+        victims, _, status = cs._select_victims_on_node(
+            state, preemptor, ni, pdbs=[], shrink_out=shrink)
+        assert status.is_success and victims
+        # the first death is the shrinkable elastic member, not the
+        # best-effort scavenger and not the whole gang
+        assert victims[0].metadata.name.startswith("m")
+        assert victims[0].metadata.uid in shrink
+        # shrink never amplifies: the eviction set is the member alone
+        assert [p.key for p in cs._eviction_set(
+            victims[0], None, shrink)] == [victims[0].key]
+        # at most (live - min) = 1 member shrinks; any further elastic
+        # victims in the same walk would amplify
+        assert sum(1 for v in victims if v.metadata.uid in shrink) <= 1
+        # drive the actual eviction: one member deleted, gang survives
+        journal = DecisionJournal()
+        with obs_scoped(journal=journal):
+            cs._evict_all([victims[0]], shrink)
+        alive = [p for p in api.list(KIND_POD)
+                 if p.metadata.labels.get(C.LABEL_POD_GROUP) == "eg"]
+        assert len(alive) == 2
+        for m in alive:
+            assert m.metadata.annotations[C.ANNOT_DP_RESIZE] == "2"
+        recs = journal.events(category=J.GANG_RESIZED)
+        assert recs and recs[0].attrs["direction"] == "shrink"
+
+    def test_train_checkpoint_honors_resize(self, tmp_path):
+        from nos_tpu.cmd.train import (
+            boot_world_size, read_resize_signal,
+        )
+
+        assert boot_world_size({}) == 1
+        assert boot_world_size(
+            {"TPU_WORKER_HOSTNAMES": "a,b,c"}) == 3
+        api = APIServer()
+        pod = self._gang_pod("m0", node_name="h0", phase="Running")
+        api.create(KIND_POD, pod)
+        assert read_resize_signal(api, "m0", "default") is None
+        pod2 = api.get(KIND_POD, "m0", "default")
+        pod2.metadata.annotations[C.ANNOT_DP_RESIZE] = "3"
+        api.patch(KIND_POD, "m0", "default",
+                  mutate=lambda p: p.metadata.annotations.update(
+                      {C.ANNOT_DP_RESIZE: "3"}))
+        assert read_resize_signal(api, "m0", "default") == 3
+        api.patch(KIND_POD, "m0", "default",
+                  mutate=lambda p: p.metadata.annotations.update(
+                      {C.ANNOT_DP_RESIZE: "garbage"}))
+        assert read_resize_signal(api, "m0", "default") is None
+
+
+class TestFragCulpritRanking:
+    """Satellite: when multiple classes strand the same pool, the
+    culprit join ranks by stranded chip-seconds, not recency."""
+
+    def test_evidence_ranked_by_stranded_chip_seconds(self):
+        from nos_tpu.cmd.assembly import build_scheduler
+
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "h0", status_geometry={"free": {"1x1": 8}}))
+        ledger = ChipSecondLedger(clock=lambda: now[0])
+        now = [0.0]
+        sched = build_scheduler(api, clock=lambda: now[0])
+        # two frag-blocked classes: slice-2x4 (8 chips) has waited with
+        # far more blocked demand than slice-2x2 (4 chips), but 2x2's
+        # rejection is NEWER every cycle
+        with obs_scoped(ledger=ledger):
+            for _ in range(5):
+                now[0] += 1.0
+                sched._waste_rejected_nodes = {"h0"}
+                sched._waste_frag_counts = {"slice-2x4": 1,
+                                            "slice-2x2": 1}
+                sched._waste_frag_chips = {"slice-2x4": 8.0,
+                                           "slice-2x2": 4.0}
+                sched._observe_waste({"slice-2x4": 1, "slice-2x2": 1})
+            now[0] += 1.0
+            ledger.observe({})
+        report = ledger.report()
+        ev = report["pools"]["pod-0"]["evidence"]["frag_stranded"]
+        assert ev["class"] == "slice-2x4"
+        ranked = [row["class"] for row in ev["classes"]]
+        assert ranked[0] == "slice-2x4"
+        assert ev["classes"][0]["stranded_chip_seconds"] > \
+            ev["classes"][1]["stranded_chip_seconds"]
+
+    def test_waste_culprit_renders_ranking_and_defrag_join(self, capsys):
+        from nos_tpu.obs.__main__ import _waste_culprit
+
+        journal = [
+            {"seq": 1, "category": J.POD_REJECTED, "subject": "ns/p1",
+             "attrs": {"class": "slice-2x4", "message": "no fit"}},
+            {"seq": 2, "category": J.DEFRAG_APPLIED, "subject": "dfrg-1",
+             "attrs": {"demand_class": "slice-2x4", "hosts": ["h0"],
+                       "unlocked_chips": 6.0, "payback": 3.2}},
+        ]
+        evidence = {
+            "class": "slice-2x4", "rejected_nodes": 3,
+            "classes": [
+                {"class": "slice-2x4", "stranded_chip_seconds": 40.0},
+                {"class": "slice-2x2", "stranded_chip_seconds": 5.0},
+            ],
+        }
+        lines = _waste_culprit(journal, "frag_stranded", evidence)
+        text = "\n".join(lines)
+        assert "culprit class slice-2x4" in text
+        assert "also stranding: class slice-2x2" in text
+        assert "dfrg-1" in text and "applied" in text
