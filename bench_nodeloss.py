@@ -1,0 +1,684 @@
+"""Node-loss recovery benchmark: adversarial kill/churn against the
+self-healing migration plane (ISSUE 15; docs/scheduler.md,
+"Self-healing node-loss recovery").
+
+r05's node-loss scenario permanently stranded 5 of 12 affected jobs
+(`never_rebound = 5`, rebind_p90 60.75 s) because killed pods re-entered
+the queue with no precedence and capacity was replaced reactively.  This
+bench manufactures a nastier regime — repeated kills across two pools,
+a wedged (not dead) agent, window-breaking losses under a near-full
+fleet — and measures whether the recovery plane holds the line:
+
+- **Displaced head-of-line**: every node-loss victim requeues with the
+  ``nos.tpu/displaced`` stamp and rebinds ahead of the batch backlog.
+- **Warm spares**: each pool holds pre-carved spare hosts; a kill's
+  vacancy is filled by ONE label patch (spare promotion takes over the
+  dead host's index), so broken gang windows are whole again without a
+  node-join + plan→actuate round trip.
+- **Failure detection + drain-then-migrate**: one host's agent WEDGES
+  mid-trace (node object stays, heartbeat freezes); the missed-
+  heartbeat detector quarantines it as suspect, residents are asked to
+  checkpoint-and-exit and evicted after the grace — displaced, not
+  stranded — and the host later dies for real (spare promotion again).
+
+Gates (the ISSUE 15 acceptance criteria, asserted per seed):
+- never_rebound == 0: every affected job re-binds before trace end;
+- rebind_p90 < 15 s measured from the displacement stamp;
+- lost chip-seconds <= 50% of the no-recovery baseline on the SAME
+  trace and seed (the baseline runs the identical kill schedule with
+  the plane disabled: no displaced stamps, no suspicion, no
+  promotions);
+- spares disabled + no displaced pods => scheduler/planner decisions
+  byte-identical to a build without the plane (journal compare over a
+  kill-free trace, the defrag off-means-off pattern);
+- chip-second conservation holds per run (asserted inside every run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+from nos_tpu.api import constants as C
+from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device import default_tpu_runtime
+from nos_tpu.device.fake import FakePodResources
+from nos_tpu.kube.client import (
+    APIServer, KIND_NODE, KIND_POD, KIND_POD_GROUP, NotFound,
+)
+from nos_tpu.kube.objects import ObjectMeta, PENDING, RUNNING
+from nos_tpu.obs import journal as J, scoped as obs_scoped
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import (
+    new_slice_partitioner_controller,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+from nos_tpu.utils.pod_util import displaced_value
+from nos_tpu.utils.retry import retry_on_conflict
+
+POOLS = ("pod-0", "pod-1")
+HOSTS_PER_POOL = 8
+SPARES_PER_POOL = 2
+CHIPS_PER_HOST = V5E.chips_per_host              # 8
+ACTIVE_CHIPS = len(POOLS) * HOSTS_PER_POOL * CHIPS_PER_HOST   # 128
+
+TICK_S = 0.25
+WARMUP_S = 40.0
+TRACE_S = 300.0
+BATCH_IDLE_S = 0.5
+BATCH_TIMEOUT_S = 2.0
+
+# Recovery knobs under test (PartitionerConfig analogs)
+SUSPECT_AFTER_S = 5.0
+MIGRATE_GRACE_S = 3.0
+
+# Adversarial schedule: three dead-host kills (alternating pools,
+# always a BUSY host so jobs are actually displaced) plus one WEDGE
+# (agent freezes, node stays) that later dies for real.  A fresh warm
+# spare joins the victim pool 60 s after each kill, so the policy's
+# keep-N-warm accounting is exercised, not just the first promotion.
+KILL_TIMES = (100.0, 160.0, 220.0)
+WEDGE_T = 130.0
+WEDGE_DEATH_T = 155.0
+SPARE_REFILL_DELAY_S = 60.0
+
+REBIND_P90_TARGET_S = 15.0
+LOST_CHIP_SECONDS_HALVING = 0.50
+
+GANG_PRIORITY = 5
+DURATION_S = {
+    "gang": (50.0, 90.0),       # 2-host 4x4 gangs: window-sensitive
+    "slice": (30.0, 60.0),      # whole-host 2x4 singles
+    "small": (20.0, 40.0),      # 2x2 fillers, the preemptible tail
+}
+CLASS_SPECS = {
+    "gang": ("4x4", 2, GANG_PRIORITY),
+    "slice": ("2x4", 1, 0),
+    "small": ("2x2", 1, 0),
+}
+# in-flight chip-footprint targets (pending + running), ~93% of the
+# 128 active chips: full enough that a lost host hurts, loose enough
+# that recovery is feasible once capacity returns
+TARGETS = {"gang": 48.0, "slice": 40.0, "small": 32.0}
+
+
+def percentile(xs, q, digits=3):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+
+def chip_equiv(pod) -> float:
+    from nos_tpu.kube.resources import pod_request
+    from nos_tpu.topology.profile import extract_slice_requests
+
+    return sum(min(s.chips, CHIPS_PER_HOST) * q
+               for s, q in extract_slice_requests(
+                   pod_request(pod)).items())
+
+
+class Job:
+    def __init__(self, name, kind, pods, duration, created,
+                 shape="1x1", priority=0):
+        self.name = name
+        self.kind = kind
+        self.pods = pods
+        self.duration = duration
+        self.created = created
+        self.shape = shape
+        self.priority = priority
+        self.bound_at = None
+
+
+class Sim:
+    """One trace run.  `recovery` enables the whole plane (spare
+    policy + failure detector in the partitioner, displaced stamps at
+    requeue); the baseline runs the IDENTICAL kill schedule with all
+    of it off — the pre-PR control plane.  `kills` off runs a quiet
+    trace (the byte-identity basis)."""
+
+    def __init__(self, seed=0, recovery=True, kills=True):
+        self.seed = seed
+        self.recovery = recovery
+        self.kills = kills
+        self.rng = random.Random(seed)
+        self.now = [0.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        api = self.api = APIServer()
+        state = ClusterState()
+        NodeController(api, state, SliceNodeInitializer(api)).bind()
+        PodController(api, state).bind()
+        self.ctl = new_slice_partitioner_controller(
+            api, state, batch_timeout_s=BATCH_TIMEOUT_S,
+            batch_idle_s=BATCH_IDLE_S, clock=clock,
+            spare_hosts_per_pool=SPARES_PER_POOL if recovery else 0,
+            node_suspect_after_s=SUSPECT_AFTER_S if recovery else 0.0,
+            migrate_grace_s=MIGRATE_GRACE_S)
+        self.ctl.bind()
+        self.agents: dict[str, SliceAgent] = {}
+        self._spare_seq = 0
+        for pool in POOLS:
+            for h in range(HOSTS_PER_POOL):
+                self._add_host(f"{pool}-h{h}", pool, h)
+            for s in range(SPARES_PER_POOL):
+                self._add_spare(pool)
+        self.scheduler = build_scheduler(
+            api, 16, shard_chips_per_host=CHIPS_PER_HOST,
+            drain_preempt_after_cycles=40,
+            drain_preempt_progress_fn=self._pod_progress, clock=clock)
+        self.ledger = ChipSecondLedger(clock=clock)
+        self.journal = DecisionJournal(maxlen=300_000, clock=clock)
+        self.jobs: dict[str, Job] = {}
+        self._job_seq = 0
+        self._pod_job: dict[str, Job] = {}
+        self._pod_node: dict[str, str] = {}
+        self.latencies: list[float] = []
+        self.completed = 0
+        # node-loss bookkeeping
+        self._kills_done = 0
+        self._wedged: set[str] = set()
+        self._wedge_done = False
+        self._wedge_dead = False
+        self._killed_pods: set[str] = set()
+        # jobs currently down from a displacement (never_rebound at
+        # trace end) and the total episode count — an episode opens
+        # when a job's first victim pod requeues and closes at its
+        # next full bind (or completion); a job displaced twice is two
+        # episodes with two independent stamps
+        self._affected: set[str] = set()
+        self._episodes = 0
+        self._displaced_at: dict[str, float] = {}
+        self._rebind_latencies: list[float] = []
+        self._spare_refills: list[tuple[float, str]] = []
+        self.lost_chip_seconds = 0.0
+        self._util_area = 0.0
+        self._util_time = 0.0
+
+    # -- cluster -------------------------------------------------------------
+    def _add_host(self, name, pool, host_index, spare=False):
+        extra = {C.LABEL_SPARE: C.SPARE_WARM} if spare else None
+        self.api.create(KIND_NODE, make_tpu_node(
+            name, pod_id=pool, host_index=host_index,
+            extra_labels=extra))
+        agent = SliceAgent(self.api, name, default_tpu_runtime(V5E),
+                           FakePodResources())
+        agent.start()
+        self.agents[name] = agent
+
+    def _add_spare(self, pool):
+        self._spare_seq += 1
+        # spare index parks far above the active range; promotion
+        # patches it onto the vacated index
+        self._add_host(f"{pool}-spare{self._spare_seq}", pool,
+                       100 + self._spare_seq, spare=True)
+
+    def _live_active_chips(self) -> float:
+        chips = 0.0
+        for node in self.api.list(KIND_NODE):
+            if node.metadata.labels.get(C.LABEL_SPARE, "") \
+                    == C.SPARE_WARM:
+                continue
+            chips += float(node.metadata.labels.get(
+                C.LABEL_CHIP_COUNT, "0") or 0.0)
+        return chips
+
+    # -- kill schedule -------------------------------------------------------
+    def _maybe_fail(self):
+        if not self.kills:
+            return
+        if self._kills_done < len(KILL_TIMES) \
+                and self.now[0] >= KILL_TIMES[self._kills_done]:
+            pool = POOLS[self._kills_done % len(POOLS)]
+            victim = self._busiest_host(pool)
+            if victim is not None:
+                self._kill_host(victim)
+                self._spare_refills.append(
+                    (self.now[0] + SPARE_REFILL_DELAY_S, pool))
+            self._kills_done += 1
+        if not self._wedge_done and self.now[0] >= WEDGE_T:
+            self._wedge_done = True
+            victim = self._busiest_host(POOLS[0], exclude=self._wedged)
+            if victim is not None:
+                # the agent freezes: ticks stop, heartbeat stops, the
+                # node object and its pods REMAIN — the suspicion path
+                # (affected accounting happens when the migrator's
+                # evictions requeue, like every other displacement)
+                self._wedged.add(victim)
+        if self._wedge_done and not self._wedge_dead \
+                and self.now[0] >= WEDGE_DEATH_T:
+            self._wedge_dead = True
+            for name in list(self._wedged):
+                if self.api.try_get(KIND_NODE, name) is not None:
+                    self._kill_host(name, wedged=True)
+        for due, pool in [r for r in self._spare_refills
+                          if r[0] <= self.now[0]]:
+            self._spare_refills.remove((due, pool))
+            self._add_spare(pool)
+
+    def _busiest_host(self, pool, exclude=()):
+        """The active host of `pool` hosting the most distinct JOBS
+        (ties: most chip-equivalents) — an adversarial kill displaces
+        as much work as one host can."""
+        best, best_key = None, (-1, -1.0)
+        for node in self.api.list(KIND_NODE):
+            labels = node.metadata.labels
+            if labels.get(C.LABEL_POD_ID, "") != pool:
+                continue
+            if labels.get(C.LABEL_SPARE, "") == C.SPARE_WARM:
+                continue
+            name = node.metadata.name
+            if name in exclude:
+                continue
+            residents = self.api.pods_on_node(name)
+            jobs = {self._pod_job[p.metadata.name].name
+                    for p in residents
+                    if p.metadata.name in self._pod_job}
+            key = (len(jobs), sum(chip_equiv(p) for p in residents))
+            if key > best_key:
+                best, best_key = name, key
+        return best
+
+    def _kill_host(self, name, wedged=False):
+        agent = self.agents.pop(name, None)
+        if agent is not None:
+            agent.stop()
+        for p in self.api.pods_on_node(name):
+            self._killed_pods.add(p.metadata.name)
+            try:
+                self.api.delete(KIND_POD, p.metadata.name,
+                                p.metadata.namespace)
+            except NotFound:
+                pass
+        try:
+            self.api.delete(KIND_NODE, name)
+        except NotFound:
+            pass
+        self._wedged.discard(name)
+
+    # -- workload ------------------------------------------------------------
+    def _spawn(self):
+        footprint = {cls: 0.0 for cls in TARGETS}
+        for p in self.api.list(KIND_POD):
+            job = self._pod_job.get(p.metadata.name)
+            if job is not None and job.kind in footprint:
+                footprint[job.kind] += chip_equiv(p)
+        for cls, target in TARGETS.items():
+            while footprint[cls] < target:
+                footprint[cls] += self._spawn_job(cls)
+
+    def _spawn_job(self, cls):
+        shape, members, priority = CLASS_SPECS[cls]
+        lo, hi = DURATION_S[cls]
+        self._job_seq += 1
+        name = f"{cls}-{self._job_seq}"
+        job = Job(name, cls, [], self.rng.uniform(lo, hi), self.now[0],
+                  shape=shape, priority=priority)
+        if members > 1:
+            self.api.create(KIND_POD_GROUP, PodGroup(
+                metadata=ObjectMeta(name=name, namespace="work"),
+                spec=PodGroupSpec(min_member=members)))
+        spawned = 0.0
+        for i in range(members):
+            pod = self._make_pod(job, f"{name}-{i}")
+            self.api.create(KIND_POD, pod)
+            job.pods.append(pod.metadata.name)
+            self._pod_job[pod.metadata.name] = job
+            spawned += chip_equiv(pod)
+        self.jobs[name] = job
+        return spawned
+
+    def _make_pod(self, job, pod_name, annotations=None):
+        members = CLASS_SPECS[job.kind][1]
+        return make_slice_pod(
+            job.shape, 1, name=pod_name, namespace="work",
+            labels=({C.LABEL_POD_GROUP: job.name} if members > 1
+                    else None),
+            annotations=annotations, priority=job.priority,
+            creation_timestamp=job.created)
+
+    def _pod_progress(self, pod):
+        job = self._pod_job.get(pod.metadata.name)
+        if job is None or job.bound_at is None or job.duration <= 0:
+            return 0.0
+        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+                            / job.duration))
+
+    def _stamp_progress(self):
+        """Running pods report job progress (the production
+        cmd/train.py hook) every few seconds, so the restart-cost-aware
+        victim walk and drain preemption see real fractions."""
+        if int(round(self.now[0] / TICK_S)) % 20:
+            return
+        for p in self.api.list(KIND_POD):
+            if not p.spec.node_name or p.status.phase != RUNNING:
+                continue
+            frac = self._pod_progress(p)
+            if frac <= 0.0:
+                continue
+            value = f"{frac:.3f}"
+
+            def mutate(q, v=value):
+                q.metadata.annotations[C.ANNOT_JOB_PROGRESS] = v
+
+            try:
+                retry_on_conflict(self.api, KIND_POD, p.metadata.name,
+                                  mutate, "work",
+                                  component="bench-progress")
+            except NotFound:
+                pass
+
+    def _complete_finished(self):
+        for job in list(self.jobs.values()):
+            if job.bound_at is None \
+                    or self.now[0] < job.bound_at + job.duration:
+                continue
+            for pname in job.pods:
+                try:
+                    self.api.delete(KIND_POD, pname, "work")
+                except NotFound:
+                    pass
+                self._pod_job.pop(pname, None)
+            try:
+                self.api.delete(KIND_POD_GROUP, job.name, "work")
+            except NotFound:
+                pass
+            del self.jobs[job.name]
+            # a job that completed was bound — it cannot be down from
+            # a displacement (stale same-tick completions resolve the
+            # episode the cheap way: the work finished)
+            self._affected.discard(job.name)
+            self._displaced_at.pop(job.name, None)
+            self.completed += 1
+
+    def _requeue_evicted(self):
+        """The workload controller: recreate missing pods.  Node-loss
+        victims and drain-migrate evictees carry the displaced stamp
+        (cause + time) — exactly what a production Job controller
+        would copy from the eviction event — IF the recovery plane is
+        on; the baseline requeues them bare, which is the pre-PR
+        behavior this bench prices."""
+        live = {p.metadata.name for p in self.api.list(KIND_POD)}
+        for job in self.jobs.values():
+            missing = [n for n in job.pods if n not in live]
+            if not missing:
+                continue
+            job.bound_at = None
+            for pname in missing:
+                annotations = None
+                cause = None
+                if pname in self._killed_pods:
+                    self._killed_pods.discard(pname)
+                    cause = C.DISPLACED_NODE_LOSS
+                elif self._pod_node.get(pname) in self._wedged:
+                    cause = C.DISPLACED_DRAIN_MIGRATE
+                if cause is not None:
+                    if job.name not in self._affected:
+                        # a new displacement episode: fresh stamp —
+                        # rebind latency is per episode, never from a
+                        # previous kill's stale stamp
+                        self._affected.add(job.name)
+                        self._episodes += 1
+                        self._displaced_at[job.name] = self.now[0]
+                    if self.recovery:
+                        annotations = {
+                            C.ANNOT_DISPLACED: displaced_value(
+                                cause, self._displaced_at[job.name])}
+                        journal_job = (f"work/{job.name}"
+                                       if len(job.pods) > 1
+                                       else f"work/{pname}")
+                        self.journal.record(
+                            J.JOB_DISPLACED, journal_job, cause=cause)
+                self._pod_node.pop(pname, None)
+                pod = self._make_pod(job, pname,
+                                     annotations=annotations)
+                self.api.create(KIND_POD, pod)
+                self._pod_job[pname] = job
+
+    def _record_binds(self):
+        bound = {}
+        for p in self.api.list(KIND_POD):
+            if p.spec.node_name and p.status.phase == RUNNING:
+                bound[p.metadata.name] = p.spec.node_name
+        self._pod_node.update(bound)
+        # gang mates of an evicted member: remember where they ran so
+        # a whole-gang eviction off a wedged host attributes causes
+        for job in self.jobs.values():
+            if job.bound_at is None and all(n in bound
+                                            for n in job.pods):
+                job.bound_at = self.now[0]
+                self.latencies.append(self.now[0] - job.created)
+                if job.name in self._affected:
+                    self._affected.discard(job.name)
+                    self._rebind_latencies.append(
+                        self.now[0] - self._displaced_at.pop(
+                            job.name, self.now[0]))
+
+    def _sample_utilization(self):
+        live = self._live_active_chips()
+        lost = max(0.0, ACTIVE_CHIPS - live)
+        if lost > 0 and self.now[0] >= WARMUP_S:
+            self.lost_chip_seconds += lost * TICK_S
+        used = sum(chip_equiv(p) for p in self.api.list(KIND_POD)
+                   if p.spec.node_name and p.status.phase == RUNNING)
+        if self.now[0] >= WARMUP_S and live > 0:
+            self._util_area += min(1.0, used / live) * TICK_S
+            self._util_time += TICK_S
+
+    # -- main loop -----------------------------------------------------------
+    def run(self):
+        with obs_scoped(journal=self.journal, ledger=self.ledger):
+            while self.now[0] < TRACE_S:
+                self.now[0] += TICK_S
+                self._maybe_fail()
+                self._complete_finished()
+                self._spawn()
+                self.scheduler.run_cycle()
+                self._requeue_evicted()
+                self.ctl.process_if_ready()
+                for name, a in list(self.agents.items()):
+                    if name not in self._wedged:
+                        a.tick()
+                self._stamp_progress()
+                self._record_binds()
+                self._sample_utilization()
+            # drain the tail: kills stop, the backlog settles — a job
+            # displaced seconds before trace end deserves its rebind
+            # before the never_rebound verdict is passed
+            settle_until = self.now[0] + 30.0
+            while self.now[0] < settle_until and self._affected:
+                self.now[0] += TICK_S
+                self._complete_finished()
+                self.scheduler.run_cycle()
+                self._requeue_evicted()
+                self.ctl.process_if_ready()
+                for name, a in list(self.agents.items()):
+                    if name not in self._wedged:
+                        a.tick()
+                self._record_binds()
+                self._sample_utilization()
+        waste = self.ledger.report()
+        assert conservation_ok(waste), (
+            "chip-second conservation violated: "
+            + str({p: v["conservation_delta"]
+                   for p, v in waste["pools"].items()}))
+        rebinds = self._rebind_latencies
+        return {
+            "utilization_pct": round(self._util_area / self._util_time,
+                                     4) if self._util_time else 0.0,
+            "jobs_completed": self.completed,
+            "affected_jobs": self._episodes,
+            "rebound_jobs": len(rebinds),
+            "never_rebound": len(self._affected),
+            "never_rebound_jobs": sorted(self._affected),
+            "rebind_p50_s": percentile(rebinds, 0.5, 2),
+            "rebind_p90_s": percentile(rebinds, 0.9, 2),
+            "rebind_max_s": (round(max(rebinds), 2) if rebinds
+                             else None),
+            "lost_chip_seconds": round(self.lost_chip_seconds, 1),
+            "spare_promotions": len(self.journal.events(
+                category=J.SPARE_PROMOTED)),
+            "suspects": len([r for r in self.journal.events(
+                category=J.QUARANTINED)
+                if r.attrs.get("reason") == "heartbeat-suspect"]),
+            "rebound_records": len(self.journal.events(
+                category=J.JOB_REBOUND)),
+            "drain_chip_seconds": round(
+                waste["fleet"]["chip_seconds"].get("drain", 0.0), 1),
+        }
+
+    def decision_trace(self):
+        """(category, subject, attrs) with run-unique identifiers
+        (uuid plan ids) normalized — the byte-identity basis."""
+        return [(r.category, r.subject, tuple(sorted(
+            (k, str(v)) for k, v in r.attrs.items()
+            if k != "plan_id")))
+            for r in self.journal.events()]
+
+
+def check_byte_identity():
+    """Spares disabled + no displaced pods ⇒ byte-identical decisions:
+    a kill-free trace with the recovery plane constructed-but-armed
+    must journal the EXACT record sequence of the plane-off build —
+    the detector, spare policy and SpareGuard must leak nothing into
+    decisions while nothing fails.  Shortened trace: identity either
+    holds from the first divergent record or not at all."""
+    global TRACE_S
+    prev = TRACE_S
+    TRACE_S = 90.0
+    try:
+        off = Sim(seed=0, recovery=False, kills=False)
+        off.run()
+        on = Sim(seed=0, recovery=True, kills=False)
+        on.run()
+    finally:
+        TRACE_S = prev
+    a, b = off.decision_trace(), on.decision_trace()
+    if a == b:
+        return True, f"{len(a)} records identical"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if ra != rb:
+            return False, f"first divergence at record {i}: {ra} vs {rb}"
+    return False, f"length mismatch: {len(a)} vs {len(b)}"
+
+
+def assert_gates(seed, on, off):
+    failures = []
+    if on["never_rebound"] != 0:
+        failures.append(
+            f"seed {seed}: never_rebound = {on['never_rebound']} "
+            f"({on['never_rebound_jobs']})")
+    p90 = on["rebind_p90_s"]
+    if p90 is None or p90 >= REBIND_P90_TARGET_S:
+        failures.append(
+            f"seed {seed}: rebind_p90 {p90} >= {REBIND_P90_TARGET_S}s")
+    if on["affected_jobs"] < 3:
+        failures.append(
+            f"seed {seed}: only {on['affected_jobs']} affected jobs — "
+            f"the kill schedule displaced nothing, the gates are "
+            f"vacuous")
+    if on["spare_promotions"] < 1:
+        failures.append(f"seed {seed}: no spare was ever promoted")
+    if off["lost_chip_seconds"] > 0 and on["lost_chip_seconds"] \
+            > LOST_CHIP_SECONDS_HALVING * off["lost_chip_seconds"]:
+        failures.append(
+            f"seed {seed}: lost chip-seconds {on['lost_chip_seconds']}"
+            f" > {LOST_CHIP_SECONDS_HALVING} x baseline "
+            f"{off['lost_chip_seconds']}")
+    return failures
+
+
+def run_bench(seeds, identity=True):
+    per_seed = {}
+    failures = []
+    for seed in seeds:
+        on = Sim(seed=seed, recovery=True).run()
+        off = Sim(seed=seed, recovery=False).run()
+        failures.extend(assert_gates(seed, on, off))
+        per_seed[str(seed)] = {"recovery": on, "baseline": {
+            "never_rebound": off["never_rebound"],
+            "rebind_p50_s": off["rebind_p50_s"],
+            "rebind_p90_s": off["rebind_p90_s"],
+            "lost_chip_seconds": off["lost_chip_seconds"],
+            "utilization_pct": off["utilization_pct"],
+        }}
+    out = {
+        "active_chips": ACTIVE_CHIPS,
+        "spares_per_pool": SPARES_PER_POOL,
+        "trace_seconds": TRACE_S,
+        "never_rebound": sum(
+            s["recovery"]["never_rebound"] for s in per_seed.values()),
+        "rebind_p90_s_worst": max(
+            (s["recovery"]["rebind_p90_s"] or 1e9
+             for s in per_seed.values()), default=None),
+        "per_seed": per_seed,
+        "gates": {
+            "rebind_p90_target_s": REBIND_P90_TARGET_S,
+            "lost_chip_seconds_halving": LOST_CHIP_SECONDS_HALVING,
+            "failures": failures,
+        },
+    }
+    if identity:
+        identical, detail = check_byte_identity()
+        if not identical:
+            failures.append(
+                f"recovery-disabled not byte-identical: {detail}")
+        out["byte_identity"] = {"ok": identical, "detail": detail}
+    out["ok"] = not failures
+    return out
+
+
+def run_smoke():
+    """CI gate (scripts/check.sh): one seed, full kill schedule, every
+    gate asserted — never_rebound == 0, rebind_p90 bound, lost
+    chip-seconds halving vs the baseline, byte-identity, conservation
+    (inside each run).  Raises AssertionError on regression."""
+    t0 = time.perf_counter()
+    out = run_bench([0])
+    out["smoke"] = "ok" if out["ok"] else "FAILED"
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    assert out["ok"], "node-loss gates failed: " + "; ".join(
+        out["gates"]["failures"])
+    assert out["wall_s"] < 420.0, \
+        f"node-loss smoke took {out['wall_s']}s (> 420s bound)"
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="self-healing node-loss recovery bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed recovery gate (CI)")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="seeds for the full run")
+    ap.add_argument("--nodeloss-report", default="",
+                    help="also write the result JSON to this file "
+                         "(CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_bench(list(range(args.seeds)))
+    if args.nodeloss_report:
+        with open(args.nodeloss_report, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=2)
+        print(f"node-loss report written to {args.nodeloss_report}",
+              file=sys.stderr)
+    print(json.dumps(out))
+    if not out.get("ok", True):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

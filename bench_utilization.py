@@ -109,7 +109,7 @@ from nos_tpu.testing.factory import make_slice_pod, make_timeshare_pod, make_tpu
 from nos_tpu.topology import V5E
 from nos_tpu.topology.hybrid import slice_generation_for
 from nos_tpu.topology.profile import extract_slice_requests, extract_timeshare_requests
-from nos_tpu.utils.pod_util import is_over_quota
+from nos_tpu.utils.pod_util import displaced_value, is_over_quota
 
 SLICE_DOMAINS = {"pod-0": 16, "pod-1": 12}
 TS_HOSTS = 2
@@ -247,6 +247,15 @@ def slo_objectives() -> list[SLOObjective]:
         SLOObjective(name="rebind-ceiling", kind=RATE_CEILING,
                      metric="nos_tpu_drain_preemptions_total",
                      target=1.0),
+        # Node-loss recovery SLO: displacement-stamp → re-bind latency
+        # (the scheduler's displaced head-of-line path populates the
+        # histogram; the node-loss victims in this trace exercise it).
+        # A breached displaced class joins to its rejecting plugin
+        # through `obs slo` exactly like schedule latency.
+        SLOObjective(name="rebind-latency", kind=LATENCY,
+                     metric="nos_tpu_rebind_latency_seconds",
+                     target=60.0, each_label="class", compliance=0.9,
+                     min_events=3),
     ]
 
 
@@ -422,6 +431,13 @@ class Sim:
         self._restored = False
         self._kill_affected: set[str] = set()
         self._killed_pod_names: set[str] = set()
+        # job -> displacement stamp time (the moment its first killed
+        # pod re-entered the queue with the nos.tpu/displaced
+        # annotation) — rebind latency is measured from THIS stamp,
+        # not the kill time: the stamp is what the real head-of-line
+        # machinery keys on, and it is what the scheduler's
+        # nos_tpu_rebind_latency_seconds observes too
+        self._displaced_at: dict[str, float] = {}
         self._rebind_latencies: list[float] = []
         self._affected_total = 0
         self.replacement_ready_s: float | None = None
@@ -559,7 +575,14 @@ class Sim:
                 self._kill_affected.discard(name)
             elif job.bound_at is not None:
                 self._kill_affected.discard(name)
-                self._rebind_latencies.append(self.now[0] - NODE_KILL_T)
+                # rebind latency from the DISPLACEMENT STAMP (the
+                # annotation the head-of-line machinery keys on), not
+                # the kill time — jobs whose pods were never stamped
+                # (killed but requeued before the stamp landed) fall
+                # back to the kill time
+                self._rebind_latencies.append(
+                    self.now[0]
+                    - self._displaced_at.get(name, NODE_KILL_T))
         if self._restored and self.replacement_ready_s is None:
             ready = 0
             for name in REPLACEMENT_NODES:
@@ -630,16 +653,18 @@ class Sim:
         self.jobs[name] = job
         return spawned
 
-    def _make_job_pod(self, job: Job, pod_name: str, created: float):
+    def _make_job_pod(self, job: Job, pod_name: str, created: float,
+                      annotations: dict | None = None):
         if job.kind == "ts":
             return make_timeshare_pod(
                 job.arg, 1, name=pod_name, namespace=job.namespace,
-                creation_timestamp=created)
+                annotations=annotations, creation_timestamp=created)
         labels = ({C.LABEL_POD_GROUP: job.name}
                   if job.kind == "gang" else None)
         return make_slice_pod(
             job.arg, 1, name=pod_name, namespace=job.namespace,
-            labels=labels, creation_timestamp=created,
+            labels=labels, annotations=annotations,
+            creation_timestamp=created,
             priority=GANG_PRIORITY if job.kind == "gang" else 0)
 
     def _pod_progress(self, pod) -> float:
@@ -684,13 +709,22 @@ class Sim:
             job.bound_at = None         # re-run from scratch once rebound
             job.evictions += 1
             for pname in missing:
+                annotations = None
                 if pname in self._preempt_victim_names:
                     self._preempt_victim_names.discard(pname)
                 elif pname in self._killed_pod_names:
                     self._killed_pod_names.discard(pname)
+                    # node-loss victims re-enter the queue DISPLACED
+                    # (cause + stamp): the scheduler's admission sort
+                    # ranks them between serving and batch, so the
+                    # bench exercises the real head-of-line path
+                    annotations = {C.ANNOT_DISPLACED: displaced_value(
+                        C.DISPLACED_NODE_LOSS, self.now[0])}
+                    self._displaced_at.setdefault(job.name, self.now[0])
                 else:
                     self.drain_evictions += 1
-                pod = self._make_job_pod(job, pname, job.created)
+                pod = self._make_job_pod(job, pname, job.created,
+                                         annotations=annotations)
                 self.api.create(KIND_POD, pod)
                 self._pod_job[pname] = job
 

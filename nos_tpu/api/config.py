@@ -107,6 +107,24 @@ class PartitionerConfig(ManagerConfig):
     defrag_interval_s: float = 0.0
     # Deadline after which a stuck drain is aborted and healed.
     defrag_drain_timeout_s: float = 120.0
+    # Self-healing node-loss recovery (partitioning/core/failure.py;
+    # docs/scheduler.md).  All three default OFF: with every knob at
+    # its default the policy object is never constructed and decisions
+    # are byte-identical to a build without the plane.
+    # Warm spares kept pre-carved per topology pool: a vanished host's
+    # index is taken over by a spare (one label patch) instead of
+    # waiting out node-join + plan→actuate.  0 disables.
+    spare_hosts_per_pool: int = 0
+    # Missed-heartbeat suspicion: a node whose agent heartbeat
+    # (nos.tpu/agent-heartbeat) has not changed for this many seconds
+    # is quarantined as suspect and its residents drain-migrated.
+    # 0 disables the failure detector.  Must comfortably exceed the
+    # agent report interval or healthy nodes flap suspect.
+    node_suspect_after_s: float = 0.0
+    # Grace between stamping residents with nos.tpu/migrate (the
+    # checkpoint-exit signal cmd/train.py honors) and evicting the
+    # stragglers that did not exit on their own.
+    migrate_grace_s: float = 5.0
     # Geometry-override file (SetKnownGeometries analog, reference
     # known_configs.go:144-150 wired at cmd/gpupartitioner/:370-380).
     known_geometries_file: str = ""
@@ -136,6 +154,12 @@ class PartitionerConfig(ManagerConfig):
             raise ConfigError("plan_shard_min_hosts must be >= 0")
         if self.plan_workers < 0:
             raise ConfigError("plan_workers must be >= 0")
+        if self.spare_hosts_per_pool < 0:
+            raise ConfigError("spare_hosts_per_pool must be >= 0")
+        if self.node_suspect_after_s < 0:
+            raise ConfigError("node_suspect_after_s must be >= 0")
+        if self.migrate_grace_s < 0:
+            raise ConfigError("migrate_grace_s must be >= 0")
         if self.defrag_payback_min <= 0:
             raise ConfigError("defrag_payback_min must be positive")
         if self.defrag_interval_s < 0:
@@ -183,9 +207,18 @@ class SchedulerConfig(ManagerConfig):
     # disables growth (shrink — a preemption rung — is always on, but
     # only ever fires for annotated gangs).
     elastic_grow_budget_per_cycle: int = 1
+    # Displaced head-of-line anti-starvation cap (docs/scheduler.md,
+    # "Self-healing node-loss recovery"): a pod stamped
+    # `nos.tpu/displaced` ranks between serving and batch until its
+    # stamp is older than this many seconds, then reads plain batch
+    # again — an unplaceable displaced pod must not camp the head of
+    # the queue forever.  0 = the boost never expires.
+    displaced_age_cap_s: float = 300.0
 
     def validate(self) -> None:
         super().validate()
+        if self.displaced_age_cap_s < 0:
+            raise ConfigError("displaced_age_cap_s must be >= 0")
         if self.tpu_memory_gb_per_chip <= 0:
             raise ConfigError("tpu_memory_gb_per_chip must be positive")
         if self.cycle_interval_s <= 0:
@@ -282,6 +315,14 @@ class AgentConfig(ManagerConfig):
     node_name: str = ""
     report_interval_s: float = 10.0
     generation: str = "tpu-v5e"
+    # Stamp the liveness heartbeat annotation with each report.  The
+    # partitioner's missed-heartbeat failure detector
+    # (partitioning/core/failure.py) has NO signal for this node
+    # without it — set true wherever node_suspect_after_s > 0 on the
+    # partitioner (the helm chart documents the pairing).  Default off
+    # because the stamp turns every steady-state report into a real
+    # node write + watch event fleet-wide.
+    heartbeat: bool = False
 
     def validate(self) -> None:
         super().validate()

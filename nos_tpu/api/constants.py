@@ -84,6 +84,26 @@ TIER_BEST_EFFORT = "best-effort"
 # counts and scales by it.
 LABEL_SERVICE = f"{GROUP}/service"
 
+# Warm-spare hold (docs/scheduler.md, "Self-healing node-loss
+# recovery"): a host labeled `nos.tpu/spare: "warm"` is a pre-carved
+# replacement kept OUT of scheduling (the SpareGuard filter rejects
+# every pod) and out of demand-driven planning (the partitioner's
+# snapshot excludes it) — its default geometry is already actuated, so
+# promoting it after a node loss is one label patch, not a
+# plan→actuate round trip.  The spare policy (partitioning/core/
+# failure.py) promotes one per vanished host: the label is removed and
+# the dead host's host-index taken over, making its gang windows whole
+# again.
+LABEL_SPARE = f"{GROUP}/spare"
+SPARE_WARM = "warm"
+
+
+def is_warm_spare_labels(labels: dict) -> bool:
+    """THE warm-spare predicate — shared by the SpareGuard filter, the
+    waste waterfall, the partitioner's snapshot exclusion and the spare
+    policy, so the four layers can never disagree on what 'held' means."""
+    return labels.get(LABEL_SPARE, "") == SPARE_WARM
+
 # ---------------------------------------------------------------------------
 # Annotations
 # ---------------------------------------------------------------------------
@@ -166,6 +186,40 @@ ANNOT_DP_RESIZE = f"{GROUP}/dp-resize"
 # of being refilled by the very pods just migrated off it.
 ANNOT_DEFRAG_DRAIN = f"{GROUP}/defrag-drain"
 
+# Migration drains share the annotation with a "migrate:<cause>" value
+# (partitioning/core/failure.py): unlike a defrag proposal's drain —
+# soft score-key avoidance on a healthy host — a migration drain is a
+# HARD scheduling rejection (the host is presumed dying) and the
+# defrag plane's stray-drain heal must never touch it.
+MIGRATION_DRAIN_PREFIX = "migrate:"
+
+
+def is_migration_drain(annotations: dict) -> bool:
+    """THE migration-drain predicate — shared by MigrationDrainGuard,
+    the partitioner's snapshot exclusion, the recovery plane's own
+    heal, and defrag's stray-drain sweep."""
+    return annotations.get(ANNOT_DEFRAG_DRAIN, "").startswith(
+        MIGRATION_DRAIN_PREFIX)
+
+
+def migration_drain_value(kind: str, cause: str) -> str:
+    """Render a migration drain: ``migrate:<kind>:<cause>``.  The kind
+    segment is the OWNING family — on a hybrid host both the slice and
+    the timeshare recovery planes can want the drain, and the owner is
+    the only one allowed to retract it (failure.py's exclusive-
+    ownership contract)."""
+    return f"{MIGRATION_DRAIN_PREFIX}{kind}:{cause}"
+
+
+def migration_drain_owner(annotations: dict) -> str:
+    """The family that owns a node's migration drain, or "" when the
+    node carries none (a defrag drain is not a migration drain)."""
+    raw = annotations.get(ANNOT_DEFRAG_DRAIN, "")
+    if not raw.startswith(MIGRATION_DRAIN_PREFIX):
+        return ""
+    kind, sep, _cause = raw[len(MIGRATION_DRAIN_PREFIX):].partition(":")
+    return kind if sep else ""
+
 # Gang window lease: stamped by the scheduler on every host of the aligned
 # window a stuck multi-host gang is draining toward (value "<ns>/<gang>").
 # The partitioner reads it — the per-node loop re-carves leased hosts last
@@ -185,6 +239,50 @@ ANNOT_MESH = f"{GROUP}/mesh"
 # nothing — and spares near-done stragglers entirely (they drain the window
 # for free by completing).  Absent = 0 (nothing to lose).
 ANNOT_JOB_PROGRESS = f"{GROUP}/job-progress"
+
+# Displaced-workload head-of-line claim (docs/scheduler.md): stamped
+# on a pod recreated after its previous incarnation was killed by node
+# loss, a drain-migration, or a predicted-failure eviction.  Value is
+# "<cause>@<timestamp>" (e.g. "node-loss@153.250", the stamp time in
+# the scheduler's clock domain); the admission queue ranks displaced
+# batch pods in their own tier between serving and batch, with an
+# anti-starvation age cap after which the boost expires and the pod
+# reads plain batch again.  The scheduler clears the annotation at
+# bind and observes nos_tpu_rebind_latency_seconds from the stamp.
+# Malformed values degrade to not-displaced (normal rank), never to a
+# permanent boost.
+ANNOT_DISPLACED = f"{GROUP}/displaced"
+DISPLACED_NODE_LOSS = "node-loss"
+DISPLACED_DRAIN_MIGRATE = "drain-migrate"
+
+# Migration request, stamped on a pod by the drain-then-migrate plane
+# (partitioning/core/failure.py) when its host is suspected of failing
+# or marked for maintenance.  Value is the cause.  cmd/train.py reads
+# it back at each checkpoint (the dp-resize hook's sibling) and exits
+# cleanly at the durable point, so reschedule resumes from the
+# checkpoint instead of losing the run; pods that never exit are
+# evicted after the migrate grace.
+ANNOT_MIGRATE = f"{GROUP}/migrate"
+
+# Maintenance signal: the operator stamps a node to request
+# drain-then-migrate ahead of planned work (the predicted-failure
+# sibling of heartbeat suspicion).  Value is free-form (the reason).
+ANNOT_MAINTENANCE = f"{GROUP}/maintenance"
+
+# Node-agent liveness heartbeat: the agent's reporter stamps a
+# monotonic per-process counter on every report, so the failure
+# detector (partitioning/core/failure.py) can distinguish a wedged or
+# dead agent (value frozen) from a healthy one whose geometry simply
+# is not changing — a no-op status re-write emits no event on a real
+# apiserver, so annotation churn alone is not a liveness signal.
+# Keyed per profile family ("slice" / "timeshare") like the plan
+# handshake: a hybrid host runs BOTH agents, and a shared key would
+# let the live one mask its dead sibling forever.
+ANNOT_AGENT_HEARTBEAT_PREFIX = f"{GROUP}/agent-heartbeat"
+
+
+def heartbeat_annotation(family: str = "slice") -> str:
+    return f"{ANNOT_AGENT_HEARTBEAT_PREFIX}.{family}"
 
 # Requests-in-flight load signal for a serving replica, self-reported by
 # the replica (the downward-API annotation pattern ANNOT_JOB_PROGRESS

@@ -19,7 +19,9 @@ from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_control
 from nos_tpu.partitioning.state import ClusterState
 from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
 from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
-from nos_tpu.scheduler.framework import Framework, NodeResourcesFit
+from nos_tpu.scheduler.framework import (
+    Framework, MigrationDrainGuard, NodeResourcesFit, SpareGuard,
+)
 from nos_tpu.scheduler.gang import TopologyFilter
 from nos_tpu.scheduler.scheduler import Scheduler
 
@@ -56,7 +58,10 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
                 defrag_enabled=cfg.defrag_enabled,
                 defrag_payback_min=cfg.defrag_payback_min,
                 defrag_interval_s=cfg.defrag_interval_s or None,
-                defrag_drain_timeout_s=cfg.defrag_drain_timeout_s)
+                defrag_drain_timeout_s=cfg.defrag_drain_timeout_s,
+                spare_hosts_per_pool=cfg.spare_hosts_per_pool,
+                node_suspect_after_s=cfg.node_suspect_after_s,
+                migrate_grace_s=cfg.migrate_grace_s)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-slice", ctl.process_if_ready,
@@ -70,7 +75,10 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
                 plan_deadline_s=plan_deadline,
                 replan_epoch_s=replan_epoch,
                 plan_shard_min_hosts=cfg.plan_shard_min_hosts,
-                plan_workers=cfg.plan_workers)
+                plan_workers=cfg.plan_workers,
+                spare_hosts_per_pool=cfg.spare_hosts_per_pool,
+                node_suspect_after_s=cfg.node_suspect_after_s,
+                migrate_grace_s=cfg.migrate_grace_s)
             ctl.bind()
             controllers.append(ctl)
             main.add_loop("partitioner-timeshare", ctl.process_if_ready,
@@ -98,14 +106,18 @@ def build_scheduler(api: APIServer,
                     backfill_remaining_fn=None,
                     backfill_duration_fn=None,
                     elastic_grow_budget_per_cycle: int = 1,
+                    displaced_age_cap_s: float = 300.0,
                     clock=None) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
-    topology + capacity plugins, quota ledger attached to the API."""
+    spare-hold + topology + capacity plugins, quota ledger attached to
+    the API.  SpareGuard runs AFTER NodeResourcesFit so the native
+    prescreen's exact-message contract holds (native_filter.py)."""
     from nos_tpu.quota import TPUResourceCalculator
 
     plugin = CapacityScheduling(TPUResourceCalculator(
         tpu_memory_gb_per_chip, shard_chips_per_host))
-    fw = Framework([NodeResourcesFit(), TopologyFilter(api), plugin])
+    fw = Framework([NodeResourcesFit(), SpareGuard(),
+                    MigrationDrainGuard(), TopologyFilter(api), plugin])
     plugin.set_framework(fw)
     plugin.attach(api)
     kwargs = {} if clock is None else {"clock": clock}
@@ -119,5 +131,6 @@ def build_scheduler(api: APIServer,
         backfill_remaining_fn=backfill_remaining_fn,
         backfill_duration_fn=backfill_duration_fn,
         elastic_grow_budget_per_cycle=elastic_grow_budget_per_cycle,
+        displaced_age_cap_s=displaced_age_cap_s,
         hbm_gb_per_chip=float(tpu_memory_gb_per_chip),
         **kwargs)

@@ -49,7 +49,7 @@ def build_chipagent_main(api: APIServer, cfg: AgentConfig,
             partitioning="timeshare"))
     main = main or Main(f"nos-tpu-chipagent-{cfg.node_name}",
                         cfg.health_probe_addr, api=api)
-    agent = ChipAgent(api, cfg.node_name)
+    agent = ChipAgent(api, cfg.node_name, heartbeat=cfg.heartbeat)
     agent.start()  # raises on slice nodes (the gpuagent guard)
     main.add_loop("chipagent", agent.tick, cfg.report_interval_s)
     if cfg.kubeconfig:

@@ -38,7 +38,9 @@ def main(argv=None) -> int:
         drain_preempt_max_busy_fraction=cfg.drain_preempt_max_busy_fraction,
         drain_preempt_spare_progress=cfg.drain_preempt_spare_progress,
         shard_chips_per_host=cfg.shard_chips_per_host,
-        preempt_budget_per_cycle=cfg.preempt_budget_per_cycle)
+        preempt_budget_per_cycle=cfg.preempt_budget_per_cycle,
+        elastic_grow_budget_per_cycle=cfg.elastic_grow_budget_per_cycle,
+        displaced_age_cap_s=cfg.displaced_age_cap_s)
     m = Main("nos-tpu-scheduler", cfg.health_probe_addr, api=api)
     if cfg.leader_election:
         from nos_tpu.kube.leaderelection import LeaderElector

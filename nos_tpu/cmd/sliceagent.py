@@ -110,7 +110,8 @@ def build_agent_main(api: APIServer, cfg: AgentConfig,
                 "kubelet device-plugins dir %s missing: slice resources "
                 "will not be advertised to the kubelet", PLUGINS_DIR)
     agent = SliceAgent(api, cfg.node_name, runtime, pod_resources,
-                       plugin_manager=plugin_manager)
+                       plugin_manager=plugin_manager,
+                       heartbeat=cfg.heartbeat)
     if plugin_manager is not None:
         main.add_shutdown_hook(plugin_manager.stop)
     agent.start()  # startup cleanup + first report (migagent.go:190-199)

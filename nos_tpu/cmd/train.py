@@ -157,22 +157,26 @@ def boot_world_size(environ=None) -> int:
     return max(1, len(hosts))
 
 
-def read_resize_signal(api, name: str, namespace: str) -> int | None:
-    """The `nos.tpu/dp-resize` annotation on this workload's own Pod —
-    stamped by the elastic grow/shrink machinery (scheduler/elastic.py)
-    with the gang's NEW dp replica count.  None when absent/garbage
-    (no resize requested, or the contract is malformed — either way the
-    job keeps training).  Best-effort like the progress write: a read
-    failure must never kill a training step."""
-    from nos_tpu.api.constants import ANNOT_DP_RESIZE
+def _fetch_own_pod(api, name: str, namespace: str, what: str):
+    """This workload's own Pod object, or None when unreadable — a
+    signal read failure must never kill a training step."""
     from nos_tpu.kube.client import KIND_POD
 
     try:
-        pod = api.try_get(KIND_POD, name, namespace)
+        return api.try_get(KIND_POD, name, namespace)
     except Exception:  # noqa: BLE001 — advisory read
-        logger.warning("dp-resize read failed for %s/%s",
-                       namespace, name, exc_info=True)
+        logger.warning("%s read failed for %s/%s",
+                       what, namespace, name, exc_info=True)
         return None
+
+
+def _parse_resize(pod) -> int | None:
+    """The `nos.tpu/dp-resize` annotation — stamped by the elastic
+    grow/shrink machinery (scheduler/elastic.py) with the gang's NEW dp
+    replica count.  None when absent/garbage (no resize requested, or
+    the contract is malformed — either way the job keeps training)."""
+    from nos_tpu.api.constants import ANNOT_DP_RESIZE
+
     if pod is None:
         return None
     raw = pod.metadata.annotations.get(ANNOT_DP_RESIZE, "")
@@ -183,10 +187,38 @@ def read_resize_signal(api, name: str, namespace: str) -> int | None:
     return value if value >= 1 else None
 
 
-def resize_checker(cfg: TrainConfig, environ=None):
-    """Build the per-checkpoint resize probe, or None when pod identity
-    / cluster access is unavailable (same downward-API contract as
-    progress_reporter — the two hooks ride the same checkpoint)."""
+def _parse_migrate(pod) -> str | None:
+    """The `nos.tpu/migrate` annotation — stamped by drain-then-migrate
+    (partitioning/core/failure.py) when the host is suspected of
+    failing or marked for maintenance.  The value is the cause; None
+    when absent (the eviction fallback still fires after the grace)."""
+    from nos_tpu.api.constants import ANNOT_MIGRATE
+
+    if pod is None:
+        return None
+    return pod.metadata.annotations.get(ANNOT_MIGRATE, "") or None
+
+
+def read_resize_signal(api, name: str, namespace: str) -> int | None:
+    return _parse_resize(_fetch_own_pod(api, name, namespace,
+                                        "dp-resize"))
+
+
+def read_migrate_signal(api, name: str, namespace: str) -> str | None:
+    return _parse_migrate(_fetch_own_pod(api, name, namespace,
+                                         "migrate-signal"))
+
+
+def _probe_identity(cfg: TrainConfig, environ, hook: str):
+    """Shared (api, name, namespace) for the per-checkpoint pod hooks,
+    or None when the hook must stay inert.  Identity comes from the
+    downward API (`POD_NAME`/`POD_NAMESPACE` env, the standard fieldRef
+    projection — deploy/train.yaml wires it); the API substrate comes
+    from the config's kubeconfig (production).  Both env vars or
+    nothing: a partially-projected downward API (POD_NAME without
+    POD_NAMESPACE) must stay inert rather than touch <name> in a
+    guessed namespace — a same-named pod there would inherit this
+    job's progress and be wrongly spared from drain preemption."""
     import os
 
     env = environ if environ is not None else os.environ
@@ -198,44 +230,43 @@ def resize_checker(cfg: TrainConfig, environ=None):
 
     try:
         api = build_api(cfg)
-    except Exception:  # noqa: BLE001 — advisory hook, like the
-        # progress reporter: the job just never sees resize requests
-        logger.warning("resize checker disabled: kubeconfig %s "
-                       "unusable", cfg.kubeconfig, exc_info=True)
+    except Exception:  # noqa: BLE001 — advisory hooks: a malformed
+        # kubeconfig must not kill the training job at startup; the
+        # job just loses the signal (progress errs toward being spared
+        # less, resize/migrate fall to the eviction path)
+        logger.warning("%s disabled: kubeconfig %s unusable",
+                       hook, cfg.kubeconfig, exc_info=True)
         return None
-    return lambda: read_resize_signal(api, name, namespace)
+    return api, name, namespace
+
+
+def signal_checker(cfg: TrainConfig, environ=None):
+    """Build THE per-checkpoint control-signal probe — () -> (desired
+    dp replica count or None, migration cause or None) — or None when
+    pod identity / cluster access is unavailable.  Both signals ride
+    one API client and ONE pod read per landed checkpoint; building
+    separate probes would double the apiserver load fleet-wide for two
+    annotations on the same object."""
+    ident = _probe_identity(cfg, environ, "signal checker")
+    if ident is None:
+        return None
+    api, name, namespace = ident
+
+    def probe() -> tuple[int | None, str | None]:
+        pod = _fetch_own_pod(api, name, namespace, "control-signal")
+        return _parse_resize(pod), _parse_migrate(pod)
+
+    return probe
 
 
 def progress_reporter(cfg: TrainConfig, environ=None):
     """Build the per-checkpoint progress callback, or None when the pod
-    identity is unavailable.  Identity comes from the downward API
-    (`POD_NAME`/`POD_NAMESPACE` env, the standard fieldRef projection —
-    deploy/train.yaml wires it); the API substrate comes from the
-    config's kubeconfig (production) — without one there is no cluster
-    to annotate and the hook stays inert."""
-    import os
-
-    env = environ if environ is not None else os.environ
-    name = env.get("POD_NAME", "")
-    namespace = env.get("POD_NAMESPACE", "")
-    # both or nothing: a partially-projected downward API (POD_NAME
-    # without POD_NAMESPACE) must stay inert rather than annotate
-    # <name> in a guessed namespace — a same-named pod there would
-    # inherit this job's progress and be wrongly spared from drain
-    # preemption
-    if not name or not namespace or not cfg.kubeconfig:
+    identity is unavailable (_probe_identity documents the downward-API
+    contract)."""
+    ident = _probe_identity(cfg, environ, "progress reporter")
+    if ident is None:
         return None
-    from nos_tpu.cmd._runtime import build_api
-
-    try:
-        api = build_api(cfg)
-    except Exception:  # noqa: BLE001 — advisory hook: a malformed
-        # kubeconfig must not kill the training job at startup; the
-        # scheduler just loses the progress signal, which only errs
-        # toward sparing this job less
-        logger.warning("progress reporter disabled: kubeconfig %s "
-                       "unusable", cfg.kubeconfig, exc_info=True)
-        return None
+    api, name, namespace = ident
     return lambda fraction: report_job_progress(api, name, namespace,
                                                 fraction)
 
@@ -295,24 +326,38 @@ def build(cfg: TrainConfig):
 
 
 def train(cfg: TrainConfig, progress_cb=None,
-          resize_cb=None) -> float | None:
+          resize_cb=None, migrate_cb=None) -> float | None:
     """Run the loop; returns the final loss, or None when the checkpoint
     already covers every requested step (nothing to do).  `progress_cb`
     (fraction in [0, 1], called after each landed checkpoint) defaults
     to the downward-API pod annotation reporter when available.
 
     `resize_cb` (no args -> desired dp replica count or None, probed
-    after each landed checkpoint) defaults to the dp-resize annotation
-    reader: when the elastic machinery resized this job's gang, the
-    loop exits cleanly AT THE CHECKPOINT — the restart re-derives its
-    mesh from the new worker set and resumes, so a resize costs one
-    checkpoint restart and zero lost steps (docs/performance.md,
-    "Malleable gangs")."""
+    after each landed checkpoint): when the elastic machinery resized
+    this job's gang, the loop exits cleanly AT THE CHECKPOINT — the
+    restart re-derives its mesh from the new worker set and resumes,
+    so a resize costs one checkpoint restart and zero lost steps
+    (docs/performance.md, "Malleable gangs").
+
+    `migrate_cb` (no args -> migration cause or None, probed after each
+    landed checkpoint): when drain-then-migrate asked this job to move
+    off a suspect/maintenance host, the loop exits cleanly AT THE
+    CHECKPOINT — snapshot → reschedule → resume, instead of eviction
+    mid-step (docs/scheduler.md, "Self-healing node-loss recovery").
+
+    When neither is injected, both default to ONE combined
+    `signal_checker` probe: one API client, one pod read per landed
+    checkpoint serving both annotations."""
 
     if progress_cb is None:
         progress_cb = progress_reporter(cfg)
-    if resize_cb is None:
-        resize_cb = resize_checker(cfg)
+    if resize_cb is None and migrate_cb is None:
+        signal_cb = signal_checker(cfg)
+    else:
+        # injected probes (tests / embedders) keep their own reads
+        _r, _m = resize_cb, migrate_cb
+        signal_cb = lambda: (_r() if _r else None,  # noqa: E731
+                             _m() if _m else None)
     world = boot_world_size()
     trainer, loader, checkpointer, state, start_step = build(cfg)
     if start_step >= cfg.steps:
@@ -365,8 +410,8 @@ def train(cfg: TrainConfig, progress_cb=None,
                     # backing it: report AFTER the save lands, never
                     # before
                     progress_cb(step / cfg.steps)
-                if resize_cb is not None:
-                    desired = resize_cb()
+                if signal_cb is not None:
+                    desired, cause = signal_cb()
                     if desired is not None and desired != world:
                         # honor the elastic resize at the durable point:
                         # exit cleanly, the restart re-meshes from the
@@ -375,6 +420,17 @@ def train(cfg: TrainConfig, progress_cb=None,
                             "dp resize requested (%d -> %d workers): "
                             "exiting at checkpoint step %d for re-mesh",
                             world, desired, step)
+                        loss = float(loss_arr)
+                        checkpointer.close()
+                        return loss
+                    if cause:
+                        # honor drain-then-migrate at the durable
+                        # point: this checkpoint IS the snapshot; the
+                        # rescheduled pod resumes it on a healthy host
+                        logger.info(
+                            "migration requested (%s): exiting at "
+                            "checkpoint step %d for reschedule",
+                            cause, step)
                         loss = float(loss_arr)
                         checkpointer.close()
                         return loss

@@ -34,10 +34,18 @@ logger = logging.getLogger(__name__)
 
 class ChipReporter:
     def __init__(self, api: APIServer, node_name: str,
-                 plugin: TimeshareDevicePlugin) -> None:
+                 plugin: TimeshareDevicePlugin,
+                 heartbeat: bool = True) -> None:
         self._api = api
         self._node_name = node_name
         self._plugin = plugin
+        # Liveness heartbeat (see SliceReporter): stamped with each
+        # landed report; nodes that never reported carry no heartbeat
+        # and the failure detector has no signal for them by design.
+        # Gateable (AgentConfig.heartbeat) — the stamp makes every
+        # steady-state report a real write + watch event.
+        self._heartbeat_enabled = heartbeat
+        self._heartbeat = 0
 
     def reconcile(self) -> None:
         node = self._api.get(KIND_NODE, self._node_name)
@@ -72,10 +80,17 @@ class ChipReporter:
                         f"{C.ANNOT_STATUS_PREFIX}{idx}-{profile}-free"] = str(free)
 
         plan_id = plan_id_from_key(self._node_name, applied)
+        heartbeat = ""
+        if self._heartbeat_enabled:
+            self._heartbeat += 1
+            heartbeat = str(self._heartbeat)
 
         def mutate(n: Node) -> None:
             strip_status_annotations(n.metadata.annotations, family="timeshare")
             n.metadata.annotations.update(annotations)
+            if heartbeat:
+                n.metadata.annotations[C.heartbeat_annotation("timeshare")] = \
+                    heartbeat
             if plan_id:
                 n.metadata.annotations[C.status_plan_annotation("timeshare")] = plan_id
 

@@ -35,7 +35,8 @@ from nos_tpu.obs.ledger import ACTUATION as LEDGER_ACTUATION, get_ledger
 from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.partitioning.core import (
     Actuator, Planner, QuarantineList, REASON_ACTUATION,
-    REASON_PLAN_DEADLINE, SnapshotTaker,
+    REASON_PLAN_DEADLINE, REASON_SUSPECT, SnapshotTaker,
+    heal_stray_migration_drains,
 )
 from nos_tpu.partitioning.state import ClusterState
 from nos_tpu.utils.batcher import Batcher
@@ -71,6 +72,7 @@ class PartitionerController:
                  rescan_interval_s: float | None = None,
                  replan_epoch_s: float | None = None,
                  defrag=None,
+                 recovery=None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._api = api
         self._state = cluster_state
@@ -104,6 +106,20 @@ class PartitionerController:
         # disables the plane entirely — decisions byte-identical to a
         # build without it.
         self._defrag = defrag
+        # Self-healing recovery plane (partitioning/core/failure.py):
+        # heartbeat suspicion, warm-spare promotion, drain-then-migrate
+        # — driven per poll, BEFORE the plan path, so a suspect node is
+        # out of the snapshot and a promoted spare is in it by the time
+        # the next plan runs.  None (the factory default with every
+        # knob off) disables the plane entirely.
+        self._recovery = recovery
+        # With the plane disabled, a recovery-enabled predecessor's
+        # migration drains would never be retracted (the enabled plane
+        # adopts or heals its own strays each poll; defrag's sweep
+        # deliberately skips migration drains) — heal them once at the
+        # first poll.  A clean cluster sees no writes, so the
+        # disabled-path byte-identity contract holds.
+        self._stray_migrations_healed = recovery is not None
         self._clock = clock
         self._last_scan = clock()
         # first plan is never deferred: the epoch starts one period ago
@@ -143,6 +159,11 @@ class PartitionerController:
     def process_if_ready(self) -> bool:
         """Poll from the run loop; returns True if a plan cycle ran."""
         self._reconcile_quarantine()
+        if self._recovery is not None:
+            self._recovery.step(self._state.nodes())
+        elif not self._stray_migrations_healed:
+            self._stray_migrations_healed = True
+            heal_stray_migration_drains(self._api, self._kind)
         self._refresh_lagging_journal()
         self._observe_landed_actuations()
         if self._clock() - self._last_plan < self._replan_epoch_s:
@@ -201,8 +222,21 @@ class PartitionerController:
                 p for p in self._api.pods_by_phase(PENDING)
                 if extra_resources_could_help_scheduling(p)
             ]
+        # Warm spares are excluded from demand-driven planning like
+        # quarantined nodes: their pre-carved default geometry must
+        # stay intact for promotion, and the scheduler's SpareGuard
+        # would refuse any pod a plan carved for them anyway.  Hosts
+        # being drain-MIGRATED (maintenance/suspect) are excluded for
+        # the same reason — the MigrationDrainGuard hard-rejects
+        # binds there, so carving demand onto them only buys a
+        # replanning loop.
+        exclude = set(self._quarantine.names())
+        for name, node in self._state.nodes().items():
+            if C.is_warm_spare_labels(node.metadata.labels) \
+                    or C.is_migration_drain(node.metadata.annotations):
+                exclude.add(name)
         snapshot = self._snapshot_taker.take_snapshot(
-            self._state, exclude=self._quarantine.names())
+            self._state, exclude=exclude)
         if not snapshot.nodes():
             return False
         # the flight recorder's "where did the repartition budget go"
@@ -347,6 +381,12 @@ class PartitionerController:
                     # window re-opens the breaker
                     self._quarantine.release_for_probe(
                         name, self._plan_deadline_s)
+            elif reason == REASON_SUSPECT:
+                # released by the failure detector when the heartbeat
+                # moves again — a wedged agent's spec==status trivially
+                # (it wrote nothing new), so a caught-up report must
+                # not release it here
+                pass
             elif self._node_reported(node):
                 self._lag_since.pop(name, None)
                 self._quarantine.unquarantine(name)

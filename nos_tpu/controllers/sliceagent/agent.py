@@ -27,7 +27,7 @@ class SliceAgent:
     def __init__(self, api: APIServer, node_name: str,
                  runtime: TpuRuntimeClient,
                  pod_resources: PodResourcesClient,
-                 plugin_manager=None) -> None:
+                 plugin_manager=None, heartbeat: bool = True) -> None:
         self.api = api
         self.node_name = node_name
         self.runtime = runtime
@@ -36,7 +36,8 @@ class SliceAgent:
         self.shared = SharedState()
         self.plugin = DevicePluginClient(api, node_name, runtime,
                                          manager=plugin_manager)
-        self.reporter = SliceReporter(api, node_name, self.client, self.shared)
+        self.reporter = SliceReporter(api, node_name, self.client, self.shared,
+                                      heartbeat=heartbeat)
         self.actuator = SliceActuator(api, node_name, self.client, self.shared,
                                       self.plugin)
         # kubelet sim (in-memory substrate only): device-backed admission,

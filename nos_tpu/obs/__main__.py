@@ -323,8 +323,11 @@ def _waste_culprit(journal: list[dict], category: str,
             {"class": evidence["class"],
              "rejected_nodes": evidence.get("rejected_nodes", "?")}]
         cls = str(ranked[0].get("class", evidence["class"]))
+        displaced = (f" (displaced: {evidence['displaced_cause']})"
+                     if evidence.get("displaced_cause") else "")
         lines.append(f"culprit class {cls}: rejected on "
-                     f"{evidence.get('rejected_nodes', '?')} node(s)")
+                     f"{evidence.get('rejected_nodes', '?')} "
+                     f"node(s){displaced}")
         for row in ranked[1:]:
             lines.append(
                 f"also stranding: class {row.get('class', '?')} "
@@ -364,6 +367,10 @@ def _waste_culprit(journal: list[dict], category: str,
         gang = str(evidence["gang"])
         verb = ("assembly stalled" if category == "gang_wait"
                 else "window bought by drain eviction")
+        if evidence.get("displaced_cause"):
+            # a displaced victim failing to rebind is a recovery
+            # problem, not ordinary gang assembly — name the kill
+            verb += f" (displaced: {evidence['displaced_cause']})"
         lines.append(f"culprit gang {gang}: {verb}")
         rec = _newest(journal, J.GANG_REJECTED, subject=gang)
         if rec is not None:

@@ -30,7 +30,14 @@ def _pod_records(journal: list[dict], key: str) -> list[dict]:
     out, seen, gangs = [], set(), set()
     for rec in journal:
         attrs = rec.get("attrs", {})
-        if rec["subject"] == key or key in attrs.get("members", ()):
+        # membership only against a LIST-typed members attr: a record
+        # carrying a members COUNT (the members_total convention, but
+        # guard against future drift) must not crash the flight
+        # recorder for every pod in the journal
+        members = attrs.get("members", ())
+        if not isinstance(members, (list, tuple)):
+            members = ()
+        if rec["subject"] == key or key in members:
             out.append(rec)
             seen.add(rec["seq"])
             if attrs.get("gang"):

@@ -83,6 +83,15 @@ DEFRAG_PROPOSED = "defrag-proposed"
 DEFRAG_APPLIED = "defrag-applied"
 DEFRAG_REJECTED = "defrag-rejected"
 GANG_RESIZED = "gang-resized"
+# Self-healing node-loss recovery (partitioning/core/failure.py +
+# scheduler displaced head-of-line): a workload is DISPLACED when node
+# loss / a drain-migration evicts it (cause + node recorded), REBOUND
+# when the scheduler re-binds it (rebind latency from the displacement
+# stamp); SPARE_PROMOTED records a warm spare taking over a vanished
+# host's index.
+JOB_DISPLACED = "job-displaced"
+JOB_REBOUND = "job-rebound"
+SPARE_PROMOTED = "spare-promoted"
 
 
 class DecisionRecord:

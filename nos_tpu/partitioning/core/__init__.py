@@ -13,8 +13,11 @@ from .parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from .pools import PlanPool, partition_pools, split_pods
 from .actuator import GeometryActuator, new_plan_id
 from .defrag import DefragProposer
+from .failure import (
+    SelfHealingPolicy, heal_stray_migration_drains, is_warm_spare,
+)
 from .quarantine import (
-    QuarantineList, REASON_ACTUATION, REASON_PLAN_DEADLINE,
+    QuarantineList, REASON_ACTUATION, REASON_PLAN_DEADLINE, REASON_SUSPECT,
 )
 
 __all__ = [
@@ -26,4 +29,6 @@ __all__ = [
     "ParallelGeometryPlanner", "PLAN_SHARD_MIN_HOSTS",
     "PlanPool", "partition_pools", "split_pods",
     "QuarantineList", "REASON_ACTUATION", "REASON_PLAN_DEADLINE",
+    "REASON_SUSPECT", "SelfHealingPolicy", "heal_stray_migration_drains",
+    "is_warm_spare",
 ]

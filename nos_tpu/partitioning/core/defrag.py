@@ -741,9 +741,15 @@ class DefragProposer:
             # drain only weakens refill avoidance; cleanup() heals it
             logger.debug("defrag drain stamp failed for %s", host)
 
-    def _clear_drain(self, host: str) -> None:
+    def _clear_drain(self, host: str, owned_value: str) -> None:
+        """Pop the drain only while it still holds `owned_value` — a
+        migration drain (failure.py) that superseded our stamp on a
+        host that started dying mid-proposal must survive our cleanup,
+        or the scheduler would refill a presumed-dying host."""
         def mutate(node: Any) -> None:
-            node.metadata.annotations.pop(C.ANNOT_DEFRAG_DRAIN, None)
+            if node.metadata.annotations.get(
+                    C.ANNOT_DEFRAG_DRAIN) == owned_value:
+                node.metadata.annotations.pop(C.ANNOT_DEFRAG_DRAIN, None)
 
         try:
             retry_on_conflict(self._api, KIND_NODE, host, mutate,
@@ -763,11 +769,18 @@ class DefragProposer:
         self._healed = True
         owned = {pid for pid in self._active}
         for node in self._api.list(KIND_NODE):
+            if C.is_migration_drain(node.metadata.annotations):
+                # the recovery plane's drain (failure.py) — never ours
+                # to heal: an enabled policy adopts or retracts its own
+                # strays every poll, and a recovery-DISABLED controller
+                # heals them once at startup
+                # (heal_stray_migration_drains)
+                continue
             value = node.metadata.annotations.get(C.ANNOT_DEFRAG_DRAIN)
             if value and value not in owned:
                 logger.info("defrag[%s]: healing stray drain %s on %s",
                             self._kind, value, node.metadata.name)
-                self._clear_drain(node.metadata.name)
+                self._clear_drain(node.metadata.name, value)
                 get_ledger().clear_hold(node.metadata.name,
                                         LEDGER_DRAIN, owner=self._owner)
 
@@ -792,7 +805,7 @@ class DefragProposer:
             if not drained and now < deadline:
                 continue
             for host in hosts:
-                self._clear_drain(host)
+                self._clear_drain(host, pid)
                 ledger.clear_hold(host, LEDGER_DRAIN, owner=self._owner)
             del self._active[pid]
             if not drained:

@@ -42,6 +42,13 @@ logger = logging.getLogger(__name__)
 
 REASON_PLAN_DEADLINE = "plan-deadline"
 REASON_ACTUATION = "actuation-failures"
+# Missed-heartbeat suspicion (partitioning/core/failure.py): the
+# failure detector quarantines a node whose agent heartbeat froze and
+# releases it itself the moment the heartbeat moves — the controller's
+# report-caught-up release path deliberately skips this reason (a
+# wedged agent's spec==status trivially, so a caught-up report proves
+# nothing).
+REASON_SUSPECT = "heartbeat-suspect"
 
 DEFAULT_FAILURE_THRESHOLD = 3
 

@@ -9,7 +9,9 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import DefragProposer, GeometryActuator, QuarantineList
+from ..core import (
+    DefragProposer, GeometryActuator, QuarantineList, SelfHealingPolicy,
+)
 from ..core.parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from ..state import ClusterState
 from .calculators import SlicePartitionCalculator, SliceProfileCalculator
@@ -31,6 +33,9 @@ def new_slice_partitioner_controller(
     defrag_interval_s: float | None = None,
     defrag_drain_timeout_s: float = 120.0,
     defrag_progress_fn=None,
+    spare_hosts_per_pool: int = 0,
+    node_suspect_after_s: float = 0.0,
+    migrate_grace_s: float = 5.0,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
@@ -73,12 +78,24 @@ def new_slice_partitioner_controller(
                         else (replan_epoch_s or batch_idle_s)),
             drain_timeout_s=defrag_drain_timeout_s,
             progress_fn=defrag_progress_fn, **kwargs)
+    # Self-healing recovery plane (partitioning/core/failure.py):
+    # opt-in like defrag — with both knobs at 0 it is never
+    # constructed, so decisions stay byte-identical to a build
+    # without the plane.
+    recovery = None
+    if spare_hosts_per_pool > 0 or node_suspect_after_s > 0:
+        recovery = SelfHealingPolicy(
+            api, SLICE_KIND, quarantine,
+            spare_hosts_per_pool=spare_hosts_per_pool,
+            suspect_after_s=node_suspect_after_s,
+            migrate_grace_s=migrate_grace_s, **kwargs)
     return PartitionerController(
         api=api, cluster_state=cluster_state, kind=SLICE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=SliceSnapshotTaker(), batcher=batcher,
         quarantine=quarantine, plan_deadline_s=plan_deadline_s,
-        replan_epoch_s=replan_epoch_s, defrag=defrag, **kwargs,
+        replan_epoch_s=replan_epoch_s, defrag=defrag,
+        recovery=recovery, **kwargs,
     )
 
 

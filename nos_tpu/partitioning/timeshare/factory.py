@@ -9,7 +9,9 @@ from nos_tpu.kube.client import APIServer
 from nos_tpu.scheduler.framework import Framework
 from nos_tpu.utils.batcher import Batcher
 
-from ..core import GeometryActuator, GeometryPlanner, QuarantineList
+from ..core import (
+    GeometryActuator, GeometryPlanner, QuarantineList, SelfHealingPolicy,
+)
 from ..core.parallel import PLAN_SHARD_MIN_HOSTS, ParallelGeometryPlanner
 from ..state import ClusterState
 from .calculators import TimesharePartitionCalculator, TimeshareProfileCalculator
@@ -29,6 +31,9 @@ def new_timeshare_partitioner_controller(
     replan_epoch_s: float | None = None,
     plan_shard_min_hosts: int = PLAN_SHARD_MIN_HOSTS,
     plan_workers: int = 0,
+    spare_hosts_per_pool: int = 0,
+    node_suspect_after_s: float = 0.0,
+    migrate_grace_s: float = 5.0,
     clock=None,
 ):
     from nos_tpu.controllers.partitioner_controller import PartitionerController
@@ -54,10 +59,19 @@ def new_timeshare_partitioner_controller(
         TimesharePartitioner(api, cm_name, cm_namespace),
         partition_calculator, quarantine=quarantine)
     batcher = Batcher(batch_timeout_s, batch_idle_s, **kwargs)
+    # Self-healing recovery (partitioning/core/failure.py): opt-in —
+    # both knobs at 0 never constructs it (byte-identical decisions).
+    recovery = None
+    if spare_hosts_per_pool > 0 or node_suspect_after_s > 0:
+        recovery = SelfHealingPolicy(
+            api, TIMESHARE_KIND, quarantine,
+            spare_hosts_per_pool=spare_hosts_per_pool,
+            suspect_after_s=node_suspect_after_s,
+            migrate_grace_s=migrate_grace_s, **kwargs)
     return PartitionerController(
         api=api, cluster_state=cluster_state, kind=TIMESHARE_KIND,
         planner=planner, actuator=actuator,
         snapshot_taker=TimeshareSnapshotTaker(), batcher=batcher,
         quarantine=quarantine, plan_deadline_s=plan_deadline_s,
-        replan_epoch_s=replan_epoch_s, **kwargs,
+        replan_epoch_s=replan_epoch_s, recovery=recovery, **kwargs,
     )

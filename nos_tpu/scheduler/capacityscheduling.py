@@ -41,7 +41,8 @@ from nos_tpu.scheduler.framework import (
     CycleState, Framework, NodeInfo, SharedLister, Status,
 )
 from nos_tpu.utils.pod_util import (
-    elastic_replica_bounds, is_over_quota, tier_rank, workload_tier,
+    elastic_replica_bounds, is_displaced_fresh, is_over_quota,
+    job_progress, tier_rank, workload_tier,
 )
 
 logger = logging.getLogger(__name__)
@@ -53,6 +54,11 @@ REGISTRY.describe("nos_tpu_preemption_victims_total",
 
 PRE_FILTER_STATE_KEY = "PreFilterCapacityScheduling"
 ELASTIC_QUOTA_SNAPSHOT_KEY = "ElasticQuotaSnapshot"
+# (now, displaced_age_cap_s) the scheduler stashes before PostFilter so
+# the restart-cost victim walk judges "displaced" with the SAME
+# freshness rule as the admission queue (pod_util.is_displaced_fresh);
+# absent (plugin driven directly) the stamp never expires.
+DISPLACED_CONTEXT_KEY = "DisplacedPreemptorContext"
 
 
 class PreFilterState:
@@ -562,12 +568,34 @@ class CapacityScheduling:
 
         from nos_tpu.scheduler.gang import gang_name
 
+        # Restart-cost-aware walk for a DISPLACED preemptor
+        # (docs/scheduler.md): when the pod making room is itself a
+        # node-loss/migration victim, equal-tier equal-priority victims
+        # are walked least-job-progress first — evicting a fresh job
+        # loses nothing, evicting a nearly-done one wastes its whole
+        # run, and the displaced gang already lost one run.  Gated on
+        # the preemptor's displacement stamp so every non-displaced
+        # walk stays byte-identical; eligibility branches are untouched
+        # (order only), preserving victim_prescreen's superset
+        # contract.  "Displaced" is the admission queue's definition
+        # (is_displaced_fresh): a stamp past the age cap lost its
+        # head-of-line slot, so it must not keep the altered victim
+        # order either, and a serving preemptor never had the slot.
+        disp_now, disp_cap = state.get(DISPLACED_CONTEXT_KEY,
+                                       (0.0, 0.0))
+        displaced_preemptor = is_displaced_fresh(pod, disp_now,
+                                                 disp_cap)
+
+        def _restart_cost(p: Pod) -> float:
+            return job_progress(p) if displaced_preemptor else 0.0
+
         node_pods = sorted(
             (p for p in ni.pods
              if workload_tier(p) != C.TIER_SERVING
              or is_over_quota(p)),
             key=lambda p: (0 if _shrink_headroom(p) > 0 else 1,
                            -tier_rank(p), p.spec.priority,
+                           _restart_cost(p),
                            -p.metadata.creation_timestamp))
         def select(pv: Pod) -> None:
             """Take `pv` as a potential victim, consuming its gang's
@@ -665,10 +693,14 @@ class CapacityScheduling:
         # tier key here the reprieve pass silently undoes the
         # tier-ordered walk above.  Shrink victims reprieve LAST for
         # the same reason: they are the cheapest rung, so they must be
-        # the last deaths undone.
+        # the last deaths undone.  The displaced-preemptor restart-cost
+        # key mirrors into the reprieve too (most-progress reprieved
+        # first), or the reprieve would silently undo the
+        # least-progress-first walk exactly like the tier key story.
         _shrunk = shrink_out or set()
         by_prio = lambda p: (p.metadata.uid in _shrunk,  # noqa: E731
                              tier_rank(p), -p.spec.priority,
+                             -_restart_cost(p),
                              p.metadata.creation_timestamp)
         for pv in sorted(violating, key=by_prio):
             if not reprieve(pv):

@@ -116,6 +116,11 @@ def clone_member_for_grow(template: Pod, name: str,
     pod.metadata.labels.pop(C.LABEL_UNSCHEDULABLE_CLASS, None)
     pod.metadata.annotations.pop(C.ANNOT_JOB_PROGRESS, None)
     pod.metadata.annotations.pop(C.ANNOT_DP_RESIZE, None)
+    # a grown replica is NEW work: it must not inherit a template's
+    # displaced head-of-line claim (or a displaced elastic gang would
+    # mint queue-jumping clones until its max)
+    pod.metadata.annotations.pop(C.ANNOT_DISPLACED, None)
+    pod.metadata.annotations.pop(C.ANNOT_MIGRATE, None)
     pod.spec.node_name = ""
     pod.status.phase = PENDING
     pod.status.conditions = []

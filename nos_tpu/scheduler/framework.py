@@ -16,7 +16,9 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterable, Protocol, runtime_checkable
 
-from nos_tpu.api.constants import LABEL_POD_GROUP
+from nos_tpu.api.constants import (
+    LABEL_POD_GROUP, is_migration_drain, is_warm_spare_labels,
+)
 from nos_tpu.kube.objects import Node, Pod
 from nos_tpu.kube.resources import (
     ResourceList, fits, pod_request, subtract, sum_resources,
@@ -251,6 +253,55 @@ class NodeResourcesFit:
         return Status.unschedulable(
             f"insufficient {', '.join(sorted(missing))}"
         )
+
+
+# ---------------------------------------------------------------------------
+# Built-in plugin: SpareGuard
+# ---------------------------------------------------------------------------
+
+
+class SpareGuard:
+    """A host labeled ``nos.tpu/spare: "warm"`` is a pre-carved warm
+    replacement (docs/scheduler.md, "Self-healing node-loss recovery"):
+    it accepts NO pods until the spare policy promotes it by removing
+    the label.  Registered as a plain Filter so every placement path —
+    the cycle loop, gang what-ifs, preemption what-ifs, the elastic
+    grow probe — respects the hold without per-call-site checks.  With
+    no spare labels in the cluster the plugin rejects nothing and every
+    decision (and journal message) is byte-identical to a build without
+    it.  Runs AFTER NodeResourcesFit so the native prescreen's
+    exact-message contract (native_filter.py `message_exact`) is
+    untouched."""
+
+    name = "SpareGuard"
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        if is_warm_spare_labels(node_info.node.metadata.labels):
+            return Status.unschedulable("node held as warm spare")
+        return Status.ok()
+
+
+class MigrationDrainGuard:
+    """A node being drain-migrated (``nos.tpu/defrag-drain`` with a
+    ``migrate:`` value — stamped by partitioning/core/failure.py on a
+    suspect or maintenance host) accepts no NEW pods: its agent is
+    presumed dying, so anything bound there would be admitted by
+    nobody and lost with the host.  This is deliberately HARDER than a
+    defrag drain (same annotation, proposal-id value), which stays a
+    soft score-key avoidance — a defrag'd host is healthy and refusing
+    it outright would shrink the fleet for a mere optimization.  With
+    no migration drains the plugin rejects nothing: decisions are
+    byte-identical to a build without it."""
+
+    name = "MigrationDrainGuard"
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        if is_migration_drain(node_info.node.metadata.annotations):
+            return Status.unschedulable(
+                "node draining for migration")
+        return Status.ok()
 
 
 # ---------------------------------------------------------------------------

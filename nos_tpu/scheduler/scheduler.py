@@ -19,6 +19,7 @@ from typing import Any, Callable
 
 from nos_tpu.api.constants import (
     ANNOT_DEFRAG_DRAIN as C_ANNOT_DEFRAG_DRAIN,
+    ANNOT_DISPLACED as C_ANNOT_DISPLACED,
     ANNOT_GANG_LEASE as C_ANNOT_GANG_LEASE,
     LABEL_ACCELERATOR as C_LABEL_ACCELERATOR,
     LABEL_CHIP_COUNT as C_LABEL_CHIP_COUNT,
@@ -28,6 +29,7 @@ from nos_tpu.api.constants import (
     LABEL_UNSCHEDULABLE_CLASS as C_LABEL_UNSCHEDULABLE_CLASS,
     RESOURCE_TPU,
     TIER_SERVING as C_TIER_SERVING,
+    is_warm_spare_labels,
 )
 from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod, fast_deepcopy
@@ -47,7 +49,9 @@ from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
 from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
-from nos_tpu.utils.pod_util import tier_rank, workload_class, workload_tier
+from nos_tpu.utils.pod_util import (
+    admission_rank, displacement, workload_class, workload_tier,
+)
 from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
@@ -64,6 +68,15 @@ REGISTRY.describe("nos_tpu_schedule_latency_seconds",
                   buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                            0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                            120.0, 240.0, 480.0))
+# Displacement stamp → re-bind latency: the node-loss recovery SLO's
+# histogram (docs/scheduler.md, "Self-healing node-loss recovery").
+# Same batch-scale top buckets as schedule latency — a stranded rebind
+# runs minutes, and the whole point is seeing that tail.
+REGISTRY.describe("nos_tpu_rebind_latency_seconds",
+                  "Displacement stamp to re-bind latency per workload "
+                  "class (gang = last member bound)",
+                  buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0,
+                           30.0, 60.0, 120.0, 240.0, 480.0))
 REGISTRY.describe("nos_tpu_schedule_pending_age_seconds",
                   "Oldest still-pending pod's age per workload class")
 REGISTRY.describe("nos_tpu_schedule_pending_pods",
@@ -143,19 +156,11 @@ def attribute_free_chips(
 def _annotation_progress(pod: Pod) -> float:
     """Default drain-preemption progress source: the workload-reported
     ANNOT_JOB_PROGRESS fraction (absent/garbage/non-finite = 0: nothing
-    to lose)."""
-    import math
+    to lose).  ONE parsing, shared with the displaced-preemptor victim
+    walk (utils/pod_util.job_progress)."""
+    from nos_tpu.utils.pod_util import job_progress
 
-    from nos_tpu.api.constants import ANNOT_JOB_PROGRESS
-
-    raw = pod.metadata.annotations.get(ANNOT_JOB_PROGRESS, "")
-    try:
-        value = float(raw)
-    except ValueError:
-        return 0.0
-    if not math.isfinite(value):
-        return 0.0
-    return min(1.0, max(0.0, value))
+    return job_progress(pod)
 
 
 class Scheduler:
@@ -172,6 +177,7 @@ class Scheduler:
                  backfill_duration_fn: Callable[
                      [Pod], float | None] | None = None,
                  elastic_grow_budget_per_cycle: int = 1,
+                 displaced_age_cap_s: float = 300.0,
                  clock: Callable[[], float] = time.time,
                  hbm_gb_per_chip: float = 16.0) -> None:
         self._api = api
@@ -334,6 +340,16 @@ class Scheduler:
         self._drain_hold_hosts: frozenset[str] = frozenset()
         # timeshare-GB -> chips conversion for productive accounting
         self._hbm_gb_per_chip = hbm_gb_per_chip
+        # Displaced head-of-line (docs/scheduler.md): a pod stamped
+        # ANNOT_DISPLACED ranks in its own admission tier between
+        # serving and batch until the stamp is older than this cap —
+        # the anti-starvation bound that stops an unplaceable displaced
+        # pod from camping the head of the queue (<= 0: no expiry).
+        self._displaced_age_cap_s = displaced_age_cap_s
+        # displaced kill causes for the cycle's waste evidence:
+        # stranding class / stuck gang -> its displacement cause, so
+        # the frag/gang_wait culprit join can name the node-loss victim
+        self._waste_displaced: dict[str, str] = {}
 
     def close(self) -> None:
         """Detach the incremental cache's watch subscriptions.  A
@@ -533,6 +549,7 @@ class Scheduler:
             return None
         self._assume_bound(pod, chosen.name)
         self._observe_schedule_latency([pod])
+        self._observe_rebind([pod])
         return chosen.name
 
     def _filter_equiv_key(self, pod: Pod) -> tuple | None:
@@ -646,17 +663,23 @@ class Scheduler:
         self._waste_frag_chips = {}
         self._waste_quota_blocked = {}
         self._waste_pending_gangs = {}
+        self._waste_displaced = {}
         pods = [
             p for p in self._api.pods_by_phase(PENDING)
             if not p.spec.node_name and p.spec.scheduler_name == self.name
         ]
-        # Tiered admission queue (docs/serving.md): serving pods are
-        # picked FIRST every cycle — before any batch gang, whatever
-        # its PriorityClass — then batch, then best-effort; priority
-        # and FIFO order break ties within a tier.  This is also what
-        # routes the per-cycle preemption budget to the serving tier
-        # under contention: serving pods spend it before batch can.
-        pods.sort(key=lambda p: (tier_rank(p), -p.spec.priority,
+        # Tiered admission queue (docs/serving.md + docs/scheduler.md):
+        # serving pods are picked FIRST every cycle — before any batch
+        # gang, whatever its PriorityClass — then DISPLACED victims of
+        # node loss / drain migration (their own tier, with an
+        # anti-starvation age cap), then batch, then best-effort;
+        # priority and FIFO order break ties within a tier.  This is
+        # also what routes the per-cycle preemption budget under
+        # contention: serving spends it first, displaced rebinds next.
+        now = self._clock()
+        cap = self._displaced_age_cap_s
+        pods.sort(key=lambda p: (admission_rank(p, now, cap),
+                                 -p.spec.priority,
                                  p.metadata.creation_timestamp, p.key))
         # Release the window lease once its gang is no longer waiting;
         # a still-stuck gang re-earns (and may move) it this cycle.
@@ -934,6 +957,7 @@ class Scheduler:
             # gang latency = last member bound, measured from the
             # EARLIEST admission (the gang waited as one unit)
             self._observe_schedule_latency(members)
+            self._observe_rebind(members)
         self._gang_journal(members, True, "gang admitted",
                            bound=bound_members)
         logger.info("gang %s: bound %d pods",
@@ -981,6 +1005,15 @@ class Scheduler:
             return "", Status.unschedulable(
                 "preemption budget for this cycle spent")
         self._preempt_budget -= 1
+        # The restart-cost victim walk judges "displaced" with the
+        # admission queue's freshness rule (pod_util.is_displaced_fresh)
+        # — hand it the same clock + age cap the queue sort used.
+        from nos_tpu.scheduler.capacityscheduling import (
+            DISPLACED_CONTEXT_KEY,
+        )
+
+        state[DISPLACED_CONTEXT_KEY] = (
+            self._clock(), self._displaced_age_cap_s)
         nominated, status = self._framework.run_post_filter_plugins(
             state, pod, lister)
         if status.is_success:
@@ -1529,6 +1562,38 @@ class Scheduler:
         REGISTRY.observe("nos_tpu_schedule_latency_seconds", latency,
                          labels={"class": workload_class(pods[0])})
 
+    def _observe_rebind(self, pods: list[Pod]) -> None:
+        """A displaced scheduling unit just re-bound: observe
+        displacement-stamp→bind latency into the rebind histogram and
+        journal JOB_REBOUND.  Gangs observe once, from the EARLIEST
+        member stamp (the job was down from the first kill) — members
+        bound in earlier cycles had their stamp cleared at bind, so the
+        min runs over whatever stamps this cycle still carries.  Called
+        BEFORE _bind's annotation clear lands in the caller's pod
+        objects (they are this cycle's stale copies)."""
+        stamps = [d for d in (displacement(p) for p in pods)
+                  if d is not None]
+        if not stamps:
+            return
+        cause, ts = min(stamps, key=lambda d: d[1])
+        if ts <= 0.0:
+            return      # fabricated stamp: no honest sample exists
+        latency = self._clock() - ts
+        if latency < 0.0:
+            return      # clock domains disagree
+        REGISTRY.observe("nos_tpu_rebind_latency_seconds", latency,
+                         labels={"class": workload_class(pods[0])})
+        first = pods[0]
+        g = gang_name(first)
+        subject = (f"{first.metadata.namespace}/{g}" if g else first.key)
+        # members_total (the COUNT convention — a `members` attr is
+        # reserved for pod-key lists, which explain's membership match
+        # iterates)
+        journal_record(J.JOB_REBOUND, subject, cause=cause,
+                       latency_s=round(latency, 3),
+                       members_total=len(pods),
+                       **{"class": workload_class(first)})
+
     # -- chip-second waste attribution (obs/ledger.py) ----------------------
     def _clear_drain_holds(self) -> None:
         if not self._drain_hold_hosts:
@@ -1567,6 +1632,12 @@ class Scheduler:
 
         self._waste_rejected_nodes.update(rejections)
         cls = workload_class(pod)
+        disp = displacement(pod)
+        if disp is not None:
+            # the stranded class is a node-loss/migration victim: the
+            # waste evidence must name the kill cause, so `obs waste`
+            # can say "this frag is a displaced gang failing to rebind"
+            self._waste_displaced.setdefault(cls, disp[0])
         self._waste_frag_counts[cls] = max(
             self._waste_frag_counts.get(cls, 0), len(rejections))
         shard = float(getattr(getattr(self._capacity, "calculator", None),
@@ -1593,6 +1664,10 @@ class Scheduler:
                     for m in members)
         self._waste_pending_gangs[key] = max(
             self._waste_pending_gangs.get(key, 0.0), chips)
+        disp = next((d for d in (displacement(m) for m in members)
+                     if d is not None), None)
+        if disp is not None:
+            self._waste_displaced.setdefault(key, disp[0])
 
     def _observe_waste(self, pending_by_class: dict[str, int]) -> None:
         """Cycle end: attribute every chip in the cycle snapshot to ONE
@@ -1648,6 +1723,11 @@ class Scheduler:
                          self._waste_frag_chips.get(c, 0.0), 2)}
                     for c in ranked[:3]],
             }
+            if top in self._waste_displaced:
+                # the stranding class is a displaced victim: name the
+                # kill cause so displaced-wait is distinguishable from
+                # ordinary fragmentation in the waterfall evidence
+                frag_ev["displaced_cause"] = self._waste_displaced[top]
         quota_ev: dict[str, object] | None = None
         if self._waste_quota_blocked:
             top_q = max(self._waste_quota_blocked.items(),
@@ -1661,10 +1741,19 @@ class Scheduler:
             top_g = max(self._waste_pending_gangs.items(),
                         key=lambda kv: kv[1])
             gang_ev = {"gang": top_g[0]}
+        if gang_ev is not None:
+            cause = self._waste_displaced.get(str(gang_ev["gang"]))
+            if cause is not None:
+                gang_ev["displaced_cause"] = cause
 
         pools: dict[str, dict[str, object]] = {}
         for ni in lister.list():
             labels = ni.node.metadata.labels
+            if is_warm_spare_labels(labels):
+                # a warm spare is deliberately-held reserve, not fleet
+                # capacity: outside the waterfall until promoted (its
+                # SpareGuard rejections must not read frag_stranded)
+                continue
             try:
                 cap = float(labels.get(C_LABEL_CHIP_COUNT, "0") or 0.0)
             except ValueError:
@@ -1773,6 +1862,10 @@ class Scheduler:
             # a bound pod is no longer unschedulable: the class label
             # dies with the condition it refines
             p.metadata.labels.pop(C_LABEL_UNSCHEDULABLE_CLASS, None)
+            # the displaced claim is consumed by this bind: a LATER
+            # requeue (quota preemption, drain) is a fresh event and
+            # must not inherit the head-of-line boost
+            p.metadata.annotations.pop(C_ANNOT_DISPLACED, None)
         if not self._patch_pod(pod, mutate):
             return False
         journal_record(J.POD_BOUND, pod.key, node=node_name)

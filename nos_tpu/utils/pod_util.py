@@ -5,6 +5,8 @@ Analog of reference pkg/util/pod/pod.go:31-101.
 
 from __future__ import annotations
 
+import math
+
 from nos_tpu.api import constants as C
 from nos_tpu.kube.objects import PENDING, Pod
 
@@ -41,6 +43,82 @@ def tier_rank(pod: Pod) -> int:
     of any batch gang regardless of PriorityClass arithmetic."""
     return {C.TIER_SERVING: 0, C.TIER_BATCH: 1,
             C.TIER_BEST_EFFORT: 2}[workload_tier(pod)]
+
+
+def displaced_value(cause: str, now: float) -> str:
+    """Render the ``nos.tpu/displaced`` annotation value for a workload
+    displaced at `now` (the stamping clock must share the scheduler's
+    time domain — the rebind latency is clock() minus this stamp)."""
+    return f"{cause}@{now:.3f}"
+
+
+def displacement(pod: Pod) -> tuple[str, float] | None:
+    """(cause, stamped-at) of a displaced pod, or None when the pod is
+    not displaced or the annotation is malformed — a garbage stamp
+    degrades to not-displaced (normal admission rank), never to a
+    permanent head-of-line boost."""
+    raw = pod.metadata.annotations.get(C.ANNOT_DISPLACED, "")
+    if not raw:
+        return None
+    cause, sep, ts_raw = raw.rpartition("@")
+    if not sep or not cause:
+        return None
+    try:
+        ts = float(ts_raw)
+    except ValueError:
+        return None
+    if not math.isfinite(ts):
+        return None
+    return cause, ts
+
+
+def is_displaced_fresh(pod: Pod, now: float = 0.0,
+                       age_cap_s: float = 0.0) -> bool:
+    """THE "counts as displaced" predicate — a batch/best-effort pod
+    carrying an unexpired ``nos.tpu/displaced`` stamp.  Shared by the
+    admission queue's head-of-line slot and capacityscheduling's
+    restart-cost victim walk so the two can never disagree: a pod
+    whose boost expired (stamp older than `age_cap_s` > 0) reads plain
+    batch in BOTH, and a serving pod's stamp alters neither (serving
+    already outranks displaced).  `age_cap_s` <= 0 means no expiry."""
+    if tier_rank(pod) == 0:
+        return False
+    disp = displacement(pod)
+    if disp is None:
+        return False
+    return age_cap_s <= 0.0 or now - disp[1] <= age_cap_s
+
+
+def admission_rank(pod: Pod, now: float = 0.0,
+                   age_cap_s: float = 0.0) -> int:
+    """Admission-queue rank with the displaced head-of-line slot
+    (docs/scheduler.md): serving 0, displaced batch/best-effort 1,
+    batch 2, best-effort 4.  A displaced victim of node loss or a
+    drain-migration rebinds ahead of the whole batch backlog but never
+    ahead of serving; once its stamp is older than `age_cap_s` (> 0)
+    the boost expires — an unplaceable displaced pod must not camp the
+    head of the queue forever.  `age_cap_s` <= 0 means no expiry.
+    With no displaced pods this is a monotone transform of
+    ``tier_rank`` — the sort order is byte-identical."""
+    rank = 2 * tier_rank(pod)
+    if rank >= 2 and is_displaced_fresh(pod, now, age_cap_s):
+        rank = 1
+    return rank
+
+
+def job_progress(pod: Pod) -> float:
+    """The workload-reported ``nos.tpu/job-progress`` fraction in
+    [0, 1] (absent/garbage/non-finite = 0: nothing to lose) — the
+    restart-cost signal drain preemption and the displaced-preemptor
+    victim walk key on."""
+    raw = pod.metadata.annotations.get(C.ANNOT_JOB_PROGRESS, "")
+    try:
+        value = float(raw)
+    except ValueError:
+        return 0.0
+    if not math.isfinite(value):
+        return 0.0
+    return min(1.0, max(0.0, value))
 
 
 def workload_class(pod: Pod) -> str:

@@ -68,6 +68,13 @@ if ! env JAX_PLATFORMS=cpu python bench_serving.py --smoke \
     rc=1
 fi
 
+echo "==> bench_nodeloss.py --smoke (node-loss gate: never_rebound = 0, rebind p90 bound, lost chip-seconds halved, disabled byte-identity)"
+if ! env JAX_PLATFORMS=cpu python bench_nodeloss.py --smoke \
+        --nodeloss-report "${NODELOSS_REPORT_PATH:-/tmp/nos_tpu_nodeloss_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
 echo "==> bench_defrag.py --smoke (defrag gate: utilization floor, frag halving, churn bound, disabled byte-identity)"
 if ! env JAX_PLATFORMS=cpu python bench_defrag.py --smoke \
         --defrag-report "${DEFRAG_REPORT_PATH:-/tmp/nos_tpu_defrag_report.json}" \

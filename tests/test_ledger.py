@@ -103,6 +103,60 @@ class TestConservationProperty:
         assert report["overcommit_events"] == 1
         assert conservation_ok(report)
 
+    @pytest.mark.parametrize("seed", range(8))
+    def test_node_kill_transitions_conserve_exactly(self, seed):
+        """Node-loss property (ISSUE 15): pools randomly LOSE capacity
+        (a host dies: capacity drops, the displaced share lands in
+        gang_wait/frag/quarantine), regain it (spare promoted), vanish
+        outright and come back — with drain/quarantine holds toggling
+        through the churn, Σ category chip-seconds still equals
+        ∫ capacity dt per pool exactly."""
+        rng = random.Random(1000 + seed)
+        clock = [0.0]
+        led = make_ledger(clock)
+        pools = ["pod-0", "pod-1"]
+        full = {p: 64.0 for p in pools}
+        cap = dict(full)
+        for _ in range(rng.randrange(30, 70)):
+            clock[0] += rng.uniform(0.01, 5.0)
+            event = rng.random()
+            victim = rng.choice(pools)
+            if event < 0.25:
+                # a host dies: one 8-chip host's capacity gone
+                cap[victim] = max(0.0, cap[victim] - 8.0)
+            elif event < 0.45:
+                # spare promoted / replacement joined
+                cap[victim] = min(full[victim], cap[victim] + 8.0)
+            elif event < 0.55:
+                cap[victim] = 0.0       # whole pool lost
+            sample = {}
+            for p in pools:
+                if cap[p] <= 0.0 and rng.random() < 0.5:
+                    continue            # vanished pools stop reporting
+                cats = {}
+                budget = cap[p]
+                # displaced-wait categories first (the node-loss
+                # shape), then the rest of the waterfall
+                for cat in (GANG_WAIT, FRAG_STRANDED, QUARANTINE,
+                            DRAIN, PRODUCTIVE):
+                    take = rng.uniform(0.0, budget)
+                    budget -= take
+                    if take > 0.0:
+                        cats[cat] = take
+                sample[p] = {"capacity": cap[p], "categories": cats,
+                             "evidence": {GANG_WAIT: {
+                                 "gang": "work/gang-1",
+                                 "displaced_cause": "node-loss"}}}
+            led.observe(sample)
+        clock[0] += 1.0
+        led.observe({p: {"capacity": cap[p], "categories": {}}
+                     for p in pools})
+        report = led.report()
+        assert conservation_ok(report), report["pools"]
+        # the displaced evidence survives into the report
+        ev = report["pools"]["pod-0"]["evidence"].get(GANG_WAIT, {})
+        assert ev.get("displaced_cause") == "node-loss"
+
     def test_capacity_change_mid_run_conserves(self):
         """Node loss: capacity drops between observes; both sides of
         the invariant integrate the same snapshots."""
@@ -333,6 +387,35 @@ class TestSchedulerAttribution:
         assert pool["chip_seconds"][GANG_WAIT] == pytest.approx(4.0)
         assert pool["chip_seconds"][IDLE_NO_DEMAND] == pytest.approx(12.0)
         assert pool["evidence"][GANG_WAIT]["gang"] == "default/g1"
+
+    def test_displaced_gang_wait_evidence_names_kill_cause(self):
+        """Satellite (ISSUE 15): when the stuck gang is a displaced
+        node-loss victim, the gang_wait evidence carries the kill
+        cause — displaced wait is distinguishable from ordinary gang
+        assembly in the waterfall."""
+        from nos_tpu.api import constants as C
+        from nos_tpu.api.podgroup import PodGroup, PodGroupSpec
+        from nos_tpu.kube.client import KIND_POD_GROUP
+        from nos_tpu.kube.objects import ObjectMeta
+        from nos_tpu.utils.pod_util import displaced_value
+
+        clock = [10.0]
+        led = make_ledger(clock)
+        api, sched = self._cluster(clock)
+        with obs.scoped(ledger=led):
+            api.create(KIND_POD_GROUP, PodGroup(
+                metadata=ObjectMeta(name="g1", namespace="default"),
+                spec=PodGroupSpec(min_member=3)))
+            api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name="m0",
+                labels={C.LABEL_POD_GROUP: "g1"},
+                annotations={C.ANNOT_DISPLACED: displaced_value(
+                    "node-loss", 9.0)}))
+            self._accrue(clock, sched)
+            self._accrue(clock, sched)
+        ev = led.report()["pools"]["pod-0"]["evidence"][GANG_WAIT]
+        assert ev["gang"] == "default/g1"
+        assert ev["displaced_cause"] == "node-loss"
 
     def test_hold_precedence_quarantine_over_actuation(self):
         clock = [0.0]

@@ -1,0 +1,1271 @@
+"""Self-healing node-loss recovery tests (ISSUE 15): the displaced
+head-of-line contract, the rebind histogram, warm-spare promotion, the
+missed-heartbeat failure detector, drain-then-migrate, the
+restart-cost-aware victim walk, the disabled-path byte-identity, and a
+chaos scenario that kills a node mid-handshake under lockcheck +
+guard_state (docs/scheduler.md, "Self-healing node-loss recovery")."""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu import obs
+from nos_tpu.api import constants as C
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.controllers.node_controller import NodeController
+from nos_tpu.controllers.pod_controller import PodController
+from nos_tpu.controllers.sliceagent.agent import SliceAgent
+from nos_tpu.device import default_tpu_runtime
+from nos_tpu.device.fake import FakePodResources, FakeTpuRuntime
+from nos_tpu.exporter.metrics import REGISTRY
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.obs import journal as J
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import ChipSecondLedger, DRAIN, conservation_ok
+from nos_tpu.partitioning.core import (
+    REASON_SUSPECT, SelfHealingPolicy, QuarantineList, is_warm_spare,
+)
+from nos_tpu.partitioning.slicepart import SliceNodeInitializer
+from nos_tpu.partitioning.slicepart.factory import (
+    new_slice_partitioner_controller,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.testing.chaos import ChaosAPIServer
+from nos_tpu.testing.factory import (
+    admit_all, make_slice_pod, make_tpu_node,
+)
+from nos_tpu.testing.lockcheck import LockGraph, guard_state, unguard_all
+from nos_tpu.topology import V5E
+from nos_tpu.utils.pod_util import (
+    admission_rank, displaced_value, displacement, job_progress,
+)
+
+
+# ---------------------------------------------------------------------------
+# The displacement contract (utils/pod_util)
+# ---------------------------------------------------------------------------
+
+
+class TestDisplacementContract:
+    def test_value_roundtrip(self):
+        pod = make_slice_pod("2x2", 1, name="p", annotations={
+            C.ANNOT_DISPLACED: displaced_value("node-loss", 153.25)})
+        assert displacement(pod) == ("node-loss", 153.25)
+
+    @pytest.mark.parametrize("raw", [
+        "", "node-loss", "node-loss@", "@1.0", "node-loss@nan",
+        "node-loss@inf", "node-loss@abc",
+    ])
+    def test_garbage_degrades_to_not_displaced(self, raw):
+        """A malformed stamp must read not-displaced (normal rank),
+        never grant a permanent head-of-line boost."""
+        pod = make_slice_pod("2x2", 1, name="p",
+                             annotations={C.ANNOT_DISPLACED: raw})
+        assert displacement(pod) is None
+        assert admission_rank(pod, now=10.0, age_cap_s=300.0) == 2
+
+    def test_rank_order_serving_displaced_batch_best_effort(self):
+        serving = make_slice_pod(
+            "2x2", 1, name="s",
+            labels={C.LABEL_TIER: C.TIER_SERVING})
+        displaced = make_slice_pod(
+            "2x2", 1, name="d",
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 5.0)})
+        batch = make_slice_pod("2x2", 1, name="b")
+        be = make_slice_pod(
+            "2x2", 1, name="e",
+            labels={C.LABEL_TIER: C.TIER_BEST_EFFORT})
+        ranks = [admission_rank(p, now=6.0, age_cap_s=300.0)
+                 for p in (serving, displaced, batch, be)]
+        assert ranks == sorted(ranks)
+        assert ranks[0] < ranks[1] < ranks[2] < ranks[3]
+
+    def test_displaced_never_outranks_serving(self):
+        serving_displaced = make_slice_pod(
+            "2x2", 1, name="sd",
+            labels={C.LABEL_TIER: C.TIER_SERVING},
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 5.0)})
+        assert admission_rank(serving_displaced, now=6.0,
+                              age_cap_s=300.0) == 0
+
+    def test_anti_starvation_age_cap(self):
+        pod = make_slice_pod(
+            "2x2", 1, name="d",
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 0.0)})
+        assert admission_rank(pod, now=299.0, age_cap_s=300.0) == 1
+        # past the cap the boost expires: plain batch again
+        assert admission_rank(pod, now=301.0, age_cap_s=300.0) == 2
+        # cap 0 = no expiry
+        assert admission_rank(pod, now=10_000.0, age_cap_s=0.0) == 1
+
+    def test_job_progress_parses_and_degrades(self):
+        pod = make_slice_pod("2x2", 1, name="p", annotations={
+            C.ANNOT_JOB_PROGRESS: "0.4"})
+        assert job_progress(pod) == pytest.approx(0.4)
+        for raw, want in [("", 0.0), ("junk", 0.0), ("inf", 0.0),
+                          ("-3", 0.0), ("7", 1.0)]:
+            pod.metadata.annotations[C.ANNOT_JOB_PROGRESS] = raw
+            assert job_progress(pod) == want
+
+
+# ---------------------------------------------------------------------------
+# Displaced head-of-line + rebind histogram (scheduler e2e)
+# ---------------------------------------------------------------------------
+
+
+def _one_slot_cluster(clock):
+    """One host advertising exactly one free 2x2 slot."""
+    api = APIServer()
+    api.create(KIND_NODE, make_tpu_node(
+        "h0", pod_id="pod-0", host_index=0,
+        status_geometry={"free": {"2x2": 1}}))
+    sched = build_scheduler(api, 16, clock=lambda: clock[0])
+    return api, sched
+
+
+class TestDisplacedHeadOfLine:
+    def test_displaced_binds_before_older_batch(self):
+        clock = [100.0]
+        api, sched = _one_slot_cluster(clock)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="old-batch", creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="victim", creation_timestamp=2.0,
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 99.0)}))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "victim", "default").spec.node_name == "h0"
+        assert not api.get(KIND_POD, "old-batch", "default").spec.node_name
+
+    def test_expired_boost_yields_to_fifo(self):
+        """Past the age cap the displaced pod is plain batch again —
+        the OLDER batch pod wins the slot (anti-starvation)."""
+        clock = [1000.0]
+        api, sched = _one_slot_cluster(clock)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="old-batch", creation_timestamp=1.0))
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="victim", creation_timestamp=2.0,
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 10.0)}))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "old-batch", "default").spec.node_name == "h0"
+        assert not api.get(KIND_POD, "victim", "default").spec.node_name
+
+    def test_rebind_observed_journaled_and_stamp_cleared(self):
+        clock = [50.0]
+        api, sched = _one_slot_cluster(clock)
+        journal = DecisionJournal(clock=lambda: clock[0])
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="victim", creation_timestamp=2.0,
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 44.0)}))
+        before = REGISTRY.snapshot().get(
+            "nos_tpu_rebind_latency_seconds_count", {})
+        with obs.scoped(journal=journal):
+            sched.run_cycle()
+        bound = api.get(KIND_POD, "victim", "default")
+        assert bound.spec.node_name == "h0"
+        # the stamp is consumed by the bind: a later requeue is a
+        # fresh displacement event, not an inherited boost
+        assert C.ANNOT_DISPLACED not in bound.metadata.annotations
+        recs = journal.events(category=J.JOB_REBOUND)
+        assert len(recs) == 1
+        assert recs[0].subject == "default/victim"
+        assert recs[0].attrs["cause"] == "node-loss"
+        assert recs[0].attrs["latency_s"] == pytest.approx(6.0)
+        # COUNT convention: a `members` attr is reserved for pod-key
+        # lists (explain's membership match iterates it) — a count
+        # there crashed `obs explain pod` for EVERY pod whenever any
+        # job had rebound (found by the boundary drive)
+        assert recs[0].attrs["members_total"] == 1
+        assert "members" not in recs[0].attrs
+        from nos_tpu.obs.explain import explain_pod
+
+        lines = explain_pod(
+            {"journal": [r.to_dict() for r in journal.events()]},
+            "default/other")
+        assert lines        # renders, never raises
+        after = REGISTRY.snapshot().get(
+            "nos_tpu_rebind_latency_seconds_count", {})
+        key = "class=slice-2x2"
+        assert after.get(key, 0) == before.get(key, 0) + 1
+
+    def test_non_displaced_bind_observes_nothing(self):
+        clock = [50.0]
+        api, sched = _one_slot_cluster(clock)
+        journal = DecisionJournal(clock=lambda: clock[0])
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="plain", creation_timestamp=2.0))
+        with obs.scoped(journal=journal):
+            sched.run_cycle()
+        assert api.get(KIND_POD, "plain", "default").spec.node_name == "h0"
+        assert journal.events(category=J.JOB_REBOUND) == []
+
+
+# ---------------------------------------------------------------------------
+# Restart-cost-aware victim walk (capacityscheduling)
+# ---------------------------------------------------------------------------
+
+
+class TestRestartCostVictims:
+    def _walk(self, preemptor, ctx=None):
+        from nos_tpu.quota import ElasticQuotaInfos, TPUResourceCalculator
+        from nos_tpu.scheduler.capacityscheduling import (
+            CapacityScheduling, DISPLACED_CONTEXT_KEY,
+            ELASTIC_QUOTA_SNAPSHOT_KEY, PRE_FILTER_STATE_KEY,
+            PreFilterState,
+        )
+        from nos_tpu.scheduler.framework import (
+            CycleState, Framework, NodeInfo, NodeResourcesFit,
+        )
+
+        api = APIServer()
+        node = make_tpu_node("h0", status_geometry={"free": {"2x2": 2}})
+        api.create(KIND_NODE, node)
+        ni = NodeInfo(node=node)
+        # same priority, same tier; 'fresh' reported 10% progress and
+        # is OLDER, 'done' reported 90% and is NEWER — the default walk
+        # (newest first) picks 'done', the displaced walk must pick
+        # 'fresh' (least restart cost)
+        fresh = make_slice_pod(
+            "2x2", 1, name="fresh", node_name="h0", phase="Running",
+            creation_timestamp=1.0,
+            annotations={C.ANNOT_JOB_PROGRESS: "0.1"})
+        done = make_slice_pod(
+            "2x2", 1, name="done", node_name="h0", phase="Running",
+            creation_timestamp=9.0,
+            annotations={C.ANNOT_JOB_PROGRESS: "0.9"})
+        for p in (fresh, done):
+            api.create(KIND_POD, p)
+            ni.add_pod(p)
+        calc = TPUResourceCalculator()
+        cs = CapacityScheduling(calc)
+        cs.set_framework(Framework([NodeResourcesFit()]))
+        cs._api = api
+        state = CycleState()
+        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = ElasticQuotaInfos()
+        state[PRE_FILTER_STATE_KEY] = PreFilterState(
+            calc.compute_pod_request(preemptor))
+        if ctx is not None:
+            state[DISPLACED_CONTEXT_KEY] = ctx
+        victims, _, status = cs._select_victims_on_node(
+            state, preemptor, ni, pdbs=[])
+        assert status.is_success and victims
+        return victims
+
+    def test_displaced_preemptor_takes_least_progress_victim(self):
+        preemptor = make_slice_pod(
+            "2x2", 1, name="pree", priority=10,
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 1.0)})
+        victims = self._walk(preemptor)
+        assert victims[0].metadata.name == "fresh"
+
+    def test_plain_preemptor_order_unchanged(self):
+        """Without a displacement stamp the walk's order is the
+        historical one (newest first) — byte-identical decisions."""
+        preemptor = make_slice_pod("2x2", 1, name="pree", priority=10)
+        victims = self._walk(preemptor)
+        assert victims[0].metadata.name == "done"
+
+    def test_expired_stamp_loses_the_altered_order_too(self):
+        """A stamp past displaced_age_cap_s reads plain batch in the
+        admission queue — the victim walk must agree (the scheduler
+        hands the walk its clock + cap via DISPLACED_CONTEXT_KEY)."""
+        preemptor = make_slice_pod(
+            "2x2", 1, name="pree", priority=10,
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 1.0)})
+        victims = self._walk(preemptor, ctx=(1000.0, 300.0))
+        assert victims[0].metadata.name == "done"
+        # the same stamp, still fresh: altered order applies
+        victims = self._walk(preemptor, ctx=(100.0, 300.0))
+        assert victims[0].metadata.name == "fresh"
+
+    def test_serving_preemptor_stamp_alters_nothing(self):
+        """Serving never had the displaced head-of-line slot, so a
+        stamped serving preemptor keeps the historical walk order."""
+        preemptor = make_slice_pod(
+            "2x2", 1, name="pree", priority=10,
+            labels={C.LABEL_TIER: C.TIER_SERVING},
+            annotations={C.ANNOT_DISPLACED:
+                         displaced_value("node-loss", 1.0)})
+        victims = self._walk(preemptor, ctx=(2.0, 300.0))
+        assert victims[0].metadata.name == "done"
+
+
+# ---------------------------------------------------------------------------
+# SpareGuard + MigrationDrainGuard (framework filters)
+# ---------------------------------------------------------------------------
+
+
+class TestHoldGuards:
+    def test_pod_never_binds_to_warm_spare(self):
+        clock = [0.0]
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "spare", pod_id="pod-0", host_index=7,
+            status_geometry={"free": {"2x2": 2}},
+            extra_labels={C.LABEL_SPARE: C.SPARE_WARM}))
+        sched = build_scheduler(api, 16, clock=lambda: clock[0])
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p",
+                                            creation_timestamp=1.0))
+        sched.run_cycle()
+        pod = api.get(KIND_POD, "p", "default")
+        assert not pod.spec.node_name
+        # promotion = the label comes off; the SAME pod binds next cycle
+        api.patch(KIND_NODE, "spare",
+                  mutate=lambda n: n.metadata.labels.pop(
+                      C.LABEL_SPARE, None))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "p", "default").spec.node_name == "spare"
+
+    def test_migration_drained_node_hard_rejected(self):
+        clock = [0.0]
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "dying", pod_id="pod-0", host_index=0,
+            status_geometry={"free": {"2x2": 2}}))
+        api.patch(KIND_NODE, "dying",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: "migrate:node-suspect"}))
+        sched = build_scheduler(api, 16, clock=lambda: clock[0])
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p",
+                                            creation_timestamp=1.0))
+        sched.run_cycle()
+        assert not api.get(KIND_POD, "p", "default").spec.node_name
+
+    def test_defrag_drain_stays_a_soft_avoidance(self):
+        """A defrag proposal's drain (non-migrate value) must NOT hard-
+        reject: the host is healthy — with no alternative the pod still
+        binds (the score key only prefers elsewhere)."""
+        clock = [0.0]
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "defragged", pod_id="pod-0", host_index=0,
+            status_geometry={"free": {"2x2": 2}}))
+        api.patch(KIND_NODE, "defragged",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: "dfrg-slice-7"}))
+        sched = build_scheduler(api, 16, clock=lambda: clock[0])
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p",
+                                            creation_timestamp=1.0))
+        sched.run_cycle()
+        assert api.get(KIND_POD, "p", "default").spec.node_name == "defragged"
+
+    def test_spare_excluded_from_waste_waterfall(self):
+        """A warm spare is reserve, not fleet capacity: its chips
+        appear in no waterfall pool (its SpareGuard rejections must
+        not read frag_stranded)."""
+        clock = [0.0]
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "spare", pod_id="pod-9", host_index=0,
+            status_geometry={"free": {"2x2": 2}},
+            extra_labels={C.LABEL_SPARE: C.SPARE_WARM}))
+        sched = build_scheduler(api, 16, clock=lambda: clock[0])
+        with obs.scoped(ledger=ledger):
+            api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name="p", creation_timestamp=1.0))
+            clock[0] += 1.0
+            sched.run_cycle()
+            clock[0] += 1.0
+            sched.run_cycle()
+        assert "pod-9" not in ledger.report()["pools"]
+
+
+# ---------------------------------------------------------------------------
+# Failure detector + warm spares + drain-then-migrate (the policy)
+# ---------------------------------------------------------------------------
+
+
+def _policy_cluster(spares=1, suspect_after=5.0, grace=3.0):
+    clock = [0.0]
+    api = APIServer()
+    quarantine = QuarantineList(kind="slice", clock=lambda: clock[0])
+    policy = SelfHealingPolicy(
+        api, "slice", quarantine, spare_hosts_per_pool=spares,
+        suspect_after_s=suspect_after, migrate_grace_s=grace,
+        clock=lambda: clock[0])
+    nodes = {}
+    for i in range(2):
+        node = make_tpu_node(f"h{i}", pod_id="pod-0", host_index=i)
+        node.metadata.annotations[C.heartbeat_annotation("slice")] = "1"
+        api.create(KIND_NODE, node)
+        nodes[f"h{i}"] = node
+    for s in range(spares):
+        node = make_tpu_node(
+            f"spare{s}", pod_id="pod-0", host_index=100 + s,
+            extra_labels={C.LABEL_SPARE: C.SPARE_WARM})
+        api.create(KIND_NODE, node)
+        nodes[f"spare{s}"] = node
+    return clock, api, quarantine, policy, nodes
+
+
+def _fresh_nodes(api):
+    return {n.metadata.name: n for n in api.list(KIND_NODE)}
+
+
+class TestFailureDetector:
+    def test_frozen_heartbeat_suspects_and_resume_releases(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        policy.step(nodes)              # baseline observation
+        clock[0] = 4.0
+        policy.step(nodes)
+        assert not quarantine.is_quarantined("h0")
+        clock[0] = 6.0                  # > suspect_after_s, value frozen
+        policy.step(nodes)
+        assert quarantine.reason("h0") == REASON_SUSPECT
+        assert quarantine.reason("h1") == REASON_SUSPECT
+        # the heartbeat moves again: released by the detector itself
+        nodes["h0"].metadata.annotations[
+            C.heartbeat_annotation("slice")] = "2"
+        policy.step(nodes)
+        assert not quarantine.is_quarantined("h0")
+        assert quarantine.is_quarantined("h1")
+
+    def test_node_without_heartbeat_is_never_suspected(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        silent = make_tpu_node("mute", pod_id="pod-0", host_index=5)
+        api.create(KIND_NODE, silent)
+        nodes["mute"] = silent
+        policy.step(nodes)
+        clock[0] = 100.0
+        policy.step(nodes)
+        assert not quarantine.is_quarantined("mute")
+
+    def test_heartbeat_stamp_is_gateable(self):
+        """AgentConfig.heartbeat=False keeps the agent from stamping
+        the liveness counter, so steady-state reports stay no-op
+        status re-writes (no watch event per node per report interval
+        fleet-wide) on deployments running without the detector."""
+        api = APIServer()
+        api.create(KIND_NODE,
+                   make_tpu_node("h0", pod_id="pod-0", host_index=0))
+        agent = SliceAgent(api, "h0", FakeTpuRuntime(V5E),
+                           FakePodResources(), heartbeat=False)
+        agent.start()
+        agent.tick()
+        annotations = api.get(KIND_NODE, "h0").metadata.annotations
+        assert C.heartbeat_annotation("slice") not in annotations
+        agent.stop()
+        # default stays ON: every in-process sim/bench keeps the signal
+        api.create(KIND_NODE,
+                   make_tpu_node("h1", pod_id="pod-0", host_index=1))
+        agent = SliceAgent(api, "h1", FakeTpuRuntime(V5E),
+                           FakePodResources())
+        agent.start()
+        annotations = api.get(KIND_NODE, "h1").metadata.annotations
+        assert C.heartbeat_annotation("slice") in annotations
+        agent.stop()
+
+    def test_guarded_by_contract(self):
+        """The detector/spare/migration state is @guarded_by the policy
+        lock — writes without it are convicted at runtime exactly like
+        the static N010 rule."""
+        graph = LockGraph(name="recovery-guard")
+        try:
+            with graph.install():
+                clock, api, quarantine, policy, nodes = _policy_cluster()
+            guard_state(policy, graph,
+                        name="core.SelfHealingPolicy")
+            policy.step(nodes)
+            clock[0] = 6.0
+            policy.step(nodes)
+            graph.assert_clean()
+        finally:
+            graph.close()
+            unguard_all()
+
+
+class TestSparePromotion:
+    def test_vanished_host_promotes_a_spare_into_its_index(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        journal = DecisionJournal(clock=lambda: clock[0])
+        with obs.scoped(journal=journal):
+            policy.step(_fresh_nodes(api))      # baseline membership
+            api.delete(KIND_NODE, "h0")         # the kill
+            policy.step(_fresh_nodes(api))
+        spare = api.get(KIND_NODE, "spare0")
+        assert not is_warm_spare(spare)
+        assert spare.metadata.labels[C.LABEL_HOST_INDEX] == "0"
+        recs = journal.events(category=J.SPARE_PROMOTED)
+        assert len(recs) == 1
+        assert recs[0].subject == "spare0"
+        assert recs[0].attrs["replaced"] == "h0"
+        assert recs[0].attrs["host_index"] == 0
+
+    def test_one_vacancy_consumes_one_spare(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster(spares=2)
+        policy.step(_fresh_nodes(api))
+        api.delete(KIND_NODE, "h0")
+        policy.step(_fresh_nodes(api))
+        policy.step(_fresh_nodes(api))
+        policy.step(_fresh_nodes(api))
+        promoted = [n for n in api.list(KIND_NODE)
+                    if n.metadata.name.startswith("spare")
+                    and not is_warm_spare(n)]
+        assert len(promoted) == 1
+
+    def test_unhealthy_spare_is_not_promoted(self):
+        """A quarantined spare (its own agent died) or one marked for
+        maintenance must not consume a vacancy — it would hold the
+        gang window broken while a healthy spare sits idle (the
+        no-replacement-while-present rule would never revisit it)."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(spares=2)
+        policy.step(_fresh_nodes(api))
+        quarantine.quarantine("spare0", REASON_SUSPECT)
+        api.delete(KIND_NODE, "h0")
+        policy.step(_fresh_nodes(api))
+        assert is_warm_spare(api.get(KIND_NODE, "spare0"))
+        promoted = api.get(KIND_NODE, "spare1")
+        assert not is_warm_spare(promoted)
+        assert promoted.metadata.labels[C.LABEL_HOST_INDEX] == "0"
+        # inventory counts PROMOTABLE spares only: the dead spare is
+        # not inventory, so the pool reads 0 held and warns short
+        snap = REGISTRY.snapshot()["nos_tpu_spare_hosts"]
+        assert snap["pool=pod-0"] == 0.0
+        # maintenance-stamped spares are equally ineligible
+        quarantine.unquarantine("spare0")
+        api.patch(KIND_NODE, "spare0",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_MAINTENANCE: "planned"}))
+        api.delete(KIND_NODE, "h1")
+        policy.step(_fresh_nodes(api))
+        assert is_warm_spare(api.get(KIND_NODE, "spare0"))
+
+    def test_hybrid_pool_promotion_owned_by_slice_family(self):
+        """Hybrid hosts are seen by BOTH families' policies; promotion
+        is single-owner (slice by convention) or two concurrent
+        reconciles could label two different spares with one vacated
+        index — two live nodes sharing a host-index breaks the window
+        convention for good."""
+        clock = [0.0]
+        api = APIServer()
+        policies = {}
+        for kind in ("slice", "timeshare"):
+            policies[kind] = SelfHealingPolicy(
+                api, kind, QuarantineList(kind=kind,
+                                          clock=lambda: clock[0]),
+                spare_hosts_per_pool=1, clock=lambda: clock[0])
+        for i in range(2):
+            api.create(KIND_NODE, make_tpu_node(
+                f"y{i}", pod_id="pod-9", host_index=i,
+                partitioning="hybrid"))
+        for s in range(2):
+            api.create(KIND_NODE, make_tpu_node(
+                f"yspare{s}", pod_id="pod-9", host_index=200 + s,
+                partitioning="hybrid",
+                extra_labels={C.LABEL_SPARE: C.SPARE_WARM}))
+        for p in policies.values():
+            p.step(_fresh_nodes(api))       # both observe the baseline
+        api.delete(KIND_NODE, "y0")
+        for p in policies.values():
+            p.step(_fresh_nodes(api))
+        promoted = [n for n in api.list(KIND_NODE)
+                    if n.metadata.name.startswith("yspare")
+                    and not is_warm_spare(n)]
+        assert len(promoted) == 1
+        assert promoted[0].metadata.labels[C.LABEL_HOST_INDEX] == "0"
+
+    def test_restart_lost_vacancy_inferred_from_index_gap(self):
+        """A host that died BEFORE the policy's first poll (controller
+        restart, leader failover) is in no in-memory baseline — but
+        the window convention indexes a pool's hosts contiguously from
+        0, so the first poll infers the vacancy from the index GAP and
+        still promotes a spare."""
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        api.create(KIND_NODE,
+                   make_tpu_node("h2", pod_id="pod-0", host_index=2))
+        api.delete(KIND_NODE, "h1")     # dies while nobody is watching
+        fresh = SelfHealingPolicy(
+            api, "slice", quarantine, spare_hosts_per_pool=1,
+            clock=lambda: clock[0])
+        fresh.step(_fresh_nodes(api))   # FIRST poll of a fresh process
+        spare = api.get(KIND_NODE, "spare0")
+        assert not is_warm_spare(spare)
+        assert spare.metadata.labels[C.LABEL_HOST_INDEX] == "1"
+
+    def test_intact_pool_first_poll_promotes_nothing(self):
+        """Gap inference must not fire on a healthy contiguous pool —
+        and a dead HIGHEST index is indistinguishable from a smaller
+        pool, so it stays invisible to a fresh process (the documented
+        limitation)."""
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        policy.step(_fresh_nodes(api))
+        assert is_warm_spare(api.get(KIND_NODE, "spare0"))
+        api.delete(KIND_NODE, "h1")     # h1 holds the highest index
+        fresh = SelfHealingPolicy(
+            api, "slice", quarantine, spare_hosts_per_pool=1,
+            clock=lambda: clock[0])
+        fresh.step(_fresh_nodes(api))
+        assert is_warm_spare(api.get(KIND_NODE, "spare0"))
+
+    def test_quarantined_but_present_host_is_not_replaced(self):
+        """Promotion is for VANISHED nodes only: a suspect host still
+        holds its index (two nodes must never share one)."""
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        policy.step(_fresh_nodes(api))
+        quarantine.quarantine("h0", REASON_SUSPECT)
+        policy.step(_fresh_nodes(api))
+        assert is_warm_spare(api.get(KIND_NODE, "spare0"))
+
+    def test_spare_gauge_tracks_inventory(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster()
+        policy.step(_fresh_nodes(api))
+        snap = REGISTRY.snapshot()["nos_tpu_spare_hosts"]
+        assert snap["pool=pod-0"] == 1.0
+        api.delete(KIND_NODE, "h0")
+        policy.step(_fresh_nodes(api))
+        snap = REGISTRY.snapshot()["nos_tpu_spare_hosts"]
+        assert snap["pool=pod-0"] == 0.0
+
+
+class TestDrainMigrate:
+    def _suspect_h0(self, clock, policy, api):
+        policy.step(_fresh_nodes(api))
+        clock[0] += 6.0
+        policy.step(_fresh_nodes(api))
+
+    def test_suspect_node_drains_stamps_and_evicts_after_grace(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=3.0)
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work")
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        journal = DecisionJournal(clock=lambda: clock[0])
+        with obs.scoped(journal=journal, ledger=ledger):
+            self._suspect_h0(clock, policy, api)
+            node = api.get(KIND_NODE, "h0")
+            assert node.metadata.annotations[
+                C.ANNOT_DEFRAG_DRAIN] == "migrate:slice:node-suspect"
+            assert ledger.holds()["h0"][DRAIN]["cause"] == "node-suspect"
+            pod = api.get(KIND_POD, "r0", "work")
+            assert pod.metadata.annotations[C.ANNOT_MIGRATE] \
+                == "node-suspect"
+            recs = journal.events(category=J.JOB_DISPLACED)
+            assert recs and recs[0].subject == "work/r0"
+            assert recs[0].attrs["cause"] == "node-suspect"
+            # inside the grace nothing is evicted (the checkpoint exit
+            # window)
+            assert api.try_get(KIND_POD, "r0", "work") is not None
+            clock[0] += 3.5
+            policy.step(_fresh_nodes(api))
+            assert api.try_get(KIND_POD, "r0", "work") is None
+
+    def test_recovered_node_heals_drain_and_hold(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        with obs.scoped(ledger=ledger):
+            self._suspect_h0(clock, policy, api)
+            assert "h0" in ledger.holds()
+            # the heartbeat moves: suspect released, drain healed
+            api.patch(KIND_NODE, "h0",
+                      mutate=lambda n: n.metadata.annotations.update(
+                          {C.heartbeat_annotation("slice"): "2"}))
+            policy.step(_fresh_nodes(api))
+            assert not quarantine.is_quarantined("h0")
+            node = api.get(KIND_NODE, "h0")
+            assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+            assert "h0" not in ledger.holds()
+
+    def test_recovered_node_unstamps_residents(self):
+        """A retracted migration must retract the checkpoint-exit
+        request too: residents lose nos.tpu/migrate when the node
+        recovers, or every job on the healthy node would exit at its
+        next landed checkpoint — a spurious restart wave."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work")
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        with obs.scoped(ledger=ledger):
+            self._suspect_h0(clock, policy, api)
+            pod = api.get(KIND_POD, "r0", "work")
+            assert pod.metadata.annotations[C.ANNOT_MIGRATE] \
+                == "node-suspect"
+            # the heartbeat moves: migration retracted end to end
+            api.patch(KIND_NODE, "h0",
+                      mutate=lambda n: n.metadata.annotations.update(
+                          {C.heartbeat_annotation("slice"): "2"}))
+            policy.step(_fresh_nodes(api))
+            pod = api.get(KIND_POD, "r0", "work")
+            assert C.ANNOT_MIGRATE not in pod.metadata.annotations
+
+    def test_other_family_migration_is_never_retracted(self):
+        """Migration-drain ownership is exclusive per family
+        (migrate:<kind>:<cause>): the slice policy must neither begin
+        over nor retract a timeshare-owned drain on a hybrid host —
+        clearing it would let the scheduler refill a still-dying host
+        and strip the residents' checkpoint-exit request."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        ts_value = C.migration_drain_value("timeshare", "node-suspect")
+        api.patch(KIND_NODE, "h0",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: ts_value}))
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work",
+                                  annotations={C.ANNOT_MIGRATE:
+                                               "node-suspect"})
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        with obs.scoped(ledger=ledger):
+            # h0's SLICE agent goes suspect too: slice wants its own
+            # migration but timeshare already owns the drain — defer
+            self._suspect_h0(clock, policy, api)
+            node = api.get(KIND_NODE, "h0")
+            assert node.metadata.annotations[
+                C.ANNOT_DEFRAG_DRAIN] == ts_value
+            # slice's heartbeat resumes: nothing of timeshare's is
+            # retracted (drain stays, resident stamp stays)
+            api.patch(KIND_NODE, "h0",
+                      mutate=lambda n: n.metadata.annotations.update(
+                          {C.heartbeat_annotation("slice"): "2"}))
+            policy.step(_fresh_nodes(api))
+            node = api.get(KIND_NODE, "h0")
+            assert node.metadata.annotations[
+                C.ANNOT_DEFRAG_DRAIN] == ts_value
+            pod = api.get(KIND_POD, "r0", "work")
+            assert pod.metadata.annotations[C.ANNOT_MIGRATE] \
+                == "node-suspect"
+
+    def test_stray_drain_of_recovered_node_is_healed(self):
+        """A predecessor died mid-migration, the node recovered during
+        the downtime: the fresh policy (empty in-memory state) must
+        retract the stray drain and the residents' migrate stamps —
+        otherwise the host is hard-unschedulable forever.  The verdict
+        needs the heartbeat to MOVE: on first sight a recovered node
+        and a frozen-dead one look identical, so the stray is HELD
+        (not retracted — a retraction would un-ask the residents of a
+        genuinely dying host and re-journal the displacement on every
+        failover) until the agent's next report proves life."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        api.patch(KIND_NODE, "h0",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: C.migration_drain_value(
+                          "slice", "node-suspect")}))
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work",
+                                  annotations={C.ANNOT_MIGRATE:
+                                               "node-suspect"})
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        with obs.scoped(ledger=ledger):
+            policy.step(_fresh_nodes(api))   # first sight: undecided
+            node = api.get(KIND_NODE, "h0")
+            assert C.ANNOT_DEFRAG_DRAIN in node.metadata.annotations
+            clock[0] += 1.0                  # the agent reports again
+            api.patch(KIND_NODE, "h0",
+                      mutate=lambda n: n.metadata.annotations.update(
+                          {C.heartbeat_annotation("slice"): "2"}))
+            policy.step(_fresh_nodes(api))   # moved: alive — retract
+            node = api.get(KIND_NODE, "h0")
+            assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+            pod = api.get(KIND_POD, "r0", "work")
+            assert C.ANNOT_MIGRATE not in pod.metadata.annotations
+
+    def test_stray_drain_of_still_dead_node_is_adopted(self):
+        """The predecessor's migration target is STILL suspect at
+        restart: the fresh policy adopts the stray (tracks it, keeps
+        the drain, restores the ledger hold) instead of healing it —
+        WITHOUT re-stamping already-asked residents or journaling a
+        second displacement event for the same displacement."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        api.patch(KIND_NODE, "h0",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: C.migration_drain_value(
+                          "slice", "node-suspect")}))
+        resident = make_slice_pod(
+            "2x2", 1, name="r0", node_name="h0", phase="Running",
+            namespace="work",
+            annotations={C.ANNOT_MIGRATE: "node-suspect"})
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        journal = DecisionJournal(clock=lambda: clock[0])
+        with obs.scoped(journal=journal, ledger=ledger):
+            self._suspect_h0(clock, policy, api)   # h0 still frozen
+            node = api.get(KIND_NODE, "h0")
+            assert node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] \
+                == "migrate:slice:node-suspect"
+            assert ledger.holds()["h0"][DRAIN]["cause"] == "node-suspect"
+            # adoption is idempotent on the workload side
+            assert journal.events(category=J.JOB_DISPLACED) == []
+
+    def test_straggler_eviction_fires_once_per_pod(self, monkeypatch):
+        """Graceful termination on a real apiserver keeps evicted pods
+        in _residents for many polls — the straggler pass must not
+        re-delete them (and re-count nos_tpu_drain_migrations_total by
+        the gang size) every poll past the grace."""
+        import nos_tpu.scheduler.gang as gang_mod
+
+        calls: list[str] = []
+        monkeypatch.setattr(
+            gang_mod, "evict_gang",
+            lambda api, pod: (calls.append(pod.key), [pod.key])[1])
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=3.0)
+        api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="r0", node_name="h0", phase="Running",
+            namespace="work"))
+        self._suspect_h0(clock, policy, api)
+        for _ in range(4):                  # polls past the grace;
+            clock[0] += 2.0                 # the pod never leaves
+            policy.step(_fresh_nodes(api))
+        assert calls == ["work/r0"]
+
+    def test_other_family_drain_defers_begin_inside_the_write(self):
+        """ONE family owns a node's migration: when the other family's
+        drain is already on the node, ours defers — judged INSIDE the
+        retried mutate, so a hybrid host's two concurrent detectors
+        cannot both read no-owner and double-run the migration."""
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        api.patch(KIND_NODE, "h0",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_DEFRAG_DRAIN: C.migration_drain_value(
+                          "timeshare", "maintenance")}))
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work")
+        api.create(KIND_POD, resident)
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        journal = DecisionJournal(clock=lambda: clock[0])
+        with obs.scoped(journal=journal, ledger=ledger):
+            self._suspect_h0(clock, policy, api)
+        node = api.get(KIND_NODE, "h0")
+        assert node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] \
+            == "migrate:timeshare:maintenance"      # never overwritten
+        pod = api.get(KIND_POD, "r0", "work")
+        assert C.ANNOT_MIGRATE not in pod.metadata.annotations
+        assert DRAIN not in ledger.holds().get("h0", {})
+        assert journal.events(category=J.JOB_DISPLACED) == []
+
+    def test_defrag_cleanup_spares_a_superseding_migration_drain(self):
+        """Defrag stamped a host, the host then started dying and the
+        recovery plane overwrote the stamp with its migration drain:
+        defrag's cleanup/heal must NOT pop the migration drain — the
+        scheduler would refill a presumed-dying host."""
+        from nos_tpu.partitioning.slicepart.calculators import (
+            SliceProfileCalculator,
+        )
+        from nos_tpu.partitioning.core.defrag import DefragProposer
+
+        api = APIServer()
+        node = make_tpu_node("h0", pod_id="pod-0", host_index=0)
+        migrate = C.migration_drain_value("slice", "node-suspect")
+        node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] = migrate
+        api.create(KIND_NODE, node)
+        proposer = DefragProposer(api, "slice",
+                                  SliceProfileCalculator(),
+                                  clock=lambda: 0.0)
+        # the direct clear path (cleanup's per-host call): the stamp
+        # it owned was superseded, so nothing is popped
+        proposer._clear_drain("h0", "defrag-proposal-123")
+        assert api.get(KIND_NODE, "h0").metadata.annotations[
+            C.ANNOT_DEFRAG_DRAIN] == migrate
+        # the startup stray sweep also leaves migration drains alone
+        proposer._heal_stray_drains()
+        assert api.get(KIND_NODE, "h0").metadata.annotations[
+            C.ANNOT_DEFRAG_DRAIN] == migrate
+
+    def test_disabled_controller_heals_predecessor_stray_once(self):
+        """A controller built WITHOUT the recovery plane heals a
+        recovery-enabled predecessor's migration drains at its first
+        poll (heal_stray_migration_drains) — nothing else ever would."""
+        from nos_tpu.partitioning.core import heal_stray_migration_drains
+
+        api = APIServer()
+        node = make_tpu_node("h0", pod_id="pod-0", host_index=0)
+        node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] = \
+            C.migration_drain_value("slice", "maintenance")
+        api.create(KIND_NODE, node)
+        other = make_tpu_node("h1", pod_id="pod-0", host_index=1)
+        other.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] = \
+            C.migration_drain_value("timeshare", "maintenance")
+        api.create(KIND_NODE, other)
+        resident = make_slice_pod("2x2", 1, name="r0", node_name="h0",
+                                  phase="Running", namespace="work",
+                                  annotations={C.ANNOT_MIGRATE:
+                                               "maintenance"})
+        api.create(KIND_POD, resident)
+        assert heal_stray_migration_drains(api, "slice") == 1
+        node = api.get(KIND_NODE, "h0")
+        assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+        pod = api.get(KIND_POD, "r0", "work")
+        assert C.ANNOT_MIGRATE not in pod.metadata.annotations
+        # the other family's drain is not ours to heal
+        other = api.get(KIND_NODE, "h1")
+        assert C.is_migration_drain(other.metadata.annotations)
+
+    def test_maintenance_annotation_drains_without_suspicion(self):
+        clock, api, quarantine, policy, nodes = _policy_cluster(
+            spares=0, suspect_after=5.0, grace=300.0)
+        api.patch(KIND_NODE, "h1",
+                  mutate=lambda n: n.metadata.annotations.update(
+                      {C.ANNOT_MAINTENANCE: "planned-reboot"}))
+        policy.step(_fresh_nodes(api))
+        node = api.get(KIND_NODE, "h1")
+        assert node.metadata.annotations[
+            C.ANNOT_DEFRAG_DRAIN] == "migrate:slice:maintenance"
+        assert not quarantine.is_quarantined("h1")
+
+    def test_train_reads_the_migrate_signal(self):
+        from nos_tpu.cmd.train import read_migrate_signal
+
+        api = APIServer()
+        pod = make_slice_pod("2x2", 1, name="w0", namespace="work",
+                             node_name="h0", phase="Running")
+        api.create(KIND_POD, pod)
+        assert read_migrate_signal(api, "w0", "work") is None
+        api.patch(KIND_POD, "w0", "work",
+                  mutate=lambda p: p.metadata.annotations.update(
+                      {C.ANNOT_MIGRATE: "maintenance"}))
+        assert read_migrate_signal(api, "w0", "work") == "maintenance"
+        assert read_migrate_signal(api, "gone", "work") is None
+
+    def test_signal_checker_one_read_serves_both(self, monkeypatch):
+        """The default per-checkpoint probe serves BOTH the dp-resize
+        and migrate annotations from ONE pod read on ONE client —
+        separate probes would double the apiserver load fleet-wide."""
+        from nos_tpu.cmd import _runtime
+        from nos_tpu.cmd.train import TrainConfig, signal_checker
+
+        api = APIServer()
+        pod = make_slice_pod("2x2", 1, name="w0", namespace="work",
+                             node_name="h0", phase="Running")
+        pod.metadata.annotations[C.ANNOT_DP_RESIZE] = "3"
+        pod.metadata.annotations[C.ANNOT_MIGRATE] = "maintenance"
+        api.create(KIND_POD, pod)
+        reads = [0]
+        real_try_get = api.try_get
+
+        def counting_try_get(kind, name, namespace=None):
+            if kind == KIND_POD:
+                reads[0] += 1
+            return real_try_get(kind, name, namespace)
+
+        monkeypatch.setattr(api, "try_get", counting_try_get)
+        monkeypatch.setattr(_runtime, "build_api", lambda cfg: api)
+        probe = signal_checker(
+            TrainConfig(kubeconfig="in-memory"),
+            environ={"POD_NAME": "w0", "POD_NAMESPACE": "work"})
+        assert probe() == (3, "maintenance")
+        assert reads[0] == 1
+        # identity incomplete -> inert, never a guessed namespace
+        assert signal_checker(TrainConfig(kubeconfig="in-memory"),
+                              environ={"POD_NAME": "w0"}) is None
+
+
+# ---------------------------------------------------------------------------
+# Disabled-path byte-identity + end-to-end recovery
+# ---------------------------------------------------------------------------
+
+
+def _mini_cluster(recovery: bool, hosts=2, spares=1):
+    """A small real control plane (controller + agents + scheduler) on
+    a virtual clock, with the recovery plane on or off."""
+    clock = [0.0]
+    api = APIServer()
+    state = ClusterState()
+    NodeController(api, state, SliceNodeInitializer(api)).bind()
+    PodController(api, state).bind()
+    ctl = new_slice_partitioner_controller(
+        api, state, batch_timeout_s=2.0, batch_idle_s=0.5,
+        clock=lambda: clock[0],
+        spare_hosts_per_pool=spares if recovery else 0,
+        node_suspect_after_s=5.0 if recovery else 0.0,
+        migrate_grace_s=2.0)
+    ctl.bind()
+    agents = {}
+    for i in range(hosts):
+        name = f"h{i}"
+        api.create(KIND_NODE, make_tpu_node(
+            name, pod_id="pod-0", host_index=i))
+        agent = SliceAgent(api, name, default_tpu_runtime(V5E),
+                           FakePodResources())
+        agent.start()
+        agents[name] = agent
+    for s in range(spares):
+        name = f"spare{s}"
+        api.create(KIND_NODE, make_tpu_node(
+            name, pod_id="pod-0", host_index=100 + s,
+            extra_labels={C.LABEL_SPARE: C.SPARE_WARM}))
+        agent = SliceAgent(api, name, default_tpu_runtime(V5E),
+                           FakePodResources())
+        agent.start()
+        agents[name] = agent
+    sched = build_scheduler(api, 16, clock=lambda: clock[0])
+    return clock, api, ctl, agents, sched
+
+
+def _drive(clock, ctl, agents, sched, ticks, dt=1.0):
+    for _ in range(ticks):
+        clock[0] += dt
+        sched.run_cycle()
+        ctl.process_if_ready()
+        for a in agents.values():
+            a.tick()
+
+
+class TestByteIdentity:
+    def test_disabled_plane_is_byte_identical(self):
+        """Recovery constructed-but-unprovoked (spares held, detector
+        armed, no failures) must journal the EXACT record sequence of
+        the plane-off build."""
+        traces = []
+        for recovery in (False, True):
+            clock, api, ctl, agents, sched = _mini_cluster(recovery)
+            journal = DecisionJournal(clock=lambda: clock[0])
+            with obs.scoped(journal=journal):
+                for i in range(3):
+                    api.create(KIND_POD, make_slice_pod(
+                        "2x2", 1, name=f"p{i}",
+                        creation_timestamp=0.5))
+                _drive(clock, ctl, agents, sched, 12)
+            traces.append([
+                (r.category, r.subject, tuple(sorted(
+                    (k, str(v)) for k, v in r.attrs.items()
+                    if k != "plan_id")))
+                for r in journal.events()])
+        assert traces[0] == traces[1]
+
+
+class TestEndToEndRecovery:
+    def test_kill_promote_rebind_with_zero_never_rebound(self):
+        """The seeded kill-trace regression pin: a killed busy host's
+        job requeues displaced, a spare is promoted into the index,
+        and the job rebinds — never_rebound == 0."""
+        clock, api, ctl, agents, sched = _mini_cluster(recovery=True)
+        journal = DecisionJournal(clock=lambda: clock[0])
+        with obs.scoped(journal=journal):
+            api.create(KIND_POD, make_slice_pod(
+                "2x4", 1, name="job", namespace="work",
+                creation_timestamp=0.5))
+            _drive(clock, ctl, agents, sched, 10)
+            pod = api.get(KIND_POD, "job", "work")
+            killed_on = pod.spec.node_name
+            assert killed_on and pod.status.phase == RUNNING
+            # keep the other host busy so the rebind NEEDS the spare
+            other = next(h for h in ("h0", "h1") if h != killed_on)
+            filler = make_slice_pod("2x4", 1, name="filler",
+                                    namespace="work", node_name=other,
+                                    phase="Running")
+            api.create(KIND_POD, filler)
+            # the kill: agent dies, pods die, node object vanishes
+            agents.pop(killed_on).stop()
+            api.delete(KIND_POD, "job", "work")
+            api.delete(KIND_NODE, killed_on)
+            # the workload controller requeues the victim DISPLACED
+            api.create(KIND_POD, make_slice_pod(
+                "2x4", 1, name="job", namespace="work",
+                creation_timestamp=0.5,
+                annotations={C.ANNOT_DISPLACED: displaced_value(
+                    "node-loss", clock[0])}))
+            _drive(clock, ctl, agents, sched, 20)
+        promoted = journal.events(category=J.SPARE_PROMOTED)
+        assert promoted and promoted[0].attrs["replaced"] == killed_on
+        pod = api.get(KIND_POD, "job", "work")
+        assert pod.spec.node_name == "spare0"
+        assert pod.status.phase == RUNNING          # never_rebound = 0
+        rebound = journal.events(category=J.JOB_REBOUND)
+        assert rebound and rebound[0].attrs["cause"] == "node-loss"
+
+
+class TestConfigKnobs:
+    def test_recovery_knobs_validate(self):
+        from nos_tpu.api.config import (
+            ConfigError, PartitionerConfig, SchedulerConfig,
+        )
+
+        PartitionerConfig().validate()          # defaults: plane off
+        SchedulerConfig().validate()
+        PartitionerConfig(spare_hosts_per_pool=2,
+                          node_suspect_after_s=30.0,
+                          migrate_grace_s=5.0).validate()
+        for bad in (PartitionerConfig(spare_hosts_per_pool=-1),
+                    PartitionerConfig(node_suspect_after_s=-1.0),
+                    PartitionerConfig(migrate_grace_s=-1.0),
+                    SchedulerConfig(displaced_age_cap_s=-1.0)):
+            with pytest.raises(ConfigError):
+                bad.validate()
+
+    def test_agent_heartbeat_defaults_off(self):
+        """Production agents stamp the liveness heartbeat only on
+        opt-in (pair with node_suspect_after_s on the partitioner) —
+        the stamp makes every steady-state report a real write."""
+        from nos_tpu.api.config import AgentConfig
+
+        assert AgentConfig(node_name="h0").heartbeat is False
+        AgentConfig(node_name="h0", heartbeat=True).validate()
+
+
+class TestWasteDisplacedRendering:
+    def test_obs_waste_names_the_kill_cause(self, capsys):
+        """The cookbook's promise (docs/troubleshooting.md): displaced
+        wait is distinguishable in the waterfall — the gang_wait and
+        frag culprit lines name the kill cause."""
+        from nos_tpu.obs.__main__ import cmd_waste
+
+        clock = [0.0]
+        led = ChipSecondLedger(clock=lambda: clock[0])
+        led.observe({"pod-0": {
+            "capacity": 16.0,
+            "categories": {"gang_wait": 10.0, "frag_stranded": 6.0},
+            "evidence": {
+                "gang_wait": {"gang": "work/gang-7",
+                              "displaced_cause": "node-loss"},
+                "frag_stranded": {"class": "gang-4x4",
+                                  "rejected_nodes": 3,
+                                  "displaced_cause": "drain-migrate"},
+            }}})
+        clock[0] = 5.0
+        led.observe({"pod-0": {"capacity": 16.0, "categories": {}}})
+        assert cmd_waste({"waste": led.report(), "journal": []}) == 0
+        out = capsys.readouterr().out
+        assert "culprit gang work/gang-7: assembly stalled " \
+               "(displaced: node-loss)" in out
+        assert "(displaced: drain-migrate)" in out
+
+
+# ---------------------------------------------------------------------------
+# Chaos: kill a node mid-handshake under lockcheck + guard_state
+# ---------------------------------------------------------------------------
+
+
+class TestChaosNodeKillMidHandshake:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_kill_mid_handshake_recovers_under_lockdep(self, seed):
+        """A node dies BETWEEN the plan write and its report (the spec
+        plan id is ahead of status) while the chaos substrate injects
+        conflicts/transients/watch drops: the handshake must not wedge,
+        the spare must be promoted, demand must converge — with every
+        lock constructed in the window checked for order inversions and
+        the policy/quarantine/ledger state @guarded_by-convicted on any
+        unlocked write."""
+        from nos_tpu.utils import retry as retry_mod
+
+        original_sleep = retry_mod.sleep
+        retry_mod.sleep = lambda s: None
+        graph = LockGraph(name=f"nodeloss-chaos-{seed}")
+        try:
+            with graph.install():
+                api = ChaosAPIServer(seed, conflict_rate=0.15,
+                                     transient_rate=0.10,
+                                     drop_watch_rate=0.10,
+                                     replay_after_ops=5)
+                state = ClusterState()
+            clock = [0.0]
+            with graph.install():
+                NodeController(api, state,
+                               SliceNodeInitializer(api)).bind()
+                PodController(api, state).bind()
+                ctl = new_slice_partitioner_controller(
+                    api, state, batch_timeout_s=60.0, batch_idle_s=10.0,
+                    clock=lambda: clock[0],
+                    spare_hosts_per_pool=1, node_suspect_after_s=300.0)
+                ctl.bind()
+                agents = {}
+                for i in range(2):
+                    name = f"host-{i}"
+                    api.create(KIND_NODE, make_tpu_node(
+                        name, pod_id="pod-0", host_index=i))
+                    agent = SliceAgent(api, name, FakeTpuRuntime(V5E),
+                                       FakePodResources())
+                    agent.start()
+                    agents[name] = agent
+                api.create(KIND_NODE, make_tpu_node(
+                    "spare-0", pod_id="pod-0", host_index=100,
+                    extra_labels={C.LABEL_SPARE: C.SPARE_WARM}))
+                spare_agent = SliceAgent(api, "spare-0",
+                                         FakeTpuRuntime(V5E),
+                                         FakePodResources())
+                spare_agent.start()
+                agents["spare-0"] = spare_agent
+                sched = build_scheduler(api, clock=lambda: clock[0])
+                journal = DecisionJournal(maxlen=256,
+                                          clock=lambda: clock[0])
+                ledger = ChipSecondLedger(clock=lambda: clock[0])
+            guard_state(state, graph, name="partitioning.ClusterState")
+            guard_state(ctl.quarantine, graph,
+                        name="core.QuarantineList")
+            guard_state(ctl._recovery, graph,
+                        name="core.SelfHealingPolicy")
+            guard_state(journal, graph, name="obs.DecisionJournal")
+            guard_state(ledger, graph, name="obs.ChipSecondLedger")
+
+            for i in range(3):
+                api.create(KIND_POD, make_slice_pod(
+                    "2x2", 1, name=f"c{i}"))
+            errors = []
+
+            def tick(name, fn):
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"seed={seed} {name}: {e!r}")
+
+            killed = False
+            with obs.scoped(journal=journal, ledger=ledger):
+                for rnd in range(60):
+                    clock[0] += 61.0
+                    tick("scheduler", sched.run_cycle)
+                    tick("partitioner", ctl.process_if_ready)
+                    if not killed and rnd >= 2:
+                        # mid-handshake: the controller just planned;
+                        # kill host-0 BEFORE its agent can report, so
+                        # its spec plan id dies ahead of its status
+                        killed = True
+                        agents.pop("host-0").stop()
+                        victims = []
+                        for p in api.pods_on_node("host-0"):
+                            try:
+                                api.delete(KIND_POD, p.metadata.name,
+                                           p.metadata.namespace)
+                                victims.append(p.metadata.name)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        try:
+                            api.delete(KIND_NODE, "host-0")
+                        except Exception:  # noqa: BLE001
+                            pass
+                        # the workload controller's duty: requeue the
+                        # victims DISPLACED (the bench/production path)
+                        for name in victims:
+                            api.create(KIND_POD, make_slice_pod(
+                                "2x2", 1, name=name,
+                                annotations={
+                                    C.ANNOT_DISPLACED: displaced_value(
+                                        "node-loss", clock[0])}))
+                    for name, a in list(agents.items()):
+                        tick(f"agent-{name}", a.tick)
+                    api.replay_dropped()
+                    bound = [p for p in api.list(KIND_POD)
+                             if p.spec.node_name
+                             and p.status.phase == RUNNING]
+                    if killed and len(bound) == 3:
+                        break
+                clock[0] += 61.0
+                tick("scheduler-final", sched.run_cycle)
+
+            assert not errors, errors
+            bound = [p for p in api.list(KIND_POD)
+                     if p.spec.node_name and p.status.phase == RUNNING]
+            assert len(bound) == 3, [p.key for p in api.list(KIND_POD)]
+            assert journal.events(category=J.SPARE_PROMOTED)
+            assert conservation_ok(ledger.report())
+            graph.assert_clean()
+        finally:
+            graph.close()
+            unguard_all()
+            retry_mod.sleep = original_sleep
