@@ -1,0 +1,538 @@
+"""Capacity-plane benchmark: demand swings and stockout storms against
+the cloud node-pool provisioner (ISSUE 16; docs/capacity.md).
+
+Before this plane the fleet was fixed: a 2x demand swing either queued
+jobs against a wall (too few hosts) or stranded chips idle (too many),
+and a zonal stockout during a ramp was an operator page.  This bench
+drives both regimes against the provisioner and gates that the plane
+holds the line with ZERO operator action:
+
+- **Swing**: steady demand doubles mid-trace, then halves back.  The
+  provisioner must scale the pool up (sustained-deficit trigger,
+  bounded in-flight creates, slow cloud + slow join modeled) and back
+  down (drained top-index hosts only), keeping serving utilization >=
+  95% outside brief adaptation windows.  The join lag shows up in the
+  waste ledger as `provisioning` chip-seconds — "cloud is slow", NOT
+  `idle_no_demand` — and chip-second conservation holds throughout.
+- **Storm**: a zonal stockout opens exactly when demand steps up.  The
+  per-(class, zone) breaker must OPEN (journaled transition), spare
+  borrowing from the sibling pool must cover the whole gap, no job may
+  starve, and every pending create must be landed or reaped by trace
+  end — nothing leaks.
+- **Off means off**: a provisioner-disabled run never constructs the
+  plane; an ARMED-but-quiescent run (capacity exactly matching steady
+  demand) must journal the byte-identical decision sequence — the
+  plane leaks nothing into scheduling while it has nothing to do.
+
+Gates (asserted per seed, exit 1 on regression):
+- swing utilization >= 0.95 (outside warmup + adaptation windows);
+- swing scale-up landed >= 4 hosts and scale-down released >= 3, final
+  pool within one host of the baseline (round trip, no ratchet);
+- provisioning chip-seconds > 0 attributed in the swing run's ledger;
+- storm: breaker open transition journaled, borrows == 2, every job
+  bound by settle end (never_bound == 0), zero outstanding cloud ops;
+- byte-identity of the quiescent armed run vs the plane-off run;
+- chip-second conservation inside every run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+from nos_tpu.api import constants as C
+from nos_tpu.capacity import CapacityProvisioner, CloudTPUAPI
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD, NotFound
+from nos_tpu.obs import journal as J, scoped as obs_scoped
+from nos_tpu.obs import ledger as L
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
+from nos_tpu.testing.chaos import ChaosCloudTPUAPI
+from nos_tpu.testing.factory import admit_all, make_slice_pod, make_tpu_node
+from nos_tpu.topology import V5E
+from nos_tpu.topology.profile import slice_resource_name
+from nos_tpu.utils import retry as retry_mod
+from nos_tpu.utils.retry import retry_on_conflict
+
+MC = V5E.name                        # "tpu-v5e"
+CHIPS_PER_HOST = V5E.chips_per_host  # 8
+SHAPE = "2x4"                        # whole-host jobs: 8 chips each
+SLICE_RES = slice_resource_name(SHAPE)
+
+TICK_S = 0.5
+WARMUP_S = 30.0
+SETTLE_S = 90.0
+JOIN_LAG_S = 6.0                     # VM up -> agent serving geometry
+PROVISION_DELAY_S = 8.0
+
+# swing: 4 hosts' demand -> 8 hosts' -> back, one pool, one zone
+SWING_TRACE_S = 600.0
+SWING_SHIFTS = (200.0, 400.0)
+SWING_ADAPT_S = 90.0
+BASE_HOSTS = 4
+UTIL_TARGET = 0.95
+
+# storm: two pools, demand steps up exactly as the target zone stocks out
+STORM_TRACE_S = 420.0
+STORM_START = 120.0
+STORM_DURATION_S = 160.0
+STORM_ADAPT_S = 40.0
+STORM_POOL_HOSTS = 3
+STORM_SPARES = 2
+
+QUIET_TRACE_S = 120.0
+
+DURATION_LO, DURATION_HI = 15.0, 25.0
+
+PROV_KNOBS = dict(
+    scale_up_deficit_chips=8.0, scale_up_after_s=4.0,
+    scale_up_cooldown_s=6.0, max_pending_creates=4,
+    scale_down_idle_s=15.0, scale_down_cooldown_s=8.0,
+    min_hosts_per_pool=1, provision_deadline_s=60.0,
+    join_grace_s=JOIN_LAG_S + 4.0, vacancy_grace_s=2.0,
+    breaker_threshold=2, breaker_open_s=40.0, spare_target_per_pool=0,
+)
+
+
+def percentile(xs, q, digits=2):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], digits)
+
+
+class Job:
+    def __init__(self, name, duration, created):
+        self.name = name
+        self.duration = duration
+        self.created = created
+        self.bound_at = None
+
+
+class Sim:
+    """One trace run.  `plane` constructs + polls the provisioner; the
+    plane-off run never constructs it (off means off — there is no
+    disabled-but-present mode).  `scenario` picks the demand/fault
+    schedule: swing | storm | quiet."""
+
+    def __init__(self, seed=0, plane=True, scenario="swing"):
+        self.seed = seed
+        self.plane = plane
+        self.scenario = scenario
+        self.rng = random.Random(seed)
+        self.now = [0.0]
+        clock = lambda: self.now[0]  # noqa: E731
+        self.api = APIServer()
+        self.scheduler = build_scheduler(self.api, 16, clock=clock)
+        self.ledger = ChipSecondLedger(clock=clock)
+        self.journal = DecisionJournal(maxlen=200_000, clock=clock)
+        self.trace_s = {"swing": SWING_TRACE_S, "storm": STORM_TRACE_S,
+                        "quiet": QUIET_TRACE_S}[scenario]
+        self._join_queue: list[tuple[float, str]] = []
+        self.cloud = None
+        self.prov = None
+        if plane:
+            if scenario == "storm":
+                # deterministic storm: the injected window is the fault;
+                # the random fault rates stay 0 so gates are exact
+                self.cloud = ChaosCloudTPUAPI(
+                    seed, clock=clock,
+                    provision_delay_s=PROVISION_DELAY_S)
+            else:
+                self.cloud = CloudTPUAPI(
+                    clock=clock, provision_delay_s=PROVISION_DELAY_S)
+            self.cloud.set_joiner(self._cloud_join)
+            self.prov = CapacityProvisioner(self.api, self.cloud,
+                                            clock=clock, **PROV_KNOBS)
+        if scenario == "storm":
+            for h in range(STORM_POOL_HOSTS):
+                self._add_host("pod-0", h, zone="us-a")
+                self._add_host("pod-1", h, zone="us-b")
+            for s in range(STORM_SPARES):
+                self._add_host("pod-1", 100 + s, zone="us-b", spare=True)
+        else:
+            for h in range(BASE_HOSTS):
+                self._add_host("pod-0", h, zone="us-a")
+        self._storm_injected = False
+        self.jobs: dict[str, Job] = {}
+        self._job_seq = 0
+        self._pod_job: dict[str, Job] = {}
+        self.completed = 0
+        self.waits: list[float] = []
+        self._util_area = 0.0
+        self._util_time = 0.0
+        self._util_min = 1.0
+
+    # -- cluster -------------------------------------------------------------
+    def _add_host(self, pool, host_index, zone, spare=False):
+        extra = {C.LABEL_ZONE: zone}
+        name = f"{pool}-h{host_index}"
+        if spare:
+            extra[C.LABEL_SPARE] = C.SPARE_WARM
+            name = f"{pool}-spare{host_index}"
+        self.api.create(KIND_NODE, make_tpu_node(
+            name, pod_id=pool, host_index=host_index,
+            status_geometry={"free": {SHAPE: 1}}, extra_labels=extra))
+
+    def _cloud_join(self, cloud_node):
+        """The kubelet-join model: the node object appears bare (labels
+        only, no geometry — the agent is still starting) and begins
+        serving JOIN_LAG_S later.  Until then its chips read as
+        `provisioning` in the waste waterfall (the hold the provisioner
+        stamped at create), not `idle_no_demand`."""
+        labels = dict(cloud_node.labels)
+        pool = labels.pop(C.LABEL_POD_ID, "pod-0")
+        idx = int(labels.pop(C.LABEL_HOST_INDEX, "0"))
+        for managed in (C.LABEL_ACCELERATOR, C.LABEL_PARTITIONING,
+                        C.LABEL_CHIP_COUNT):
+            labels.pop(managed, None)
+        self.api.create(KIND_NODE, make_tpu_node(
+            cloud_node.name, pod_id=pool, host_index=idx,
+            extra_labels=labels))
+        self._join_queue.append((self.now[0] + JOIN_LAG_S,
+                                 cloud_node.name))
+
+    def _land_joins(self):
+        for due, name in [e for e in self._join_queue
+                          if e[0] <= self.now[0]]:
+            self._join_queue.remove((due, name))
+
+            def mutate(node):
+                node.metadata.annotations[
+                    f"{C.ANNOT_STATUS_PREFIX}0-{SHAPE}-free"] = "1"
+                node.status.allocatable[SLICE_RES] = 1.0
+                node.status.capacity[SLICE_RES] = 1.0
+
+            try:
+                retry_on_conflict(self.api, KIND_NODE, name, mutate,
+                                  component="bench-join")
+            except NotFound:
+                pass        # scaled down / reaped before it ever served
+
+    # -- demand schedule -----------------------------------------------------
+    def _target_chips(self) -> float:
+        t = self.now[0]
+        if self.scenario == "swing":
+            lo, hi = SWING_SHIFTS
+            base = BASE_HOSTS * CHIPS_PER_HOST
+            return float(2 * base if lo <= t < hi else base)
+        if self.scenario == "storm":
+            base = 2 * STORM_POOL_HOSTS * CHIPS_PER_HOST
+            return float(base + STORM_SPARES * CHIPS_PER_HOST
+                         if t >= STORM_START else base)
+        return float(BASE_HOSTS * CHIPS_PER_HOST)       # quiet
+
+    def _scenario_events(self):
+        if (self.scenario == "storm" and not self._storm_injected
+                and self.now[0] >= STORM_START):
+            self._storm_injected = True
+            self.cloud.inject_stockout(MC, "us-a",
+                                       duration_s=STORM_DURATION_S)
+
+    def _in_adaptation(self) -> bool:
+        t = self.now[0]
+        if t < WARMUP_S:
+            return True
+        if self.scenario == "swing":
+            return any(s <= t < s + SWING_ADAPT_S for s in SWING_SHIFTS)
+        if self.scenario == "storm":
+            return STORM_START <= t < STORM_START + STORM_ADAPT_S
+        return False
+
+    # -- workload ------------------------------------------------------------
+    def _spawn(self, target=None):
+        target = self._target_chips() if target is None else target
+        inflight = len(self.jobs) * float(CHIPS_PER_HOST)
+        while inflight < target:
+            self._job_seq += 1
+            name = f"job-{self._job_seq}"
+            job = Job(name, self.rng.uniform(DURATION_LO, DURATION_HI),
+                      self.now[0])
+            self.api.create(KIND_POD, make_slice_pod(
+                SHAPE, 1, name=name, namespace="work",
+                creation_timestamp=self.now[0]))
+            self.jobs[name] = job
+            self._pod_job[name] = job
+            inflight += CHIPS_PER_HOST
+
+    def _complete_finished(self):
+        for job in list(self.jobs.values()):
+            if job.bound_at is None \
+                    or self.now[0] < job.bound_at + job.duration:
+                continue
+            try:
+                self.api.delete(KIND_POD, job.name, "work")
+            except NotFound:
+                pass
+            self._pod_job.pop(job.name, None)
+            del self.jobs[job.name]
+            self.completed += 1
+
+    def _record_binds(self):
+        for p in self.api.list(KIND_POD):
+            if not p.spec.node_name:
+                continue
+            job = self._pod_job.get(p.metadata.name)
+            if job is not None and job.bound_at is None:
+                job.bound_at = self.now[0]
+                self.waits.append(self.now[0] - job.created)
+
+    # -- measurement ---------------------------------------------------------
+    def _serving_chips(self) -> float:
+        chips = 0.0
+        for node in self.api.list(KIND_NODE):
+            labels = node.metadata.labels
+            if labels.get(C.LABEL_SPARE, "") == C.SPARE_WARM:
+                continue
+            if not any(k.startswith(C.ANNOT_STATUS_PREFIX)
+                       for k in node.metadata.annotations):
+                continue        # joined but not serving yet
+            chips += float(labels.get(C.LABEL_CHIP_COUNT, "0") or 0.0)
+        return chips
+
+    def _sample_utilization(self):
+        if self._in_adaptation():
+            return
+        live = self._serving_chips()
+        if live <= 0:
+            return
+        used = sum(CHIPS_PER_HOST for p in self.api.list(KIND_POD)
+                   if p.spec.node_name)
+        util = min(1.0, used / live)
+        self._util_area += util * TICK_S
+        self._util_time += TICK_S
+        self._util_min = min(self._util_min, util)
+
+    def _active_hosts(self) -> int:
+        return sum(1 for n in self.api.list(KIND_NODE)
+                   if n.metadata.labels.get(C.LABEL_SPARE, "")
+                   != C.SPARE_WARM)
+
+    # -- main loop -----------------------------------------------------------
+    def _tick(self, spawn_target=None):
+        self._scenario_events()
+        self._complete_finished()
+        self._land_joins()
+        self._spawn(target=spawn_target)
+        self.scheduler.run_cycle()
+        if self.prov is not None:
+            self.prov.reconcile()
+        admit_all(self.api)
+        self._record_binds()
+        self._sample_utilization()
+
+    def run(self):
+        # cloud 429 retries back off through utils/retry's sleep seam;
+        # virtual time must not really sleep
+        real_sleep, retry_mod.sleep = retry_mod.sleep, lambda s: None
+        try:
+            with obs_scoped(journal=self.journal, ledger=self.ledger):
+                while self.now[0] < self.trace_s:
+                    self.now[0] += TICK_S
+                    self._tick()
+                # settle: demand stops, the backlog must drain — a job
+                # spawned seconds before trace end deserves its bind
+                # before the never_bound verdict is passed
+                settle_until = self.now[0] + SETTLE_S
+                while self.now[0] < settle_until \
+                        and any(j.bound_at is None
+                                for j in self.jobs.values()):
+                    self.now[0] += TICK_S
+                    self._tick(spawn_target=0.0)
+        finally:
+            retry_mod.sleep = real_sleep
+        waste = self.ledger.report()
+        assert conservation_ok(waste), (
+            "chip-second conservation violated: "
+            + str({p: v["conservation_delta"]
+                   for p, v in waste["pools"].items()}))
+        never_bound = sorted(j.name for j in self.jobs.values()
+                             if j.bound_at is None)
+        counters = dict(self.prov.report().get("counters", {})) \
+            if self.prov is not None else {}
+        outstanding = (list(self.cloud.list_operations())
+                       if self.cloud is not None else [])
+        breaker_opens = len([
+            r for r in self.journal.events(category=J.PROVISION_STOCKOUT)
+            if r.attrs.get("state") == "open"])
+        return {
+            "utilization_pct": round(
+                self._util_area / self._util_time, 4)
+                if self._util_time else 0.0,
+            "utilization_min": round(self._util_min, 4),
+            "jobs_completed": self.completed,
+            "never_bound": len(never_bound),
+            "never_bound_jobs": never_bound,
+            "bind_wait_p50_s": percentile(self.waits, 0.5),
+            "bind_wait_p90_s": percentile(self.waits, 0.9),
+            "hosts_final": self._active_hosts(),
+            "provision_landed": counters.get("landed", 0),
+            "scale_downs": counters.get("scale_downs", 0),
+            "borrows": counters.get("borrows", 0),
+            "breaker_opens": breaker_opens,
+            "outstanding_ops": len(outstanding),
+            "provisioning_chip_seconds": round(
+                waste["fleet"]["chip_seconds"].get(L.PROVISIONING, 0.0),
+                1),
+        }
+
+    def decision_trace(self):
+        """(category, subject, attrs) with run-unique identifiers (uuid
+        plan ids) normalized — the byte-identity basis."""
+        return [(r.category, r.subject, tuple(sorted(
+            (k, str(v)) for k, v in r.attrs.items()
+            if k != "plan_id")))
+            for r in self.journal.events()]
+
+
+def check_byte_identity():
+    """Off means off: the armed-but-quiescent plane (capacity exactly
+    matching steady demand, no faults) must journal the EXACT record
+    sequence of a run that never constructed the plane.  Any leak —
+    a speculative create, a scale-down twitch on a churn gap — shows
+    up as the first divergent record."""
+    off = Sim(seed=0, plane=False, scenario="quiet")
+    off.run()
+    on = Sim(seed=0, plane=True, scenario="quiet")
+    on_result = on.run()
+    a, b = off.decision_trace(), on.decision_trace()
+    quiescent = (on_result["provision_landed"] == 0
+                 and on_result["scale_downs"] == 0)
+    if not quiescent:
+        return False, ("armed plane acted on a quiet trace: "
+                       + json.dumps(on_result))
+    if a == b:
+        return True, f"{len(a)} records identical"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if ra != rb:
+            return False, f"first divergence at record {i}: {ra} vs {rb}"
+    return False, f"length mismatch: {len(a)} vs {len(b)}"
+
+
+def assert_gates(seed, swing, storm):
+    failures = []
+    if swing["utilization_pct"] < UTIL_TARGET:
+        failures.append(
+            f"seed {seed}: swing utilization "
+            f"{swing['utilization_pct']} < {UTIL_TARGET}")
+    if swing["provision_landed"] < BASE_HOSTS:
+        failures.append(
+            f"seed {seed}: swing landed only "
+            f"{swing['provision_landed']} hosts (< {BASE_HOSTS})")
+    if swing["scale_downs"] < BASE_HOSTS - 1:
+        failures.append(
+            f"seed {seed}: swing released only "
+            f"{swing['scale_downs']} hosts (< {BASE_HOSTS - 1})")
+    if swing["hosts_final"] > BASE_HOSTS + 1:
+        failures.append(
+            f"seed {seed}: swing did not round-trip — "
+            f"{swing['hosts_final']} hosts at end (ratchet)")
+    if swing["never_bound"] != 0:
+        failures.append(
+            f"seed {seed}: swing never_bound = {swing['never_bound']} "
+            f"({swing['never_bound_jobs']})")
+    if swing["provisioning_chip_seconds"] <= 0.0:
+        failures.append(
+            f"seed {seed}: no provisioning chip-seconds attributed — "
+            f"the join lag read as idle_no_demand")
+    if swing["outstanding_ops"] != 0:
+        failures.append(
+            f"seed {seed}: swing left {swing['outstanding_ops']} cloud "
+            f"ops outstanding")
+    if storm["breaker_opens"] < 1:
+        failures.append(f"seed {seed}: storm never opened the breaker")
+    if storm["borrows"] != STORM_SPARES:
+        failures.append(
+            f"seed {seed}: storm borrowed {storm['borrows']} spares "
+            f"(expected {STORM_SPARES} — borrowing must cover the gap)")
+    if storm["never_bound"] != 0:
+        failures.append(
+            f"seed {seed}: storm never_bound = {storm['never_bound']} "
+            f"({storm['never_bound_jobs']})")
+    if storm["outstanding_ops"] != 0:
+        failures.append(
+            f"seed {seed}: storm left {storm['outstanding_ops']} cloud "
+            f"ops outstanding (pending creates must land or be reaped)")
+    if storm["utilization_pct"] < UTIL_TARGET:
+        failures.append(
+            f"seed {seed}: storm utilization "
+            f"{storm['utilization_pct']} < {UTIL_TARGET}")
+    return failures
+
+
+def run_bench(seeds, identity=True):
+    per_seed = {}
+    failures = []
+    for seed in seeds:
+        swing = Sim(seed=seed, plane=True, scenario="swing").run()
+        storm = Sim(seed=seed, plane=True, scenario="storm").run()
+        failures.extend(assert_gates(seed, swing, storm))
+        per_seed[str(seed)] = {"swing": swing, "storm": storm}
+    out = {
+        "base_hosts": BASE_HOSTS,
+        "trace_seconds": {"swing": SWING_TRACE_S, "storm": STORM_TRACE_S},
+        "utilization_target": UTIL_TARGET,
+        "utilization_worst": min(
+            (min(s["swing"]["utilization_pct"],
+                 s["storm"]["utilization_pct"])
+             for s in per_seed.values()), default=None),
+        "per_seed": per_seed,
+        "gates": {"failures": failures},
+    }
+    if identity:
+        identical, detail = check_byte_identity()
+        if not identical:
+            failures.append(
+                f"provisioner-disabled not byte-identical: {detail}")
+        out["byte_identity"] = {"ok": identical, "detail": detail}
+    out["ok"] = not failures
+    return out
+
+
+def run_smoke():
+    """CI gate (scripts/check.sh): one seed, both scenarios, every gate
+    asserted — swing utilization and round trip, storm breaker +
+    borrowing + op hygiene, byte-identity, conservation (inside each
+    run).  Raises AssertionError on regression."""
+    t0 = time.perf_counter()
+    out = run_bench([0])
+    out["smoke"] = "ok" if out["ok"] else "FAILED"
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    assert out["ok"], "capacity gates failed: " + "; ".join(
+        out["gates"]["failures"])
+    assert out["wall_s"] < 300.0, \
+        f"capacity smoke took {out['wall_s']}s (> 300s bound)"
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="cloud capacity provisioner bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed capacity gate (CI)")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="seeds for the full run")
+    ap.add_argument("--capacity-report", default="",
+                    help="also write the result JSON to this file "
+                         "(CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_bench(list(range(args.seeds)))
+    if args.capacity_report:
+        with open(args.capacity_report, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=2)
+        print(f"capacity report written to {args.capacity_report}",
+              file=sys.stderr)
+    print(json.dumps(out))
+    if not out.get("ok", True):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

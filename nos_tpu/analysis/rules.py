@@ -128,7 +128,10 @@ class InjectableClock(Rule):
     # under the virtual bench clock, and a raw time.time() would both
     # break seed reproducibility and mis-measure cooldowns against
     # pod creation timestamps stamped from the injected clock.
-    scope = ("nos_tpu/controllers/", "nos_tpu/obs/",
+    # capacity/ too: the provisioner's deadlines, breaker windows and
+    # surplus timers all run on the injected clock — bench_capacity's
+    # virtual-clock scenarios and the chaos soak depend on it.
+    scope = ("nos_tpu/capacity/", "nos_tpu/controllers/", "nos_tpu/obs/",
              "nos_tpu/partitioning/", "nos_tpu/scheduler/",
              "nos_tpu/serving/")
 

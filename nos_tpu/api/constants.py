@@ -54,6 +54,12 @@ LABEL_CHIP_COUNT = f"{GROUP}/chip-count"            # chips on this host
 LABEL_POD_ID = f"{GROUP}/pod-id"                    # physical TPU pod identity
 LABEL_HOST_INDEX = f"{GROUP}/host-index"            # host ordinal within the pod
 LABEL_HOST_COORDS = f"{GROUP}/host-coords"          # host origin in pod mesh, "x,y[,z]"
+# Cloud zone the host was provisioned in (capacity plane,
+# nos_tpu/capacity): the stockout circuit breaker keys on
+# (machine class, zone) — a v5e stockout in one zone must not stop
+# creates for the same class elsewhere.  Absent reads as "-" (single
+# unnamed zone), so pre-capacity clusters need no relabel.
+LABEL_ZONE = f"{GROUP}/zone"
 
 # Timeshare device-plugin config selector (analog of
 # nvidia.com/device-plugin.config, reference internal/partitioning/mps/partitioner.go:103-110).

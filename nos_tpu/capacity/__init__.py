@@ -1,0 +1,56 @@
+"""Cloud capacity plane: the provisioner that grows, shrinks and heals
+the TPU node fleet against a (simulated) cloud node-pool API.
+
+Everything above this package assumes the set of hosts is whatever the
+API server says it is; this package is the only place that *changes*
+that set.  Two halves:
+
+- ``cloudapi``  — the provider model: an async create/delete node-pool
+  API with operations that land after a provisioning delay, plus the
+  fault seams (stockout, quota, 429, slow, zombie, failed delete) that
+  ``nos_tpu.testing.chaos.ChaosCloudTPUAPI`` overrides.
+- ``provisioner`` — the level-triggered reconcile controller: scale-up
+  on sustained pending demand, scale-down of drained empty hosts, warm
+  spare replacement, per-(machine class, zone) stockout circuit breaker
+  with cross-pool spare borrowing, and provisioning-deadline reaping of
+  zombies.  Crash-safe: desired state is re-derived every poll from the
+  observed inventory plus a durable pool-size record.
+
+Off means off: with ``ProvisionerConfig.enabled`` false (the default)
+none of this is constructed and the decision journal is byte-identical
+to a build without the plane (bench_capacity.py proves it).
+"""
+
+from .cloudapi import (
+    AlreadyExistsError,
+    CloudError,
+    CloudNotFoundError,
+    CloudTPUAPI,
+    DeleteFailedError,
+    QuotaExceededError,
+    RateLimitedError,
+    StockoutError,
+)
+from .provisioner import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CapacityProvisioner,
+    StockoutBreaker,
+)
+
+__all__ = [
+    "AlreadyExistsError",
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CapacityProvisioner",
+    "CloudError",
+    "CloudNotFoundError",
+    "CloudTPUAPI",
+    "DeleteFailedError",
+    "QuotaExceededError",
+    "RateLimitedError",
+    "StockoutError",
+    "StockoutBreaker",
+]

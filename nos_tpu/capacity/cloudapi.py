@@ -1,0 +1,287 @@
+"""Simulated Cloud TPU node-pool API.
+
+The provider model the capacity plane reconciles against.  Shaped after
+the real Cloud TPU node API in the three ways that matter for control
+logic, and deliberately nothing else:
+
+- **Creates are asynchronous.**  ``create_node`` returns an operation
+  id immediately; the node materialises only after a provisioning
+  delay, observed on the next read.  Controllers must therefore be
+  level-triggered — they can never assume a create they issued last
+  poll has landed, or even that it ever will.
+- **Capacity errors are typed.**  ``StockoutError`` (the class/zone has
+  no machines) and ``QuotaExceededError`` are *not* retryable inline —
+  retrying a stockout hot-loops against an empty warehouse; they feed
+  the provisioner's circuit breaker instead.  ``RateLimitedError``
+  (HTTP 429) subclasses the kube client's ``TransientAPIError`` so the
+  standard jittered-backoff retry path covers it.
+- **Joining is a separate step.**  A landed cloud node only becomes a
+  scheduler-visible host when the ``joiner`` callback fires (the test
+  harness wires it to create the API-server Node and start an agent; a
+  real deployment's kubelet plays this role).  A "zombie" is a create
+  the cloud reports DONE whose joiner never fires — the node exists,
+  burns quota, and never takes work; only deadline reaping clears it.
+
+Fault injection lives in the ``_pre_call`` / ``_draw_create_fault`` /
+``_draw_delete_fault`` seams, which this base class leaves inert;
+``nos_tpu.testing.chaos.ChaosCloudTPUAPI`` overrides them with seeded
+draws.  Keeping the base class fault-free preserves the repo's pattern:
+production-shaped code here, chaos in testing/.
+
+Locking: one leaf lock over the operation/node tables.  The joiner is
+invoked *outside* the lock (it creates API-server objects, which takes
+the API-server lock — calling it under ours would add a lock-order
+edge; noslint N004).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from nos_tpu.kube.client import TransientAPIError
+from nos_tpu.utils.guards import guarded_by
+
+OP_PENDING = "PENDING"
+OP_DONE = "DONE"
+OP_FAILED = "FAILED"
+
+
+class CloudError(Exception):
+    """Base class for cloud node-pool API errors."""
+
+
+class CloudNotFoundError(CloudError):
+    """The named node/operation does not exist."""
+
+
+class AlreadyExistsError(CloudError):
+    """A node or in-flight create with this name already exists.  The
+    idempotency backstop: a provisioner that crashed after issuing a
+    create and re-issues it on restart gets this, not a duplicate."""
+
+
+class StockoutError(CloudError):
+    """No machines of this class available in this zone right now.
+    NOT retryable inline — feed the stockout circuit breaker."""
+
+    def __init__(self, machine_class: str, zone: str) -> None:
+        super().__init__(f"stockout: {machine_class} in {zone}")
+        self.machine_class = machine_class
+        self.zone = zone
+
+
+class QuotaExceededError(CloudError):
+    """The project's node quota is exhausted.  NOT retryable inline —
+    only a scale-down or a quota bump clears it."""
+
+
+class RateLimitedError(CloudError, TransientAPIError):
+    """HTTP 429.  Subclasses TransientAPIError so the standard
+    utils/retry jittered-backoff path retries it."""
+
+
+class DeleteFailedError(CloudError, TransientAPIError):
+    """A delete the cloud accepted but failed to execute.  Transient:
+    the level-triggered reconcile simply retries next poll."""
+
+
+class CloudOperation:
+    """One asynchronous create.  ``lands_at`` is when the create
+    settles against the clock; ``zombie`` (sim-internal) marks a create
+    whose node will land in the cloud but never invoke the joiner."""
+
+    __slots__ = ("op_id", "name", "machine_class", "zone", "labels",
+                 "status", "error", "created_at", "lands_at", "zombie")
+
+    def __init__(self, op_id: str, name: str, machine_class: str,
+                 zone: str, labels: dict[str, str], created_at: float,
+                 lands_at: float, zombie: bool) -> None:
+        self.op_id = op_id
+        self.name = name
+        self.machine_class = machine_class
+        self.zone = zone
+        self.labels = labels
+        self.status = OP_PENDING
+        self.error = ""
+        self.created_at = created_at
+        self.lands_at = lands_at
+        self.zombie = zombie
+
+    def to_dict(self) -> dict[str, object]:
+        return {
+            "op_id": self.op_id,
+            "name": self.name,
+            "machine_class": self.machine_class,
+            "zone": self.zone,
+            "labels": dict(self.labels),
+            "status": self.status,
+            "error": self.error,
+            "created_at": self.created_at,
+            "lands_at": self.lands_at,
+        }
+
+
+class CloudNode:
+    """A node the cloud believes exists (landed create, not deleted)."""
+
+    __slots__ = ("name", "machine_class", "zone", "labels", "created_at")
+
+    def __init__(self, name: str, machine_class: str, zone: str,
+                 labels: dict[str, str], created_at: float) -> None:
+        self.name = name
+        self.machine_class = machine_class
+        self.zone = zone
+        self.labels = labels
+        self.created_at = created_at
+
+    def to_dict(self) -> dict[str, object]:
+        return {
+            "name": self.name,
+            "machine_class": self.machine_class,
+            "zone": self.zone,
+            "labels": dict(self.labels),
+            "created_at": self.created_at,
+        }
+
+
+@guarded_by("_lock", "_ops", "_nodes", "_seq")
+class CloudTPUAPI:
+    """The fault-free provider.  Operations settle lazily: every read
+    first lands any due creates against the clock, invoking ``joiner``
+    for each non-zombie landing (outside the lock)."""
+
+    def __init__(self, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 provision_delay_s: float = 1.0,
+                 quota_nodes: int = 0,
+                 joiner: Callable[[CloudNode], None] | None = None) -> None:
+        self._clock = clock
+        self._provision_delay_s = provision_delay_s
+        # 0 = unlimited.  Quota counts landed nodes plus in-flight
+        # creates: a pending create reserves its machine.
+        self._quota_nodes = quota_nodes
+        self._joiner = joiner
+        self._lock = threading.Lock()
+        self._ops: dict[str, CloudOperation] = {}
+        self._nodes: dict[str, CloudNode] = {}
+        self._seq = 0
+
+    def set_joiner(self, joiner: Callable[[CloudNode], None]) -> None:
+        self._joiner = joiner
+
+    # -- fault seams (inert here; ChaosCloudTPUAPI overrides) ---------------
+    def _pre_call(self, verb: str) -> None:
+        """Raise RateLimitedError to 429 a call before it executes."""
+
+    def _draw_create_fault(self, machine_class: str,
+                           zone: str) -> tuple[float, bool]:
+        """Return (extra provisioning delay, zombie?) for one create, or
+        raise StockoutError / QuotaExceededError."""
+        return 0.0, False
+
+    def _draw_delete_fault(self, name: str) -> None:
+        """Raise DeleteFailedError to fail one delete."""
+
+    # -- write side ---------------------------------------------------------
+    def create_node(self, name: str, machine_class: str, zone: str = "-",
+                    labels: dict[str, str] | None = None) -> str:
+        """Start an asynchronous node create; returns the operation id.
+
+        Raises AlreadyExistsError for a duplicate name (landed or in
+        flight), QuotaExceededError / StockoutError / RateLimitedError
+        per the provider's state and the chaos seams."""
+        self._pre_call("create")
+        now = self._clock()
+        with self._lock:
+            if name in self._nodes or any(
+                    op.name == name and op.status == OP_PENDING
+                    for op in self._ops.values()):
+                raise AlreadyExistsError(name)
+            if self._quota_nodes > 0:
+                in_use = len(self._nodes) + sum(
+                    1 for op in self._ops.values()
+                    if op.status == OP_PENDING)
+                if in_use >= self._quota_nodes:
+                    raise QuotaExceededError(
+                        f"quota: {in_use}/{self._quota_nodes} nodes")
+        # the fault draw takes its own (chaos) lock; never ours
+        extra, zombie = self._draw_create_fault(machine_class, zone)
+        with self._lock:
+            self._seq += 1
+            op = CloudOperation(
+                f"op-{self._seq}", name, machine_class, zone,
+                dict(labels or {}), now,
+                now + self._provision_delay_s + extra, zombie)
+            self._ops[op.op_id] = op
+            return op.op_id
+
+    def delete_node(self, name: str) -> None:
+        """Delete a landed node, or cancel its in-flight create.  Raises
+        CloudNotFoundError if the cloud has no record of the name, and
+        DeleteFailedError (transient) under chaos."""
+        self._pre_call("delete")
+        self._settle()
+        self._draw_delete_fault(name)
+        with self._lock:
+            if name in self._nodes:
+                del self._nodes[name]
+                return
+            for op in self._ops.values():
+                if op.name == name and op.status == OP_PENDING:
+                    op.status = OP_FAILED
+                    op.error = "cancelled"
+                    return
+        raise CloudNotFoundError(name)
+
+    def ack_operation(self, op_id: str) -> None:
+        """Drop a settled operation record: the controller's GC after it
+        has journalled the outcome.  Unknown ids are a no-op (crash
+        between ack and journal is at-least-once, never lost)."""
+        with self._lock:
+            op = self._ops.get(op_id)
+            if op is not None and op.status != OP_PENDING:
+                del self._ops[op_id]
+
+    # -- read side ----------------------------------------------------------
+    def get_operation(self, op_id: str) -> dict[str, object]:
+        self._settle()
+        with self._lock:
+            op = self._ops.get(op_id)
+            if op is None:
+                raise CloudNotFoundError(op_id)
+            return op.to_dict()
+
+    def list_operations(self) -> list[dict[str, object]]:
+        """All unacked operations, oldest first."""
+        self._settle()
+        with self._lock:
+            return [op.to_dict() for op in
+                    sorted(self._ops.values(), key=lambda o: o.op_id)]
+
+    def list_nodes(self) -> list[dict[str, object]]:
+        self._settle()
+        with self._lock:
+            return [self._nodes[k].to_dict()
+                    for k in sorted(self._nodes)]
+
+    # -- settlement ---------------------------------------------------------
+    def _settle(self) -> None:
+        """Land every due create.  Joiner callbacks fire after the lock
+        is released (they take the API-server lock; N004)."""
+        now = self._clock()
+        joined: list[CloudNode] = []
+        with self._lock:
+            for op in self._ops.values():
+                if op.status != OP_PENDING or now < op.lands_at:
+                    continue
+                op.status = OP_DONE
+                node = CloudNode(op.name, op.machine_class, op.zone,
+                                 dict(op.labels), now)
+                self._nodes[op.name] = node
+                if not op.zombie:
+                    joined.append(node)
+        if self._joiner is not None:
+            for node in joined:
+                self._joiner(node)

@@ -8,7 +8,8 @@ the benchmark, and a simulation wire the identical control plane.
 from __future__ import annotations
 
 from nos_tpu.api.config import (
-    HYBRID_KIND, PartitionerConfig, SLICE_KIND, TIMESHARE_KIND,
+    HYBRID_KIND, PartitionerConfig, ProvisionerConfig, SLICE_KIND,
+    TIMESHARE_KIND,
 )
 from nos_tpu.cmd._runtime import Main
 from nos_tpu.controllers.node_controller import NodeController
@@ -93,6 +94,73 @@ def build_partitioner_main(api: APIServer, state: ClusterState,
     else:
         bind_controllers()
     return main, controllers
+
+
+def build_provisioner_main(api: APIServer, cfg: ProvisionerConfig,
+                           cloud=None, main: Main | None = None,
+                           clock=None) -> Main:
+    """The capacity provisioner wired as a leader-gated run loop.
+
+    Off means off: this must only be called with ``cfg.enabled`` true —
+    the disabled path (cmd/provisioner.py, benches) never constructs
+    the plane, so a disabled build's decision journal is byte-identical
+    to one without the plane at all.  `cloud` defaults to an in-memory
+    CloudTPUAPI (tests/benches pass a ChaosCloudTPUAPI)."""
+    from nos_tpu.capacity import CapacityProvisioner, CloudTPUAPI
+
+    if not cfg.enabled:
+        raise ValueError("build_provisioner_main requires enabled=true "
+                         "(off means off: the disabled path never "
+                         "constructs the capacity plane)")
+    main = main or Main("nos-tpu-provisioner", cfg.health_probe_addr,
+                        api=api)
+    kwargs = {} if clock is None else {"clock": clock}
+    if cloud is None:
+        cloud = CloudTPUAPI(provision_delay_s=cfg.provision_delay_s,
+                            quota_nodes=cfg.quota_nodes, **kwargs)
+    provisioner = CapacityProvisioner(
+        api, cloud,
+        scale_up_deficit_chips=cfg.scale_up_deficit_chips,
+        scale_up_after_s=cfg.scale_up_after_s,
+        scale_up_cooldown_s=cfg.scale_up_cooldown_s,
+        max_pending_creates=cfg.max_pending_creates,
+        scale_down_idle_s=cfg.scale_down_idle_s,
+        scale_down_cooldown_s=cfg.scale_down_cooldown_s,
+        min_hosts_per_pool=cfg.min_hosts_per_pool,
+        provision_deadline_s=cfg.provision_deadline_s,
+        join_grace_s=cfg.join_grace_s,
+        vacancy_grace_s=cfg.vacancy_grace_s,
+        breaker_threshold=cfg.breaker_threshold,
+        breaker_open_s=cfg.breaker_open_s,
+        spare_target_per_pool=cfg.spare_target_per_pool,
+        inventory_configmap=cfg.inventory_configmap,
+        inventory_namespace=cfg.inventory_namespace,
+        chips_per_host_cap=cfg.chips_per_host_cap,
+        hbm_gb_per_chip=cfg.hbm_gb_per_chip,
+        cloud_attempts=cfg.cloud_attempts,
+        **kwargs)
+    main.provisioner = provisioner      # test/bench/obs handle
+    from nos_tpu.obs import set_flight_block
+
+    set_flight_block("capacity", provisioner.report)
+
+    def bind() -> None:
+        """The reconcile writes (cloud creates/deletes, node deletes,
+        the inventory ConfigMap), so with leader election it binds only
+        on GAINING the lease — a standby must not provision."""
+        main.add_loop("provisioner", provisioner.reconcile,
+                      cfg.poll_interval_s)
+
+    if cfg.leader_election:
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        main.attach_leader_election(LeaderElector(
+            api, "nos-tpu-provisioner-leader", on_started_leading=bind))
+    else:
+        bind()
+    if cfg.slo_interval_s > 0:
+        main.attach_slo(interval_s=cfg.slo_interval_s)
+    return main
 
 
 def build_scheduler(api: APIServer,

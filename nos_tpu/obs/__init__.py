@@ -19,6 +19,7 @@ Three layers over the scheduler ↔ partitioner ↔ actuator pipeline
 from __future__ import annotations
 
 import contextlib
+import logging
 from typing import Iterator
 
 from .explain import explain_plan, explain_pod
@@ -43,9 +44,27 @@ __all__ = [
     "TimeSeriesSampler", "Tracer",
     "bump", "current_span", "detail_span", "explain_plan", "explain_pod",
     "flight_snapshot", "get_engine", "get_journal", "get_ledger",
-    "get_tracer", "record", "scoped", "set_engine", "set_journal",
-    "set_ledger", "set_tracer", "span",
+    "get_tracer", "record", "scoped", "set_engine", "set_flight_block",
+    "set_journal", "set_ledger", "set_tracer", "span",
 ]
+
+# Extra named blocks a component can ride into the flight-recorder
+# payload (the capacity plane registers its report here, so `obs
+# capacity` works from the same one-fetch snapshot as waste/explain).
+# A provider is a zero-arg callable returning a JSON-ready dict; a
+# raising provider is dropped from THAT snapshot, never fails the dump.
+_flight_blocks: dict = {}
+
+
+def set_flight_block(name, provider=None):
+    """Register (or, with provider=None, remove) a named snapshot
+    block.  Returns the previous provider."""
+    prev = _flight_blocks.get(name)
+    if provider is None:
+        _flight_blocks.pop(name, None)
+    else:
+        _flight_blocks[name] = provider
+    return prev
 
 
 def flight_snapshot() -> dict:
@@ -68,6 +87,12 @@ def flight_snapshot() -> dict:
     # journal, so `obs waste`'s culprit→journal join works from one
     # fetch (the explain/slo workflow, docs/observability.md)
     snapshot["waste"] = get_ledger().report()
+    for name, provider in list(_flight_blocks.items()):
+        try:
+            snapshot[name] = provider()
+        except Exception:  # noqa: BLE001 — a sick block must not kill the dump
+            logging.getLogger(__name__).warning(
+                "flight snapshot block %r raised; omitted", name)
     return snapshot
 
 

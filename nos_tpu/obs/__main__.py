@@ -397,6 +397,25 @@ def _waste_culprit(journal: list[dict], category: str,
         if rec is not None:
             lines.append(f"quarantined (seq {rec['seq']}): "
                          f"{rec.get('attrs', {}).get('reason', '?')}")
+    elif category == "provisioning":
+        node = str(evidence.get("node", "") or "?")
+        lines.append(f"culprit node {node}: create requested from "
+                     f"{evidence.get('machine_class', '?')}/"
+                     f"{evidence.get('zone', '?')}, not usable yet "
+                     "(cloud is slow or stocked out — NOT idle slack)")
+        stock = _newest(journal, J.PROVISION_STOCKOUT)
+        if stock is not None:
+            lines.append(f"newest breaker transition: {stock['subject']} "
+                         f"-> {stock.get('attrs', {}).get('state', '?')}")
+        rec = _newest(journal, J.PROVISION_REQUESTED, subject=node) \
+            or _newest(journal, J.PROVISION_REQUESTED)
+        if rec is not None:
+            attrs = rec.get("attrs", {})
+            lines.append(f"newest create request ({rec['subject']}): "
+                         f"pool {attrs.get('pool', '?')} op "
+                         f"{attrs.get('op', '?')}")
+        lines.append("next: `obs capacity` for breaker states and "
+                     "in-flight creates")
     elif category == "quota_stranded" and evidence.get("class"):
         cls = str(evidence["class"])
         lines.append(f"culprit class {cls}: "
@@ -466,6 +485,77 @@ def cmd_waste(payload: dict) -> int:
               f"({'borrow' if flip.get('borrowed') else 'reclaim'}, "
               f"namespace {flip.get('namespace')})")
     return 0 if conserved else 1
+
+
+def cmd_capacity(payload: dict) -> int:
+    """Render the capacity plane's state: per-pool inventory vs the
+    durable size record, stockout breaker states, in-flight creates,
+    and the provisioning counters — the surface the troubleshooting
+    runbook sends operators to when pending demand coexists with an
+    `idle_no_demand` (or `provisioning`) deficit."""
+    block = payload.get("capacity")
+    if not isinstance(block, dict):
+        print("payload carries no capacity block — the provisioner is "
+              "disabled (off means off) or this snapshot predates it; "
+              "fetch /debug/flightrecorder from the provisioner main",
+              file=sys.stderr)
+        return 1
+    journal = payload.get("journal", [])
+    pools = block.get("pools", {})
+    print("capacity plane:")
+    print(f"  pending demand {_fmt(block.get('pending_demand_chips'), 1)} "
+          f"chips | free {_fmt(block.get('free_chips'), 1)} | arriving "
+          f"{_fmt(block.get('arriving_chips'), 1)} | deficit "
+          f"{_fmt(block.get('deficit_chips'), 1)}")
+    for pool in sorted(pools):
+        p = pools[pool]
+        gap = int(p.get("recorded_size", 0)) - int(p.get("active", 0))
+        note = f" ({gap} vacant)" if gap > 0 else ""
+        print(f"pool {pool}: {p.get('active', 0)}/"
+              f"{p.get('recorded_size', 0)} hosts{note}, "
+              f"{p.get('spares', 0)} spare(s), "
+              f"{_fmt(p.get('free_chips'), 1)} free chips "
+              f"[{p.get('machine_class', '?')}/{p.get('zone', '?')}]")
+    breakers = block.get("breakers", {})
+    if breakers:
+        print("stockout breakers:")
+        for key in sorted(breakers):
+            b = breakers[key]
+            retry = (f", probe in {_fmt(b.get('retry_in_s'), 1)}s"
+                     if b.get("state") == "open" else "")
+            print(f"  {key}: {b.get('state', '?')} "
+                  f"(streak {b.get('streak', 0)}{retry})")
+    pending = block.get("pending_creates", [])
+    if pending:
+        print("in-flight creates:")
+        for row in pending:
+            print(f"  {row.get('name', '?')} -> pool "
+                  f"{row.get('pool', '?')} "
+                  f"[{row.get('machine_class', '?')}/"
+                  f"{row.get('zone', '?')}] {row.get('status', '?')} "
+                  f"for {_fmt(row.get('age_s'), 1)}s")
+    counters = block.get("counters", {})
+    if counters:
+        print("counters: " + ", ".join(
+            f"{k}={counters[k]}" for k in sorted(counters)))
+    # journal joins: the newest breaker transition and failure tell the
+    # operator WHY capacity is not arriving, not just that it is not
+    stock = _newest(journal, J.PROVISION_STOCKOUT)
+    if stock is not None:
+        print(f"newest breaker transition: {stock['subject']} -> "
+              f"{stock.get('attrs', {}).get('state', '?')} "
+              f"(seq {stock['seq']})")
+    failed = _newest(journal, J.PROVISION_FAILED)
+    if failed is not None:
+        print(f"newest abandoned create: {failed['subject']} "
+              f"({failed.get('attrs', {}).get('reason', '?')})")
+    borrow = _newest(journal, J.SPARE_BORROWED)
+    if borrow is not None:
+        attrs = borrow.get("attrs", {})
+        print(f"newest cross-pool borrow: {borrow['subject']} -> pool "
+              f"{attrs.get('pool', '?')} index "
+              f"{attrs.get('host_index', '?')}")
+    return 0
 
 
 def selftest() -> int:
@@ -698,7 +788,10 @@ def main(argv: list[str] | None = None) -> int:
     p_waste = sub.add_parser(
         "waste", help="chip-second waste waterfall: per-pool category "
                       "breakdown, conservation verdict, ranked culprits")
-    for p in (p_pod, p_plan, p_dump, p_slo, p_top, p_waste):
+    p_capacity = sub.add_parser(
+        "capacity", help="capacity plane: pool inventory vs recorded "
+                         "size, stockout breakers, in-flight creates")
+    for p in (p_pod, p_plan, p_dump, p_slo, p_top, p_waste, p_capacity):
         p.add_argument("--snapshot", default="",
                        help="saved snapshot JSON ('-'=stdin)")
         p.add_argument("--url", default="",
@@ -739,6 +832,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_top(snapshot)
     if args.command == "waste":
         return cmd_waste(snapshot)
+    if args.command == "capacity":
+        return cmd_capacity(snapshot)
     if args.what == "pod":
         if "/" not in args.key:
             print("pod key must be <namespace>/<name>", file=sys.stderr)

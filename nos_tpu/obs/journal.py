@@ -92,6 +92,20 @@ GANG_RESIZED = "gang-resized"
 JOB_DISPLACED = "job-displaced"
 JOB_REBOUND = "job-rebound"
 SPARE_PROMOTED = "spare-promoted"
+# Cloud capacity plane (nos_tpu/capacity): a scale-up/replacement
+# decision is REQUESTED when the provisioner asks the cloud for a node,
+# LANDED when the node joined and became usable (latency from the
+# request), FAILED when it was abandoned (stockout/quota/zombie reap/
+# deadline; reason recorded).  STOCKOUT records a per-(machine class,
+# zone) breaker transition (state recorded: open / half-open / closed).
+# SPARE_BORROWED records a cross-pool spare promoted into a vacancy
+# because the preferred machine class was stocked out.
+PROVISION_REQUESTED = "provision-requested"
+PROVISION_LANDED = "provision-landed"
+PROVISION_FAILED = "provision-failed"
+PROVISION_STOCKOUT = "provision-stockout"
+SPARE_BORROWED = "spare-borrowed"
+SCALE_DOWN = "scale-down"
 
 
 class DecisionRecord:

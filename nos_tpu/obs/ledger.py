@@ -71,11 +71,16 @@ ACTUATION = "actuation"
 QUARANTINE = "quarantine"
 QUOTA_STRANDED = "quota_stranded"
 DRAIN = "drain"
+# A host the capacity plane asked the cloud for, between the scale-up
+# decision and the node becoming usable (nos_tpu/capacity): its free
+# chips are "cloud is slow", NOT idle_no_demand — `obs waste` must be
+# able to tell a stocked-out/slow provider from genuine slack.
+PROVISIONING = "provisioning"
 IDLE_NO_DEMAND = "idle_no_demand"
 
 CATEGORIES: tuple[str, ...] = (
     PRODUCTIVE, FRAG_STRANDED, GANG_WAIT, ACTUATION, QUARANTINE,
-    QUOTA_STRANDED, DRAIN, IDLE_NO_DEMAND,
+    QUOTA_STRANDED, DRAIN, PROVISIONING, IDLE_NO_DEMAND,
 )
 
 #: Categories that are *waste* (everything but productive).  Idle with
@@ -86,8 +91,11 @@ WASTE_CATEGORIES: tuple[str, ...] = tuple(
 
 #: Hold kinds an owning subsystem may stamp on a node (attribution of
 #: the node's FREE chips, strongest first): quarantine outranks an
-#: in-flight actuation, which outranks a drain marker.
-HOLD_PRECEDENCE: tuple[str, ...] = (QUARANTINE, ACTUATION, DRAIN)
+#: in-flight actuation, which outranks a drain marker, which outranks
+#: the capacity plane's provisioning window (a just-joined host that
+#: is simultaneously quarantined or draining is THAT problem first).
+HOLD_PRECEDENCE: tuple[str, ...] = (QUARANTINE, ACTUATION, DRAIN,
+                                    PROVISIONING)
 
 
 def stranded_free(free_by_host: Mapping[str, float],

@@ -86,6 +86,82 @@ def _pool_of(node: Node) -> str:
     return node.metadata.labels.get(C.LABEL_POD_ID, "") or "-"
 
 
+def host_index_vacancies(live: Mapping[int, str],
+                         expected_count: int) -> list[int]:
+    """Missing host indices under the contiguous-from-0 window
+    convention (topology/windows.py), judged against `expected_count`
+    hosts.  THE shared vacancy inference: the spare policy seeds its
+    first-sight baseline with ``expected_count = max(live)`` (interior
+    gaps only — all it can prove from one snapshot), while the capacity
+    provisioner passes its durably recorded pool size, which also
+    exposes a dead HIGHEST index (the blind spot documented in
+    docs/scheduler.md, closed by nos_tpu/capacity)."""
+    return [idx for idx in range(expected_count) if idx not in live]
+
+
+def healthy_spares_by_pool(
+        nodes: Mapping[str, Node],
+        is_quarantined: Callable[[str], bool] | None = None,
+) -> dict[str, list[str]]:
+    """pool -> sorted warm-spare names that are PROMOTABLE: not
+    quarantined (a spare whose own agent froze would consume the
+    vacancy while the gang window stays broken) and not marked for
+    maintenance.  Shared by the spare policy's inventory walk and the
+    capacity provisioner's replacement/borrowing passes so the two
+    planes can never disagree on what "held and healthy" means."""
+    out: dict[str, list[str]] = {}
+    for name, node in nodes.items():
+        if not is_warm_spare(node):
+            continue
+        if is_quarantined is not None and is_quarantined(name):
+            continue
+        if node.metadata.annotations.get(C.ANNOT_MAINTENANCE, ""):
+            continue
+        out.setdefault(_pool_of(node), []).append(name)
+    for names in out.values():
+        names.sort()
+    return out
+
+
+def promote_spare(api: APIServer, spare: str, pool: str, idx: int, *,
+                  kind: str = "", dead: str = "",
+                  cross_pool: bool = False) -> bool:
+    """One label patch turns a warm spare into a vacancy's replacement:
+    spare label off, the vacated host-index on — and, for a CROSS-POOL
+    borrow (capacity plane, stockout degradation), the target pool-id
+    too, in the same patch.  The geometry is already carved and
+    reported, so the displaced gang can rebind the moment the
+    scheduler's next snapshot sees it.  Returns False (advisory: the
+    caller's next poll retries) when the spare vanished or the patch
+    failed."""
+    def mutate(n: Node) -> None:
+        n.metadata.labels.pop(C.LABEL_SPARE, None)
+        n.metadata.labels[C.LABEL_HOST_INDEX] = str(idx)
+        if cross_pool:
+            n.metadata.labels[C.LABEL_POD_ID] = pool
+
+    try:
+        retry_on_conflict(api, KIND_NODE, spare, mutate,
+                          component="spare-promotion")
+    except NotFound:
+        return False            # the spare itself vanished
+    except Exception:  # noqa: BLE001 — advisory: next poll retries
+        logger.warning("spare promotion patch failed for %s "
+                       "(kind=%s pool=%s)", spare, kind, pool)
+        return False
+    REGISTRY.inc("nos_tpu_spare_promotions_total", labels={"pool": pool})
+    if cross_pool:
+        journal_record(J.SPARE_BORROWED, spare, kind=kind, pool=pool,
+                       host_index=idx, replaced=dead)
+    else:
+        journal_record(J.SPARE_PROMOTED, spare, kind=kind, pool=pool,
+                       host_index=idx, replaced=dead)
+    logger.info("spare promotion[%s]: %s into %s index %d "
+                "(replacing %s%s)", kind, spare, pool, idx, dead,
+                ", cross-pool borrow" if cross_pool else "")
+    return True
+
+
 @guarded_by("_lock", "_hb", "_expected", "_migrations", "_stray_hb",
             "_evicted")
 class SelfHealingPolicy:
@@ -198,30 +274,29 @@ class SelfHealingPolicy:
     def _reconcile_spares(self, nodes: Mapping[str, Node]) -> None:
         spares_by_pool: dict[str, list[str]] = {}
         active: dict[str, dict[int, str]] = {}
-        for name, node in nodes.items():
-            if not self._owns_promotion(node):
-                continue
-            pool = _pool_of(node)
+        owned = {name: node for name, node in nodes.items()
+                 if self._owns_promotion(node)}
+        # only HEALTHY spares are promotable (and counted as
+        # inventory — a pool whose spares are dead should warn
+        # short): a quarantined spare (its own agent's heartbeat
+        # froze) or one marked for maintenance would consume the
+        # vacancy while its gang window stays broken — the
+        # never_rebound outcome the plane exists to kill.  A spare
+        # with NO heartbeat signal stays promotable (the detector's
+        # no-signal rule).  The health predicate is the shared
+        # healthy_spares_by_pool, so the capacity provisioner's
+        # replacement pass counts the same inventory.
+        spares_by_pool.update(healthy_spares_by_pool(
+            owned, self._quarantine.is_quarantined))
+        for name, node in owned.items():
             if is_warm_spare(node):
-                # only HEALTHY spares are promotable (and counted as
-                # inventory — a pool whose spares are dead should warn
-                # short): a quarantined spare (its own agent's
-                # heartbeat froze) or one marked for maintenance would
-                # consume the vacancy while its gang window stays
-                # broken — the never_rebound outcome the plane exists
-                # to kill.  A spare with NO heartbeat signal stays
-                # promotable (the detector's no-signal rule).
-                if not self._quarantine.is_quarantined(name) \
-                        and not node.metadata.annotations.get(
-                            C.ANNOT_MAINTENANCE, ""):
-                    spares_by_pool.setdefault(pool, []).append(name)
                 continue
             try:
                 idx = int(node.metadata.labels.get(
                     C.LABEL_HOST_INDEX, ""))
             except ValueError:
                 continue
-            active.setdefault(pool, {})[idx] = name
+            active.setdefault(_pool_of(node), {})[idx] = name
         with self._lock:
             expected = {pool: dict(table)
                         for pool, table in self._expected.items()}
@@ -233,13 +308,15 @@ class SelfHealingPolicy:
         # missing interior index IS a vacancy: seed it into the
         # baseline with a placeholder name.  Losing the pool's HIGHEST
         # index pre-restart is indistinguishable from a smaller pool
-        # and stays invisible until the node rejoins or an operator
-        # relabels — documented in docs/scheduler.md.
+        # FROM ONE SNAPSHOT ALONE (max(live) is all this pass can
+        # prove); the capacity provisioner closes that last gap by
+        # judging the same inference against its durably recorded pool
+        # size (docs/scheduler.md; nos_tpu/capacity/provisioner.py).
         for pool, live in active.items():
             if pool in expected or not live:
                 continue
             gaps = {idx: "(lost-before-restart)"
-                    for idx in range(max(live)) if idx not in live}
+                    for idx in host_index_vacancies(live, max(live))}
             if gaps:
                 expected[pool] = {**live, **gaps}
         promoted: dict[str, dict[int, str]] = {}
@@ -299,31 +376,11 @@ class SelfHealingPolicy:
 
     def _promote(self, spare: str, pool: str, idx: int,
                  dead: str) -> bool:
-        """One label patch turns a warm spare into the dead host's
-        replacement: spare label off, the vacated host-index on.  The
-        geometry is already carved and reported, so the displaced gang
-        can rebind the moment the scheduler's next snapshot sees it."""
-        def mutate(n: Node) -> None:
-            n.metadata.labels.pop(C.LABEL_SPARE, None)
-            n.metadata.labels[C.LABEL_HOST_INDEX] = str(idx)
-
-        try:
-            retry_on_conflict(self._api, KIND_NODE, spare, mutate,
-                              component="spare-promotion")
-        except NotFound:
-            return False            # the spare itself vanished
-        except Exception:  # noqa: BLE001 — advisory: next poll retries
-            logger.warning("self-healing[%s]: spare promotion patch "
-                           "failed for %s", self._kind, spare)
-            return False
-        REGISTRY.inc("nos_tpu_spare_promotions_total",
-                     labels={"pool": pool})
-        journal_record(J.SPARE_PROMOTED, spare, kind=self._kind,
-                       pool=pool, host_index=idx, replaced=dead)
-        logger.info("self-healing[%s]: promoted warm spare %s into "
-                    "%s index %d (replacing %s)",
-                    self._kind, spare, pool, idx, dead)
-        return True
+        """Same-pool promotion via the shared promote_spare helper (the
+        capacity provisioner's cross-pool borrow uses the same patch
+        path with cross_pool=True)."""
+        return promote_spare(self._api, spare, pool, idx,
+                             kind=self._kind, dead=dead)
 
     # -- drain-then-migrate --------------------------------------------------
     def _migration_targets(self, nodes: Mapping[str, Node]

@@ -111,7 +111,8 @@ def attribute_free_chips(
 ) -> tuple[str, float, float, float]:
     """Attribute ONE node's free chips to exactly one waterfall category
     (docs/observability.md, "The waterfall"): hold precedence first
-    (quarantine > actuation > drain — including defrag drains, so
+    (quarantine > actuation > drain > provisioning — including defrag
+    drains, so
     chip-seconds spent emptying a window for a re-carve land in `drain`
     and are never double-counted with `frag_stranded`), then the gang
     window lease, then this cycle's own verdicts, with the demand-capped
@@ -130,6 +131,11 @@ def attribute_free_chips(
         cat = L.ACTUATION
     elif hold is not None and L.DRAIN in hold:
         cat = L.DRAIN
+    elif hold is not None and L.PROVISIONING in hold:
+        # a host the capacity plane is still landing (cloud create →
+        # join → first report): its free chips are "cloud is slow",
+        # never idle_no_demand or frag (nos_tpu/capacity/provisioner)
+        cat = L.PROVISIONING
     elif reserved:
         cat = L.GANG_WAIT
     elif not demand:
@@ -1785,6 +1791,8 @@ class Scheduler:
                 evidence = {"node": name, **(hold or {})[L.ACTUATION]}
             elif cat == L.DRAIN:
                 evidence = {"node": name, **(hold or {})[L.DRAIN]}
+            elif cat == L.PROVISIONING:
+                evidence = {"node": name, **(hold or {})[L.PROVISIONING]}
             elif cat == L.GANG_WAIT:
                 evidence = gang_ev
             elif cat == L.FRAG_STRANDED:

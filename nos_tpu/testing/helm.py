@@ -101,6 +101,7 @@ CONFIG_KINDS = {
     "nos-tpu-sliceagent-config": "AgentConfig",
     "nos-tpu-chipagent-config": "AgentConfig",
     "nos-tpu-autoscaler-config": "AutoscalerConfig",
+    "nos-tpu-provisioner-config": "ProvisionerConfig",
 }
 
 

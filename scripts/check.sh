@@ -24,12 +24,13 @@ if ! python -m nos_tpu.analysis; then
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/)"
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/)"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
             nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
-            nos_tpu/scheduler nos_tpu/obs nos_tpu/serving; then
+            nos_tpu/scheduler nos_tpu/obs nos_tpu/serving \
+            nos_tpu/capacity; then
         rc=1
     fi
 else
@@ -78,6 +79,13 @@ fi
 echo "==> bench_defrag.py --smoke (defrag gate: utilization floor, frag halving, churn bound, disabled byte-identity)"
 if ! env JAX_PLATFORMS=cpu python bench_defrag.py --smoke \
         --defrag-report "${DEFRAG_REPORT_PATH:-/tmp/nos_tpu_defrag_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
+echo "==> bench_capacity.py --smoke (capacity gate: swing round-trip >= 0.95 util, stockout-storm borrowing, disabled byte-identity)"
+if ! env JAX_PLATFORMS=cpu python bench_capacity.py --smoke \
+        --capacity-report "${CAPACITY_REPORT_PATH:-/tmp/nos_tpu_capacity_report.json}" \
         > /dev/null; then
     rc=1
 fi

@@ -1,0 +1,793 @@
+"""Capacity plane tests: cloud API contract, stockout breaker, the
+provisioner's level-triggered reconcile, and seeded chaos soaks with
+cloud faults under lockcheck.
+
+The regression test the satellite demands is here too: killing a pool's
+HIGHEST-index host while no controller was watching (the blind spot
+docs/scheduler.md documents for the purely observational spare policy)
+and asserting a freshly restarted provisioner still closes the vacancy
+from its durable pool-size record.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nos_tpu import obs
+from nos_tpu.api import constants as C
+from nos_tpu.api.config import ConfigError, ProvisionerConfig
+from nos_tpu.capacity import (
+    AlreadyExistsError, BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+    CapacityProvisioner, CloudNotFoundError, CloudTPUAPI, RateLimitedError,
+    StockoutBreaker, StockoutError,
+)
+from nos_tpu.capacity.cloudapi import OP_DONE, OP_PENDING
+from nos_tpu.cmd.assembly import build_scheduler
+from nos_tpu.kube.client import APIServer, KIND_CONFIGMAP, KIND_NODE, KIND_POD
+from nos_tpu.obs import journal as J
+from nos_tpu.obs import ledger as L
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
+from nos_tpu.testing.chaos import ChaosAPIServer, ChaosCloudTPUAPI
+from nos_tpu.testing.factory import admit_all, make_slice_pod, make_tpu_node
+from nos_tpu.testing.lockcheck import LockGraph, guard_state, unguard_all
+from nos_tpu.utils import retry as retry_mod
+
+
+@pytest.fixture(autouse=True)
+def fast_retry(monkeypatch):
+    monkeypatch.setattr(retry_mod, "sleep", lambda s: None)
+
+
+def make_joiner(api):
+    """The kubelet-join analog the harness wires into the cloud: a
+    landed cloud node becomes an API-server Node carrying the create's
+    labels, with free geometry already reported (agentless)."""
+    def join(cloud_node):
+        labels = dict(cloud_node.labels)
+        pool = labels.pop(C.LABEL_POD_ID, "pod-0")
+        idx = int(labels.pop(C.LABEL_HOST_INDEX, "0"))
+        for managed in (C.LABEL_ACCELERATOR, C.LABEL_PARTITIONING,
+                        C.LABEL_CHIP_COUNT):
+            labels.pop(managed, None)
+        api.create(KIND_NODE, make_tpu_node(
+            cloud_node.name, pod_id=pool, host_index=idx,
+            status_geometry={"free": {"2x2": 2}}, extra_labels=labels))
+    return join
+
+
+class Harness:
+    """Virtual-clock provisioner rig: APIServer + CloudTPUAPI with the
+    join callback wired, obs scoped per test."""
+
+    def __init__(self, cloud=None, provision_delay_s: float = 5.0,
+                 **prov_kw):
+        self.clock = [0.0]
+        self.api = APIServer()
+        self.cloud = cloud if cloud is not None else CloudTPUAPI(
+            clock=lambda: self.clock[0],
+            provision_delay_s=provision_delay_s)
+        self.cloud.set_joiner(make_joiner(self.api))
+        self.journal = DecisionJournal(maxlen=4096,
+                                       clock=lambda: self.clock[0])
+        self.ledger = ChipSecondLedger(clock=lambda: self.clock[0])
+        self.prov = CapacityProvisioner(
+            self.api, self.cloud, clock=lambda: self.clock[0], **prov_kw)
+
+    def add_host(self, pool: str, idx: int, zone: str = "-",
+                 spare: bool = False, park: int | None = None):
+        extra = {C.LABEL_ZONE: zone}
+        if spare:
+            extra[C.LABEL_SPARE] = C.SPARE_WARM
+        name = f"{pool}-h{idx}" if not spare else f"{pool}-sp{idx}"
+        self.api.create(KIND_NODE, make_tpu_node(
+            name, pod_id=pool, host_index=park if park is not None
+            else idx, status_geometry={"free": {"2x2": 2}},
+            extra_labels=extra))
+        return name
+
+    def scoped(self):
+        return obs.scoped(journal=self.journal, ledger=self.ledger)
+
+    def events(self, category):
+        return self.journal.events(category)
+
+
+# ---------------------------------------------------------------------------
+# cloud API contract
+# ---------------------------------------------------------------------------
+
+class TestCloudAPI:
+    def test_create_lands_async_and_joins(self):
+        h = Harness(provision_delay_s=10.0)
+        op = h.cloud.create_node("pod-0-h2", "tpu-v5e", "us-a",
+                                 {C.LABEL_POD_ID: "pod-0",
+                                  C.LABEL_HOST_INDEX: "2"})
+        assert h.cloud.get_operation(op)["status"] == OP_PENDING
+        assert h.api.try_get(KIND_NODE, "pod-0-h2") is None
+        h.clock[0] = 11.0
+        assert h.cloud.get_operation(op)["status"] == OP_DONE
+        node = h.api.try_get(KIND_NODE, "pod-0-h2")
+        assert node is not None
+        assert node.metadata.labels[C.LABEL_HOST_INDEX] == "2"
+        assert [n["name"] for n in h.cloud.list_nodes()] == ["pod-0-h2"]
+
+    def test_duplicate_create_is_already_exists(self):
+        h = Harness()
+        h.cloud.create_node("n1", "tpu-v5e")
+        with pytest.raises(AlreadyExistsError):
+            h.cloud.create_node("n1", "tpu-v5e")
+        h.clock[0] = 6.0
+        h.cloud.list_nodes()
+        with pytest.raises(AlreadyExistsError):
+            h.cloud.create_node("n1", "tpu-v5e")
+
+    def test_delete_cancels_pending_create(self):
+        h = Harness()
+        op = h.cloud.create_node("n1", "tpu-v5e")
+        h.cloud.delete_node("n1")
+        assert h.cloud.get_operation(op)["status"] == "FAILED"
+        h.clock[0] = 60.0
+        assert h.cloud.list_nodes() == []       # never lands
+        with pytest.raises(CloudNotFoundError):
+            h.cloud.delete_node("n1")
+
+    def test_ack_gc_and_quota(self):
+        h = Harness(cloud=None)
+        cloud = CloudTPUAPI(clock=lambda: h.clock[0],
+                            provision_delay_s=1.0, quota_nodes=1)
+        cloud.create_node("n1", "tpu-v5e")
+        from nos_tpu.capacity import QuotaExceededError
+        with pytest.raises(QuotaExceededError):
+            cloud.create_node("n2", "tpu-v5e")
+        h.clock[0] = 2.0
+        ops = cloud.list_operations()
+        assert len(ops) == 1 and ops[0]["status"] == OP_DONE
+        cloud.ack_operation(str(ops[0]["op_id"]))
+        assert cloud.list_operations() == []
+
+    def test_chaos_zombie_never_joins(self):
+        h = Harness(cloud=ChaosCloudTPUAPI(
+            seed=7, zombie_rate=1.0, clock=None))
+        # rebuild with the virtual clock (ctor order quirk)
+        h.cloud = ChaosCloudTPUAPI(seed=7, zombie_rate=1.0,
+                                   clock=lambda: h.clock[0],
+                                   provision_delay_s=1.0)
+        h.cloud.set_joiner(make_joiner(h.api))
+        h.cloud.create_node("z1", "tpu-v5e")
+        h.clock[0] = 5.0
+        assert [n["name"] for n in h.cloud.list_nodes()] == ["z1"]
+        assert h.api.try_get(KIND_NODE, "z1") is None
+        assert h.cloud.cloud_stats["zombies"] == 1
+
+    def test_chaos_stockout_window_is_a_state(self):
+        clock = [0.0]
+        cloud = ChaosCloudTPUAPI(seed=1, clock=lambda: clock[0],
+                                 stockout_window_s=30.0)
+        cloud.inject_stockout("tpu-v5e", "us-a")
+        for _ in range(3):
+            with pytest.raises(StockoutError):
+                cloud.create_node("x", "tpu-v5e", "us-a")
+        # other zones unaffected; window expiry clears the state
+        cloud.create_node("y", "tpu-v5e", "us-b")
+        clock[0] = 31.0
+        cloud.create_node("x", "tpu-v5e", "us-a")
+
+
+# ---------------------------------------------------------------------------
+# stockout breaker
+# ---------------------------------------------------------------------------
+
+class TestStockoutBreaker:
+    def test_threshold_opens_and_half_open_probe(self):
+        clock = [0.0]
+        b = StockoutBreaker(threshold=3, open_s=60.0,
+                            clock=lambda: clock[0])
+        key = ("tpu-v5e", "us-a")
+        assert b.record_stockout(key) is None
+        assert b.record_stockout(key) is None
+        assert b.state(key) == BREAKER_CLOSED and b.allow(key)
+        assert b.record_stockout(key) == BREAKER_OPEN
+        assert b.state(key) == BREAKER_OPEN and not b.allow(key)
+        clock[0] = 61.0
+        assert b.state(key) == BREAKER_HALF_OPEN
+        assert b.allow(key)             # the single probe slot
+        assert not b.allow(key)         # second caller stays blocked
+        # failed probe: full window again
+        assert b.record_stockout(key) == BREAKER_OPEN
+        assert not b.allow(key)
+        clock[0] = 122.0
+        assert b.allow(key)
+        assert b.record_success(key) == BREAKER_CLOSED
+        assert b.state(key) == BREAKER_CLOSED and b.allow(key)
+        assert b.open_count() == 0
+
+    def test_keys_are_independent(self):
+        b = StockoutBreaker(threshold=1, open_s=10.0, clock=lambda: 0.0)
+        assert b.record_stockout(("v5e", "us-a")) == BREAKER_OPEN
+        assert not b.allow(("v5e", "us-a"))
+        assert b.allow(("v5e", "us-b"))
+        assert b.allow(("v6e", "us-a"))
+        snap = b.snapshot()
+        assert snap["v5e/us-a"]["state"] == BREAKER_OPEN
+        assert b.open_count() == 1
+
+    def test_success_resets_streak(self):
+        b = StockoutBreaker(threshold=2, open_s=10.0, clock=lambda: 0.0)
+        key = ("v5e", "-")
+        assert b.record_stockout(key) is None
+        assert b.record_success(key) is None    # closed stays closed
+        assert b.record_stockout(key) is None   # streak restarted
+        assert b.record_stockout(key) == BREAKER_OPEN
+
+
+# ---------------------------------------------------------------------------
+# provisioner reconcile
+# ---------------------------------------------------------------------------
+
+def pump(h: Harness, until: float, step: float = 1.0):
+    while h.clock[0] < until:
+        h.clock[0] = min(until, h.clock[0] + step)
+        h.prov.reconcile()
+
+
+class TestScaleUp:
+    def test_sustained_deficit_provisions_and_lands(self):
+        h = Harness(scale_up_after_s=3.0, scale_up_cooldown_s=1.0,
+                    vacancy_grace_s=1.0)
+        h.add_host("pod-0", 0, zone="us-a")
+        h.add_host("pod-0", 1, zone="us-a")
+        for i in range(7):      # 28 chips demand vs 16 free
+            h.api.create(KIND_POD, make_slice_pod("2x2", 1,
+                                                  name=f"p{i}"))
+        with h.scoped():
+            h.prov.reconcile()                  # starts the sustain timer
+            assert h.events(J.PROVISION_REQUESTED) == []
+            pump(h, 4.0)
+            reqs = h.events(J.PROVISION_REQUESTED)
+            assert reqs, "sustained deficit must provision"
+            name = reqs[0].subject
+            assert name.startswith("pod-0-h")
+            # the gap rides as a PROVISIONING hold, not idle_no_demand
+            assert L.PROVISIONING in h.ledger.holds()[name]
+            pump(h, 12.0)
+            landed = h.events(J.PROVISION_LANDED)
+            assert [r.subject for r in landed][:1] == [name]
+            assert name not in h.ledger.holds()
+            assert h.api.try_get(KIND_NODE, name) is not None
+        report = h.prov.report()
+        assert report["counters"]["landed"] >= 1
+        assert report["pools"]["pod-0"]["recorded_size"] >= 3
+
+    def test_no_demand_no_action(self):
+        h = Harness()
+        h.add_host("pod-0", 0)
+        with h.scoped():
+            pump(h, 30.0)
+        assert h.cloud.list_operations() == []
+        assert h.journal.events() == []
+        assert h.prov.report()["deficit_chips"] <= 0.0
+
+    def test_arriving_capacity_damps_further_creates(self):
+        h = Harness(scale_up_after_s=1.0, scale_up_cooldown_s=0.0,
+                    provision_delay_s=100.0, max_pending_creates=8)
+        h.add_host("pod-0", 0)
+        for i in range(4):      # 16 chips vs 8 free -> one host's worth
+            h.api.create(KIND_POD, make_slice_pod("2x2", 1,
+                                                  name=f"p{i}"))
+        with h.scoped():
+            pump(h, 10.0)
+        # deficit was 8 = one host: exactly one create, then the
+        # arriving capacity keeps the deficit below threshold
+        assert len(h.cloud.list_operations()) == 1
+
+    def test_restart_is_idempotent(self):
+        h = Harness(scale_up_after_s=1.0, scale_up_cooldown_s=0.0,
+                    provision_delay_s=100.0)
+        h.add_host("pod-0", 0)
+        for i in range(4):
+            h.api.create(KIND_POD, make_slice_pod("2x2", 1,
+                                                  name=f"p{i}"))
+        with h.scoped():
+            pump(h, 5.0)
+            assert len(h.cloud.list_operations()) == 1
+            # crash + new leader: same api, same cloud, fresh memory
+            fresh = CapacityProvisioner(
+                h.api, h.cloud, clock=lambda: h.clock[0],
+                scale_up_after_s=1.0, scale_up_cooldown_s=0.0)
+            for _ in range(6):
+                h.clock[0] += 1.0
+                fresh.reconcile()
+        ops = h.cloud.list_operations()
+        assert len(ops) == 1, "restart must not duplicate the create"
+        # durable inventory survived and matches
+        cm = h.api.try_get(KIND_CONFIGMAP, "nos-tpu-capacity-inventory",
+                           "nos-tpu-system")
+        assert cm is not None and '"pod-0": 2' in cm.data["pools"]
+
+
+class TestVacancyAndBlindSpot:
+    def test_dead_top_index_closed_from_durable_record(self):
+        """THE regression: top-index host dies while NO controller is
+        watching; the observational baseline can't see it, the durable
+        record can."""
+        h = Harness(vacancy_grace_s=2.0)
+        for i in range(3):
+            h.add_host("pod-0", i)
+        spare = h.add_host("pod-0", 0, spare=True, park=100)
+        with h.scoped():
+            h.prov.reconcile()      # seeds the durable record: size 3
+        cm = h.api.try_get(KIND_CONFIGMAP, "nos-tpu-capacity-inventory",
+                           "nos-tpu-system")
+        assert cm is not None and '"pod-0": 3' in cm.data["pools"]
+        # the kill, unwatched: nothing running, nothing in memory
+        h.api.delete(KIND_NODE, "pod-0-h2")
+        fresh = CapacityProvisioner(h.api, h.cloud,
+                                    clock=lambda: h.clock[0],
+                                    vacancy_grace_s=2.0)
+        with h.scoped():
+            h.clock[0] += 1.0
+            fresh.reconcile()       # sees the vacancy, grace pending
+            node = h.api.get(KIND_NODE, spare)
+            assert C.LABEL_SPARE in node.metadata.labels
+            h.clock[0] += 3.0
+            fresh.reconcile()       # grace over: spare takes index 2
+        node = h.api.get(KIND_NODE, spare)
+        assert C.LABEL_SPARE not in node.metadata.labels
+        assert node.metadata.labels[C.LABEL_HOST_INDEX] == "2"
+        assert [r.subject for r in h.events(J.SPARE_PROMOTED)] == [spare]
+
+    def test_vacancy_without_spare_provisions(self):
+        h = Harness(vacancy_grace_s=1.0, provision_delay_s=2.0)
+        for i in range(2):
+            h.add_host("pod-0", i, zone="us-a")
+        with h.scoped():
+            h.prov.reconcile()
+            h.api.delete(KIND_NODE, "pod-0-h1")
+            pump(h, 10.0)
+        node = h.api.try_get(KIND_NODE, "pod-0-h1")
+        assert node is not None, "vacancy must be re-provisioned"
+        assert h.events(J.PROVISION_LANDED)
+
+
+class TestStockoutDegradation:
+    def _rig(self, **kw):
+        h = Harness(cloud=None)
+        h.cloud = ChaosCloudTPUAPI(seed=3, clock=lambda: h.clock[0],
+                                   provision_delay_s=5.0)
+        h.cloud.set_joiner(make_joiner(h.api))
+        h.prov = CapacityProvisioner(
+            h.api, h.cloud, clock=lambda: h.clock[0],
+            scale_up_after_s=1.0, scale_up_cooldown_s=0.0,
+            breaker_threshold=2, breaker_open_s=50.0, **kw)
+        return h
+
+    def test_breaker_opens_then_borrowing_covers(self):
+        h = self._rig()
+        h.add_host("pod-0", 0, zone="us-a")
+        h.add_host("pod-1", 0, zone="us-b")
+        h.add_host("pod-1", 1, zone="us-b")
+        borrowable = h.add_host("pod-1", 0, spare=True, park=100)
+        h.cloud.inject_stockout("tpu-v5e", "us-a", duration_s=1000.0)
+        # deficit deep enough that one borrow doesn't erase it — the
+        # retries after the borrow push the streak past the threshold
+        for i in range(12):
+            h.api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"p{i}"))
+        with h.scoped():
+            pump(h, 8.0)
+        stock = h.events(J.PROVISION_STOCKOUT)
+        assert any(r.attrs.get("state") == BREAKER_OPEN for r in stock)
+        assert h.prov.breaker.state(("tpu-v5e", "us-a")) == BREAKER_OPEN
+        borrows = h.events(J.SPARE_BORROWED)
+        assert [r.subject for r in borrows] == [borrowable]
+        node = h.api.get(KIND_NODE, borrowable)
+        assert node.metadata.labels[C.LABEL_POD_ID] == "pod-0"
+        assert C.LABEL_SPARE not in node.metadata.labels
+        assert h.prov.report()["counters"]["borrows"] == 1
+
+    def test_half_open_probe_recloses_after_recovery(self):
+        h = self._rig()
+        h.add_host("pod-0", 0, zone="us-a")
+        h.cloud.inject_stockout("tpu-v5e", "us-a", duration_s=20.0)
+        for i in range(6):
+            h.api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"p{i}"))
+        with h.scoped():
+            pump(h, 8.0)        # stockouts open the breaker
+            assert h.prov.breaker.state(
+                ("tpu-v5e", "us-a")) == BREAKER_OPEN
+            pump(h, 80.0)       # window expires, probe succeeds
+        assert h.prov.breaker.state(("tpu-v5e", "us-a")) == BREAKER_CLOSED
+        states = [r.attrs.get("state")
+                  for r in h.events(J.PROVISION_STOCKOUT)]
+        assert BREAKER_CLOSED in states
+
+
+class TestZombieReap:
+    def test_zombie_reaped_after_deadline(self):
+        h = Harness(cloud=None)
+        h.cloud = ChaosCloudTPUAPI(seed=5, zombie_rate=1.0,
+                                   clock=lambda: h.clock[0],
+                                   provision_delay_s=2.0)
+        h.cloud.set_joiner(make_joiner(h.api))
+        h.prov = CapacityProvisioner(
+            h.api, h.cloud, clock=lambda: h.clock[0],
+            scale_up_after_s=1.0, scale_up_cooldown_s=0.0,
+            provision_deadline_s=10.0)
+        h.add_host("pod-0", 0)
+        for i in range(4):
+            h.api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"p{i}"))
+        with h.scoped():
+            pump(h, 5.0)
+            assert h.events(J.PROVISION_REQUESTED)
+            name = h.events(J.PROVISION_REQUESTED)[0].subject
+            assert L.PROVISIONING in h.ledger.holds().get(name, {})
+            pump(h, 30.0)
+        failed = h.events(J.PROVISION_FAILED)
+        assert any(r.attrs.get("reason") == "zombie" for r in failed)
+        assert name not in h.ledger.holds()     # hold reaped with it
+        assert name not in [n["name"] for n in h.cloud.list_nodes()]
+        assert not [op for op in h.cloud.list_operations()
+                    if op["status"] != OP_PENDING], "reaped ops are acked"
+
+    def test_stuck_pending_create_cancelled_at_deadline(self):
+        h = Harness(cloud=None)
+        h.cloud = ChaosCloudTPUAPI(seed=5, slow_rate=1.0,
+                                   slow_extra_s=500.0,
+                                   clock=lambda: h.clock[0],
+                                   provision_delay_s=2.0)
+        h.cloud.set_joiner(make_joiner(h.api))
+        h.prov = CapacityProvisioner(
+            h.api, h.cloud, clock=lambda: h.clock[0],
+            scale_up_after_s=1.0, scale_up_cooldown_s=1000.0,
+            provision_deadline_s=10.0)
+        h.add_host("pod-0", 0)
+        for i in range(4):
+            h.api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"p{i}"))
+        with h.scoped():
+            pump(h, 30.0)
+        failed = h.events(J.PROVISION_FAILED)
+        assert any(r.attrs.get("reason") in ("deadline", "cancelled")
+                   for r in failed)
+
+
+class TestScaleDown:
+    def _rig(self):
+        h = Harness(scale_down_idle_s=5.0, scale_down_cooldown_s=0.0,
+                    min_hosts_per_pool=1)
+        h.add_host("pod-0", 0)
+        h.add_host("pod-0", 1)
+        return h
+
+    def test_never_deletes_host_with_residents(self):
+        h = self._rig()
+        h.api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="r0", node_name="pod-0-h1", phase="Running"))
+        with h.scoped():
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is not None
+
+    def test_never_deletes_held_host(self):
+        h = self._rig()
+        with h.scoped():
+            h.ledger.set_hold("pod-0-h1", L.DRAIN, owner="t",
+                              gang="g1")
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is not None
+        assert h.events(J.SCALE_DOWN) == []
+
+    def test_never_deletes_while_demand_needs_the_host(self):
+        # 16 pending chips against 16 free: releasing a host would
+        # leave the demand unservable — the release must not happen
+        h = self._rig()
+        for i in range(2):
+            h.api.create(KIND_POD, make_slice_pod("2x4", 1,
+                                                  name=f"q{i}"))
+        with h.scoped():
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is not None
+        assert h.events(J.SCALE_DOWN) == []
+
+    def test_absorbable_pending_demand_does_not_block_release(self):
+        # a churn-transient 4-chip pod fits the remaining host; it must
+        # not reset the idle timer (that would ratchet the fleet up)
+        h = self._rig()
+        h.api.create(KIND_POD, make_slice_pod("2x2", 1, name="q0"))
+        with h.scoped():
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is None
+        assert [r.subject for r in h.events(J.SCALE_DOWN)] \
+            == ["pod-0-h1"]
+
+    def test_busy_shrink_candidate_is_cordoned_then_released(self):
+        # drain-then-release: a resident on the top host must not stall
+        # the shrink forever — the host is cordoned with a capacity-
+        # owned migration drain so the scheduler stops refilling it,
+        # and released once the resident finishes
+        h = self._rig()
+        h.api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="r0", node_name="pod-0-h1", phase="Running"))
+        with h.scoped():
+            pump(h, 30.0)
+            node = h.api.get(KIND_NODE, "pod-0-h1")
+            assert node.metadata.annotations.get(C.ANNOT_DEFRAG_DRAIN) \
+                == C.migration_drain_value("capacity", "scale-down")
+            assert h.prov.report()["counters"]["cordons"] == 1
+            h.api.delete(KIND_POD, "r0", "default")
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is None
+        assert [r.subject for r in h.events(J.SCALE_DOWN)] \
+            == ["pod-0-h1"]
+
+    def test_cordon_retracted_when_demand_returns(self):
+        # level-triggered healing: the surplus evaporates (pending
+        # demand needs the host) — the stamped cordon must come off
+        # the same reconcile, not linger and starve placement
+        h = self._rig()
+        h.api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="r0", node_name="pod-0-h1", phase="Running"))
+        with h.scoped():
+            pump(h, 30.0)
+            node = h.api.get(KIND_NODE, "pod-0-h1")
+            assert C.ANNOT_DEFRAG_DRAIN in node.metadata.annotations
+            for i in range(3):      # 12 pending chips > 12 free
+                h.api.create(KIND_POD, make_slice_pod(
+                    "2x2", 1, name=f"q{i}"))
+            pump(h, 40.0)
+        node = h.api.get(KIND_NODE, "pod-0-h1")
+        assert C.ANNOT_DEFRAG_DRAIN not in node.metadata.annotations
+        assert h.events(J.SCALE_DOWN) == []
+
+    def test_cordon_never_touches_foreign_drains(self):
+        # a defrag/recovery-owned migration drain on the shrink
+        # candidate is someone else's state: the provisioner neither
+        # overwrites it nor retracts it
+        h = self._rig()
+        foreign = C.migration_drain_value("defrag", "plan-7")
+        h.api.patch(KIND_NODE, "pod-0-h1", mutate=lambda n: n.metadata
+                    .annotations.__setitem__(C.ANNOT_DEFRAG_DRAIN, foreign))
+        h.api.create(KIND_POD, make_slice_pod(
+            "2x2", 1, name="r0", node_name="pod-0-h1", phase="Running"))
+        with h.scoped():
+            pump(h, 30.0)
+        node = h.api.get(KIND_NODE, "pod-0-h1")
+        assert node.metadata.annotations[C.ANNOT_DEFRAG_DRAIN] == foreign
+        assert h.prov.report()["counters"]["cordons"] == 0
+
+    def test_sustained_surplus_releases_top_index_only(self):
+        h = self._rig()
+        with h.scoped():
+            pump(h, 60.0)
+        assert h.api.try_get(KIND_NODE, "pod-0-h1") is None
+        assert h.api.try_get(KIND_NODE, "pod-0-h0") is not None, \
+            "min_hosts_per_pool floor holds"
+        downs = h.events(J.SCALE_DOWN)
+        assert [r.subject for r in downs] == ["pod-0-h1"]
+        cm = h.api.try_get(KIND_CONFIGMAP, "nos-tpu-capacity-inventory",
+                           "nos-tpu-system")
+        assert '"pod-0": 1' in cm.data["pools"]
+
+
+class TestSpareReplacement:
+    def test_dead_spare_is_replaced(self):
+        h = Harness(spare_target_per_pool=1, provision_delay_s=2.0,
+                    provision_deadline_s=6.0, join_grace_s=1.0)
+        h.add_host("pod-0", 0)
+        with h.scoped():
+            pump(h, 10.0)
+        spares = [n for n in h.api.list(KIND_NODE)
+                  if C.LABEL_SPARE in n.metadata.labels]
+        assert len(spares) == 1, "missing warm spare gets provisioned"
+        with h.scoped():
+            h.api.delete(KIND_NODE, spares[0].metadata.name)
+            pump(h, 20.0)
+        spares = [n for n in h.api.list(KIND_NODE)
+                  if C.LABEL_SPARE in n.metadata.labels]
+        assert len(spares) == 1, "dead spare is auto-replaced"
+
+    def test_quarantined_spare_not_counted_healthy(self):
+        h = Harness(spare_target_per_pool=1, provision_delay_s=2.0)
+        h.add_host("pod-0", 0)
+        sick = h.add_host("pod-0", 0, spare=True, park=100)
+        with h.scoped():
+            h.ledger.set_hold(sick, L.QUARANTINE, owner="t",
+                              reason="plan-deadline")
+            pump(h, 10.0)
+        spares = [n.metadata.name for n in h.api.list(KIND_NODE)
+                  if C.LABEL_SPARE in n.metadata.labels]
+        assert len(spares) == 2, "replacement provisioned alongside"
+
+
+class TestWasteAttribution:
+    def test_provisioning_hold_is_not_idle_no_demand(self):
+        from nos_tpu.scheduler.scheduler import attribute_free_chips
+        cat, take, q, g = attribute_free_chips(
+            4.0, {L.PROVISIONING: {"pool": "pod-0"}}, False, 0.0, {},
+            0.0, 0.0)
+        assert cat == L.PROVISIONING and take == 4.0
+
+    def test_conservation_holds_with_provisioning(self):
+        h = Harness(scale_up_after_s=1.0, scale_up_cooldown_s=0.0,
+                    provision_delay_s=50.0)
+        h.add_host("pod-0", 0)
+        for i in range(4):
+            h.api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"p{i}"))
+        sched = build_scheduler(h.api, 16, clock=lambda: h.clock[0])
+        with h.scoped():
+            for _ in range(6):
+                h.clock[0] += 2.0
+                sched.run_cycle()
+                h.prov.reconcile()
+            report = h.ledger.report()
+        assert conservation_ok(report)
+        # the in-flight host is NOT a pool member yet, so its hold must
+        # stay inert in the waterfall (off-snapshot holds never accrue)
+        assert h.events(J.PROVISION_REQUESTED)
+
+
+class TestRetryPath:
+    def test_rate_limits_are_retried_with_backoff(self, monkeypatch):
+        h = Harness()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RateLimitedError("429")
+            return "op-1"
+
+        slept: list[float] = []
+        monkeypatch.setattr(retry_mod, "sleep", slept.append)
+        assert h.prov._call_cloud("create", flaky) == "op-1"
+        assert calls["n"] == 3 and len(slept) == 2
+        assert all(s > 0.0 for s in slept)
+
+    def test_exhausted_retries_raise(self, monkeypatch):
+        h = Harness(cloud_attempts=2)
+        monkeypatch.setattr(retry_mod, "sleep", lambda s: None)
+
+        def always():
+            raise RateLimitedError("429")
+
+        with pytest.raises(RateLimitedError):
+            h.prov._call_cloud("create", always)
+
+
+class TestProvisionerConfig:
+    def test_defaults_validate_and_are_off(self):
+        cfg = ProvisionerConfig()
+        cfg.validate()
+        assert cfg.enabled is False
+
+    @pytest.mark.parametrize("field,value", [
+        ("poll_interval_s", 0.0),
+        ("scale_up_deficit_chips", -1.0),
+        ("max_pending_creates", 0),
+        ("provision_deadline_s", 0.0),
+        ("breaker_threshold", 0),
+        ("spare_target_per_pool", -1),
+        ("inventory_configmap", ""),
+        ("chips_per_host_cap", 0.0),
+        ("hbm_gb_per_chip", 0.0),
+        ("cloud_attempts", 0),
+        ("quota_nodes", -1),
+        ("breaker_open_s", -1.0),
+    ])
+    def test_rejects_bad_values(self, field, value):
+        cfg = ProvisionerConfig(enabled=True)
+        setattr(cfg, field, value)
+        with pytest.raises(ConfigError):
+            cfg.validate()
+
+    def test_disabled_build_refuses_construction(self):
+        from nos_tpu.cmd.assembly import build_provisioner_main
+        with pytest.raises(ValueError):
+            build_provisioner_main(APIServer(), ProvisionerConfig())
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: provisioner + scheduler under cloud + apiserver faults,
+# lockcheck-instrumented
+# ---------------------------------------------------------------------------
+
+def run_capacity_soak(seed: int, rounds: int = 60):
+    lock_graph = LockGraph(name=f"capacity-soak-{seed}")
+    clock = [0.0]
+    errors: list[str] = []
+    with lock_graph.install():
+        api = ChaosAPIServer(seed, conflict_rate=0.10,
+                             transient_rate=0.05, replay_after_ops=7)
+        cloud = ChaosCloudTPUAPI(seed, stockout_rate=0.15,
+                                 stockout_window_s=20.0,
+                                 rate_limit_rate=0.15, slow_rate=0.3,
+                                 slow_extra_s=10.0, zombie_rate=0.2,
+                                 delete_fail_rate=0.3,
+                                 clock=lambda: clock[0],
+                                 provision_delay_s=4.0)
+        cloud.set_joiner(make_joiner(api))
+        prov = CapacityProvisioner(
+            api, cloud, clock=lambda: clock[0],
+            scale_up_after_s=2.0, scale_up_cooldown_s=4.0,
+            scale_down_idle_s=20.0, scale_down_cooldown_s=10.0,
+            provision_deadline_s=15.0, vacancy_grace_s=2.0,
+            breaker_threshold=2, breaker_open_s=15.0,
+            spare_target_per_pool=1)
+        scheduler = build_scheduler(api, 16, clock=lambda: clock[0])
+        journal = DecisionJournal(maxlen=8192, clock=lambda: clock[0])
+        ledger = ChipSecondLedger(clock=lambda: clock[0])
+        guard_state(journal, lock_graph, name="obs.DecisionJournal")
+        guard_state(ledger, lock_graph, name="obs.ChipSecondLedger")
+        guard_state(prov, lock_graph, name="capacity.CapacityProvisioner")
+        guard_state(prov.breaker, lock_graph,
+                    name="capacity.StockoutBreaker")
+        guard_state(cloud, lock_graph, name="capacity.CloudTPUAPI")
+
+    for i in range(2):
+        api.create(KIND_NODE, make_tpu_node(
+            f"pod-0-h{i}", pod_id="pod-0", host_index=i,
+            status_geometry={"free": {"2x2": 2}},
+            extra_labels={C.LABEL_ZONE: "us-a"}))
+
+    def tick(name, fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — recorded, then asserted
+            errors.append(f"seed={seed} round={rnd} {name}: {e!r}")
+
+    rng = __import__("random").Random(seed)
+    with obs.scoped(journal=journal, ledger=ledger):
+        for rnd in range(rounds):
+            clock[0] += 2.0
+            if rnd == 5:
+                for i in range(6):
+                    api.create(KIND_POD, make_slice_pod(
+                        "2x2", 1, name=f"soak-{seed}-{i}"))
+            if rnd == 20:       # node loss mid-run, provisioner watching
+                names = sorted(n.metadata.name
+                               for n in api.list(KIND_NODE))
+                if names:
+                    tick("kill", lambda: api.delete(
+                        KIND_NODE, rng.choice(names)))
+            if rnd == 30:       # mid-reconcile controller kill/restart
+                prov = CapacityProvisioner(
+                    api, cloud, clock=lambda: clock[0],
+                    scale_up_after_s=2.0, scale_up_cooldown_s=4.0,
+                    scale_down_idle_s=20.0, scale_down_cooldown_s=10.0,
+                    provision_deadline_s=15.0, vacancy_grace_s=2.0,
+                    breaker_threshold=2, breaker_open_s=15.0,
+                    spare_target_per_pool=1)
+                with lock_graph.install():
+                    guard_state(prov, lock_graph,
+                                name="capacity.CapacityProvisioner-2")
+            tick("scheduler", scheduler.run_cycle)
+            tick("provisioner", prov.reconcile)
+            tick("admit", lambda: admit_all(api))
+            api.replay_dropped()
+        clock[0] += 2.0
+        tick("scheduler-final", scheduler.run_cycle)
+    from types import SimpleNamespace
+    return SimpleNamespace(seed=seed, errors=errors, api=api,
+                           cloud=cloud, prov=prov, journal=journal,
+                           ledger=ledger, lock_graph=lock_graph)
+
+
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_soak_converges_clean(self, seed):
+        r = run_capacity_soak(seed)
+        try:
+            assert not r.errors, r.errors[:3]
+            r.lock_graph.assert_clean()
+        finally:
+            r.lock_graph.close()
+            unguard_all()
+        assert conservation_ok(r.ledger.report()), \
+            f"seed={seed}: conservation violated under cloud faults"
+        # every create either landed, was reaped, or is still within
+        # its deadline — nothing leaks forever
+        for op in r.cloud.list_operations():
+            assert op["status"] in (OP_PENDING, OP_DONE)

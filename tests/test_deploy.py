@@ -157,6 +157,37 @@ class TestRenderedConfigsLoad:
         cfg = load_config(str(cfg_file), cls)
         cfg.validate()
 
+    def test_provisioner_config(self, ctx, tmp_path):
+        """The capacity plane is off by default (nothing renders —
+        off means off at the chart layer too); flipping it on must
+        produce a ProvisionerConfig the loader accepts, with the
+        plane's own `enabled` gate set."""
+        import copy
+
+        from nos_tpu.api.config import ProvisionerConfig
+
+        out = render(
+            (CHART / "templates/provisioner/configmap.yaml").read_text(),
+            ctx)
+        assert all(d is None for d in yaml.safe_load_all(out))
+        c = copy.deepcopy(ctx)
+        c["Values"]["provisioner"]["enabled"] = True
+        out = render(
+            (CHART / "templates/provisioner/configmap.yaml").read_text(), c)
+        cm = yaml.safe_load(out)
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text(cm["data"]["config.yaml"])
+        cfg = load_config(str(cfg_file), ProvisionerConfig)
+        cfg.validate()
+        assert cfg.enabled is True
+        kinds = []
+        for rel in ("templates/provisioner/deployment.yaml",
+                    "templates/provisioner/rbac.yaml"):
+            kinds += [d["kind"] for d in yaml.safe_load_all(
+                render((CHART / rel).read_text(), c)) if d]
+        assert sorted(kinds) == ["ClusterRole", "ClusterRoleBinding",
+                                 "Deployment", "ServiceAccount"]
+
     @pytest.mark.parametrize("component", ["sliceagent", "chipagent"])
     def test_agent_config(self, ctx, tmp_path, component):
         out = render(
@@ -177,7 +208,7 @@ class TestDockerfiles:
     def test_one_dockerfile_per_component(self):
         components = {"operator", "partitioner", "scheduler", "sliceagent",
                       "chipagent", "metricsexporter", "train",
-                      "autoscaler"}
+                      "autoscaler", "provisioner"}
         found = {p.parent.name for p in BUILD.glob("*/Dockerfile")}
         assert found == components
         assert (BUILD / "Dockerfile.base").exists()
