@@ -5,7 +5,11 @@ Exit status 0 = clean (the CI/tier-1 contract), 1 = violations.
 ``--list-rules`` prints the catalog; ``--show-suppressed`` audits what
 the pragmas are hiding; ``--fix`` applies the mechanical autofixes
 (fix.py) before linting; ``--no-cache`` bypasses the per-file result
-cache (``.noslint_cache/``, see cache.py).
+cache (``.noslint_cache/``, see cache.py); ``--changed-only`` lints
+just the files changed against the git merge-base (the pre-commit
+mode — composes with the cache, cross-file rules still see the full
+tree they need via their registries); ``--determinism`` runs the
+dual-run journal diff harness (determinism.py) instead of linting.
 """
 
 from __future__ import annotations
@@ -20,10 +24,51 @@ from .core import iter_python_files, run
 from .rules import default_rules
 
 
+def _changed_python_files(repo_root: str, scope: list[str]) -> list[str]:
+    """Python files changed against the git merge-base (committed on
+    this branch, staged, unstaged, and untracked), restricted to
+    ``scope``.  On the default branch itself the base degenerates to
+    HEAD, which is exactly the pre-commit contract: lint what this
+    commit is about to change."""
+    import subprocess
+
+    def git(*args: str) -> "subprocess.CompletedProcess[str]":
+        return subprocess.run(["git", "-C", repo_root, *args],
+                              capture_output=True, text=True)
+
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        r = git("merge-base", "HEAD", ref)
+        if r.returncode == 0:
+            base = r.stdout.strip()
+            break
+    head = git("rev-parse", "HEAD").stdout.strip()
+    if not base or base == head:
+        base = "HEAD"
+    names: set[str] = set()
+    names.update(
+        git("diff", "--name-only", "--diff-filter=ACMR",
+            base).stdout.split())
+    names.update(
+        git("ls-files", "--others", "--exclude-standard").stdout.split())
+    scope_abs = [os.path.abspath(s) for s in scope]
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.abspath(os.path.join(repo_root, name))
+        if not os.path.isfile(path):
+            continue
+        if any(path == s or path.startswith(s + os.sep)
+               for s in scope_abs):
+            out.append(path)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m nos_tpu.analysis",
-        description="noslint: project-native invariant checks (N001-N010)")
+        description="noslint: project-native invariant checks (N001-N012)")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the nos_tpu "
                         "package)")
@@ -38,7 +83,30 @@ def main(argv: list[str] | None = None) -> int:
                         "imports, N000 naked pragmas) in place, then lint")
     parser.add_argument("--no-cache", action="store_true",
                         help="bypass the .noslint_cache/ result cache")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files changed vs the git "
+                        "merge-base (pre-commit mode; composes with "
+                        "the cache)")
+    parser.add_argument("--determinism", action="store_true",
+                        help="run the dual-run journal diff harness "
+                        "(PYTHONHASHSEED x plan_workers matrix) "
+                        "instead of linting")
+    parser.add_argument("--determinism-child", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--plan-workers", type=int, default=1,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--cycles", type=int, default=2,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
+
+    if args.determinism_child:
+        from .determinism import child_main
+
+        return child_main(args.plan_workers, args.cycles)
+    if args.determinism:
+        from .determinism import main_determinism
+
+        return main_determinism(fmt=args.format, cycles=args.cycles)
 
     rules = default_rules()
     if args.list_rules:
@@ -49,6 +117,13 @@ def main(argv: list[str] | None = None) -> int:
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     repo_root = os.path.dirname(os.path.dirname(pkg_dir))
     paths = args.paths or [os.path.dirname(pkg_dir)]
+
+    if args.changed_only:
+        paths = _changed_python_files(repo_root, paths)
+        if not paths:
+            print("noslint: --changed-only: no changed python files "
+                  "in scope")
+            return 0
 
     if args.fix:
         from .fix import fix_file

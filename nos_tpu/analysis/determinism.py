@@ -1,0 +1,259 @@
+"""Dual-run determinism harness: the dynamic half of the noslint gate.
+
+N011/N012 prove *statically* that no hash-ordered iteration feeds a
+decision and no cached view outlives its invalidation event.  This
+module proves it *dynamically*: run the real planner and scheduler on
+the benchmark trace (bench_plan's 64-host v5e-256 cluster, 200-pod
+mixed pending batch) in child interpreters across a matrix of
+
+    PYTHONHASHSEED in {0, 1, random}  x  plan_workers in {1, 4}
+
+and byte-diff the decision journals.  ``PYTHONHASHSEED`` only applies
+at interpreter start, so every cell is a fresh subprocess; the child
+pins every other source of nondeterminism:
+
+- the decision journal gets a logical clock (a counter), so ``ts`` is
+  a step number, not wall time;
+- the tracer is disabled, so journal records carry empty trace ids
+  (span-id assignment order is thread-interleaving-dependent under
+  ``plan_workers > 1`` and is not a *decision*);
+- the parallel planner gets a zero clock (its journal record includes
+  a wall-time field; shard timings are telemetry, not decisions);
+- the planner is built with ``min_shard_hosts=0`` so the 64-host trace
+  actually exercises the sharded path (the production floor is
+  ``PLAN_SHARD_MIN_HOSTS`` = 128).
+
+What's left is exactly what the certification claims is deterministic:
+the sequence of decisions.  A surviving hash-order tie-break or a
+stale cross-cycle cache shows up as the first differing journal line.
+
+CLI: ``python -m nos_tpu.analysis --determinism`` (the CI gate) or the
+``scripts/nosdiff.py`` wrapper; troubleshooting: docs/troubleshooting.md
+("plans differ across runs").
+"""
+
+from __future__ import annotations
+
+import difflib
+import itertools
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+HASH_SEEDS = ("0", "1", "random")
+PLAN_WORKERS = (1, 4)
+DEFAULT_CYCLES = 2
+
+# Per-child wall bound: the gate must never hang CI.  The bench smoke
+# bound is 5 s for one plan; a child runs one plan + two scheduler
+# cycles, so 120 s is deep headroom even on a loaded runner.
+CHILD_TIMEOUT_S = 120
+
+
+def _repo_root() -> str:
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(pkg_dir))
+
+
+# -- child: one trace run, journal to stdout --------------------------------
+
+def run_trace(plan_workers: int, cycles: int = DEFAULT_CYCLES) -> list[dict]:
+    """Run the benchmark trace once in THIS interpreter and return the
+    decision journal as dicts.  The caller (child_main via subprocess)
+    owns interpreter-level determinism knobs like PYTHONHASHSEED."""
+    from nos_tpu.cmd.assembly import build_scheduler
+    from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+    from nos_tpu.obs.journal import DecisionJournal, set_journal
+    from nos_tpu.obs.trace import Tracer, set_tracer
+    from nos_tpu.partitioning.core.parallel import ParallelGeometryPlanner
+    from nos_tpu.partitioning.slicepart import (
+        SlicePartitionCalculator, SliceProfileCalculator, SliceSnapshotTaker,
+    )
+    from nos_tpu.partitioning.slicepart.group import MultiHostGeometryPlanner
+    from nos_tpu.partitioning.slicepart.snapshot_taker import SLICE_KIND
+    from nos_tpu.scheduler.framework import Framework
+
+    # bench_plan lives at the repo root (it IS the trace definition:
+    # 64-host v5e-256, 200-pod mixed batch) — resolve it explicitly so
+    # run_trace works regardless of the caller's cwd.
+    root = _repo_root()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench_plan
+
+    ticks = itertools.count(1)
+    journal = DecisionJournal(maxlen=1 << 16,
+                              clock=lambda: float(next(ticks)))
+    set_journal(journal)
+    set_tracer(Tracer(enabled=False))
+
+    # -- plan leg: the sharded parallel planner over the 64-host trace
+    def make_planner() -> MultiHostGeometryPlanner:
+        return MultiHostGeometryPlanner(
+            framework=Framework(),
+            calculator=SliceProfileCalculator(),
+            partition_calculator=SlicePartitionCalculator(),
+        )
+
+    planner = ParallelGeometryPlanner(
+        make_planner, SliceProfileCalculator(), kind=SLICE_KIND,
+        max_workers=plan_workers, min_shard_hosts=0,
+        clock=lambda: 0.0)
+    state = bench_plan.make_cluster_state()
+    pending = bench_plan.make_pending_batch()
+    snapshot = SliceSnapshotTaker().take_snapshot(state)
+    planner.plan(snapshot, pending)
+
+    # -- schedule leg: real cycles over the same cluster through the api
+    api = APIServer()
+    per_domain = bench_plan.HOSTS // bench_plan.DOMAINS
+    from nos_tpu.testing.factory import make_pod, make_tpu_node
+
+    for i in range(bench_plan.HOSTS):
+        geometry = ({"used": {"2x4": 1}} if i < bench_plan.FULL_HOSTS
+                    else {"free": {"2x4": 1}})
+        api.create(KIND_NODE, make_tpu_node(
+            f"host-{i}", pod_id=f"pod-{i // per_domain}",
+            host_index=i % per_domain, status_geometry=geometry))
+    for i in range(bench_plan.FULL_HOSTS):
+        api.create(KIND_POD, make_pod(
+            name=f"filler-{i}", node_name=f"host-{i}",
+            resources=dict(api.get(KIND_NODE,
+                                   f"host-{i}").status.allocatable)))
+    for pod in bench_plan.make_pending_batch():
+        api.create(KIND_POD, pod)
+    scheduler = build_scheduler(api, clock=lambda: 0.0)
+    for _ in range(cycles):
+        scheduler.run_cycle()
+
+    return [rec.to_dict() for rec in journal.events()]
+
+
+def child_main(plan_workers: int, cycles: int) -> int:
+    """``--determinism-child``: run the trace, one canonical JSON line
+    per journal record on stdout.  Line-per-record keeps the parent's
+    first-difference report readable."""
+    for rec in run_trace(plan_workers, cycles):
+        sys.stdout.write(
+            json.dumps(rec, sort_keys=True, separators=(",", ":")) + "\n")
+    return 0
+
+
+# -- parent: the matrix orchestrator ----------------------------------------
+
+@dataclass
+class CellResult:
+    hash_seed: str
+    plan_workers: int
+    output: bytes
+    returncode: int
+    stderr: str = ""
+
+    @property
+    def label(self) -> str:
+        return f"PYTHONHASHSEED={self.hash_seed} plan_workers={self.plan_workers}"
+
+
+@dataclass
+class DeterminismReport:
+    cells: list[CellResult] = field(default_factory=list)
+    failures: list[str] = field(default_factory=list)
+    records: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "cells": [c.label for c in self.cells],
+            "records": self.records,
+            "failures": self.failures,
+        }
+
+
+def _first_divergence(ref: bytes, other: bytes) -> str:
+    ref_lines = ref.decode(errors="replace").splitlines()
+    other_lines = other.decode(errors="replace").splitlines()
+    for i, (a, b) in enumerate(zip(ref_lines, other_lines)):
+        if a != b:
+            diff = "\n    ".join(difflib.ndiff([a], [b]))
+            return f"first divergence at record {i + 1}:\n    {diff}"
+    return (f"journals are a prefix of each other: "
+            f"{len(ref_lines)} vs {len(other_lines)} records")
+
+
+def run_matrix(hash_seeds: tuple[str, ...] = HASH_SEEDS,
+               plan_workers: tuple[int, ...] = PLAN_WORKERS,
+               cycles: int = DEFAULT_CYCLES,
+               verbose: bool = True) -> DeterminismReport:
+    """Spawn one child per (seed, workers) cell; byte-diff every journal
+    against the first cell's."""
+    report = DeterminismReport()
+    root = _repo_root()
+    for seed in hash_seeds:
+        for workers in plan_workers:
+            env = dict(os.environ)
+            env["PYTHONHASHSEED"] = seed
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            cmd = [sys.executable, "-m", "nos_tpu.analysis",
+                   "--determinism-child",
+                   "--plan-workers", str(workers),
+                   "--cycles", str(cycles)]
+            try:
+                proc = subprocess.run(
+                    cmd, cwd=root, env=env, capture_output=True,
+                    timeout=CHILD_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                report.failures.append(
+                    f"child PYTHONHASHSEED={seed} plan_workers={workers} "
+                    f"exceeded {CHILD_TIMEOUT_S}s")
+                continue
+            cell = CellResult(seed, workers, proc.stdout,
+                              proc.returncode,
+                              proc.stderr.decode(errors="replace"))
+            report.cells.append(cell)
+            if proc.returncode != 0:
+                report.failures.append(
+                    f"child {cell.label} exited {proc.returncode}:\n"
+                    f"{cell.stderr[-2000:]}")
+            if verbose:
+                print(f"nosdiff: {cell.label}: "
+                      f"{len(cell.output.splitlines())} record(s)",
+                      file=sys.stderr)
+    good = [c for c in report.cells if c.returncode == 0]
+    if not good:
+        if not report.failures:
+            report.failures.append("no child produced a journal")
+        return report
+    ref = good[0]
+    report.records = len(ref.output.splitlines())
+    if report.records == 0:
+        report.failures.append(
+            f"reference cell {ref.label} produced an EMPTY journal — "
+            "the trace no longer records decisions, the gate is vacuous")
+    for cell in good[1:]:
+        if cell.output != ref.output:
+            report.failures.append(
+                f"journal diverges: {ref.label} vs {cell.label}\n"
+                f"  {_first_divergence(ref.output, cell.output)}")
+    return report
+
+
+def main_determinism(fmt: str = "text",
+                     cycles: int = DEFAULT_CYCLES) -> int:
+    report = run_matrix(cycles=cycles, verbose=(fmt == "text"))
+    if fmt == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        if report.ok:
+            print(f"nosdiff: OK — {len(report.cells)} runs, "
+                  f"{report.records} journal record(s), byte-identical "
+                  f"across PYTHONHASHSEED x plan_workers")
+        else:
+            for failure in report.failures:
+                print(f"nosdiff: FAIL — {failure}")
+    return 0 if report.ok else 1

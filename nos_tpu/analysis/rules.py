@@ -597,11 +597,13 @@ class NameHygiene(Rule):
 
 
 def default_rules() -> list[Rule]:
-    """Fresh instances of every rule: the tokenize/AST passes N001–N006
-    plus the dataflow rules N007–N010 (rules_flow.py; N003 and N009
-    carry cross-file state, hence fresh instances per run)."""
+    """Fresh instances of every rule: the tokenize/AST passes N001–N006,
+    the dataflow rules N007–N010 (rules_flow.py) and the determinism
+    certification N011–N012 (rules_det.py; N003, N009 and N012 carry
+    cross-file state, hence fresh instances per run)."""
+    from .rules_det import det_rules
     from .rules_flow import flow_rules
 
     return [RetryWrappedWrites(), InjectableClock(), MetricDiscipline(),
             NoBlockingUnderLock(), NoSwallowedExceptions(), NameHygiene(),
-            *flow_rules()]
+            *flow_rules(), *det_rules()]

@@ -89,7 +89,10 @@ def new_config_plan(state: SliceState,
         doomed: list[str] = []
         creates: dict[str, int] = {}
         survivors_free: dict[str, list[str]] = {}
-        for profile in set(current) | set(desired):
+        # sorted: doomed/creates accumulate in profile order, and the
+        # delete list's order reaches the actuator — hash order here
+        # would make the plan PYTHONHASHSEED-dependent (noslint N011)
+        for profile in sorted(set(current) | set(desired)):
             pd = current.get(profile, ProfileDevices())
             want = desired.get(profile, 0)
             have = pd.total

@@ -31,8 +31,10 @@ Design constraints, in priority order:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import time
-from typing import Callable
+from typing import Callable, Iterator
 
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.utils.guards import guarded_by
@@ -191,6 +193,52 @@ class DecisionJournal(BoundedRing):
 
 _journal = DecisionJournal()
 
+# Context-local capture override (None = record straight to the process
+# journal).  The parallel planner's shard workers run under a capture
+# (see capture_records) so concurrent shards never interleave appends
+# nondeterministically — the merge replays each shard's records into
+# the ambient journal in pool-key order, which is what lets nosdiff
+# (analysis/determinism.py) demand byte-identical journals across
+# plan_workers settings.
+_capture: "contextvars.ContextVar[JournalCapture | None]" = \
+    contextvars.ContextVar("nos_tpu_journal_capture", default=None)
+
+
+class JournalCapture:
+    """Order-preserving buffer of ``record()`` calls for deterministic
+    replay.  Deliberately NOT a DecisionJournal: no seq/ts stamping, no
+    metrics — the replay into the ambient journal does all of that
+    exactly once, so a captured decision is indistinguishable from one
+    recorded inline (trace context is re-read at replay time; shard
+    span ids are scheduling artifacts, not decisions)."""
+
+    def __init__(self) -> None:
+        self.calls: list[tuple[str, str, dict]] = []
+
+    def record(self, category: str, subject: str,
+               **attrs: object) -> DecisionRecord:
+        self.calls.append((category, subject, attrs))
+        return DecisionRecord(0, 0.0, category, subject, attrs, "", "")
+
+    def replay(self) -> None:
+        """Append every captured decision to the ambient journal, in
+        capture order."""
+        for category, subject, attrs in self.calls:
+            record(category, subject, **attrs)
+
+
+@contextlib.contextmanager
+def capture_records(capture: JournalCapture) -> Iterator[JournalCapture]:
+    """Route this context's ``record()`` calls into ``capture`` instead
+    of the process journal (contextvar-scoped, so a worker running
+    under ``contextvars.copy_context()`` captures without affecting its
+    submitter)."""
+    token = _capture.set(capture)
+    try:
+        yield capture
+    finally:
+        _capture.reset(token)
+
 
 def get_journal() -> DecisionJournal:
     return _journal
@@ -204,5 +252,10 @@ def set_journal(journal: DecisionJournal) -> DecisionJournal:
 
 
 def record(category: str, subject: str, **attrs: object) -> DecisionRecord:
-    """Record a decision in the process journal — THE call-site API."""
+    """Record a decision in the process journal — THE call-site API.
+    Under an active :func:`capture_records` context the decision is
+    buffered for deterministic replay instead."""
+    capture = _capture.get()
+    if capture is not None:
+        return capture.record(category, subject, **attrs)
     return _journal.record(category, subject, **attrs)

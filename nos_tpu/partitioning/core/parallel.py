@@ -28,6 +28,12 @@ Contracts:
   `nos_tpu_plan_shard_seconds{pool=}`, and the merge journals one
   PLAN_SHARD_MERGED record so `nos explain plan` can attribute plan
   time per pool.
+- **Journal determinism**: shard workers record decisions into a
+  per-shard `JournalCapture` (obs/journal.py) and the merge replays
+  them into the ambient journal in pool-key order — the journal's
+  record sequence is a function of the inputs, never of worker-thread
+  timing, so nosdiff (analysis/determinism.py) can byte-diff journals
+  across `plan_workers` settings.
 """
 
 from __future__ import annotations
@@ -42,7 +48,10 @@ from typing import Callable
 from nos_tpu.exporter.metrics import REGISTRY
 from nos_tpu.kube.objects import Pod
 from nos_tpu.obs import journal as J
-from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
+from nos_tpu.obs.journal import (
+    JournalCapture, MAX_JOURNAL_NODES, capture_records,
+    record as journal_record,
+)
 from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
 
@@ -160,9 +169,10 @@ class ParallelGeometryPlanner(Planner):
             merged = PartitioningState()
             shard_seconds: dict[str, float] = {}
             first_exc: BaseException | None = None
+            captures: list[JournalCapture] = []
             for pool, future in futures:
                 try:
-                    shard_state, seconds = future.result()
+                    shard_state, seconds, capture = future.result()
                 except BaseException as e:  # noqa: BLE001 — drained + re-raised below
                     if first_exc is None:
                         first_exc = e
@@ -170,8 +180,16 @@ class ParallelGeometryPlanner(Planner):
                 if first_exc is None:
                     merged.update(shard_state)
                     shard_seconds[pool.key] = seconds
+                    captures.append(capture)
             if first_exc is not None:
                 raise first_exc
+            # Shard decisions replay here, in pool-key order: concurrent
+            # shards buffered their journal records (capture_records) so
+            # append order is a function of the POOLS, never of thread
+            # timing — the journal stays byte-identical across
+            # plan_workers settings (nosdiff's matrix contract).
+            for capture in captures:
+                capture.replay()
             self.last_shard_seconds = shard_seconds
             wall = self._clock() - t0
             if sp is not None:
@@ -187,14 +205,17 @@ class ParallelGeometryPlanner(Planner):
     # -- shard task (worker thread) -----------------------------------------
     def _run_shard(self, planner: Planner, pool: PlanPool,
                    shard_snapshot: ClusterSnapshot,
-                   shard_pods: list[Pod]) -> tuple[PartitioningState, float]:
+                   shard_pods: list[Pod]
+                   ) -> tuple[PartitioningState, float, JournalCapture]:
+        capture = JournalCapture()
         with obs_span("plan_shard", pool=pool.key, nodes=len(pool.nodes),
                       pods=len(shard_pods)):
             t0 = self._clock()
-            state = planner.plan(shard_snapshot, shard_pods)
+            with capture_records(capture):
+                state = planner.plan(shard_snapshot, shard_pods)
             seconds = self._clock() - t0
         REGISTRY.observe("nos_tpu_plan_shard_seconds", seconds,
                          labels={"pool": pool.key})
         REGISTRY.inc("nos_tpu_plan_shards_total",
                      labels={"kind": self._kind or "plan"})
-        return state, seconds
+        return state, seconds, capture

@@ -37,6 +37,8 @@ from nos_tpu.kube.resources import (
 from nos_tpu.scheduler.framework import SharedLister
 from nos_tpu.topology.profile import free_chip_equivalents
 
+from nos_tpu.utils.guards import invalidated_by
+
 from .interfaces import PartitionableNode, SliceFilter
 
 
@@ -44,6 +46,9 @@ class SnapshotError(Exception):
     pass
 
 
+# the epoch is the coherence signal for _candidate_cache/_free_cache:
+# noslint N012 proves every in-place write to the node map bumps it
+@invalidated_by("_mutation_gen", "_nodes")
 class ClusterSnapshot:
     def __init__(self, nodes: Mapping[str, PartitionableNode],
                  slice_filter: SliceFilter) -> None:

@@ -34,15 +34,20 @@ import threading
 from nos_tpu.kube.client import APIServer, Informer, KIND_NODE, KIND_POD
 from nos_tpu.kube.objects import Node, PENDING, Pod, RUNNING
 from nos_tpu.scheduler.framework import NodeInfo, SharedLister
-from nos_tpu.utils.guards import guarded_by
+from nos_tpu.utils.guards import guarded_by, invalidated_by
 
 
 @guarded_by("_lock", "_node_objs", "_pods_by_node", "_pod_node",
             "_gen", "_built")
+@invalidated_by("_bump_locked", "_node_objs", "_pods_by_node", "_pod_node")
 class SchedulerCache:
     """Every index is written on watch fan-out threads AND read by the
     scheduling loop: the @guarded_by declaration is checked statically
-    (noslint N010) and at soak time (lockcheck.guard_state)."""
+    (noslint N010) and at soak time (lockcheck.guard_state).  The
+    @invalidated_by declaration certifies the generation protocol
+    (noslint N012): every in-place mutation of the node/pod indexes is
+    post-dominated by a _bump_locked emission, so snapshot()'s
+    generation-gated NodeInfo reuse can never serve a stale build."""
 
     def __init__(self, api: APIServer) -> None:
         self._lock = threading.Lock()

@@ -102,6 +102,7 @@ def gang_slice_windows(api: APIServer, members: list[Pod]
         shapes.update(extract_slice_requests(pod_request(pod)))
     if len(shapes) != 1:
         return []
+    # noslint: N011 — singleton set: the len(shapes) == 1 guard above makes the only element order-free
     shape = next(iter(shapes))
 
     by_pod: dict[str, dict[int, object]] = {}

@@ -52,6 +52,7 @@ from nos_tpu.obs.trace import bump as obs_bump, span as obs_span
 from nos_tpu.utils.pod_util import (
     admission_rank, displacement, workload_class, workload_tier,
 )
+from nos_tpu.utils.guards import invalidated_by
 from nos_tpu.utils.retry import retry_on_conflict
 
 logger = logging.getLogger(__name__)
@@ -169,6 +170,10 @@ def _annotation_progress(pod: Pod) -> float:
     return job_progress(pod)
 
 
+# the cycle lister is the source view behind the per-class scan cache,
+# the per-node Filter/chips memos and the window-busy map; noslint N012
+# proves every in-place booking through it emits _invalidate_scans
+@invalidated_by("_invalidate_scans", "_cycle_lister_cache")
 class Scheduler:
     def __init__(self, api: APIServer, framework: Framework,
                  name: str = "nos-tpu-scheduler",
@@ -596,25 +601,29 @@ class Scheduler:
     def _assume_bound(self, pod: Pod, node_name: str) -> None:
         """Book a just-bound pod into the cycle snapshot so later pods
         this cycle see its capacity consumed (the assume cache)."""
-        # the node's capacity changed: its memoised Filter verdicts die,
-        # and every class's cached full scan with them
-        self._filter_cache.pop(node_name, None)
-        self._chips_cache.pop(node_name, None)
-        self._class_scan_cache = {}
         assumed = fast_deepcopy(pod)
         assumed.spec.node_name = node_name
-        self._mark_busy(node_name)
         if self._cache is not None:
             # also book into the incremental cache: on an async watch
             # substrate the bind's pod event can lag a node event whose
             # rebuild would otherwise resurrect the pre-bind view
             self._cache.assume(assumed)
         lister = self._cycle_lister_cache
-        if lister is None:
-            return
-        ni = lister.get(node_name)
-        if ni is not None:
-            ni.add_pod(assumed)
+        if lister is not None:
+            ni = lister.get(node_name)
+            if ni is not None:
+                ni.add_pod(assumed)
+        self._invalidate_scans(node_name)
+
+    def _invalidate_scans(self, node_name: str) -> None:
+        """The declared invalidation event (@invalidated_by) for the
+        per-cycle derived caches: the node's capacity changed, so its
+        memoised Filter verdicts die, every class's cached full scan
+        with them, and the window-busy map entry flips busy."""
+        self._filter_cache.pop(node_name, None)
+        self._chips_cache.pop(node_name, None)
+        self._class_scan_cache = {}
+        self._mark_busy(node_name)
 
     @staticmethod
     def _window_key(labels: dict) -> tuple[str, int] | None:

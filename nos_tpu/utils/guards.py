@@ -40,12 +40,21 @@ from typing import TypeVar
 T = TypeVar("T", bound=type)
 
 
+def _all_names(head: str, fields: tuple[str, ...]) -> bool:
+    """Both checkers read these tables as attribute names; anything that
+    is not a non-empty string is unresolvable for them, so reject it at
+    declaration time rather than letting the contract silently decay."""
+    return (isinstance(head, str) and bool(head) and bool(fields)
+            and all(isinstance(f, str) and f for f in fields))
+
+
 def guarded_by(lock_attr: str, *fields: str):
     """Declare that ``fields`` may only be written with ``self.<lock_attr>``
     held.  Returns a class decorator; see the module docstring."""
-    if not lock_attr or not fields or not all(fields):
+    if not _all_names(lock_attr, fields):
         raise ValueError("guarded_by needs a lock attribute name and "
-                         "at least the fields it guards")
+                         "at least the fields it guards, all non-empty "
+                         "strings")
 
     def decorate(cls: T) -> T:
         # copy-on-extend: never mutate a base class's table in place
@@ -66,3 +75,57 @@ def guarded_by(lock_attr: str, *fields: str):
 def guarded_fields(cls: type) -> dict[str, str]:
     """The declared field -> lock-attribute table ({} when undeclared)."""
     return dict(getattr(cls, "__guarded_by__", {}))
+
+
+def invalidated_by(event: str, *fields: str):
+    """Declare that in-place mutations of ``fields`` feed a derived cache
+    whose coherence signal is ``event`` — a method of the class (e.g.
+    ``SchedulerCache._bump_locked``) or a counter attribute that every
+    mutator bumps (e.g. ``ClusterSnapshot._mutation_gen``).
+
+    Like :func:`guarded_by`, the decorator is pure declaration (one class
+    attribute, no behavior change) read by an independent checker:
+    noslint N012 (nos_tpu/analysis/rules_det.py) proves every in-place
+    mutation site of a declared field is post-dominated by an emission of
+    its event — a call whose last segment is the event name, or an
+    assignment/augassignment to ``self.<event>``.  Whole-field rebinds
+    (``self._cache = {}``) are the invalidate-by-rebuild idiom and are
+    not convicted; ``__init__``/``__post_init__`` and the event method
+    itself are exempt.
+
+    Usage::
+
+        @invalidated_by("_bump_locked", "_node_objs", "_pods_by_node")
+        class SchedulerCache:
+            ...
+
+    Stacking is allowed for classes with more than one invalidation
+    event; each field belongs to exactly one event (re-declaring a field
+    under a different event raises at import time).  Event and field
+    names must be string literals so N012 can check without running the
+    code.  Subclasses inherit and may extend the table.
+    """
+    if not _all_names(event, fields):
+        raise ValueError("invalidated_by needs an event name and "
+                         "at least one watched field, all non-empty "
+                         "strings")
+
+    def decorate(cls: T) -> T:
+        # copy-on-extend: never mutate a base class's table in place
+        table = dict(getattr(cls, "__invalidated_by__", {}))
+        for f in fields:
+            prior = table.get(f)
+            if prior is not None and prior != event:
+                raise ValueError(
+                    f"{cls.__name__}.{f} declared invalidated by both "
+                    f"{prior!r} and {event!r} — one event per field")
+            table[f] = event
+        cls.__invalidated_by__ = table
+        return cls
+
+    return decorate
+
+
+def invalidated_fields(cls: type) -> dict[str, str]:
+    """The declared field -> invalidation-event table ({} when undeclared)."""
+    return dict(getattr(cls, "__invalidated_by__", {}))

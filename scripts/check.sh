@@ -19,18 +19,45 @@ rc=0
 
 # Result cache (.noslint_cache/, content-hashed + rule-versioned) keeps
 # the dataflow rules fast on unchanged files; --no-cache to bypass.
-echo "==> noslint (python -m nos_tpu.analysis, rules N001-N010)"
+echo "==> noslint (python -m nos_tpu.analysis, rules N001-N012)"
 if ! python -m nos_tpu.analysis; then
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/)"
+# Dual-run determinism gate (noslint v3's dynamic half): run the
+# benchmark trace in child interpreters across PYTHONHASHSEED x
+# plan_workers and byte-diff the decision journals.  ~5 s wall for the
+# 6-cell matrix; each child is hard-bounded (CHILD_TIMEOUT_S = 120 in
+# analysis/determinism.py) and the whole gate by the timeout below, so
+# a hung child can never wedge CI.  On failure: the report names the
+# first differing journal record — docs/troubleshooting.md ("plans
+# differ across runs") is the playbook.
+echo "==> nosdiff (python -m nos_tpu.analysis --determinism)"
+if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
+        python -m nos_tpu.analysis --determinism; then
+    rc=1
+fi
+
+# Interleaving explorer regression corpus (the DPOR-lite model
+# checker): the seeded critical pairs must reach their pinned verdicts
+# — the buggy replay_dropped model rediscovered inside the
+# 5000-schedule budget, every fixed pair certified clean to
+# completion.  Sub-second; tests/test_interleave.py holds the budget
+# assertions.
+echo "==> interleave corpus (pytest -m interleave)"
+if ! env JAX_PLATFORMS=cpu python -m pytest tests/test_interleave.py \
+        -q -m interleave -p no:cacheprovider; then
+    rc=1
+fi
+
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/, analysis/, testing/{lockcheck,interleave})"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
             nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
             nos_tpu/scheduler nos_tpu/obs nos_tpu/serving \
-            nos_tpu/capacity; then
+            nos_tpu/capacity nos_tpu/analysis \
+            nos_tpu/testing/lockcheck.py nos_tpu/testing/interleave.py; then
         rc=1
     fi
 else

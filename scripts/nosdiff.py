@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""nosdiff: dual-run determinism gate for the decision plane.
+
+Thin wrapper over ``python -m nos_tpu.analysis --determinism``
+(nos_tpu/analysis/determinism.py): runs the benchmark trace in child
+interpreters across a PYTHONHASHSEED x plan_workers matrix and
+byte-diffs the decision journals.  Exit 0 = byte-identical everywhere.
+
+  scripts/nosdiff.py                  # the CI gate (scripts/check.sh)
+  scripts/nosdiff.py --json           # machine-readable report
+  scripts/nosdiff.py --seeds 0 7 --workers 1 2 8 --cycles 3
+
+When this gate fails, start at docs/troubleshooting.md ("plans differ
+across runs"): the report names the first differing journal record,
+which is the decision a hash-order iteration or a stale cache leaked
+into.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from nos_tpu.analysis.determinism import (  # noqa: E402
+    DEFAULT_CYCLES, HASH_SEEDS, PLAN_WORKERS, run_matrix,
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--seeds", nargs="+", default=list(HASH_SEEDS),
+                        help="PYTHONHASHSEED values (default: "
+                        f"{' '.join(HASH_SEEDS)})")
+    parser.add_argument("--workers", nargs="+", type=int,
+                        default=list(PLAN_WORKERS),
+                        help="plan_workers values (default: "
+                        f"{' '.join(str(w) for w in PLAN_WORKERS)})")
+    parser.add_argument("--cycles", type=int, default=DEFAULT_CYCLES,
+                        help="scheduler cycles per child run")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    args = parser.parse_args()
+    report = run_matrix(hash_seeds=tuple(args.seeds),
+                        plan_workers=tuple(args.workers),
+                        cycles=args.cycles,
+                        verbose=not args.json)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    elif report.ok:
+        print(f"nosdiff: OK — {len(report.cells)} runs, "
+              f"{report.records} journal record(s), byte-identical")
+    else:
+        for failure in report.failures:
+            print(f"nosdiff: FAIL — {failure}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

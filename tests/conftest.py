@@ -25,6 +25,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "analysis: noslint static checks + lockcheck over the "
         "tree (tests/test_analysis.py); select with `-m analysis`")
+    config.addinivalue_line(
+        "markers", "interleave: DPOR-lite interleaving explorer smoke "
+        "(tests/test_interleave.py, runs in tier-1); select with "
+        "`-m interleave`")
 
 
 @pytest.fixture

@@ -55,7 +55,7 @@ class TestTreeIsClean:
         assert noslint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule_id in ("N001", "N002", "N003", "N004", "N005", "N006",
-                        "N007", "N008", "N009", "N010"):
+                        "N007", "N008", "N009", "N010", "N011", "N012"):
             assert rule_id in out
 
     def test_every_suppression_carries_a_reason(self):
