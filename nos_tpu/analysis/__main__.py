@@ -95,6 +95,8 @@ def main(argv: list[str] | None = None) -> int:
                         help=argparse.SUPPRESS)
     parser.add_argument("--plan-workers", type=int, default=1,
                         help=argparse.SUPPRESS)
+    parser.add_argument("--incremental", choices=("on", "off"),
+                        default="on", help=argparse.SUPPRESS)
     parser.add_argument("--cycles", type=int, default=2,
                         help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
@@ -102,7 +104,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.determinism_child:
         from .determinism import child_main
 
-        return child_main(args.plan_workers, args.cycles)
+        return child_main(args.plan_workers, args.cycles,
+                          incremental=(args.incremental == "on"))
     if args.determinism:
         from .determinism import main_determinism
 

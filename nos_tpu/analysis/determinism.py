@@ -7,8 +7,15 @@ the benchmark trace (bench_plan's 64-host v5e-256 cluster, 200-pod
 mixed pending batch) in child interpreters across a matrix of
 
     PYTHONHASHSEED in {0, 1, random}  x  plan_workers in {1, 4}
+                                      x  incremental in {on, off}
 
-and byte-diff the decision journals.  ``PYTHONHASHSEED`` only applies
+and byte-diff the decision journals.  The ``incremental`` axis is the
+ISSUE 18 correctness anchor: the dirty-set scheduler with persistent
+feasibility indexes and native hot loops must emit the byte-identical
+decision sequence as the full-rescan path (``incremental=off``) — one
+stale cross-cycle memo, one skipped node the full walk would have
+visited, or one native/Python comparator divergence shows up as the
+first differing journal line.  ``PYTHONHASHSEED`` only applies
 at interpreter start, so every cell is a fresh subprocess; the child
 pins every other source of nondeterminism:
 
@@ -44,6 +51,7 @@ from dataclasses import dataclass, field
 
 HASH_SEEDS = ("0", "1", "random")
 PLAN_WORKERS = (1, 4)
+INCREMENTAL = ("on", "off")
 DEFAULT_CYCLES = 2
 
 # Per-child wall bound: the gate must never hang CI.  The bench smoke
@@ -59,7 +67,8 @@ def _repo_root() -> str:
 
 # -- child: one trace run, journal to stdout --------------------------------
 
-def run_trace(plan_workers: int, cycles: int = DEFAULT_CYCLES) -> list[dict]:
+def run_trace(plan_workers: int, cycles: int = DEFAULT_CYCLES,
+              incremental: bool = True) -> list[dict]:
     """Run the benchmark trace once in THIS interpreter and return the
     decision journal as dicts.  The caller (child_main via subprocess)
     owns interpreter-level determinism knobs like PYTHONHASHSEED."""
@@ -124,18 +133,20 @@ def run_trace(plan_workers: int, cycles: int = DEFAULT_CYCLES) -> list[dict]:
                                    f"host-{i}").status.allocatable)))
     for pod in bench_plan.make_pending_batch():
         api.create(KIND_POD, pod)
-    scheduler = build_scheduler(api, clock=lambda: 0.0)
+    scheduler = build_scheduler(api, incremental=incremental,
+                                clock=lambda: 0.0)
     for _ in range(cycles):
         scheduler.run_cycle()
 
     return [rec.to_dict() for rec in journal.events()]
 
 
-def child_main(plan_workers: int, cycles: int) -> int:
+def child_main(plan_workers: int, cycles: int,
+               incremental: bool = True) -> int:
     """``--determinism-child``: run the trace, one canonical JSON line
     per journal record on stdout.  Line-per-record keeps the parent's
     first-difference report readable."""
-    for rec in run_trace(plan_workers, cycles):
+    for rec in run_trace(plan_workers, cycles, incremental=incremental):
         sys.stdout.write(
             json.dumps(rec, sort_keys=True, separators=(",", ":")) + "\n")
     return 0
@@ -147,13 +158,16 @@ def child_main(plan_workers: int, cycles: int) -> int:
 class CellResult:
     hash_seed: str
     plan_workers: int
+    incremental: str
     output: bytes
     returncode: int
     stderr: str = ""
 
     @property
     def label(self) -> str:
-        return f"PYTHONHASHSEED={self.hash_seed} plan_workers={self.plan_workers}"
+        return (f"PYTHONHASHSEED={self.hash_seed} "
+                f"plan_workers={self.plan_workers} "
+                f"incremental={self.incremental}")
 
 
 @dataclass
@@ -188,42 +202,46 @@ def _first_divergence(ref: bytes, other: bytes) -> str:
 
 def run_matrix(hash_seeds: tuple[str, ...] = HASH_SEEDS,
                plan_workers: tuple[int, ...] = PLAN_WORKERS,
+               incremental: tuple[str, ...] = INCREMENTAL,
                cycles: int = DEFAULT_CYCLES,
                verbose: bool = True) -> DeterminismReport:
-    """Spawn one child per (seed, workers) cell; byte-diff every journal
-    against the first cell's."""
+    """Spawn one child per (seed, workers, incremental) cell; byte-diff
+    every journal against the first cell's."""
     report = DeterminismReport()
     root = _repo_root()
     for seed in hash_seeds:
         for workers in plan_workers:
-            env = dict(os.environ)
-            env["PYTHONHASHSEED"] = seed
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            cmd = [sys.executable, "-m", "nos_tpu.analysis",
-                   "--determinism-child",
-                   "--plan-workers", str(workers),
-                   "--cycles", str(cycles)]
-            try:
-                proc = subprocess.run(
-                    cmd, cwd=root, env=env, capture_output=True,
-                    timeout=CHILD_TIMEOUT_S)
-            except subprocess.TimeoutExpired:
-                report.failures.append(
-                    f"child PYTHONHASHSEED={seed} plan_workers={workers} "
-                    f"exceeded {CHILD_TIMEOUT_S}s")
-                continue
-            cell = CellResult(seed, workers, proc.stdout,
-                              proc.returncode,
-                              proc.stderr.decode(errors="replace"))
-            report.cells.append(cell)
-            if proc.returncode != 0:
-                report.failures.append(
-                    f"child {cell.label} exited {proc.returncode}:\n"
-                    f"{cell.stderr[-2000:]}")
-            if verbose:
-                print(f"nosdiff: {cell.label}: "
-                      f"{len(cell.output.splitlines())} record(s)",
-                      file=sys.stderr)
+            for inc in incremental:
+                env = dict(os.environ)
+                env["PYTHONHASHSEED"] = seed
+                env.setdefault("JAX_PLATFORMS", "cpu")
+                cmd = [sys.executable, "-m", "nos_tpu.analysis",
+                       "--determinism-child",
+                       "--plan-workers", str(workers),
+                       "--incremental", inc,
+                       "--cycles", str(cycles)]
+                try:
+                    proc = subprocess.run(
+                        cmd, cwd=root, env=env, capture_output=True,
+                        timeout=CHILD_TIMEOUT_S)
+                except subprocess.TimeoutExpired:
+                    report.failures.append(
+                        f"child PYTHONHASHSEED={seed} "
+                        f"plan_workers={workers} incremental={inc} "
+                        f"exceeded {CHILD_TIMEOUT_S}s")
+                    continue
+                cell = CellResult(seed, workers, inc, proc.stdout,
+                                  proc.returncode,
+                                  proc.stderr.decode(errors="replace"))
+                report.cells.append(cell)
+                if proc.returncode != 0:
+                    report.failures.append(
+                        f"child {cell.label} exited {proc.returncode}:\n"
+                        f"{cell.stderr[-2000:]}")
+                if verbose:
+                    print(f"nosdiff: {cell.label}: "
+                          f"{len(cell.output.splitlines())} record(s)",
+                          file=sys.stderr)
     good = [c for c in report.cells if c.returncode == 0]
     if not good:
         if not report.failures:
@@ -252,7 +270,7 @@ def main_determinism(fmt: str = "text",
         if report.ok:
             print(f"nosdiff: OK — {len(report.cells)} runs, "
                   f"{report.records} journal record(s), byte-identical "
-                  f"across PYTHONHASHSEED x plan_workers")
+                  f"across PYTHONHASHSEED x plan_workers x incremental")
         else:
             for failure in report.failures:
                 print(f"nosdiff: FAIL — {failure}")

@@ -375,8 +375,9 @@ class InvalidationProtocol(Rule):
         ("nos_tpu.scheduler.cache", "SchedulerCache",
          "the watch-maintained node/pod indexes behind snapshot()"),
         ("nos_tpu.scheduler.scheduler", "Scheduler",
-         "the cycle lister feeding the class-scan and window-busy "
-         "caches"),
+         "the cycle lister feeding the class-scan caches, and the "
+         "window-busy map (_busy_map_cache) whose mutations must ride "
+         "_mark_busy"),
         ("nos_tpu.partitioning.core.snapshot", "ClusterSnapshot",
          "the node map behind the epoch-memoised planner views"),
     )

@@ -175,6 +175,8 @@ def build_scheduler(api: APIServer,
                     backfill_duration_fn=None,
                     elastic_grow_budget_per_cycle: int = 1,
                     displaced_age_cap_s: float = 300.0,
+                    incremental: bool = True,
+                    full_rescan_every: int = 512,
                     clock=None) -> Scheduler:
     """The recompiled-kube-scheduler analog: framework with resources +
     spare-hold + topology + capacity plugins, quota ledger attached to
@@ -200,5 +202,7 @@ def build_scheduler(api: APIServer,
         backfill_duration_fn=backfill_duration_fn,
         elastic_grow_budget_per_cycle=elastic_grow_budget_per_cycle,
         displaced_age_cap_s=displaced_age_cap_s,
+        incremental=incremental,
+        full_rescan_every=full_rescan_every,
         hbm_gb_per_chip=float(tpu_memory_gb_per_chip),
         **kwargs)

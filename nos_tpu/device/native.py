@@ -152,6 +152,27 @@ def _bind(so: pathlib.Path):
     lib.nos_gil_handshake.restype = ctypes.c_int
     lib.nos_gil_handshake.argtypes = [
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_double]
+    # incremental decision plane (ISSUE 18): window-busy sort, Score
+    # argmin, victim prescreen — declared here so a stale .so missing
+    # any of them raises AttributeError and triggers the forced rebuild
+    lib.nos_window_busy.restype = ctypes.c_int
+    lib.nos_window_busy.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong]
+    lib.nos_score_batch.restype = ctypes.c_int
+    lib.nos_score_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.nos_victim_prescreen.restype = ctypes.c_int
+    lib.nos_victim_prescreen.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint8)]
     return lib
 
 
@@ -301,6 +322,64 @@ def fit_batch(free_flat: list[float], req_flat: list[float],
         return None
     return bytes(out[:cells]), (list(miss[:cells])
                                 if miss is not None else None)
+
+
+def window_busy_sort(gid_arr: "ctypes.Array[ctypes.c_longlong]",
+                     idx_arr: "ctypes.Array[ctypes.c_longlong]",
+                     val_arr: "ctypes.Array[ctypes.c_uint8]",
+                     n: int) -> bool:
+    """In-place lexicographic sort of the (gid, host-index, busy)
+    triples via nos_window_busy — the native form of Python's
+    `sorted(triples)` over the window-busy map.  Returns False when the
+    shim is unavailable/rejects (caller sorts in Python)."""
+    lib = _load(allow_build=False)      # never compile from a hot path
+    if lib is None:
+        return False
+    return lib.nos_window_busy(gid_arr, idx_arr, val_arr, n) == 0
+
+
+def score_batch(avoided, headroom, gids, widx, hidx, rank, wsizes, woff,
+                busy_gid, busy_idx, busy_val, n: int, m: int) -> int | None:
+    """Bridge to nos_score_batch (tpu_shim.cc): the Score argmin over n
+    pre-marshalled candidates against an m-entry sorted window-busy
+    table.  Returns the winning candidate index, or None when the shim
+    is unavailable or rejects the arguments (caller runs the Python
+    min).  GIL released for the duration (ctypes CDLL), so planner
+    shards scoring concurrently genuinely overlap."""
+    lib = _load(allow_build=False)      # never compile from a hot path
+    if lib is None:
+        return None
+    out = ctypes.c_longlong(-1)
+    rc = lib.nos_score_batch(avoided, headroom, gids, widx, hidx, rank,
+                             wsizes, woff, busy_gid, busy_idx, busy_val,
+                             n, m, ctypes.byref(out))
+    if rc != 0 or out.value < 0 or out.value >= n:
+        return None
+    return out.value
+
+
+def victim_prescreen(alloc_rows: list[list[float]], req: list[float],
+                     cap_chips: list[int], pod_chips: int
+                     ) -> list[bool] | None:
+    """Bridge to nos_victim_prescreen (tpu_shim.cc): per-node
+    empty-node fit verdicts for the preemption walk's persistent
+    prescreen (NodeResourcesFit at zero occupancy).  Returns None when
+    the shim is unavailable/rejects (caller screens in Python)."""
+    lib = _load(allow_build=False)      # never compile from a hot path
+    if lib is None:
+        return None
+    n = len(alloc_rows)
+    n_res = len(req)
+    flat = [v for row in alloc_rows for v in row]
+    out = (ctypes.c_uint8 * max(1, n))()
+    rc = lib.nos_victim_prescreen(
+        (ctypes.c_double * max(1, len(flat)))(*flat),
+        (ctypes.c_double * max(1, n_res))(*req),
+        (ctypes.c_longlong * max(1, n))(*cap_chips),
+        pod_chips, n, n_res, out)
+    if rc != 0:
+        return None
+    return [bool(v) for v in out[:n]]
 
 
 def install_native_packer(build: bool = False) -> bool:

@@ -21,6 +21,7 @@
 // exhaustive search is both exact and fast.
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -403,6 +404,140 @@ int nos_fit_batch(const double* free_m, const double* req_m,
       out[(size_t)i * n_classes + j] = fit ? 1 : 0;
       if (miss_out) miss_out[(size_t)i * n_classes + j] = miss;
     }
+  }
+  return 0;
+}
+
+// Lexicographic sort of the window-busy triples (gid, host-index,
+// busy) — the native form of the Score path's membership table
+// (scheduler.py _busy_score_arrays).  Sorts the three parallel arrays
+// in place by (gid, idx, val), exactly Python's `sorted(triples)`, so
+// nos_score_batch below can binary-search window membership.  Returns
+// 0, or -3 on bad args.  Stateless; GIL released via ctypes CDLL.
+int nos_window_busy(long long* gid, long long* idx, uint8_t* val,
+                    long long n) {
+  if (n < 0 || (n > 0 && (!gid || !idx || !val))) return -3;
+  std::vector<std::array<long long, 3>> triples((size_t)n);
+  for (long long i = 0; i < n; ++i)
+    triples[(size_t)i] = {gid[i], idx[i], (long long)val[i]};
+  std::sort(triples.begin(), triples.end());
+  for (long long i = 0; i < n; ++i) {
+    gid[i] = triples[(size_t)i][0];
+    idx[i] = triples[(size_t)i][1];
+    val[i] = (uint8_t)triples[(size_t)i][2];
+  }
+  return 0;
+}
+
+// Native Score argmin backing Scheduler._choose_node.  Replays the
+// Python _score_key tuple ordering
+//   (avoided, headroom, window_penalty, host_index, name_rank)
+// lexicographically over n candidates and writes the index of the
+// minimum (rank is the candidate's position in sorted name order —
+// unique, so the order is strict and ties cannot arise).  The window
+// penalty for candidate i with window group gid[i] >= 0 sums, over
+// its generation's window sizes wsizes[woff[i]..woff[i+1]), each size
+// whose aligned window [(widx/size)*size, +size) is WHOLLY present in
+// the sorted (busy_gid, busy_idx, busy_val) table with every slot
+// idle (val == 0) — breaking a whole free window costs its size,
+// exactly scheduler.py's window_penalty.  gid[i] < 0 => penalty 0 (no
+// window key, or pod-id absent from the busy map); m == 0 => penalty
+// 0 everywhere (Python's `if not busy: return 0`).  Host and window
+// indexes must be non-negative — the caller falls back to Python
+// otherwise, because C truncating division differs from Python floor
+// division below zero.  Returns 0, or -3 on bad args (including any
+// non-positive window size, where Python would raise).
+//
+// Stateless and lock-free: planner shards score concurrently through
+// the GIL-released ctypes CDLL binding.
+int nos_score_batch(const uint8_t* avoided, const double* headroom,
+                    const long long* gid, const long long* widx,
+                    const long long* hidx, const long long* rank,
+                    const long long* wsizes, const long long* woff,
+                    const long long* busy_gid, const long long* busy_idx,
+                    const uint8_t* busy_val, long long n, long long m,
+                    long long* out_index) {
+  if (n < 1 || m < 0 || !avoided || !headroom || !gid || !widx ||
+      !hidx || !rank || !wsizes || !woff || !busy_gid || !busy_idx ||
+      !busy_val || !out_index)
+    return -3;
+  for (long long i = 0; i < n; ++i)
+    if (gid[i] >= 0)
+      for (long long k = woff[i]; k < woff[i + 1]; ++k)
+        if (wsizes[k] <= 0) return -3;
+  // lower_bound on the sorted (gid, idx) pairs; true iff the slot
+  // exists, with *idle reporting val == 0
+  auto probe = [&](long long g, long long x, bool* idle) -> bool {
+    long long lo = 0, hi = m;
+    while (lo < hi) {
+      long long mid = lo + (hi - lo) / 2;
+      if (busy_gid[mid] < g || (busy_gid[mid] == g && busy_idx[mid] < x))
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    if (lo >= m || busy_gid[lo] != g || busy_idx[lo] != x) return false;
+    *idle = busy_val[lo] == 0;
+    return true;
+  };
+  auto penalty = [&](long long i) -> long long {
+    if (gid[i] < 0 || m == 0) return 0;
+    long long pen = 0;
+    for (long long k = woff[i]; k < woff[i + 1]; ++k) {
+      long long size = wsizes[k];
+      long long start = (widx[i] / size) * size;
+      bool whole = true;
+      for (long long w = start; w < start + size && whole; ++w) {
+        bool idle = false;
+        if (!probe(gid[i], w, &idle) || !idle) whole = false;
+      }
+      if (whole) pen += size;
+    }
+    return pen;
+  };
+  long long best = 0;
+  long long best_pen = penalty(0);
+  for (long long i = 1; i < n; ++i) {
+    if (avoided[i] != avoided[best]) {
+      if (avoided[i] < avoided[best]) { best = i; best_pen = penalty(i); }
+      continue;
+    }
+    if (headroom[i] != headroom[best]) {
+      if (headroom[i] < headroom[best]) { best = i; best_pen = penalty(i); }
+      continue;
+    }
+    long long pen = penalty(i);
+    if (pen != best_pen) {
+      if (pen < best_pen) { best = i; best_pen = pen; }
+      continue;
+    }
+    if (hidx[i] != hidx[best]) {
+      if (hidx[i] < hidx[best]) { best = i; }
+      continue;
+    }
+    if (rank[i] < rank[best]) { best = i; }
+  }
+  *out_index = best;
+  return 0;
+}
+
+// Empty-node fit mask backing CapacityScheduling._victim_screen:
+// could the preemptor fit on node i with every pod evicted?
+// out[i] = 1 iff every requested resource r satisfies
+// (req[r] <= 0 or alloc[i*n_res + r] >= req[r]) and
+// (pod_chips == 0 or pod_chips <= cap_chips[i]) — NodeResourcesFit at
+// zero occupancy.  Returns 0, or -3 on bad args.  Stateless.
+int nos_victim_prescreen(const double* alloc, const double* req,
+                         const long long* cap_chips, long long pod_chips,
+                         long long n, long long n_res, uint8_t* out) {
+  if (n < 0 || n_res < 0 || !alloc || !req || !cap_chips || !out)
+    return -3;
+  for (long long i = 0; i < n; ++i) {
+    const double* row = alloc + (size_t)i * (size_t)n_res;
+    bool ok = pod_chips == 0 || pod_chips <= cap_chips[i];
+    for (long long r = 0; ok && r < n_res; ++r)
+      if (req[r] > 0 && row[r] < req[r]) ok = false;
+    out[i] = ok ? 1 : 0;
   }
   return 0;
 }

@@ -358,7 +358,7 @@ class DefragProposer:
     def _node_slice_free(ni: Any, chips_per_host: float) -> float:
         """Free SLICE chip-equivalents on one node (shard-capped; the
         whole-chip resource a host also advertises would double-count
-        its capacity — same rule as pools._slice_free)."""
+        its capacity — same rule as pools.partition_pools' slice tally)."""
         total = 0.0
         for res, qty in ni.free().items():
             if qty <= 0:

@@ -42,7 +42,7 @@ from nos_tpu.api import constants as C
 from nos_tpu.kube.objects import Pod
 from nos_tpu.topology import DEFAULT_REGISTRY, Shape, TopologyRegistry
 from nos_tpu.topology.profile import (
-    free_chip_equivalents, shape_from_resource,
+    is_timeshare_resource, shape_from_resource,
 )
 
 from .interfaces import SliceCalculator
@@ -76,15 +76,40 @@ def partition_pools(snapshot: ClusterSnapshot) -> list[PlanPool]:
     slice_free: dict[tuple[str, str], list[float]] = {}
     for name, node in snapshot.nodes().items():
         # one node_info() read per node: this runs per plan over the
-        # whole fleet
+        # whole fleet, so both chip metrics come out of a single pass
+        # over the free map (_slice_free counts the slice-resource
+        # subset of what free_chip_equivalents counts)
         ni = node.node_info()
         labels = ni.node.metadata.labels
         key = (labels.get(C.LABEL_ACCELERATOR, ""),
                labels.get(C.LABEL_POD_ID, ""))
-        node_free_map = ni.free()
+        pf = getattr(node, "pool_free", None)
+        if pf is not None:
+            # slice nodes memoise the metric pair (warmed at snapshot
+            # construction — SliceNode.pool_free)
+            chips, slice_chips, _ = pf()
+        else:
+            chips = 0.0
+            slice_chips = 0.0
+            # free quantities derived key-by-key instead of via
+            # ni.free(): a requested-only key is strictly negative
+            # (skipped either way), so this skips one subtracted-dict
+            # allocation per node
+            req = ni.requested
+            for res, aq in ni.allocatable.items():
+                qty = aq - req.get(res, 0.0)
+                if qty <= 0:
+                    continue
+                shape = shape_from_resource(res)
+                if shape is not None:
+                    c = shape.chips * qty
+                    chips += c
+                    slice_chips += c
+                elif res == C.RESOURCE_TPU or is_timeshare_resource(res):
+                    chips += qty
         members.setdefault(key, []).append(name)
-        free[key] = free.get(key, 0.0) + free_chip_equivalents(node_free_map)
-        slice_free.setdefault(key, []).append(_slice_free(node_free_map))
+        free[key] = free.get(key, 0.0) + chips
+        slice_free.setdefault(key, []).append(slice_chips)
     return [
         PlanPool(key=f"{accel}|{domain}", accelerator=accel, domain=domain,
                  nodes=tuple(sorted(members[(accel, domain)])),
@@ -93,18 +118,6 @@ def partition_pools(snapshot: ClusterSnapshot) -> list[PlanPool]:
                      slice_free[(accel, domain)], reverse=True)))
         for accel, domain in sorted(members)
     ]
-
-
-def _slice_free(free: dict[str, float]) -> float:
-    """Free chip-equivalents in SLICE profile resources only."""
-    total = 0.0
-    for res, qty in free.items():
-        if qty <= 0:
-            continue
-        shape = shape_from_resource(res)
-        if shape is not None:
-            total += shape.chips * qty
-    return total
 
 
 def _profile_chips(profile: str, qty: int) -> float:

@@ -29,13 +29,17 @@ from __future__ import annotations
 
 from typing import Iterable, Mapping
 
-from nos_tpu.api.constants import ANNOT_DEFRAG_DRAIN, ANNOT_GANG_LEASE
+from nos_tpu.api.constants import (
+    ANNOT_DEFRAG_DRAIN, ANNOT_GANG_LEASE, RESOURCE_TPU,
+)
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.resources import (
-    negatives_only, pod_request, subtract, sum_resources,
+    negatives_only, pod_request, subtract,
 )
 from nos_tpu.scheduler.framework import SharedLister
-from nos_tpu.topology.profile import free_chip_equivalents
+from nos_tpu.topology.profile import (
+    is_timeshare_resource, shape_from_resource,
+)
 
 from nos_tpu.utils.guards import invalidated_by
 
@@ -209,13 +213,40 @@ class ClusterSnapshot:
             return [self._nodes[n] for n in cached[1]]
         out = []
         for name in sorted(self._nodes):
-            ni = self._nodes[name].node_info()
-            if any(v > 0 for v in ni.free().values()):
-                annots = ni.node.metadata.annotations
-                leased = bool(annots.get(ANNOT_GANG_LEASE)) \
-                    or bool(annots.get(ANNOT_DEFRAG_DRAIN))
-                out.append((leased, free_chip_equivalents(ni.free()),
-                            name, self._nodes[name]))
+            node = self._nodes[name]
+            pf = getattr(node, "pool_free", None)
+            if pf is not None:
+                # slice nodes memoise the metric (SliceNode.pool_free,
+                # warmed at snapshot construction)
+                chips, _, has_free = pf()
+                ni = node.node_info()
+            else:
+                ni = node.node_info()
+                # one allocation-free pass per node: free[k] > 0
+                # requires allocatable[k] > requested[k] (a
+                # requested-only key is strictly negative), so both the
+                # any-free screen and the chip-equivalent metric come
+                # straight off the two maps without building the
+                # subtracted free dict
+                req = ni.requested
+                has_free = False
+                chips = 0.0
+                for k, v in ni.allocatable.items():
+                    qty = v - req.get(k, 0.0)
+                    if qty <= 0:
+                        continue
+                    has_free = True
+                    shape = shape_from_resource(k)
+                    if shape is not None:
+                        chips += shape.chips * qty
+                    elif k == RESOURCE_TPU or is_timeshare_resource(k):
+                        chips += qty
+            if not has_free:
+                continue
+            annots = ni.node.metadata.annotations
+            leased = bool(annots.get(ANNOT_GANG_LEASE)) \
+                or bool(annots.get(ANNOT_DEFRAG_DRAIN))
+            out.append((leased, chips, name, node))
         out.sort(key=lambda t: (t[0], t[1], t[2]))
         self._candidate_cache = (self._mutation_gen, [t[2] for t in out])
         return [t[3] for t in out]
@@ -230,9 +261,16 @@ class ClusterSnapshot:
         if cached is not None and cached[0] == self._mutation_gen:
             free = cached[1]
         else:
+            # in-place accumulation over allocatable/requested directly:
+            # per-node free() would allocate one subtracted dict each, a
+            # visible slice of tracker setup on a 16k-host snapshot
             free: dict[str, float] = {}
             for pn in self._nodes.values():
-                free = sum_resources(free, pn.node_info().free())
+                ni = pn.node_info()
+                for k, v in ni.allocatable.items():
+                    free[k] = free.get(k, 0.0) + v
+                for k, v in ni.requested.items():
+                    free[k] = free.get(k, 0.0) - v
             free = {k: max(0.0, v) for k, v in free.items()}
             self._free_cache = (self._mutation_gen, free)
         lacking_resources = negatives_only(subtract(free, pod_request(pod)))

@@ -36,6 +36,12 @@ class SliceProfileFilter(SliceFilter):
 
 class SlicePartitionCalculator(PartitionCalculator):
     def node_partitioning(self, node: PartitionableNode) -> NodePartitioning:
+        part = getattr(node, "partitioning", None)
+        if part is not None:
+            # slice nodes derive and memoise their own row
+            # (SliceNode.partitioning, warmed at snapshot construction):
+            # this runs once per node per plan, over the whole fleet
+            return part()
         units = []
         for idx, geometry in sorted(node.geometries().items()):
             units.append(UnitPartitioning(

@@ -32,6 +32,7 @@ from collections import defaultdict
 from nos_tpu.kube.objects import Pod
 from nos_tpu.obs.trace import span as obs_span
 from nos_tpu.topology import DEFAULT_REGISTRY, TopologyRegistry
+from nos_tpu.topology.known import Generation
 from nos_tpu.topology.shape import Shape
 
 from nos_tpu.topology.windows import aligned_index_windows
@@ -86,7 +87,14 @@ class MultiHostGeometryPlanner(GeometryPlanner):
             return mutated
         # Classification is per generation: a profile can be sub-host on
         # v5e (8 chips/host) and multi-host on v4 (4 chips/host) at once.
-        gens = {n.generation for n in nodes}
+        # Deduped by object identity: generations are registry
+        # singletons, and hashing the frozen dataclass re-tuples every
+        # field per node — pure overhead at fleet scale.
+        gens_by_id: dict[int, Generation] = {}
+        for n in nodes:
+            g = n.generation
+            gens_by_id.setdefault(id(g), g)
+        gens = list(gens_by_id.values())
         shapes_lacking: dict[Shape, int] = {}
         sub_lacking_chips = 0
         for profile, qty in lacking.items():
@@ -109,6 +117,17 @@ class MultiHostGeometryPlanner(GeometryPlanner):
         # member hosts advertises N shard resources, satisfying N pending
         # gang pods.
         remaining = dict(shapes_lacking)
+        # Clean-host index, built once per physical pod: a window is
+        # carvable only from hosts with no used slices that are not
+        # already shards, and an aligned window of the CLEAN members is
+        # exactly an aligned all-clean window of the full member set —
+        # so prefiltering here replaces the per-window member re-test.
+        # On a busy fleet the old walk paid O(members x window) per
+        # lacking shape per plan just to rediscover that nothing was
+        # carvable.  The index is maintained across carves (a carved
+        # window's hosts become shards, hence dirty for smaller shapes
+        # visited later in the same pass).
+        clean_by_pod: dict[str, list[SliceNode]] = {}
         for shape in sorted(remaining, key=lambda s: -s.chips):
             for pod_id in sorted(by_pod):
                 if remaining[shape] <= 0:
@@ -119,6 +138,14 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                         shape not in gen.multihost_shapes():
                     continue
                 hosts = gen.hosts_for(shape)
+                clean = clean_by_pod.get(pod_id)
+                if clean is None:
+                    clean = clean_by_pod[pod_id] = [
+                        m for m in members
+                        if not m.has_used_slices()
+                        and not m.is_multihost_member()]
+                if len(clean) < hosts:
+                    continue
                 # Leased windows first: the scheduler drained these hosts
                 # for exactly this kind of gang (ANNOT_GANG_LEASE), so the
                 # moment one is clean it must become the gang's slice.
@@ -130,20 +157,22 @@ class MultiHostGeometryPlanner(GeometryPlanner):
                         if w.node_info().node.metadata.annotations.get(
                             ANNOT_GANG_LEASE))
 
-                for window in sorted(aligned_windows(members, hosts),
+                carved: set[str] = set()
+                for window in sorted(aligned_windows(clean, hosts),
                                      key=lambda w: -leased_count(w)):
                     if remaining[shape] <= 0:
                         break
-                    if any(w.has_used_slices() or w.is_multihost_member()
-                           for w in window):
-                        continue
                     for w in window:
                         w.make_member_of(shape)
+                        carved.add(w.name)
                     mutated = True
                     remaining[shape] -= hosts
                     logger.info(
                         "group pass: carved %s across %s",
                         shape.name, [w.name for w in window])
+                if carved:
+                    clean_by_pod[pod_id] = [
+                        m for m in clean if m.name not in carved]
         return mutated
 
     def _reclaim_free_instances(self, nodes: list[SliceNode],
@@ -157,21 +186,31 @@ class MultiHostGeometryPlanner(GeometryPlanner):
         arrivals the rest of the cluster can absorb).  Returns True when
         any instance was reclaimed."""
         mutated = False
-        deficit = lacking_chips
-        for n in nodes:
-            if n.is_multihost_member():
-                continue
-            for u in n.units:
-                if u.is_multihost_shard():
-                    continue
-                deficit -= sum(s.chips * c for s, c in u.free.items())
-        if deficit <= 0:
-            return mutated
-
+        # Membership scan first: with no multi-host instances present
+        # there is nothing to reclaim, whatever the deficit says — skip
+        # the full free-capacity walk entirely (the common case on a
+        # busy fleet, where that walk was pure per-plan overhead).
         by_pod: dict[str, list[SliceNode]] = defaultdict(list)
         for n in nodes:
             if n.pod_id and n.is_multihost_member():
                 by_pod[n.pod_id].append(n)
+        if not by_pod:
+            return mutated
+
+        deficit = lacking_chips
+        for n in nodes:
+            if deficit <= 0:
+                break
+            if n.is_multihost_member():
+                continue
+            # a non-member node has no multihost-shard units by
+            # definition (membership = any shard unit), so every unit's
+            # free table counts as re-carvable
+            for u in n.units:
+                deficit -= sum(s.chips * c for s, c in u.free.items())
+        if deficit <= 0:
+            return mutated
+
         for pod_id, members in by_pod.items():
             gen = members[0].generation
             # group shards into instances by shape + aligned window

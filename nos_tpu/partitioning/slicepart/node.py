@@ -18,11 +18,13 @@ from nos_tpu.topology.annotations import (
     parse_placement_annotations, parse_status_annotations,
 )
 from nos_tpu.topology.profile import (
-    extract_slice_requests, slice_resource_name,
+    extract_slice_requests, is_timeshare_resource, shape_from_resource,
+    slice_resource_name,
 )
 
 from ..core.interfaces import PartitionableNode, ProfileRequest
 from ..core.usage import claim_bound_pod_usage
+from ..state import NodePartitioning, UnitPartitioning
 
 
 def units_from_node(node: Node,
@@ -84,6 +86,19 @@ class SliceNode(PartitionableNode):
             registry.get(node.metadata.labels.get(C.LABEL_ACCELERATOR, "")))
         self._claim_bound_pod_usage()
         self._sync_allocatable()
+        # label-derived identity never changes for the life of the node
+        # object, and the derived-view memos below are warmed here so the
+        # fleet-wide walks inside a timed plan find them ready (snapshot
+        # construction is the untimed leg of every caller)
+        labels = node.metadata.labels
+        self._pod_id = labels.get(C.LABEL_POD_ID, "")
+        try:
+            self._host_index = int(labels.get(C.LABEL_HOST_INDEX, "0"))
+        except ValueError:
+            self._host_index = 0
+        self.is_multihost_member()
+        self.partitioning()
+        self.pool_free()
 
     # -- PartitionableNode --------------------------------------------------
     @property
@@ -92,15 +107,11 @@ class SliceNode(PartitionableNode):
 
     @property
     def pod_id(self) -> str:
-        return self._node_info.node.metadata.labels.get(C.LABEL_POD_ID, "")
+        return self._pod_id
 
     @property
     def host_index(self) -> int:
-        try:
-            return int(self._node_info.node.metadata.labels.get(
-                C.LABEL_HOST_INDEX, "0"))
-        except ValueError:
-            return 0
+        return self._host_index
 
     def node_info(self) -> NodeInfo:
         return self._node_info
@@ -109,7 +120,16 @@ class SliceNode(PartitionableNode):
         return any(c > 0 for u in self.units for c in u.used.values())
 
     def is_multihost_member(self) -> bool:
-        return any(u.is_multihost_shard() for u in self.units)
+        # Memoised on the geometry: every geometry transition funnels
+        # through _sync_allocatable, which resets the memo.  Pure
+        # used<->free moves (allocate/release under add_pod) cannot
+        # change membership — the shape stays in the unit's union — so
+        # they need no invalidation.  The group pass and the partition
+        # walks ask this per node per plan at fleet scale.
+        if self._mh_member is None:
+            self._mh_member = any(u.is_multihost_shard()
+                                  for u in self.units)
+        return self._mh_member
 
     def make_member_of(self, shape: Shape) -> None:
         """Dedicate this host as one shard of a multi-host slice: unit 0
@@ -169,7 +189,62 @@ class SliceNode(PartitionableNode):
                         u.release(s)
                     return False
         self._node_info.add_pod(pod)
+        # requested changed -> free changed.  The geometry union did NOT
+        # (allocate only moves shapes free->used), so the partitioning
+        # and membership memos stay valid.
+        self._pool_free = None
         return True
+
+    def partitioning(self) -> NodePartitioning:
+        """Desired-state row for this node, memoised on the geometry:
+        resources are the used+free union per unit, so pure
+        allocate/release moves cannot change it and every real geometry
+        transition funnels through _sync_allocatable, which resets the
+        memo.  The unit tables hold canonical shapes, making
+        shape->resource-name injective — name-keyed accumulation equals
+        the generic geometry_names derivation."""
+        if self._np is None:
+            units = []
+            for u in sorted(self.units, key=lambda u: u.index):
+                res: dict[str, int] = {}
+                for src in (u.used, u.free):
+                    for s, c in src.items():
+                        if c > 0:
+                            rn = slice_resource_name(s)
+                            res[rn] = res.get(rn, 0) + c
+                units.append(UnitPartitioning(index=u.index, resources=res))
+            self._np = NodePartitioning(units=units)
+        return self._np
+
+    def pool_free(self) -> tuple[float, float, bool]:
+        """(free chip-equivalents, free SLICE chip-equivalents, any free
+        at all) — the pool-partition and candidate-ordering metrics,
+        memoised on (geometry, requested).  Derived key-by-key off
+        allocatable/requested: a requested-only key is strictly negative
+        and both metrics ignore non-positive quantities, so this equals
+        free_chip_equivalents(free())/the slice subset without building
+        the subtracted dict.  Invalidated by _sync_allocatable (geometry)
+        and add_pod (requested)."""
+        if self._pool_free is None:
+            ni = self._node_info
+            req = ni.requested
+            chips = 0.0
+            slice_chips = 0.0
+            has_free = False
+            for res, aq in ni.allocatable.items():
+                qty = aq - req.get(res, 0.0)
+                if qty <= 0:
+                    continue
+                has_free = True
+                shape = shape_from_resource(res)
+                if shape is not None:
+                    c = shape.chips * qty
+                    chips += c
+                    slice_chips += c
+                elif res == C.RESOURCE_TPU or is_timeshare_resource(res):
+                    chips += qty
+            self._pool_free = (chips, slice_chips, has_free)
+        return self._pool_free
 
     def geometries(self) -> dict[int, dict[str, int]]:
         return {u.index: u.geometry_names() for u in self.units}
@@ -183,6 +258,14 @@ class SliceNode(PartitionableNode):
         # of cost, so skip the generic deepcopy dispatch over the list
         c.units = [u.__deepcopy__(None) for u in self.units]
         c.generation = self.generation
+        c._pod_id = self._pod_id
+        c._host_index = self._host_index
+        # same geometry + requested, same verdicts; sharing the memo
+        # objects is safe because invalidation REPLACES them with None,
+        # never mutates them in place
+        c._mh_member = self._mh_member
+        c._np = self._np
+        c._pool_free = self._pool_free
         return c
 
     # -- internals ----------------------------------------------------------
@@ -194,6 +277,9 @@ class SliceNode(PartitionableNode):
         """Recompute slice-resource allocatables from unit geometry so the
         embedded NodeInfo reflects the hypothetical state
         (reference node.go:171-195)."""
+        self._mh_member: bool | None = None
+        self._np: NodePartitioning | None = None
+        self._pool_free: tuple[float, float, bool] | None = None
         alloc = self._node_info.node.status.allocatable
         for res in [r for r in alloc if r.startswith(C.RESOURCE_SLICE_PREFIX)]:
             del alloc[res]

@@ -13,6 +13,22 @@ the v5e-256 trace).  This cache subscribes to Node/Pod watch events
 - `snapshot()` rebuilds the NodeInfo for exactly the nodes whose
   generation moved and reuses the cached object for every other node.
 
+The incremental decision plane (ISSUE 18) layers three more watch-fed
+views on the same event stream:
+
+- a fleet-wide **view epoch** (`view_epoch()`), bumped with every node
+  generation bump, that lets `snapshot()` return the SAME SharedLister
+  object across cycles where nothing moved — and lets downstream memos
+  (waste waterfall, victim prescreen masks) key their validity on one
+  integer instead of re-deriving from the fleet;
+- a **dirty set** (`drain_dirty()`): the node names bumped since the
+  last drain.  ``None`` means "everything" (initial state, or after
+  `invalidate_all()`), which is the level-triggered backstop's escape
+  hatch — the scheduler maps it to a full rescan;
+- a **pending-pod index** (`pending_pods()`) and a **gang-key set**
+  (`has_gang_pods()`), so the cycle's work list and the elastic-grow
+  gate stop paying an O(store) deep-copy `list()` per cycle.
+
 Coherence with the assume cache: the scheduler mutates a cycle
 snapshot's NodeInfos in place when it assumes a just-bound pod
 (`Scheduler._assume_bound`).  Every such mutation is paired with an API
@@ -24,13 +40,15 @@ leaks into a later cycle.
 Under the chaos substrate, dropped watch events leave the view stale
 until the chaos replay redelivers them at current state — the same
 staleness window a real informer has across a stream reconnect; the
-scheduler already tolerates it (binds are re-validated by admission).
+scheduler already tolerates it (binds are re-validated by admission),
+and the periodic full-rescan backstop re-levels the dirty set.
 """
 
 from __future__ import annotations
 
 import threading
 
+from nos_tpu.api.constants import LABEL_POD_GROUP
 from nos_tpu.kube.client import APIServer, Informer, KIND_NODE, KIND_POD
 from nos_tpu.kube.objects import Node, PENDING, Pod, RUNNING
 from nos_tpu.scheduler.framework import NodeInfo, SharedLister
@@ -38,7 +56,8 @@ from nos_tpu.utils.guards import guarded_by, invalidated_by
 
 
 @guarded_by("_lock", "_node_objs", "_pods_by_node", "_pod_node",
-            "_gen", "_built")
+            "_gen", "_built", "_epoch", "_dirty", "_snap",
+            "_pending", "_gang_keys")
 @invalidated_by("_bump_locked", "_node_objs", "_pods_by_node", "_pod_node")
 class SchedulerCache:
     """Every index is written on watch fan-out threads AND read by the
@@ -47,7 +66,11 @@ class SchedulerCache:
     @invalidated_by declaration certifies the generation protocol
     (noslint N012): every in-place mutation of the node/pod indexes is
     post-dominated by a _bump_locked emission, so snapshot()'s
-    generation-gated NodeInfo reuse can never serve a stale build."""
+    generation-gated NodeInfo reuse can never serve a stale build.
+    The epoch/dirty/snapshot/pending views are derived state keyed on
+    that same emission (every `_bump_locked` advances them in the same
+    critical section), not independently mutated sources — they ride
+    the declared protocol rather than extending it."""
 
     def __init__(self, api: APIServer) -> None:
         self._lock = threading.Lock()
@@ -66,6 +89,23 @@ class SchedulerCache:
         self._pod_node: dict[str, str] = {}
         self._gen: dict[str, int] = {}
         self._built: dict[str, tuple[int, NodeInfo]] = {}
+        # fleet-wide view epoch: moves with every per-node bump, so one
+        # integer comparison certifies "nothing in the fleet changed"
+        self._epoch = 0
+        # dirty node names since the last drain; None = everything is
+        # dirty (initial state and after invalidate_all) so the first
+        # cycle and the backstop both take the full-rescan path
+        self._dirty: set[str] | None = None
+        # epoch-gated snapshot reuse: the same SharedLister object is
+        # handed back while the epoch stands still, so a clean cycle
+        # costs zero NodeInfo list rebuilds
+        self._snap: tuple[int, SharedLister] | None = None
+        # pending (unbound) pods and gang-labeled pod keys, maintained
+        # from the same pod stream: the cycle work list without a full
+        # store scan.  Watch delivery hands this cache its own deep
+        # copies, so the stored objects are private to it.
+        self._pending: dict[str, Pod] = {}
+        self._gang_keys: set[str] = set()
         # hook order matters: the pod handler reads these indexes, so
         # they exist before the informers replay their initial ADDEDs;
         # store=False — this cache IS the store, a second copy per object
@@ -80,6 +120,9 @@ class SchedulerCache:
     # that every caller already holds the cache lock
     def _bump_locked(self, node_name: str) -> None:
         self._gen[node_name] = self._gen.get(node_name, 0) + 1
+        self._epoch += 1
+        if self._dirty is not None:
+            self._dirty.add(node_name)
 
     def _on_node(self, event: str, node: Node) -> None:
         name = node.metadata.name
@@ -95,7 +138,19 @@ class SchedulerCache:
         key = pod.key
         tracked = (event != "DELETED" and bool(pod.spec.node_name)
                    and pod.status.phase in (PENDING, RUNNING))
+        pending = (event != "DELETED" and not pod.spec.node_name
+                   and pod.status.phase == PENDING)
+        gang = (event != "DELETED"
+                and bool(pod.metadata.labels.get(LABEL_POD_GROUP)))
         with self._lock:
+            if pending:
+                self._pending[key] = pod
+            else:
+                self._pending.pop(key, None)
+            if gang:
+                self._gang_keys.add(key)
+            else:
+                self._gang_keys.discard(key)
             prev = self._pod_node.get(key)
             if prev is not None and (not tracked
                                      or prev != pod.spec.node_name):
@@ -121,17 +176,60 @@ class SchedulerCache:
         same key, so the two paths converge."""
         node_name = pod.spec.node_name
         with self._lock:
+            self._pending.pop(pod.key, None)
             self._pods_by_node.setdefault(node_name, {})[pod.key] = pod
             self._pod_node[pod.key] = node_name
             self._bump_locked(node_name)
+
+    # -- incremental-cycle feeds --------------------------------------------
+    def drain_dirty(self) -> frozenset[str] | None:
+        """Node names bumped since the last drain, then reset the set.
+        ``None`` means everything is dirty (first drain, or after
+        `invalidate_all()`) — the caller must full-rescan."""
+        with self._lock:
+            dirty = self._dirty
+            self._dirty = set()
+            return None if dirty is None else frozenset(dirty)
+
+    def invalidate_all(self) -> None:
+        """Level-trigger: forget all incremental state.  The next
+        `drain_dirty()` returns None and the next `snapshot()` rebuilds;
+        the periodic backstop and test harnesses call this."""
+        with self._lock:
+            self._dirty = None
+            self._snap = None
+            self._epoch += 1
+
+    def view_epoch(self) -> int:
+        """Fleet-wide change counter: equal epochs certify that no node
+        or bound-pod event landed in between (memo-key material)."""
+        with self._lock:
+            return self._epoch
+
+    def pending_pods(self) -> list[Pod]:
+        """The unbound PENDING pods, from the watch-maintained index —
+        no store scan, no deep copies.  Callers treat the objects as
+        read-only (they are this cache's private watch copies)."""
+        with self._lock:
+            return list(self._pending.values())
+
+    def has_gang_pods(self) -> bool:
+        """Whether any live pod carries the gang (pod-group) label —
+        the elastic-grow no-op gate."""
+        with self._lock:
+            return bool(self._gang_keys)
 
     # -- the per-cycle snapshot ---------------------------------------------
     def snapshot(self) -> SharedLister:
         """A SharedLister over the current view.  NodeInfos for
         unchanged nodes are the SAME objects as the previous snapshot
         (generation-gated reuse); changed nodes are rebuilt from the
-        watch-maintained node/pod records."""
+        watch-maintained node/pod records.  While the view epoch stands
+        still the SAME SharedLister object is returned, so a clean
+        cycle pays one integer compare instead of an O(nodes) rebuild."""
         with self._lock:
+            if self._snap is not None and self._snap[0] == self._epoch:
+                return self._snap[1]
             infos = []
             for name, node in self._node_objs.items():
                 gen = self._gen.get(name, 0)
@@ -143,7 +241,9 @@ class SchedulerCache:
                     cached = (gen, ni)
                     self._built[name] = cached
                 infos.append(cached[1])
-            return SharedLister(infos)
+            lister = SharedLister(infos)
+            self._snap = (self._epoch, lister)
+            return lister
 
     def close(self) -> None:
         self._nodes.close()

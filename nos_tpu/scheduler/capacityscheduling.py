@@ -33,12 +33,14 @@ from nos_tpu.kube.client import (
     NotFound,
 )
 from nos_tpu.kube.objects import PENDING, RUNNING, Pod
-from nos_tpu.kube.resources import ResourceList, sum_resources
+from nos_tpu.kube.resources import (
+    ResourceList, fits, pod_request, sum_resources,
+)
 from nos_tpu.obs import journal as J
 from nos_tpu.obs.journal import MAX_JOURNAL_NODES, record as journal_record
 from nos_tpu.quota import ElasticQuotaInfo, ElasticQuotaInfos, TPUResourceCalculator
 from nos_tpu.scheduler.framework import (
-    CycleState, Framework, NodeInfo, SharedLister, Status,
+    CycleState, Framework, NodeInfo, SharedLister, Status, _slice_chips,
 )
 from nos_tpu.utils.pod_util import (
     elastic_replica_bounds, is_displaced_fresh, is_over_quota,
@@ -59,6 +61,12 @@ ELASTIC_QUOTA_SNAPSHOT_KEY = "ElasticQuotaSnapshot"
 # freshness rule as the admission queue (pod_util.is_displaced_fresh);
 # absent (plugin driven directly) the stamp never expires.
 DISPLACED_CONTEXT_KEY = "DisplacedPreemptorContext"
+# Fleet view epoch the scheduler stamps before PostFilter when (and only
+# when) the cycle runs against the real watch-cache lister: equal epochs
+# certify the node set and every allocatable are unchanged, which keys
+# the persistent victim-prescreen mask (ISSUE 18).  Gang what-if domains
+# never carry it, so their synthetic listers cannot poison the cache.
+VIEW_EPOCH_CONTEXT_KEY = "SchedulerViewEpoch"
 
 
 class PreFilterState:
@@ -155,6 +163,10 @@ class CapacityScheduling:
         self.on_preempt = None
         self._nominated_rv: int | None = None
         self._nominated_cache: list[Pod] = []
+        # request-signature -> (view epoch, empty-node fit mask); see
+        # _victim_screen.  Bounded: cleared wholesale past 512 classes.
+        self._victim_mask_cache: dict[
+            tuple, tuple[int, frozenset[str]]] = {}
 
     # ------------------------------------------------------------------
     # Wiring
@@ -363,6 +375,20 @@ class CapacityScheduling:
         if PRE_FILTER_STATE_KEY not in state:
             return "", Status.unschedulable("PreFilter was not run")
 
+        # Persistent victim prescreen (ISSUE 18): skip nodes that could
+        # not hold the preemptor even fully drained.  The walk's final
+        # re-check (`run_filter_plugins` with all victims removed) is
+        # unconditional and NodeResourcesFit is monotone in occupancy,
+        # so those nodes can never yield a candidate — skipping them is
+        # journal-identical.  An empty mask short-circuits the whole
+        # PostFilter with the exact journal line the empty-candidates
+        # path below would emit.
+        mask = self._victim_screen(state, pod, nodes)
+        if mask is not None and not mask:
+            journal_record(J.PREEMPTION_NONE, pod.key,
+                           message="preemption found no candidates")
+            return "", Status.unschedulable("preemption found no candidates")
+
         # PDB statuses are O(namespace pods) to refresh — compute once per
         # PostFilter, not once per candidate node.
         from nos_tpu.api.pdb import (
@@ -379,6 +405,8 @@ class CapacityScheduling:
 
         candidates: list[tuple[str, list[Pod], int, set[str]]] = []
         for ni in nodes.list():
+            if mask is not None and ni.name not in mask:
+                continue
             shrink_uids: set[str] = set()
             victims, num_violating, st = self._select_victims_on_node(
                 state, pod, ni, pdbs, gang_cache, shrink_out=shrink_uids)
@@ -409,6 +437,55 @@ class CapacityScheduling:
         logger.info("preempting %d pod(s) on %s for %s",
                     len(victims), node_name, pod.key)
         return node_name, Status.ok()
+
+    def _victim_screen(self, state: CycleState, pod: Pod,
+                       nodes: SharedLister) -> frozenset[str] | None:
+        """Names of nodes where `pod` would fit on an EMPTY node — the
+        persistent cross-cycle prescreen for the preemption walk.
+
+        Soundness: `_select_victims_on_node` only succeeds after an
+        unconditional `run_filter_plugins` re-check with every candidate
+        victim removed; the non-victim residue keeps requested >= 0, so
+        free <= allocatable and used chips >= 0 — NodeResourcesFit
+        failing at zero occupancy implies it fails at any occupancy.  A
+        node outside this mask can therefore never produce a candidate,
+        and the walk itself journals nothing, so skipping it leaves the
+        decision journal byte-identical.
+
+        The mask is a pure function of (request signature, fleet node
+        allocatables), cached per signature under the view epoch that
+        the scheduler stamps into cycle state only for the real cycle
+        lister.  Returns None (screen nothing) when no epoch is present
+        — detached plugin use and gang what-if domains take the full
+        walk unchanged."""
+        epoch = state.get(VIEW_EPOCH_CONTEXT_KEY)
+        if epoch is None:
+            return None
+        req = pod_request(pod)
+        sig = tuple(sorted((k, v) for k, v in req.items() if v > 0))
+        cached = self._victim_mask_cache.get(sig)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        pod_chips = _slice_chips(req)
+        nis = nodes.list()
+        # chip capacities are only consulted when the request carries
+        # slice chips; the profile parse is the costly part, skip it
+        caps = [_slice_chips(ni.allocatable) if pod_chips else 0
+                for ni in nis]
+        from nos_tpu.device import native
+        passing = native.victim_prescreen(
+            [[ni.allocatable.get(k, 0.0) for k, _ in sig] for ni in nis],
+            [v for _, v in sig], caps, pod_chips)
+        if passing is None:
+            passing = [fits(req, ni.allocatable)
+                       and (pod_chips == 0 or pod_chips <= caps[i])
+                       for i, ni in enumerate(nis)]
+        mask = frozenset(
+            ni.name for ni, ok in zip(nis, passing) if ok)
+        if len(self._victim_mask_cache) > 512:
+            self._victim_mask_cache.clear()
+        self._victim_mask_cache[sig] = (epoch, mask)
+        return mask
 
     def _expand_eviction(self, victims: list[Pod],
                          gang_cache: dict | None = None,

@@ -39,11 +39,23 @@ class Shape:
 
     @property
     def chips(self) -> int:
-        return reduce(mul, self.dims, 1)
+        # per-instance memo, same discipline as canonical(): chips runs
+        # in every geometry-scoring and partition-derivation hot loop
+        try:
+            return object.__getattribute__(self, "_chips")
+        except AttributeError:
+            c = reduce(mul, self.dims, 1)
+            object.__setattr__(self, "_chips", c)
+            return c
 
     @property
     def name(self) -> str:
-        return "x".join(str(d) for d in self.dims)
+        try:
+            return object.__getattribute__(self, "_name")
+        except AttributeError:
+            n = "x".join(str(d) for d in self.dims)
+            object.__setattr__(self, "_name", n)
+            return n
 
     def canonical(self) -> "Shape":
         # per-instance memo (frozen dataclass: not a field, so eq/hash/

@@ -210,7 +210,13 @@ class SliceUnit:
     def is_multihost_shard(self) -> bool:
         """True if this block is (part of) a slice larger than one host."""
         limit = self.generation.chips_per_host
-        return any(s.chips > limit for s in self.current_geometry())
+        # membership test only — skip the current_geometry() dict build,
+        # this runs per unit in every group-pass and partition-state walk
+        for src in (self.used, self.free):
+            for s, c in src.items():
+                if c > 0 and s.chips > limit:
+                    return True
+        return False
 
     def make_member_of(self, shape: Shape) -> None:
         """Dedicate the whole block as one shard of a multi-host slice: the

@@ -26,12 +26,16 @@ fi
 
 # Dual-run determinism gate (noslint v3's dynamic half): run the
 # benchmark trace in child interpreters across PYTHONHASHSEED x
-# plan_workers and byte-diff the decision journals.  ~5 s wall for the
-# 6-cell matrix; each child is hard-bounded (CHILD_TIMEOUT_S = 120 in
+# plan_workers x incremental {on,off} and byte-diff the decision
+# journals — the incremental axis is the ISSUE 18 anchor (dirty-set
+# scheduling + persistent indexes + native hot loops must reproduce
+# the full-rescan journals byte-for-byte).  ~10 s wall for the
+# 12-cell matrix; each child is hard-bounded (CHILD_TIMEOUT_S = 120 in
 # analysis/determinism.py) and the whole gate by the timeout below, so
 # a hung child can never wedge CI.  On failure: the report names the
 # first differing journal record — docs/troubleshooting.md ("plans
-# differ across runs") is the playbook.
+# differ across runs" / "incremental and full journals diverge") is
+# the playbook.
 echo "==> nosdiff (python -m nos_tpu.analysis --determinism)"
 if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
         python -m nos_tpu.analysis --determinism; then
@@ -78,6 +82,11 @@ fi
 
 echo "==> bench_fleet.py --smoke (shard-count + sharded plan wall gate)"
 if ! env JAX_PLATFORMS=cpu python bench_fleet.py --smoke; then
+    rc=1
+fi
+
+echo "==> perf-gate: bench_fleet.py --scale-smoke (incremental decision plane: steady cycle p99 + delta plan p50)"
+if ! env JAX_PLATFORMS=cpu python bench_fleet.py --scale-smoke; then
     rc=1
 fi
 

@@ -3,12 +3,15 @@
 
 Thin wrapper over ``python -m nos_tpu.analysis --determinism``
 (nos_tpu/analysis/determinism.py): runs the benchmark trace in child
-interpreters across a PYTHONHASHSEED x plan_workers matrix and
-byte-diffs the decision journals.  Exit 0 = byte-identical everywhere.
+interpreters across a PYTHONHASHSEED x plan_workers x incremental
+matrix and byte-diffs the decision journals.  Exit 0 = byte-identical
+everywhere — including between the incremental (dirty-set) and
+full-rescan scheduler paths, the ISSUE 18 equivalence anchor.
 
   scripts/nosdiff.py                  # the CI gate (scripts/check.sh)
   scripts/nosdiff.py --json           # machine-readable report
   scripts/nosdiff.py --seeds 0 7 --workers 1 2 8 --cycles 3
+  scripts/nosdiff.py --incremental on # pin one side of the axis
 
 When this gate fails, start at docs/troubleshooting.md ("plans differ
 across runs"): the report names the first differing journal record,
@@ -27,7 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from nos_tpu.analysis.determinism import (  # noqa: E402
-    DEFAULT_CYCLES, HASH_SEEDS, PLAN_WORKERS, run_matrix,
+    DEFAULT_CYCLES, HASH_SEEDS, INCREMENTAL, PLAN_WORKERS, run_matrix,
 )
 
 
@@ -41,6 +44,11 @@ def main() -> int:
                         default=list(PLAN_WORKERS),
                         help="plan_workers values (default: "
                         f"{' '.join(str(w) for w in PLAN_WORKERS)})")
+    parser.add_argument("--incremental", nargs="+",
+                        choices=("on", "off"),
+                        default=list(INCREMENTAL),
+                        help="incremental scheduler modes (default: "
+                        f"{' '.join(INCREMENTAL)})")
     parser.add_argument("--cycles", type=int, default=DEFAULT_CYCLES,
                         help="scheduler cycles per child run")
     parser.add_argument("--json", action="store_true",
@@ -48,6 +56,7 @@ def main() -> int:
     args = parser.parse_args()
     report = run_matrix(hash_seeds=tuple(args.seeds),
                         plan_workers=tuple(args.workers),
+                        incremental=tuple(args.incremental),
                         cycles=args.cycles,
                         verbose=not args.json)
     if args.json:

@@ -1,0 +1,69 @@
+"""Structure tests for the ISSUE 18 scale bench tier (bench_fleet).
+
+Tier-1 runs the scale tier's exact code path on a toy fleet and pins
+the report SHAPE — the keys CI's perf gate and the acceptance JSON
+consume.  No wall-clock assertions here (this box's timing noise is
+±40%); the perf bounds live in the slow-marked smoke/scale runs.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")  # bench_fleet lives at the repo root
+
+import bench_fleet  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def scale_report():
+    # tiny fleet: the multi-generation pool split needs a few dozen
+    # hosts; 2 cycles + 1 plan repeat keeps this inside tier-1 budget
+    return bench_fleet.run_scale_bench(
+        hosts=48, pods=96, steady_cycles=2, warmup_cycles=1,
+        plan_repeats=1)
+
+
+class TestScaleBenchReport:
+    def test_acceptance_keys_present(self, scale_report):
+        for key in ("hosts", "pods", "resident_pending", "incremental",
+                    "warmup_cycle_wall_ms", "scheduler_cycle_wall_ms",
+                    "backstop_cycle_ms", "plan_delta_pods",
+                    "plan_wall_ms", "scale_targets"):
+            assert key in scale_report, f"scale report lost key {key!r}"
+
+    def test_named_targets_shape(self, scale_report):
+        targets = scale_report["scale_targets"]
+        assert set(targets) == {"cycle_p99_ms", "plan_p50_ms"}
+        assert targets["cycle_p99_ms"]["target"] == \
+            bench_fleet.SCALE_CYCLE_P99_MS
+        assert targets["plan_p50_ms"]["target"] == \
+            bench_fleet.SCALE_PLAN_P50_MS
+        for gate in targets.values():
+            assert set(gate) == {"target", "value", "ok"}
+            assert gate["value"] > 0
+            assert gate["ok"] == (gate["value"] < gate["target"])
+
+    def test_wall_summaries_have_percentiles(self, scale_report):
+        for key in ("warmup_cycle_wall_ms", "scheduler_cycle_wall_ms",
+                    "plan_wall_ms"):
+            summary = scale_report[key]
+            assert {"p50", "p99"} <= set(summary)
+            assert summary["p50"] <= summary["p99"]
+
+    def test_backstop_measured_when_incremental(self, scale_report):
+        # the forced full-rescan recovery cycle is the honesty metric
+        # for the dirty-set fast path: it must be measured (not None)
+        # whenever the bench ran incrementally
+        assert scale_report["incremental"] is True
+        assert scale_report["backstop_cycle_ms"] is not None
+        assert scale_report["backstop_cycle_ms"] > 0
+
+    def test_full_rescan_mode_skips_backstop_metric(self):
+        report = bench_fleet.run_scale_bench(
+            hosts=48, pods=96, steady_cycles=1, warmup_cycles=1,
+            plan_repeats=1, incremental=False)
+        assert report["incremental"] is False
+        assert report["backstop_cycle_ms"] is None

@@ -565,7 +565,7 @@ class TestFragCulpritRanking:
         with obs_scoped(ledger=ledger):
             for _ in range(5):
                 now[0] += 1.0
-                sched._waste_rejected_nodes = {"h0"}
+                sched._waste_rejection_maps = [{"h0": "no fit"}]
                 sched._waste_frag_counts = {"slice-2x4": 1,
                                             "slice-2x2": 1}
                 sched._waste_frag_chips = {"slice-2x4": 8.0,

@@ -345,3 +345,83 @@ class TestNativeFitBatch:
             f"no GIL overlap: handshake verdicts {results} "
             "(0 = partner never entered native code concurrently; "
             "is the shim bound via a GIL-holding loader?)")
+
+
+class TestNativeHotLoops:
+    """ISSUE 18 decision-plane hot loops: each native form and its
+    Python fallback must be interchangeable bit-for-bit — the scheduler
+    journals DECISIONS, so a single comparator divergence breaks the
+    incremental-vs-full byte-identity certification (nosdiff)."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_window_busy_sort_matches_python_sort(self, seed):
+        import ctypes
+        import random
+
+        rng = random.Random(seed)
+        # unique (gid, host-index) keys — the busy dict guarantees that
+        keys = list({(rng.randrange(6), rng.randrange(16))
+                     for _ in range(rng.randrange(0, 40))})
+        rng.shuffle(keys)
+        triples = [(g, i, rng.randrange(2)) for g, i in keys]
+        n = len(triples)
+        gid_a = (ctypes.c_longlong * max(1, n))(*[t[0] for t in triples])
+        idx_a = (ctypes.c_longlong * max(1, n))(*[t[1] for t in triples])
+        val_a = (ctypes.c_uint8 * max(1, n))(*[t[2] for t in triples])
+        assert native.window_busy_sort(gid_a, idx_a, val_a, n)
+        got = [(gid_a[i], idx_a[i], val_a[i]) for i in range(n)]
+        assert got == sorted(triples)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_victim_prescreen_matches_python_screen(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        n_res = rng.randrange(1, 5)
+        req = [round(rng.uniform(0.5, 4.0), 3) for _ in range(n_res)]
+        rows = [[round(rng.uniform(0.0, 5.0), 3) for _ in range(n_res)]
+                for _ in range(30)]
+        rows.append(list(req))                  # exact-equality edge
+        rows.append([v - 1e-9 for v in req])    # just-below edge
+        caps = [rng.randrange(0, 9) for _ in rows]
+        for pod_chips in (0, rng.randrange(1, 9)):
+            got = native.victim_prescreen(rows, req, caps, pod_chips)
+            assert got is not None
+            # the Python fallback in capacityscheduling._victim_screen:
+            # fits(req, allocatable) and the chip-capacity guard
+            want = [all(row[j] >= req[j] for j in range(n_res))
+                    and (pod_chips == 0 or pod_chips <= caps[i])
+                    for i, row in enumerate(rows)]
+            assert got == want
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_choose_node_native_matches_python_argmin(self, seed):
+        import random
+
+        from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.scheduler.scheduler import Scheduler
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        rng = random.Random(seed)
+        api = APIServer()
+        hosts, per_domain = 16, 4
+        for i in range(hosts):
+            free = rng.choice([{"2x2": 1}, {"2x2": 2}, {"2x4": 1}])
+            api.create(KIND_NODE, make_tpu_node(
+                f"h{i:02d}", pod_id=f"dom-{i // per_domain}",
+                host_index=i % per_domain, status_geometry={"free": free}))
+        for i in rng.sample(range(hosts), 5):    # busy windows
+            api.create(KIND_POD, make_slice_pod(
+                "2x2", 1, name=f"b{i}", node_name=f"h{i:02d}"))
+        scheduler = Scheduler(api, Framework())
+        scheduler._reserved_hosts = frozenset(   # avoided-host axis
+            f"h{i:02d}" for i in rng.sample(range(hosts), 2))
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="target"))
+        pod = api.get(KIND_POD, "target", "default")
+        lister = scheduler._cycle_lister()
+        nis = list(lister.list())
+        picked = scheduler._native_choose(pod, nis, lister)
+        assert picked is not None, "native scorer fell back unexpectedly"
+        want = min(nis, key=scheduler._score_key(pod, lister))
+        assert picked.name == want.name

@@ -654,6 +654,117 @@ class TestVictimPrescreen:
             {p.metadata.name for p in refused})
 
 
+class TestVictimNodeScreen:
+    """`_victim_screen` (ISSUE 18): the persistent per-request node mask
+    for the preemption walk — epoch-keyed caching, correctness of the
+    empty-node fit verdicts, and the empty-mask short-circuit that must
+    emit the exact journal line the full walk would."""
+
+    class _Lister:
+        def __init__(self, nis):
+            self._nis = nis
+
+        def list(self):
+            return list(self._nis)
+
+    def _setup(self):
+        from nos_tpu.quota import TPUResourceCalculator
+        from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
+        from nos_tpu.scheduler.framework import (
+            Framework, NodeInfo, NodeResourcesFit,
+        )
+        from nos_tpu.testing.factory import make_tpu_node
+
+        cs = CapacityScheduling(TPUResourceCalculator())
+        cs.set_framework(Framework([NodeResourcesFit()]))
+        nis = [NodeInfo(node=make_tpu_node(
+                   "big", status_geometry={"free": {"2x4": 1}})),
+               NodeInfo(node=make_tpu_node(
+                   "small", status_geometry={"free": {"2x2": 1}}))]
+        return cs, self._Lister(nis)
+
+    def _state(self, epoch=1):
+        from nos_tpu.scheduler.capacityscheduling import (
+            VIEW_EPOCH_CONTEXT_KEY,
+        )
+        from nos_tpu.scheduler.framework import CycleState
+
+        state = CycleState()
+        if epoch is not None:
+            state[VIEW_EPOCH_CONTEXT_KEY] = epoch
+        return state
+
+    def test_mask_is_the_empty_node_fit_set(self):
+        from nos_tpu.testing.factory import make_slice_pod
+
+        cs, lister = self._setup()
+        # a 2x4 preemptor fits an empty "big" (slice resource + 8 chips)
+        # but never "small" (no 2x4 resource, only 4 chips of capacity)
+        mask = cs._victim_screen(
+            self._state(), make_slice_pod("2x4", 1, name="p"), lister)
+        assert mask == frozenset({"big"})
+        # a 2x2 preemptor only fits where the 2x2 slice resource exists
+        # (the screen is NodeResourcesFit at zero occupancy: exact
+        # resource names, not chip arithmetic)
+        mask = cs._victim_screen(
+            self._state(), make_slice_pod("2x2", 1, name="q"), lister)
+        assert mask == frozenset({"small"})
+
+    def test_no_epoch_means_no_screening(self):
+        # detached plugin use / gang what-if domains carry no view
+        # epoch: the walk must stay unscreened (None), not masked-empty
+        from nos_tpu.testing.factory import make_slice_pod
+
+        cs, lister = self._setup()
+        assert cs._victim_screen(
+            self._state(epoch=None),
+            make_slice_pod("2x4", 1, name="p"), lister) is None
+
+    def test_mask_persists_under_epoch_and_refreshes_past_it(self):
+        from nos_tpu.scheduler.framework import NodeInfo
+        from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
+
+        cs, lister = self._setup()
+        pod = make_slice_pod("2x4", 1, name="p")
+        first = cs._victim_screen(self._state(epoch=7), pod, lister)
+        # unchanged epoch: the cached frozenset comes back by identity
+        # (no node re-walk — that is the cross-cycle win)
+        assert cs._victim_screen(self._state(epoch=7), pod, lister) \
+            is first
+        # fleet change bumps the epoch: the mask must see the new node
+        lister._nis.append(NodeInfo(node=make_tpu_node(
+            "big2", status_geometry={"free": {"2x4": 1}})))
+        refreshed = cs._victim_screen(self._state(epoch=8), pod, lister)
+        assert refreshed == frozenset({"big", "big2"})
+
+    def test_empty_mask_short_circuits_with_exact_journal_line(self):
+        from nos_tpu.scheduler.capacityscheduling import (
+            ELASTIC_QUOTA_SNAPSHOT_KEY, PRE_FILTER_STATE_KEY,
+            PreFilterState,
+        )
+        from nos_tpu.testing.factory import make_slice_pod
+
+        cs, lister = self._setup()
+        state = self._state()
+        state[ELASTIC_QUOTA_SNAPSHOT_KEY] = cs.elastic_quota_infos.clone()
+        # 4x4 fits neither node even fully drained -> empty mask
+        preemptor = make_slice_pod("4x4", 1, name="p", priority=10)
+        state[PRE_FILTER_STATE_KEY] = PreFilterState(
+            cs.calculator.compute_pod_request(preemptor))
+        journal = DecisionJournal(maxlen=8, clock=FakeClock())
+        with obs.scoped(journal=journal):
+            node, status = cs.post_filter(state, preemptor, lister)
+        assert node == ""
+        assert not status.is_success
+        assert status.message == "preemption found no candidates"
+        # byte-identical journal contract: the short-circuit emits the
+        # same record the exhausted walk would
+        [rec] = journal.events()
+        assert rec.category == J.PREEMPTION_NONE
+        assert rec.subject == preemptor.key
+        assert rec.attrs["message"] == "preemption found no candidates"
+
+
 # ---------------------------------------------------------------------------
 # Journal call-site regressions
 # ---------------------------------------------------------------------------

@@ -217,6 +217,55 @@ class TestN012:
             == "_mutation_gen"
         assert invalidated_fields(Scheduler)["_cycle_lister_cache"] \
             == "_invalidate_scans"
+        # the window-busy map rides its own event (ISSUE 18 satellite):
+        # every in-place flip must route through _mark_busy
+        assert invalidated_fields(Scheduler)["_busy_map_cache"] \
+            == "_mark_busy"
+
+    def test_busy_map_mutation_off_the_event_convicted(self):
+        # Conviction fixture mirroring Scheduler's stacked declaration:
+        # an in-place write to the window-busy map that does not ride
+        # _mark_busy must be an N012 verdict, with the event named.
+        src = (
+            "from nos_tpu.utils.guards import invalidated_by\n"
+            "\n"
+            "@invalidated_by('_invalidate', '_lister')\n"
+            "@invalidated_by('_mark_busy', '_busy_map_cache')\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._busy_map_cache = {}\n"
+            "        self._lister = None\n"
+            "\n"
+            "    def bind(self, key):\n"
+            "        self._busy_map_cache[key] = True\n"
+            "\n"
+            "    def _mark_busy(self, key):\n"
+            "        self._busy_map_cache[key] = True\n"
+            "\n"
+            "    def _invalidate(self):\n"
+            "        pass\n"
+        )
+        v = lint_source(src, [InvalidationProtocol()], relpath=SCHED)
+        assert rules_of(v) == ["N012"]
+        assert "_mark_busy" in v[0].message
+
+    def test_busy_map_mutation_riding_the_event_passes(self):
+        src = (
+            "from nos_tpu.utils.guards import invalidated_by\n"
+            "\n"
+            "@invalidated_by('_mark_busy', '_busy_map_cache')\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._busy_map_cache = {}\n"
+            "\n"
+            "    def bind(self, key):\n"
+            "        self._mark_busy(key)\n"
+            "\n"
+            "    def _mark_busy(self, key):\n"
+            "        self._busy_map_cache[key] = True\n"
+        )
+        assert lint_source(src, [InvalidationProtocol()],
+                           relpath=SCHED) == []
 
     def test_carrier_rejects_non_string_names(self):
         # both checkers read the table as attribute names; a non-string
@@ -294,16 +343,20 @@ class TestNosdiff:
         assert len(first) > 50      # the trace actually decides things
 
     def test_golden_matrix_corner_byte_identical(self):
-        # tier-1 corner of the full check.sh matrix: 2 seeds x 2 worker
-        # counts, one scheduler cycle; the journals must byte-match
+        # tier-1 corner of the full check.sh matrix: 2 seeds x sharded
+        # workers x incremental on/off, one scheduler cycle; the
+        # journals must byte-match — incremental off vs on is the
+        # ISSUE 18 dirty-set equivalence anchor
         report = run_matrix(hash_seeds=("0", "random"),
-                            plan_workers=(1, 4), cycles=1,
+                            plan_workers=(4,),
+                            incremental=("on", "off"), cycles=1,
                             verbose=False)
         assert report.ok, "\n".join(report.failures)
         assert len(report.cells) == 4
         assert report.records > 50
         # the cells really ran under different interpreters/settings
         assert len({c.label for c in report.cells}) == 4
+        assert {c.incremental for c in report.cells} == {"on", "off"}
         # output is canonical JSON lines
         line = report.cells[0].output.splitlines()[0]
         rec = json.loads(line)

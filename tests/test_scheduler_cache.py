@@ -305,17 +305,85 @@ class TestSchedulerEndToEnd:
         assert [p.metadata.name for p in view["n0"].pods] == ["p"]
 
 
+class TestPrescreenDisablePath:
+    """Runtime disable of the native fit prescreen (shim-less latch, a
+    test, an operator toggle) must be a benign fallback to the pure
+    Filter pipeline — never a crashed cycle.  The old code asserted on
+    ``self._prescreen`` inside the seed call, so a drop landing between
+    the caller's None check and the dereference took the whole cycle
+    down (ISSUE 18 satellite; scheduler._seed_filter_memo_native)."""
+
+    def _cluster(self):
+        api = APIServer()
+        for i in range(3):
+            api.create(KIND_NODE, make_tpu_node(
+                f"n{i}", host_index=i,
+                status_geometry={"free": {"2x2": 1}}))
+        return api, Scheduler(api, Framework([NodeResourcesFit()]))
+
+    def test_seed_with_screen_already_dropped_is_noop(self):
+        api, scheduler = self._cluster()
+        scheduler._prescreen = None
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        pod = api.get(KIND_POD, "p", "default")
+        equiv = scheduler._filter_equiv_key(pod)
+        assert equiv is not None
+        # the old assert crashed exactly here; now: quiet no-op
+        scheduler._seed_filter_memo_native(
+            pod, equiv, scheduler._cycle_lister())
+        assert scheduler._filter_cache == {}
+        # and the pure pipeline still schedules
+        assert scheduler.run_cycle() == 1
+
+    def test_screen_dropped_mid_call_finishes_on_snapshot(self, monkeypatch):
+        # simulate the race: the screen is dropped AFTER the caller's
+        # check, while the seed call is in flight — the local snapshot
+        # must keep this call self-consistent (seed or no-op, no crash)
+        from nos_tpu.device import native
+        api, scheduler = self._cluster()
+        assert scheduler._prescreen is not None
+
+        def dropping_probe(build=False):
+            scheduler._prescreen = None
+            return True
+
+        monkeypatch.setattr(native, "fit_batch_available", dropping_probe)
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        pod = api.get(KIND_POD, "p", "default")
+        scheduler._seed_filter_memo_native(
+            pod, scheduler._filter_equiv_key(pod), scheduler._cycle_lister())
+        assert scheduler._prescreen is None
+        assert scheduler.run_cycle() == 1
+
+    def test_shimless_deployment_latches_screen_off(self, monkeypatch):
+        from nos_tpu.device import native
+        api, scheduler = self._cluster()
+        assert scheduler._prescreen is not None
+        monkeypatch.setattr(native, "fit_batch_available",
+                            lambda build=False: False)
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="p"))
+        assert scheduler.run_cycle() == 1
+        # decided once, at the first cycle: the screen is latched off
+        # so later cycles skip even the availability probe
+        assert scheduler._prescreen is None
+        api.create(KIND_POD, make_slice_pod("2x2", 1, name="q"))
+        assert scheduler.run_cycle() == 1
+
+
 class TestDirectEntryPointSnapshotHygiene:
     """schedule_one/schedule_gang are public entry points: a direct call
-    (outside run_cycle) must not leave the per-cycle snapshot behind, or
-    external mutations between calls go unseen forever (ADVICE round 5;
-    scheduler.py `_in_cycle`)."""
+    (outside run_cycle) must never let an external mutation between
+    calls go unseen (ADVICE round 5; scheduler.py `_in_cycle`).
+    Full-rescan mode guarantees that by dropping the per-cycle snapshot
+    at exit; incremental mode deliberately RETAINS it and re-levels it
+    from the watch cache's dirty set on the next entry (ISSUE 18) —
+    identical visible behavior, both contracts pinned here."""
 
     def test_direct_schedule_one_drops_cycle_snapshot(self):
         api = APIServer()
         api.create(KIND_NODE, make_tpu_node(
             "n0", status_geometry={"free": {"2x2": 1}}))
-        scheduler = Scheduler(api, Framework())
+        scheduler = Scheduler(api, Framework(), incremental=False)
         blocker = make_slice_pod("2x2", 1, name="blocker")
         api.create(KIND_POD, blocker)
         assert scheduler.schedule_one(
@@ -332,11 +400,31 @@ class TestDirectEntryPointSnapshotHygiene:
         assert scheduler.schedule_one(
             api.get(KIND_POD, "late", "default")) == "n0"
 
+    def test_incremental_direct_calls_see_external_mutations(self):
+        """Incremental mode keeps the snapshot across direct calls but
+        the dirty-set re-level on entry makes every external mutation
+        visible — same observable contract as the drop."""
+        api = APIServer()
+        api.create(KIND_NODE, make_tpu_node(
+            "n0", status_geometry={"free": {"2x2": 1}}))
+        scheduler = Scheduler(api, Framework())
+        blocker = make_slice_pod("2x2", 1, name="blocker")
+        api.create(KIND_POD, blocker)
+        assert scheduler.schedule_one(
+            api.get(KIND_POD, "blocker", "default")) == "n0"
+        # retained on purpose: the next entry re-levels it
+        assert scheduler._cycle_lister_cache is not None
+        api.delete(KIND_POD, "blocker", "default")
+        late = make_slice_pod("2x2", 1, name="late")
+        api.create(KIND_POD, late)
+        assert scheduler.schedule_one(
+            api.get(KIND_POD, "late", "default")) == "n0"
+
     def test_direct_schedule_one_failure_also_drops_snapshot(self):
         api = APIServer()
         api.create(KIND_NODE, make_tpu_node(
             "n0", status_geometry={"free": {"2x4": 1}}))
-        scheduler = Scheduler(api, Framework())
+        scheduler = Scheduler(api, Framework(), incremental=False)
         stuck = make_slice_pod("2x2", 1, name="stuck")
         api.create(KIND_POD, stuck)
         assert scheduler.schedule_one(
