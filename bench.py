@@ -34,6 +34,7 @@ from nos_tpu.kube.client import (
 )
 from nos_tpu.kube.objects import ObjectMeta, RUNNING
 from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.sim.report import emit, stdout_to_stderr
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
 
@@ -240,11 +241,9 @@ def run_fleet_bench() -> dict:
 def main() -> None:
     # stdout contract: the harness parses stdout as ONE JSON document,
     # so every byte any bench (or a library it drives) prints must go
-    # to stderr — swap stdout for the duration and keep the real handle
-    # for the single final line.
-    real_stdout = sys.stdout
-    sys.stdout = sys.stderr
-    try:
+    # to stderr — nos_tpu.sim.report.stdout_to_stderr holds the swap
+    # and yields the real handle for the single final line.
+    with stdout_to_stderr() as real_stdout:
         latency = run_scenario()
         utilization = run_utilization_bench()
         serving = run_serving_bench()
@@ -256,29 +255,28 @@ def main() -> None:
         # conditions (compute runs in a subprocess, unaffected)
         fleet = run_fleet_bench()
         compute = run_compute_bench()
-    finally:
-        sys.stdout = real_stdout
-    # Headline = the BASELINE north star: chip utilization on the
-    # v5e-256 mixed trace (target >= 0.85); repartition latency, the
-    # fleet-scale numbers and the real-TPU compute ride along.
-    util = utilization.get("utilization_pct")
-    print(json.dumps({
-        "metric": "chip_utilization_v5e256_mixed_trace",
-        "value": util if util is not None else 0.0,
-        "unit": "fraction",
-        "vs_baseline": (round(util / 0.85, 4) if util is not None else 0.0),
-        "utilization": utilization,
-        "repartition": {
-            "latency_s": round(latency, 3),
-            "target_s": BASELINE_S,
-            "vs_baseline": round(latency / BASELINE_S, 4),
-        },
-        "serving": serving,
-        "plan": plan,
-        "fleet": fleet,
-        "packer": packer,
-        "compute": compute,
-    }), file=real_stdout, flush=True)
+        # Headline = the BASELINE north star: chip utilization on the
+        # v5e-256 mixed trace (target >= 0.85); repartition latency, the
+        # fleet-scale numbers and the real-TPU compute ride along.
+        util = utilization.get("utilization_pct")
+        emit({
+            "metric": "chip_utilization_v5e256_mixed_trace",
+            "value": util if util is not None else 0.0,
+            "unit": "fraction",
+            "vs_baseline": (round(util / 0.85, 4)
+                            if util is not None else 0.0),
+            "utilization": utilization,
+            "repartition": {
+                "latency_s": round(latency, 3),
+                "target_s": BASELINE_S,
+                "vs_baseline": round(latency / BASELINE_S, 4),
+            },
+            "serving": serving,
+            "plan": plan,
+            "fleet": fleet,
+            "packer": packer,
+            "compute": compute,
+        }, real_stdout)
 
 
 if __name__ == "__main__":

@@ -51,6 +51,7 @@ from nos_tpu.obs import journal as J, scoped as obs_scoped
 from nos_tpu.obs import ledger as L
 from nos_tpu.obs.journal import DecisionJournal
 from nos_tpu.obs.ledger import ChipSecondLedger, conservation_ok
+from nos_tpu.sim import SimEngine, emit, write_report
 from nos_tpu.testing.chaos import ChaosCloudTPUAPI
 from nos_tpu.testing.factory import admit_all, make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
@@ -124,8 +125,8 @@ class Sim:
         self.plane = plane
         self.scenario = scenario
         self.rng = random.Random(seed)
-        self.now = [0.0]
-        clock = lambda: self.now[0]  # noqa: E731
+        self.eng = SimEngine()
+        clock = self.eng.now
         self.api = APIServer()
         self.scheduler = build_scheduler(self.api, 16, clock=clock)
         self.ledger = ChipSecondLedger(clock=clock)
@@ -157,7 +158,6 @@ class Sim:
         else:
             for h in range(BASE_HOSTS):
                 self._add_host("pod-0", h, zone="us-a")
-        self._storm_injected = False
         self.jobs: dict[str, Job] = {}
         self._job_seq = 0
         self._pod_job: dict[str, Job] = {}
@@ -193,12 +193,12 @@ class Sim:
         self.api.create(KIND_NODE, make_tpu_node(
             cloud_node.name, pod_id=pool, host_index=idx,
             extra_labels=labels))
-        self._join_queue.append((self.now[0] + JOIN_LAG_S,
+        self._join_queue.append((self.eng.now() + JOIN_LAG_S,
                                  cloud_node.name))
 
     def _land_joins(self):
         for due, name in [e for e in self._join_queue
-                          if e[0] <= self.now[0]]:
+                          if e[0] <= self.eng.now()]:
             self._join_queue.remove((due, name))
 
             def mutate(node):
@@ -215,7 +215,7 @@ class Sim:
 
     # -- demand schedule -----------------------------------------------------
     def _target_chips(self) -> float:
-        t = self.now[0]
+        t = self.eng.now()
         if self.scenario == "swing":
             lo, hi = SWING_SHIFTS
             base = BASE_HOSTS * CHIPS_PER_HOST
@@ -226,15 +226,20 @@ class Sim:
                          if t >= STORM_START else base)
         return float(BASE_HOSTS * CHIPS_PER_HOST)       # quiet
 
-    def _scenario_events(self):
-        if (self.scenario == "storm" and not self._storm_injected
-                and self.now[0] >= STORM_START):
-            self._storm_injected = True
-            self.cloud.inject_stockout(MC, "us-a",
-                                       duration_s=STORM_DURATION_S)
+    def _install_faults(self):
+        """The storm as a first-class one-shot: a PRIO_FAULT event at
+        STORM_START fires before the same-timestamp control tick, which
+        is exactly when the old in-tick ``now >= STORM_START`` check
+        triggered."""
+        if self.scenario == "storm" and self.cloud is not None:
+            self.eng.at(
+                STORM_START,
+                lambda: self.cloud.inject_stockout(
+                    MC, "us-a", duration_s=STORM_DURATION_S),
+                label="stockout-storm")
 
     def _in_adaptation(self) -> bool:
-        t = self.now[0]
+        t = self.eng.now()
         if t < WARMUP_S:
             return True
         if self.scenario == "swing":
@@ -251,10 +256,10 @@ class Sim:
             self._job_seq += 1
             name = f"job-{self._job_seq}"
             job = Job(name, self.rng.uniform(DURATION_LO, DURATION_HI),
-                      self.now[0])
+                      self.eng.now())
             self.api.create(KIND_POD, make_slice_pod(
                 SHAPE, 1, name=name, namespace="work",
-                creation_timestamp=self.now[0]))
+                creation_timestamp=self.eng.now()))
             self.jobs[name] = job
             self._pod_job[name] = job
             inflight += CHIPS_PER_HOST
@@ -262,7 +267,7 @@ class Sim:
     def _complete_finished(self):
         for job in list(self.jobs.values()):
             if job.bound_at is None \
-                    or self.now[0] < job.bound_at + job.duration:
+                    or self.eng.now() < job.bound_at + job.duration:
                 continue
             try:
                 self.api.delete(KIND_POD, job.name, "work")
@@ -278,8 +283,8 @@ class Sim:
                 continue
             job = self._pod_job.get(p.metadata.name)
             if job is not None and job.bound_at is None:
-                job.bound_at = self.now[0]
-                self.waits.append(self.now[0] - job.created)
+                job.bound_at = self.eng.now()
+                self.waits.append(self.eng.now() - job.created)
 
     # -- measurement ---------------------------------------------------------
     def _serving_chips(self) -> float:
@@ -314,7 +319,6 @@ class Sim:
 
     # -- main loop -----------------------------------------------------------
     def _tick(self, spawn_target=None):
-        self._scenario_events()
         self._complete_finished()
         self._land_joins()
         self._spawn(target=spawn_target)
@@ -331,18 +335,20 @@ class Sim:
         real_sleep, retry_mod.sleep = retry_mod.sleep, lambda s: None
         try:
             with obs_scoped(journal=self.journal, ledger=self.ledger):
-                while self.now[0] < self.trace_s:
-                    self.now[0] += TICK_S
-                    self._tick()
+                self._install_faults()
+                self.eng.tick_loop(TICK_S, self._tick,
+                                   until=self.trace_s, label="ctl-tick")
+                self.eng.run(until=self.trace_s)
                 # settle: demand stops, the backlog must drain — a job
                 # spawned seconds before trace end deserves its bind
                 # before the never_bound verdict is passed
-                settle_until = self.now[0] + SETTLE_S
-                while self.now[0] < settle_until \
-                        and any(j.bound_at is None
-                                for j in self.jobs.values()):
-                    self.now[0] += TICK_S
-                    self._tick(spawn_target=0.0)
+                self.eng.tick_loop(
+                    TICK_S, lambda: self._tick(spawn_target=0.0),
+                    until=self.eng.now() + SETTLE_S,
+                    while_fn=lambda: any(j.bound_at is None
+                                         for j in self.jobs.values()),
+                    label="settle-tick")
+                self.eng.run()
         finally:
             retry_mod.sleep = real_sleep
         waste = self.ledger.report()
@@ -524,12 +530,8 @@ def main(argv=None):
         out = run_smoke()
     else:
         out = run_bench(list(range(args.seeds)))
-    if args.capacity_report:
-        with open(args.capacity_report, "w", encoding="utf-8") as fh:
-            json.dump(out, fh, indent=2)
-        print(f"capacity report written to {args.capacity_report}",
-              file=sys.stderr)
-    print(json.dumps(out))
+    write_report(args.capacity_report, out, note="capacity report")
+    emit(out)
     if not out.get("ok", True):
         sys.exit(1)
 

@@ -45,7 +45,6 @@ Gates (the ISSUE 14 acceptance criteria, asserted per seed):
 from __future__ import annotations
 
 import argparse
-import json
 import random
 import sys
 import time
@@ -70,6 +69,7 @@ from nos_tpu.partitioning.slicepart.factory import (
     new_slice_partitioner_controller,
 )
 from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.sim import SimEngine, emit, write_report
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
 
@@ -164,8 +164,8 @@ class Sim:
                  elastic_grow=None):
         self.seed = seed
         self.rng = random.Random(seed)
-        self.now = [0.0]
-        clock = lambda: self.now[0]  # noqa: E731
+        self.eng = SimEngine()
+        clock = self.eng.now
         api = self.api = APIServer()
         state = ClusterState()
         NodeController(api, state, SliceNodeInitializer(api)).bind()
@@ -238,7 +238,7 @@ class Sim:
     def _phase_targets(self):
         current = PHASES[0][1]
         for start, targets in PHASES:
-            if self.now[0] >= start:
+            if self.eng.now() >= start:
                 current = targets
         return current
 
@@ -265,7 +265,7 @@ class Sim:
         self._job_seq += 1
         name = f"{cls}-{self._job_seq}"
         duration = self.rng.uniform(lo, hi)
-        job = Job(name, cls, [], duration, self.now[0],
+        job = Job(name, cls, [], duration, self.eng.now(),
                   shape=shape, priority=priority)
         if members > 1:
             self.api.create(KIND_POD_GROUP, PodGroup(
@@ -293,13 +293,13 @@ class Sim:
         job = self._pod_job.get(pod.metadata.name)
         if job is None or job.bound_at is None or job.duration <= 0:
             return 0.0
-        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+        return min(1.0, max(0.0, (self.eng.now() - job.bound_at)
                             / job.duration))
 
     def _complete_finished(self):
         for job in list(self.jobs.values()):
             if job.bound_at is None \
-                    or self.now[0] < job.bound_at + job.duration:
+                    or self.eng.now() < job.bound_at + job.duration:
                 continue
             # delete by gang label too: elastic growth added members the
             # job table never saw
@@ -361,35 +361,38 @@ class Sim:
             if job.kind == "elastic":
                 if job.bound_at is None \
                         and all(n in bound for n in job.pods):
-                    job.bound_at = self.now[0]
+                    job.bound_at = self.eng.now()
                 continue
             if job.bound_at is None and all(n in bound for n in job.pods):
-                job.bound_at = self.now[0]
-                self.latencies.append(self.now[0] - job.created)
+                job.bound_at = self.eng.now()
+                self.latencies.append(self.eng.now() - job.created)
 
     def _sample_utilization(self):
         used = sum(chip_equiv(p) for p in self.api.list(KIND_POD)
                    if p.spec.node_name and p.status.phase == RUNNING)
         u = min(1.0, used / TOTAL_CHIPS)
         self._util_raw.append(u)
-        if self.now[0] >= WARMUP_S:
+        if self.eng.now() >= WARMUP_S:
             window = self._util_raw[-UTIL_WINDOW_TICKS:]
             self._util_samples.append(sum(window) / len(window))
+
+    def _tick(self):
+        self._complete_finished()
+        self._spawn()
+        self.scheduler.run_cycle()
+        self._requeue_evicted()
+        self.ctl.process_if_ready()
+        for a in self.agents.values():
+            a.tick()
+        self._record_binds()
+        self._sample_utilization()
 
     # -- main loop -----------------------------------------------------------
     def run(self):
         with obs_scoped(journal=self.journal, ledger=self.ledger):
-            while self.now[0] < TRACE_S:
-                self.now[0] += TICK_S
-                self._complete_finished()
-                self._spawn()
-                self.scheduler.run_cycle()
-                self._requeue_evicted()
-                self.ctl.process_if_ready()
-                for a in self.agents.values():
-                    a.tick()
-                self._record_binds()
-                self._sample_utilization()
+            self.eng.tick_loop(TICK_S, self._tick, until=TRACE_S,
+                               label="ctl-tick")
+            self.eng.run()
         waste = self.ledger.report()
         assert conservation_ok(waste), (
             "chip-second conservation violated: "
@@ -591,12 +594,8 @@ def main(argv=None):
         out = run_smoke()
     else:
         out = run_bench(list(range(args.seeds)))
-    if args.defrag_report:
-        with open(args.defrag_report, "w", encoding="utf-8") as fh:
-            json.dump(out, fh, indent=2)
-        print(f"defrag report written to {args.defrag_report}",
-              file=sys.stderr)
-    print(json.dumps(out))
+    write_report(args.defrag_report, out, note="defrag report")
+    emit(out)
     if not out.get("ok", True):
         sys.exit(1)
 

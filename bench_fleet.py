@@ -20,8 +20,9 @@ Three measurements, all through the REAL control-plane code paths:
   worst-case full-cluster Filter scan every cycle, served by the
   native prescreen);
 - **convergence**: the whole loop — planner, actuator, per-node slice
-  agents, gang scheduler — hand-cranked until a capacity-tiling
-  demand set is bound; utilization = bound chips / fleet chips.
+  agents, gang scheduler — cranked as `nos_tpu.sim` engine rounds
+  until a capacity-tiling demand set is bound; utilization =
+  bound chips / fleet chips.
 
 The **scale tier** (ISSUE 18, ROADMAP item 3) extends this to 16384
 hosts / 100000 bound pods: `--hosts 16384 --pods 100000` constructs a
@@ -53,6 +54,7 @@ from nos_tpu.partitioning.slicepart import (
 from nos_tpu.partitioning.slicepart.group import MultiHostGeometryPlanner
 from nos_tpu.partitioning.state import ClusterState
 from nos_tpu.scheduler.framework import Framework
+from nos_tpu.sim import SimEngine
 from nos_tpu.testing.factory import make_pod, make_slice_pod, make_tpu_node
 from nos_tpu.topology import Shape, V5E, V5P, V6E
 from nos_tpu.topology.profile import free_chip_equivalents
@@ -347,7 +349,14 @@ def run_convergence_bench(hosts: int = 1024, max_rounds: int = 30,
     cycle_walls: list[float] = []
     bound = 0
     t0 = time.perf_counter()
-    for round_no in range(max_rounds):
+    # Convergence rounds ride the sim engine: each round is one tick of
+    # the virtual clock (round number == virtual second) and the loop
+    # self-terminates through while_fn the moment the fleet is bound —
+    # the same crank, expressed as the one shared run-loop idiom.
+    eng = SimEngine()
+
+    def convergence_round() -> None:
+        nonlocal bound
         t = time.perf_counter()
         scheduler.run_cycle()
         cycle_walls.append((time.perf_counter() - t) * 1e3)
@@ -361,10 +370,13 @@ def run_convergence_bench(hosts: int = 1024, max_rounds: int = 30,
         cycle_walls.append((time.perf_counter() - t) * 1e3)
         bound = sum(1 for p in api.list(KIND_POD)
                     if p.spec.node_name and p.status.phase == RUNNING)
-        log(f"round {round_no}: bound {bound}/{total} "
+        log(f"round {int(eng.now()) - 1}: bound {bound}/{total} "
             f"(cycle {cycle_walls[-1]:.0f} ms, plan {plan_walls[-1]:.0f} ms)")
-        if bound == total:
-            break
+
+    eng.tick_loop(1.0, convergence_round, until=float(max_rounds),
+                  while_fn=lambda: bound < total,
+                  label="convergence-round")
+    eng.run()
     converge_s = time.perf_counter() - t0
 
     # host-shard accounting: a multi-host gang member requests the full

@@ -37,7 +37,6 @@ Gates (the ISSUE 15 acceptance criteria, asserted per seed):
 from __future__ import annotations
 
 import argparse
-import json
 import random
 import sys
 import time
@@ -62,6 +61,7 @@ from nos_tpu.partitioning.slicepart.factory import (
     new_slice_partitioner_controller,
 )
 from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.sim import PRIO_FAULT, SimEngine, emit, write_report
 from nos_tpu.testing.factory import make_slice_pod, make_tpu_node
 from nos_tpu.topology import V5E
 from nos_tpu.utils.pod_util import displaced_value
@@ -154,8 +154,8 @@ class Sim:
         self.recovery = recovery
         self.kills = kills
         self.rng = random.Random(seed)
-        self.now = [0.0]
-        clock = lambda: self.now[0]  # noqa: E731
+        self.eng = SimEngine()
+        clock = self.eng.now
         api = self.api = APIServer()
         state = ClusterState()
         NodeController(api, state, SliceNodeInitializer(api)).bind()
@@ -201,7 +201,6 @@ class Sim:
         self._episodes = 0
         self._displaced_at: dict[str, float] = {}
         self._rebind_latencies: list[float] = []
-        self._spare_refills: list[tuple[float, str]] = []
         self.lost_chip_seconds = 0.0
         self._util_area = 0.0
         self._util_time = 0.0
@@ -235,37 +234,50 @@ class Sim:
         return chips
 
     # -- kill schedule -------------------------------------------------------
-    def _maybe_fail(self):
+    def _install_faults(self):
+        """The kill/wedge schedule as first-class one-shot events
+        (PRIO_FAULT fires before the same-timestamp control tick,
+        exactly like the old top-of-tick `now >= T` checks).  Times
+        past TRACE_S never fire — the old loop ended first."""
         if not self.kills:
             return
-        if self._kills_done < len(KILL_TIMES) \
-                and self.now[0] >= KILL_TIMES[self._kills_done]:
-            pool = POOLS[self._kills_done % len(POOLS)]
-            victim = self._busiest_host(pool)
-            if victim is not None:
-                self._kill_host(victim)
-                self._spare_refills.append(
-                    (self.now[0] + SPARE_REFILL_DELAY_S, pool))
-            self._kills_done += 1
-        if not self._wedge_done and self.now[0] >= WEDGE_T:
-            self._wedge_done = True
-            victim = self._busiest_host(POOLS[0], exclude=self._wedged)
-            if victim is not None:
-                # the agent freezes: ticks stop, heartbeat stops, the
-                # node object and its pods REMAIN — the suspicion path
-                # (affected accounting happens when the migrator's
-                # evictions requeue, like every other displacement)
-                self._wedged.add(victim)
-        if self._wedge_done and not self._wedge_dead \
-                and self.now[0] >= WEDGE_DEATH_T:
-            self._wedge_dead = True
-            for name in list(self._wedged):
-                if self.api.try_get(KIND_NODE, name) is not None:
-                    self._kill_host(name, wedged=True)
-        for due, pool in [r for r in self._spare_refills
-                          if r[0] <= self.now[0]]:
-            self._spare_refills.remove((due, pool))
-            self._add_spare(pool)
+        for i, kt in enumerate(KILL_TIMES):
+            if kt <= TRACE_S:
+                self.eng.at(kt, (lambda i=i: self._fail_at(i)),
+                            priority=PRIO_FAULT, label="node-kill")
+        if WEDGE_T <= TRACE_S:
+            self.eng.at(WEDGE_T, self._wedge_one,
+                        priority=PRIO_FAULT, label="node-wedge")
+        if WEDGE_DEATH_T <= TRACE_S:
+            self.eng.at(WEDGE_DEATH_T, self._wedge_death,
+                        priority=PRIO_FAULT, label="node-wedge-death")
+
+    def _fail_at(self, i):
+        pool = POOLS[i % len(POOLS)]
+        victim = self._busiest_host(pool)
+        if victim is not None:
+            self._kill_host(victim)
+            due = self.eng.now() + SPARE_REFILL_DELAY_S
+            if due <= TRACE_S:
+                self.eng.at(due, (lambda p=pool: self._add_spare(p)),
+                            priority=PRIO_FAULT, label="spare-refill")
+        self._kills_done += 1
+
+    def _wedge_one(self):
+        self._wedge_done = True
+        victim = self._busiest_host(POOLS[0], exclude=self._wedged)
+        if victim is not None:
+            # the agent freezes: ticks stop, heartbeat stops, the
+            # node object and its pods REMAIN — the suspicion path
+            # (affected accounting happens when the migrator's
+            # evictions requeue, like every other displacement)
+            self._wedged.add(victim)
+
+    def _wedge_death(self):
+        self._wedge_dead = True
+        for name in list(self._wedged):
+            if self.api.try_get(KIND_NODE, name) is not None:
+                self._kill_host(name, wedged=True)
 
     def _busiest_host(self, pool, exclude=()):
         """The active host of `pool` hosting the most distinct JOBS
@@ -323,7 +335,7 @@ class Sim:
         lo, hi = DURATION_S[cls]
         self._job_seq += 1
         name = f"{cls}-{self._job_seq}"
-        job = Job(name, cls, [], self.rng.uniform(lo, hi), self.now[0],
+        job = Job(name, cls, [], self.rng.uniform(lo, hi), self.eng.now(),
                   shape=shape, priority=priority)
         if members > 1:
             self.api.create(KIND_POD_GROUP, PodGroup(
@@ -352,14 +364,14 @@ class Sim:
         job = self._pod_job.get(pod.metadata.name)
         if job is None or job.bound_at is None or job.duration <= 0:
             return 0.0
-        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+        return min(1.0, max(0.0, (self.eng.now() - job.bound_at)
                             / job.duration))
 
     def _stamp_progress(self):
         """Running pods report job progress (the production
         cmd/train.py hook) every few seconds, so the restart-cost-aware
         victim walk and drain preemption see real fractions."""
-        if int(round(self.now[0] / TICK_S)) % 20:
+        if int(round(self.eng.now() / TICK_S)) % 20:
             return
         for p in self.api.list(KIND_POD):
             if not p.spec.node_name or p.status.phase != RUNNING:
@@ -382,7 +394,7 @@ class Sim:
     def _complete_finished(self):
         for job in list(self.jobs.values()):
             if job.bound_at is None \
-                    or self.now[0] < job.bound_at + job.duration:
+                    or self.eng.now() < job.bound_at + job.duration:
                 continue
             for pname in job.pods:
                 try:
@@ -430,7 +442,7 @@ class Sim:
                         # previous kill's stale stamp
                         self._affected.add(job.name)
                         self._episodes += 1
-                        self._displaced_at[job.name] = self.now[0]
+                        self._displaced_at[job.name] = self.eng.now()
                     if self.recovery:
                         annotations = {
                             C.ANNOT_DISPLACED: displaced_value(
@@ -457,57 +469,65 @@ class Sim:
         for job in self.jobs.values():
             if job.bound_at is None and all(n in bound
                                             for n in job.pods):
-                job.bound_at = self.now[0]
-                self.latencies.append(self.now[0] - job.created)
+                job.bound_at = self.eng.now()
+                self.latencies.append(self.eng.now() - job.created)
                 if job.name in self._affected:
                     self._affected.discard(job.name)
                     self._rebind_latencies.append(
-                        self.now[0] - self._displaced_at.pop(
-                            job.name, self.now[0]))
+                        self.eng.now() - self._displaced_at.pop(
+                            job.name, self.eng.now()))
 
     def _sample_utilization(self):
         live = self._live_active_chips()
         lost = max(0.0, ACTIVE_CHIPS - live)
-        if lost > 0 and self.now[0] >= WARMUP_S:
+        if lost > 0 and self.eng.now() >= WARMUP_S:
             self.lost_chip_seconds += lost * TICK_S
         used = sum(chip_equiv(p) for p in self.api.list(KIND_POD)
                    if p.spec.node_name and p.status.phase == RUNNING)
-        if self.now[0] >= WARMUP_S and live > 0:
+        if self.eng.now() >= WARMUP_S and live > 0:
             self._util_area += min(1.0, used / live) * TICK_S
             self._util_time += TICK_S
 
     # -- main loop -----------------------------------------------------------
+    def _tick(self):
+        self._complete_finished()
+        self._spawn()
+        self.scheduler.run_cycle()
+        self._requeue_evicted()
+        self.ctl.process_if_ready()
+        for name, a in list(self.agents.items()):
+            if name not in self._wedged:
+                a.tick()
+        self._stamp_progress()
+        self._record_binds()
+        self._sample_utilization()
+
+    def _settle_tick(self):
+        self._complete_finished()
+        self.scheduler.run_cycle()
+        self._requeue_evicted()
+        self.ctl.process_if_ready()
+        for name, a in list(self.agents.items()):
+            if name not in self._wedged:
+                a.tick()
+        self._record_binds()
+        self._sample_utilization()
+
     def run(self):
         with obs_scoped(journal=self.journal, ledger=self.ledger):
-            while self.now[0] < TRACE_S:
-                self.now[0] += TICK_S
-                self._maybe_fail()
-                self._complete_finished()
-                self._spawn()
-                self.scheduler.run_cycle()
-                self._requeue_evicted()
-                self.ctl.process_if_ready()
-                for name, a in list(self.agents.items()):
-                    if name not in self._wedged:
-                        a.tick()
-                self._stamp_progress()
-                self._record_binds()
-                self._sample_utilization()
+            self._install_faults()
+            self.eng.tick_loop(TICK_S, self._tick, until=TRACE_S,
+                               label="ctl-tick")
+            self.eng.run(until=TRACE_S)
             # drain the tail: kills stop, the backlog settles — a job
             # displaced seconds before trace end deserves its rebind
             # before the never_rebound verdict is passed
-            settle_until = self.now[0] + 30.0
-            while self.now[0] < settle_until and self._affected:
-                self.now[0] += TICK_S
-                self._complete_finished()
-                self.scheduler.run_cycle()
-                self._requeue_evicted()
-                self.ctl.process_if_ready()
-                for name, a in list(self.agents.items()):
-                    if name not in self._wedged:
-                        a.tick()
-                self._record_binds()
-                self._sample_utilization()
+            self.eng.tick_loop(
+                TICK_S, self._settle_tick,
+                until=self.eng.now() + 30.0,
+                while_fn=lambda: bool(self._affected),
+                label="settle-tick")
+            self.eng.run()
         waste = self.ledger.report()
         assert conservation_ok(waste), (
             "chip-second conservation violated: "
@@ -670,12 +690,8 @@ def main(argv=None):
         out = run_smoke()
     else:
         out = run_bench(list(range(args.seeds)))
-    if args.nodeloss_report:
-        with open(args.nodeloss_report, "w", encoding="utf-8") as fh:
-            json.dump(out, fh, indent=2)
-        print(f"node-loss report written to {args.nodeloss_report}",
-              file=sys.stderr)
-    print(json.dumps(out))
+    write_report(args.nodeloss_report, out, note="node-loss report")
+    emit(out)
     if not out.get("ok", True):
         sys.exit(1)
 

@@ -53,10 +53,8 @@ wall clock.
 from __future__ import annotations
 
 import argparse
-import json
 import math
 import random
-import sys
 import time
 
 from nos_tpu.api import constants as C
@@ -84,6 +82,7 @@ from nos_tpu.obs.timeseries import TimeSeriesSampler
 from nos_tpu.partitioning.slicepart import SliceNodeInitializer
 from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.sim import SimEngine, emit, write_report
 from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
 from nos_tpu.quota import TPUResourceCalculator
 from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
@@ -233,8 +232,8 @@ class Sim:
     def __init__(self, seed: int = 0) -> None:
         self.rng = random.Random(seed)
         self.seed = seed
-        self.now = [0.0]
-        clock = lambda: self.now[0]  # noqa: E731
+        self.eng = SimEngine()
+        clock = self.eng.now
         api = self.api = APIServer()
         state = ClusterState()
         install_quota_webhooks(api)
@@ -331,13 +330,13 @@ class Sim:
         if kind == "ts":
             pod = make_timeshare_pod(arg, 1, name=name, namespace=ns,
                                      labels=labels,
-                                     creation_timestamp=self.now[0])
+                                     creation_timestamp=self.eng.now())
         else:
             pod = make_slice_pod(arg, 1, name=name, namespace=ns,
                                  labels=labels,
-                                 creation_timestamp=self.now[0])
+                                 creation_timestamp=self.eng.now())
         self.api.create(KIND_POD, pod)
-        job = Job(name, ns, name, self.rng.uniform(lo, hi), self.now[0])
+        job = Job(name, ns, name, self.rng.uniform(lo, hi), self.eng.now())
         self.jobs[name] = job
         self._pod_job[name] = job
         return chip_equiv(pod)
@@ -380,7 +379,7 @@ class Sim:
     def _complete_finished(self) -> None:
         for job in list(self.jobs.values()):
             if job.bound_at is None \
-                    or self.now[0] < job.bound_at + job.duration:
+                    or self.eng.now() < job.bound_at + job.duration:
                 continue
             try:
                 self.api.delete(KIND_POD, job.pod, job.namespace)
@@ -427,7 +426,7 @@ class Sim:
         replicas and self-report via the load annotation (retry-wrapped
         writes — the downward-API pattern)."""
         for svc in SERVICES:
-            demand = self.traces[svc.key].load_at(self.now[0])
+            demand = self.traces[svc.key].load_at(self.eng.now())
             replicas = self.api.list(
                 KIND_POD, namespace=svc.namespace,
                 label_selector={C.LABEL_SERVICE: svc.name},
@@ -457,25 +456,25 @@ class Sim:
                         or p.metadata.name in self._serving_seen:
                     continue
                 self._serving_seen.add(p.metadata.name)
-                if self.now[0] < WARMUP_S:
+                if self.eng.now() < WARMUP_S:
                     # cold-start provisioning (the first carve of an
                     # empty cluster) is not a serving-SLO event — the
                     # SLO engine's windows start at warmup too
                     continue
                 self.serving_latencies.append(
-                    self.now[0] - p.metadata.creation_timestamp)
+                    self.eng.now() - p.metadata.creation_timestamp)
 
     def _record_batch_binds(self) -> None:
         bound = {p.metadata.name for p in self.api.list(KIND_POD)
                  if p.spec.node_name and p.status.phase == RUNNING}
         for job in self.jobs.values():
             if job.bound_at is None and job.pod in bound:
-                job.bound_at = self.now[0]
-                self.batch_latencies.append(self.now[0] - job.created)
+                job.bound_at = self.eng.now()
+                self.batch_latencies.append(self.eng.now() - job.created)
 
     def _track_replicas(self) -> None:
         for svc in SERVICES:
-            load = self.traces[svc.key].load_at(self.now[0])
+            load = self.traces[svc.key].load_at(self.eng.now())
             desired = min(svc.max_replicas, max(
                 svc.min_replicas,
                 math.ceil(load / svc.target_load_per_replica)))
@@ -484,7 +483,7 @@ class Sim:
                 label_selector={C.LABEL_SERVICE: svc.name},
                 filter_fn=lambda p: p.status.phase in (PENDING, RUNNING)))
             self.replica_series[svc.key].append(
-                (round(self.now[0], 2), round(load, 2), live, desired))
+                (round(self.eng.now(), 2), round(load, 2), live, desired))
 
     def _sample_utilization(self) -> None:
         used = serving_used = 0.0
@@ -496,7 +495,7 @@ class Sim:
                     serving_used += eq
         utilization = min(1.0, used / TOTAL_CHIPS)
         REGISTRY.set("nos_tpu_cluster_utilization", utilization)
-        if self.now[0] < WARMUP_S:
+        if self.eng.now() < WARMUP_S:
             return
         self._util_area += utilization * TICK_S
         self._batch_util_area += min(
@@ -504,32 +503,36 @@ class Sim:
         self._util_time += TICK_S
 
     # -- main loop ----------------------------------------------------------
+    def _tick(self) -> None:
+        self._tick_no += 1
+        tick = self._tick_no
+        self._complete_finished()
+        self._spawn()
+        if tick % STAMP_EVERY_TICKS == 1:
+            self._stamp_loads()
+        self.autoscaler.reconcile()
+        t0 = time.perf_counter()
+        self.scheduler.run_cycle()
+        self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
+        self._requeue_evicted()
+        self.slice_ctl.process_if_ready()
+        self.ts_ctl.process_if_ready()
+        for a in list(self.agents.values()):
+            a.tick()
+        self.eq_reconciler.reconcile_all()
+        self._record_serving_binds()
+        self._record_batch_binds()
+        if tick % STAMP_EVERY_TICKS == 0:
+            self._track_replicas()
+        self._sample_utilization()
+        if self.eng.now() >= WARMUP_S:
+            self.slo_engine.tick()
+
     def run(self) -> dict:
-        tick = 0
-        while self.now[0] < TRACE_S:
-            tick += 1
-            self.now[0] += TICK_S
-            self._complete_finished()
-            self._spawn()
-            if tick % STAMP_EVERY_TICKS == 1:
-                self._stamp_loads()
-            self.autoscaler.reconcile()
-            t0 = time.perf_counter()
-            self.scheduler.run_cycle()
-            self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
-            self._requeue_evicted()
-            self.slice_ctl.process_if_ready()
-            self.ts_ctl.process_if_ready()
-            for a in list(self.agents.values()):
-                a.tick()
-            self.eq_reconciler.reconcile_all()
-            self._record_serving_binds()
-            self._record_batch_binds()
-            if tick % STAMP_EVERY_TICKS == 0:
-                self._track_replicas()
-            self._sample_utilization()
-            if self.now[0] >= WARMUP_S:
-                self.slo_engine.tick()
+        self._tick_no = 0
+        self.eng.tick_loop(TICK_S, self._tick, until=TRACE_S,
+                           label="ctl-tick")
+        self.eng.run()
         return self._report()
 
     def _cache_request(self, event: str, pod) -> None:
@@ -741,13 +744,10 @@ def main(argv=None) -> None:
         out = run_smoke()
     else:
         out = run_seeds(range(args.seeds))
-    if args.serving_report:
-        with open(args.serving_report, "w", encoding="utf-8") as fh:
-            json.dump({k: v for k, v in out.items()
-                       if k != "per_seed"}, fh, indent=2)
-        print(f"serving report written to {args.serving_report}",
-              file=sys.stderr)
-    print(json.dumps(out))
+    write_report(args.serving_report,
+                 {k: v for k, v in out.items() if k != "per_seed"},
+                 note="serving report")
+    emit(out)
 
 
 if __name__ == "__main__":

@@ -64,9 +64,7 @@ seconds of wall clock while preserving every control-loop interaction.
 from __future__ import annotations
 
 import argparse
-import json
 import random
-import sys
 import time
 
 from nos_tpu.api import constants as C
@@ -101,6 +99,7 @@ from nos_tpu.obs.timeseries import TimeSeriesSampler
 from nos_tpu.partitioning.slicepart import SliceNodeInitializer
 from nos_tpu.partitioning.slicepart.factory import new_slice_partitioner_controller
 from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.sim import PRIO_FAULT, SimEngine, emit, write_report
 from nos_tpu.partitioning.timeshare.factory import new_timeshare_partitioner_controller
 from nos_tpu.quota import TPUResourceCalculator
 from nos_tpu.scheduler.capacityscheduling import CapacityScheduling
@@ -306,8 +305,8 @@ class Job:
 class Sim:
     def __init__(self, seed: int = 0) -> None:
         self.rng = random.Random(seed)
-        self.now = [0.0]
-        clock = lambda: self.now[0]  # noqa: E731
+        self.eng = SimEngine()
+        clock = self.eng.now
         api = self.api = APIServer()
         state = ClusterState()
         install_quota_webhooks(api)
@@ -519,39 +518,48 @@ class Sim:
                     self.invariant_violations["hybrid_bare_admission"] += 1
 
     # -- node loss ----------------------------------------------------------
-    def _maybe_kill_restore(self) -> None:
-        if not self._killed and self.now[0] >= NODE_KILL_T:
-            self._killed = True
-            for name in KILL_NODES:
-                agent = self.agents.pop(name, None)
-                if agent is not None and hasattr(agent, "stop"):
-                    agent.stop()
-                self.slice_pod_resources.pop(name, None)
-                for p in self.api.list(KIND_POD):
-                    if p.spec.node_name == name:
-                        job = self._pod_job.get(p.metadata.name)
-                        if job is not None:
-                            self._kill_affected.add(job.name)
-                        self._killed_pod_names.add(p.metadata.name)
-                        try:
-                            self.api.delete(KIND_POD, p.metadata.name,
-                                            p.metadata.namespace)
-                        except NotFound:
-                            pass
-                try:
-                    self.api.delete(KIND_NODE, name)
-                except NotFound:
-                    pass
-            self._affected_total = len(self._kill_affected)
-            self.live_chips = float(
-                TOTAL_CHIPS - len(KILL_NODES) * CHIPS_PER_HOST)
-        if not self._restored and self.now[0] >= NODE_RESTORE_T:
-            self._restored = True
-            # replacements join at the SAME host-index: the plan handshake
-            # re-initializes them, gang windows become whole again
-            for name, (pod_id, idx) in REPLACEMENT_NODES.items():
-                self._add_slice_host(name, pod_id, idx)
-            self.live_chips = float(TOTAL_CHIPS)
+    def _install_faults(self) -> None:
+        """The TPU-VM preemption as first-class one-shots: kill and
+        restore fire at PRIO_FAULT, before the same-timestamp control
+        tick — exactly the old top-of-tick `now >= T` ordering."""
+        self.eng.at(NODE_KILL_T, self._kill_nodes,
+                    priority=PRIO_FAULT, label="node-kill")
+        self.eng.at(NODE_RESTORE_T, self._restore_nodes,
+                    priority=PRIO_FAULT, label="node-restore")
+
+    def _kill_nodes(self) -> None:
+        self._killed = True
+        for name in KILL_NODES:
+            agent = self.agents.pop(name, None)
+            if agent is not None and hasattr(agent, "stop"):
+                agent.stop()
+            self.slice_pod_resources.pop(name, None)
+            for p in self.api.list(KIND_POD):
+                if p.spec.node_name == name:
+                    job = self._pod_job.get(p.metadata.name)
+                    if job is not None:
+                        self._kill_affected.add(job.name)
+                    self._killed_pod_names.add(p.metadata.name)
+                    try:
+                        self.api.delete(KIND_POD, p.metadata.name,
+                                        p.metadata.namespace)
+                    except NotFound:
+                        pass
+            try:
+                self.api.delete(KIND_NODE, name)
+            except NotFound:
+                pass
+        self._affected_total = len(self._kill_affected)
+        self.live_chips = float(
+            TOTAL_CHIPS - len(KILL_NODES) * CHIPS_PER_HOST)
+
+    def _restore_nodes(self) -> None:
+        self._restored = True
+        # replacements join at the SAME host-index: the plan handshake
+        # re-initializes them, gang windows become whole again
+        for name, (pod_id, idx) in REPLACEMENT_NODES.items():
+            self._add_slice_host(name, pod_id, idx)
+        self.live_chips = float(TOTAL_CHIPS)
     def _check_recovered(self) -> None:
         """Runs at END of tick (after _requeue_evicted has voided the
         affected jobs' bound_at and _record_binds has re-set it).  Two
@@ -581,7 +589,7 @@ class Sim:
                 # (killed but requeued before the stamp landed) fall
                 # back to the kill time
                 self._rebind_latencies.append(
-                    self.now[0]
+                    self.eng.now()
                     - self._displaced_at.get(name, NODE_KILL_T))
         if self._restored and self.replacement_ready_s is None:
             ready = 0
@@ -593,13 +601,13 @@ class Sim:
                     ready += 1
             if ready == len(REPLACEMENT_NODES):
                 self.replacement_ready_s = round(
-                    self.now[0] - NODE_RESTORE_T, 2)
+                    self.eng.now() - NODE_RESTORE_T, 2)
 
     # -- trace -------------------------------------------------------------
     def _phase_targets(self) -> dict[str, float]:
         current = PHASES[0][1]
         for start, targets in PHASES:
-            if self.now[0] >= start:
+            if self.eng.now() >= start:
                 current = targets
         return current
 
@@ -613,7 +621,7 @@ class Sim:
             if not p.spec.node_name and p.metadata.namespace in backlog:
                 job = self._pod_job.get(p.metadata.name)
                 if BACKLOG_STALE_S is not None and job is not None \
-                        and self.now[0] - job.created > BACKLOG_STALE_S:
+                        and self.eng.now() - job.created > BACKLOG_STALE_S:
                     continue    # diag variant: team keeps submitting
                 table = ts_backlog if (job is not None
                                        and job.kind == "ts") else backlog
@@ -637,7 +645,7 @@ class Sim:
         name = f"job-{self._job_seq}"
         duration = self.rng.uniform(lo, hi)
         pods = []
-        job = Job(name, ns, pods, duration, self.now[0],
+        job = Job(name, ns, pods, duration, self.eng.now(),
                   cls=f"{kind}-{arg}", kind=kind, arg=arg)
         spawned = 0.0
         if kind == "gang":
@@ -673,13 +681,13 @@ class Sim:
         job = self._pod_job.get(pod.metadata.name)
         if job is None or job.bound_at is None or job.duration <= 0:
             return 0.0
-        return min(1.0, max(0.0, (self.now[0] - job.bound_at)
+        return min(1.0, max(0.0, (self.eng.now() - job.bound_at)
                             / job.duration))
 
     def _complete_finished(self) -> None:
         for job in list(self.jobs.values()):
             if job.bound_at is None \
-                    or self.now[0] < job.bound_at + job.duration:
+                    or self.eng.now() < job.bound_at + job.duration:
                 continue
             for pname in job.pods:
                 try:
@@ -719,8 +727,8 @@ class Sim:
                     # ranks them between serving and batch, so the
                     # bench exercises the real head-of-line path
                     annotations = {C.ANNOT_DISPLACED: displaced_value(
-                        C.DISPLACED_NODE_LOSS, self.now[0])}
-                    self._displaced_at.setdefault(job.name, self.now[0])
+                        C.DISPLACED_NODE_LOSS, self.eng.now())}
+                    self._displaced_at.setdefault(job.name, self.eng.now())
                 else:
                     self.drain_evictions += 1
                 pod = self._make_job_pod(job, pname, job.created,
@@ -735,8 +743,8 @@ class Sim:
                 bound[p.metadata.name] = p.metadata.creation_timestamp
         for job in self.jobs.values():
             if job.bound_at is None and all(n in bound for n in job.pods):
-                job.bound_at = self.now[0]
-                lat = self.now[0] - job.created
+                job.bound_at = self.eng.now()
+                lat = self.eng.now() - job.created
                 self.latencies.append(lat)
                 self.latency_by_class.setdefault(job.cls, []).append(lat)
 
@@ -750,39 +758,42 @@ class Sim:
         utilization = min(1.0, used / self.live_chips)
         # the SLO engine's utilization-floor objective reads this gauge
         REGISTRY.set("nos_tpu_cluster_utilization", utilization)
-        if self.now[0] < WARMUP_S:
+        if self.eng.now() < WARMUP_S:
             return
         self._util_area += utilization * TICK_S
         self._util_time += TICK_S
 
     # -- main loop ---------------------------------------------------------
+    def _tick(self) -> None:
+        self._complete_finished()
+        self._spawn()
+        t0 = time.perf_counter()
+        self.scheduler.run_cycle()
+        self.cycle_wall_ms.append(
+            (time.perf_counter() - t0) * 1e3)
+        self._requeue_evicted()
+        self.slice_ctl.process_if_ready()
+        self.ts_ctl.process_if_ready()
+        for a in list(self.agents.values()):
+            a.tick()
+        self.eq_reconciler.reconcile_all()
+        self.ceq_reconciler.reconcile_all()
+        self._record_binds()
+        self._check_recovered()
+        self._sample_utilization()
+        if self.eng.now() >= WARMUP_S:
+            # SLO judgement starts with utilization sampling:
+            # the fill ramp from an empty cluster is not an SLO
+            # event
+            self.slo_engine.tick()
+        self._check_invariants()
+
     def run(self) -> dict:
         with obs_scoped(ledger=self.ledger):
-            while self.now[0] < TRACE_S:
-                self.now[0] += TICK_S
-                self._maybe_kill_restore()
-                self._complete_finished()
-                self._spawn()
-                t0 = time.perf_counter()
-                self.scheduler.run_cycle()
-                self.cycle_wall_ms.append(
-                    (time.perf_counter() - t0) * 1e3)
-                self._requeue_evicted()
-                self.slice_ctl.process_if_ready()
-                self.ts_ctl.process_if_ready()
-                for a in list(self.agents.values()):
-                    a.tick()
-                self.eq_reconciler.reconcile_all()
-                self.ceq_reconciler.reconcile_all()
-                self._record_binds()
-                self._check_recovered()
-                self._sample_utilization()
-                if self.now[0] >= WARMUP_S:
-                    # SLO judgement starts with utilization sampling:
-                    # the fill ramp from an empty cluster is not an SLO
-                    # event
-                    self.slo_engine.tick()
-                self._check_invariants()
+            self._install_faults()
+            self.eng.tick_loop(TICK_S, self._tick, until=TRACE_S,
+                               label="ctl-tick")
+            self.eng.run(until=TRACE_S)
 
         # the waste waterfall: per-pool chip-second attribution with the
         # conservation verdict — gated PER SEED (a violation is a code
@@ -1070,16 +1081,11 @@ def main(argv=None) -> None:
         out = run_seeds()
         out["vs_target"] = round(
             out["utilization_pct"] / UTILIZATION_TARGET, 4)
-    if args.slo_report:
-        with open(args.slo_report, "w", encoding="utf-8") as fh:
-            json.dump(out.get("slo", {}), fh, indent=2)
-        print(f"slo report written to {args.slo_report}", file=sys.stderr)
-    if args.waste_report:
-        with open(args.waste_report, "w", encoding="utf-8") as fh:
-            json.dump({"waste": out.get("waste", {})}, fh, indent=2)
-        print(f"waste report written to {args.waste_report}",
-              file=sys.stderr)
-    print(json.dumps(out))
+    write_report(args.slo_report, out.get("slo", {}),
+                 note="slo report")
+    write_report(args.waste_report, {"waste": out.get("waste", {})},
+                 note="waste report")
+    emit(out)
 
 
 if __name__ == "__main__":

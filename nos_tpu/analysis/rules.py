@@ -131,9 +131,13 @@ class InjectableClock(Rule):
     # capacity/ too: the provisioner's deadlines, breaker windows and
     # surplus timers all run on the injected clock — bench_capacity's
     # virtual-clock scenarios and the chaos soak depend on it.
+    # sim/ is the virtual clock itself: the engine IS time for every
+    # composed scenario, so a raw clock call there desynchronizes the
+    # whole simulated fleet (wall-time measurement enters via an
+    # injected wall_clock reference only).
     scope = ("nos_tpu/capacity/", "nos_tpu/controllers/", "nos_tpu/obs/",
              "nos_tpu/partitioning/", "nos_tpu/scheduler/",
-             "nos_tpu/serving/")
+             "nos_tpu/serving/", "nos_tpu/sim/")
 
     BANNED_DOTTED = frozenset({
         "time.time", "time.time_ns", "time.sleep",

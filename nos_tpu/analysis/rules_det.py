@@ -66,7 +66,7 @@ class UnorderedIterationHazard(Rule):
     title = "unordered-collection iteration feeds an order-sensitive decision"
     scope = ("nos_tpu/scheduler/", "nos_tpu/partitioning/",
              "nos_tpu/capacity/", "nos_tpu/controllers/",
-             "nos_tpu/serving/", "nos_tpu/quota/")
+             "nos_tpu/serving/", "nos_tpu/quota/", "nos_tpu/sim/")
 
     SET_CONSTRUCTORS = frozenset({"set", "frozenset"})
     #: methods that return a set when their receiver is one

@@ -1,0 +1,36 @@
+"""nos_tpu.sim — the event-driven fleet simulator.
+
+One virtual clock, one deterministically ordered event queue
+(``engine``), reusable trace sources that compose into one scenario
+(``trace``), pluggable chaos injectors (``injectors``), a declarative
+control-plane assembly harness (``scenario``), the single bench report
+contract (``report``), and the composed worst-week scenario plus
+what-if capacity planner (``worstweek``, ``python -m nos_tpu.sim``).
+
+See docs/simulator.md for the engine model, the Scenario schema, the
+trace-composition cookbook, and the what-if planner guide.
+"""
+
+from .engine import (
+    PRIO_FAULT, PRIO_SAMPLE, PRIO_TICK, PRIO_TRACE, SimEngine)
+from .injectors import APIChaosInjector, CloudChaosInjector, install_all
+from .report import emit, stdout_to_stderr, write_report
+from .scenario import (
+    ControlPlane, PoolSpec, QuotaSpec, Scenario, assemble_control_plane)
+from .trace import (
+    ArrivalSource, AtSource, ComposedTrace, DiurnalLoadSource,
+    NodeKillSource, SamplerSource, TickSource, TraceSource, WindowSource,
+    compose)
+from .worstweek import WorstWeek, WorstWeekConfig, run_what_if
+
+__all__ = [
+    "PRIO_FAULT", "PRIO_SAMPLE", "PRIO_TICK", "PRIO_TRACE", "SimEngine",
+    "APIChaosInjector", "CloudChaosInjector", "install_all",
+    "emit", "stdout_to_stderr", "write_report",
+    "ControlPlane", "PoolSpec", "QuotaSpec", "Scenario",
+    "assemble_control_plane",
+    "ArrivalSource", "AtSource", "ComposedTrace", "DiurnalLoadSource",
+    "NodeKillSource", "SamplerSource", "TickSource", "TraceSource",
+    "WindowSource", "compose",
+    "WorstWeek", "WorstWeekConfig", "run_what_if",
+]

@@ -1,0 +1,105 @@
+"""Pluggable fault injectors: the chaos substrate as trace sources.
+
+``ChaosAPIServer`` and ``ChaosCloudTPUAPI`` (nos_tpu/testing/chaos.py)
+already model the production fault classes — write conflicts, transient
+errors, watch drops, stockouts, slow provisioning.  The injectors here
+adapt them to the engine so a scenario can *schedule* chaos instead of
+running under a constant rate: open a stockout during a demand step,
+raise the conflict rate for one hour of the worst week, replay dropped
+watch events at a pinned instant.
+
+Two or more injectors compose on one run (``tests/test_sim.py`` pins
+it): each is a ``TraceSource`` with its own label, so their
+same-timestamp events order deterministically by the engine contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from nos_tpu.testing.chaos import ChaosAPIServer, ChaosCloudTPUAPI
+
+from .engine import PRIO_FAULT, SimEngine
+from .trace import TraceSource, WindowSource
+
+
+class APIChaosInjector(TraceSource):
+    """Windows of elevated APIServer fault rates: during each
+    ``(start, duration)`` window the chaos server runs at the given
+    conflict/transient rates; outside the windows it is clean.  A
+    scheduled ``replay_dropped`` at window close converges any withheld
+    watch events (the informer-resync model)."""
+
+    label = "api-chaos"
+
+    def __init__(self, api: ChaosAPIServer,
+                 windows: Sequence[tuple[float, float]], *,
+                 conflict_rate: float = 0.0,
+                 transient_rate: float = 0.0,
+                 drop_watch_rate: float = 0.0) -> None:
+        self.api = api
+        self.conflict_rate = conflict_rate
+        self.transient_rate = transient_rate
+        self.drop_watch_rate = drop_watch_rate
+        self._windows = WindowSource(
+            windows, self._open, self._close, label=self.label,
+            priority=PRIO_FAULT)
+
+    def _open(self, _t: float) -> None:
+        self.api._conflict_rate = self.conflict_rate
+        self.api._transient_rate = self.transient_rate
+        self.api._drop_watch_rate = self.drop_watch_rate
+
+    def _close(self, _t: float) -> None:
+        self.api._conflict_rate = 0.0
+        self.api._transient_rate = 0.0
+        self.api._drop_watch_rate = 0.0
+        self.api.replay_dropped()
+
+    def install(self, engine: SimEngine) -> None:
+        self._windows.install(engine)
+
+
+class CloudChaosInjector(TraceSource):
+    """Scheduled zonal stockouts on the cloud node-pool API: each
+    window opens ``inject_stockout`` for its duration (the API clears
+    it by its own clock; an explicit clear at close keeps the window
+    authoritative even if the API's duration drifts)."""
+
+    label = "cloud-chaos"
+
+    def __init__(self, cloud: ChaosCloudTPUAPI,
+                 windows: Sequence[tuple[float, float]], *,
+                 machine_class: str, zone: str = "-") -> None:
+        self.cloud = cloud
+        self.machine_class = machine_class
+        self.zone = zone
+        self._windows = WindowSource(
+            windows, self._open, self._close,
+            label=f"{self.label}/{machine_class}/{zone}",
+            priority=PRIO_FAULT)
+        self.opened = 0
+        self.closed = 0
+
+    def _open(self, _t: float) -> None:
+        self.opened += 1
+        self.cloud.inject_stockout(
+            self.machine_class, self.zone, duration_s=float("inf"))
+
+    def _close(self, _t: float) -> None:
+        self.closed += 1
+        self.cloud.clear_stockout(self.machine_class, self.zone)
+
+    def install(self, engine: SimEngine) -> None:
+        self._windows.install(engine)
+
+
+def install_all(engine: SimEngine,
+                injectors: Sequence[TraceSource],
+                extra: Optional[Sequence[TraceSource]] = None) -> None:
+    """Install fault injectors (plus any extra sources) onto one run,
+    label-sorted like ``compose`` so composition order never changes
+    the stream."""
+    sources = list(injectors) + list(extra or [])
+    for src in sorted(sources, key=lambda s: s.label):
+        src.install(engine)

@@ -1,0 +1,52 @@
+"""The bench report contract: stdout is ONE JSON document.
+
+Every bench is parsed by the harness as a single JSON document on
+stdout, so every byte any library it drives prints must go to stderr.
+Three benches (and ``bench.py`` itself) each hand-rolled the same
+stdout-swap; this module is the one shared implementation, plus the
+report-artifact writer the CI gates use (``--*-report`` flags feeding
+uploaded artifacts).
+
+``tests/test_sim.py`` pins the contract: under ``stdout_to_stderr``,
+library prints land on stderr and exactly one JSON document reaches the
+real stdout via ``emit``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional, TextIO
+
+
+@contextmanager
+def stdout_to_stderr() -> Iterator[TextIO]:
+    """Route ``sys.stdout`` to stderr for the duration and yield the
+    REAL stdout handle — print stray library output safely, keep the
+    real handle for the single final JSON line."""
+    real_stdout = sys.stdout
+    sys.stdout = sys.stderr
+    try:
+        yield real_stdout
+    finally:
+        sys.stdout = real_stdout
+
+
+def emit(payload: Any, stream: Optional[TextIO] = None) -> None:
+    """The single final line: one JSON document, flushed.  Inside
+    ``stdout_to_stderr`` pass the yielded real handle; outside, the
+    current stdout is already the right place."""
+    out = stream if stream is not None else sys.stdout
+    print(json.dumps(payload), file=out, flush=True)
+
+
+def write_report(path: str, payload: Any, *,
+                 note: str = "report") -> None:
+    """CI artifact writer: dump ``payload`` to ``path`` (indent=2, the
+    render tools' expectation) and note it on stderr — never stdout."""
+    if not path:
+        return
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2)
+    print(f"{note} written to {path}", file=sys.stderr)

@@ -54,13 +54,13 @@ if ! env JAX_PLATFORMS=cpu python -m pytest tests/test_interleave.py \
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/, analysis/, testing/{lockcheck,interleave})"
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/, analysis/, sim/, testing/{lockcheck,interleave})"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
             nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
             nos_tpu/scheduler nos_tpu/obs nos_tpu/serving \
-            nos_tpu/capacity nos_tpu/analysis \
+            nos_tpu/capacity nos_tpu/analysis nos_tpu/sim \
             nos_tpu/testing/lockcheck.py nos_tpu/testing/interleave.py; then
         rc=1
     fi
@@ -123,6 +123,12 @@ echo "==> bench_capacity.py --smoke (capacity gate: swing round-trip >= 0.95 uti
 if ! env JAX_PLATFORMS=cpu python bench_capacity.py --smoke \
         --capacity-report "${CAPACITY_REPORT_PATH:-/tmp/nos_tpu_capacity_report.json}" \
         > /dev/null; then
+    rc=1
+fi
+
+echo "==> worst-week gate: python -m nos_tpu.sim --smoke (composed chaos day: ledger conservation + every SLO breach explained)"
+if ! env JAX_PLATFORMS=cpu SIM_REPORT_PATH="${SIM_REPORT_PATH:-/tmp/nos_tpu_sim_report.json}" \
+        python -m nos_tpu.sim --smoke > /dev/null; then
     rc=1
 fi
 

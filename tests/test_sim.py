@@ -1,0 +1,370 @@
+"""Simulator contract tests: the engine's deterministic tie-break, the
+event-vs-tick equivalence the bench ports stand on, injector
+composition, the worst-week smoke (ledger conservation + SLO verdicts),
+and the one-JSON-document stdout contract every bench main shares.
+
+The tie-break contract ``(time, priority, label, seq)`` is pinned HERE
+(nosdiff/N011 discipline): shuffling the order sources are installed in
+must never change a byte of the fired stream.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from nos_tpu.kube.client import KIND_POD
+from nos_tpu.kube.objects import RUNNING
+from nos_tpu.obs.ledger import conservation_ok
+from nos_tpu.sim import (
+    APIChaosInjector, ArrivalSource, AtSource, CloudChaosInjector,
+    PRIO_FAULT, PRIO_SAMPLE, PRIO_TICK, PoolSpec, QuotaSpec, SamplerSource,
+    Scenario, SimEngine, TickSource, WindowSource, WorstWeek,
+    WorstWeekConfig, assemble_control_plane, compose, emit, install_all,
+    stdout_to_stderr,
+)
+from nos_tpu.testing.chaos import ChaosAPIServer, ChaosCloudTPUAPI
+from nos_tpu.testing.factory import make_slice_pod
+
+
+# ---------------------------------------------------------------------------
+# Engine: clock, ordering, tick_loop semantics
+# ---------------------------------------------------------------------------
+
+
+def test_engine_orders_by_time_then_priority_then_label():
+    eng = SimEngine()
+    fired = []
+    eng.at(2.0, lambda: fired.append("tick@2"), priority=PRIO_TICK,
+           label="tick")
+    eng.at(1.0, lambda: fired.append("late-label@1"), priority=PRIO_FAULT,
+           label="zz")
+    eng.at(2.0, lambda: fired.append("fault@2"), priority=PRIO_FAULT,
+           label="fault")
+    eng.at(1.0, lambda: fired.append("early-label@1"), priority=PRIO_FAULT,
+           label="aa")
+    eng.at(2.0, lambda: fired.append("sample@2"), priority=PRIO_SAMPLE,
+           label="sample")
+    eng.run()
+    assert fired == ["early-label@1", "late-label@1",
+                     "fault@2", "tick@2", "sample@2"]
+    assert eng.now() == 2.0
+    assert eng.events_fired == 5
+
+
+def test_engine_rejects_scheduling_into_the_past():
+    eng = SimEngine()
+    eng.at(1.0, lambda: None, label="a")
+    eng.run()
+    with pytest.raises(ValueError):
+        eng.at(0.5, lambda: None, label="b")
+
+
+def test_engine_run_until_stops_clock_on_boundary():
+    eng = SimEngine()
+    fired = []
+    eng.at(1.0, lambda: fired.append(1.0), label="a")
+    eng.at(5.0, lambda: fired.append(5.0), label="a")
+    assert eng.run(until=3.0) == 1
+    assert fired == [1.0]
+    assert eng.now() == 3.0          # clock lands on the horizon
+    eng.run()
+    assert fired == [1.0, 5.0]
+
+
+def test_tick_loop_replicates_while_loop_float_accumulation():
+    """The ported bench loop must keep its float-accumulation sequence
+    bit-identical to ``while now < until: now += period``."""
+    period, until = 0.25, 10.0
+    expect = []
+    now = 0.0
+    while now < until:
+        now += period
+        expect.append(now)
+    eng = SimEngine()
+    got = []
+    eng.tick_loop(period, lambda: got.append(eng.now()), until=until)
+    eng.run()
+    assert got == expect             # exact float equality, by design
+
+
+def test_tick_loop_while_fn_stops_like_a_while_loop():
+    eng = SimEngine()
+    count = [0]
+
+    def body():
+        count[0] += 1
+
+    eng.tick_loop(1.0, body, until=100.0,
+                  while_fn=lambda: count[0] < 7)
+    eng.run()
+    assert count[0] == 7
+
+
+# ---------------------------------------------------------------------------
+# Tie-break determinism: shuffled installation, byte-identical stream
+# ---------------------------------------------------------------------------
+
+
+def _build_sources(log):
+    def mk(label, kind):
+        if kind == "at":
+            return AtSource([1.0, 2.0, 3.0],
+                            lambda t, lab=label: log.append((t, lab)),
+                            label=label)
+        if kind == "window":
+            return WindowSource(
+                [(1.0, 2.0)],
+                lambda t, lab=label: log.append((t, lab + "/open")),
+                lambda t, lab=label: log.append((t, lab + "/close")),
+                label=label)
+        if kind == "tick":
+            return TickSource(1.0,
+                              lambda lab=label: log.append(("tick", lab)),
+                              until=3.0, label=label)
+        return SamplerSource(1.0,
+                             lambda t, lab=label: log.append((t, lab)),
+                             until=3.0, label=label)
+
+    return [mk("kill", "at"), mk("storm", "window"), mk("ctl", "tick"),
+            mk("slo", "sample"), mk("drain", "window"),
+            mk("arrive", "at")]
+
+
+def test_shuffled_installation_is_byte_identical():
+    """The N011 discipline for scenarios: composition order must never
+    change the fired stream.  Install the same six sources in ten
+    shuffled orders and byte-compare the journals."""
+    journals = []
+    for trial in range(10):
+        log: list = []
+        sources = _build_sources(log)
+        random.Random(trial).shuffle(sources)
+        eng = SimEngine()
+        compose(*sources).install(eng)
+        eng.run()
+        journals.append(json.dumps(log).encode())
+    assert len(set(journals)) == 1
+
+
+def test_arrival_source_is_a_pure_function_of_seed():
+    def run_once():
+        times: list = []
+        eng = SimEngine()
+        ArrivalSource(7, lambda t: 0.5 + 0.4 * (t % 2.0),
+                      times.append, peak_rate=1.0,
+                      until=200.0).install(eng)
+        eng.run()
+        return times
+
+    a, b = run_once(), run_once()
+    assert a == b
+    assert len(a) > 20
+
+
+# ---------------------------------------------------------------------------
+# Event-vs-tick equivalence: the bench-port discipline
+# ---------------------------------------------------------------------------
+
+
+def _small_scenario(name: str) -> Scenario:
+    return Scenario(
+        name=name, horizon_s=8.0, tick_s=0.25, seed=3,
+        pools=(PoolSpec("pod-0", hosts=2),),
+        quotas=(QuotaSpec("work", min_gb=256.0, max_gb=1024.0),))
+
+
+def _journal_trace(plane):
+    """(category, subject, attrs) with run-unique plan ids normalized —
+    the same byte-identity basis the benches gate on."""
+    return [(r.category, r.subject, tuple(sorted(
+        (k, str(v)) for k, v in r.attrs.items() if k != "plan_id")))
+        for r in plane.journal.events()]
+
+
+def _submit_pods(plane, n=2):
+    for i in range(n):
+        plane.api.create(KIND_POD, make_slice_pod(
+            "2x4", 1, name=f"job-{i}", namespace="work"))
+
+
+def test_event_fault_equals_in_tick_fault_check():
+    """A PRIO_FAULT one-shot at T fires before the same-timestamp tick
+    — exactly the old in-tick ``if now >= T`` idiom.  Both stylings of
+    the same scenario must journal identically and converge the same
+    pods (equivalence holds whenever T is on the tick grid)."""
+    kill_t = 4.0
+
+    # event-styled: the kill is a first-class one-shot
+    ev_eng = SimEngine()
+    ev = assemble_control_plane(_small_scenario("ev"), ev_eng)
+    _submit_pods(ev)
+    compose(*ev.sources()).install(ev_eng)
+    ev_eng.at(kill_t, lambda: ev.kill_host("pod-0-h1"),
+              priority=PRIO_FAULT, label="node-kill")
+    ev_eng.run(until=8.0)
+
+    # tick-styled: the kill hides inside the tick body (the old idiom)
+    tk_eng = SimEngine()
+    tk = assemble_control_plane(_small_scenario("ev"), tk_eng)
+    _submit_pods(tk)
+    killed = [False]
+
+    def tick_with_fault_check():
+        if not killed[0] and tk_eng.now() >= kill_t:
+            killed[0] = True
+            tk.kill_host("pod-0-h1")
+        tk.tick()
+
+    tk_eng.tick_loop(0.25, tick_with_fault_check, until=8.0,
+                     label="ctl-tick")
+    tk_eng.run(until=8.0)
+
+    assert _journal_trace(ev) == _journal_trace(tk)
+
+    def phases(plane):
+        return sorted((p.metadata.name, p.status.phase,
+                       p.spec.node_name or "")
+                      for p in plane.api.list(KIND_POD))
+
+    assert phases(ev) == phases(tk)
+
+
+def test_assembled_control_plane_schedules_and_runs_pods():
+    eng = SimEngine()
+    plane = assemble_control_plane(_small_scenario("basic"), eng)
+    _submit_pods(plane)
+    compose(*plane.sources()).install(eng)
+    eng.run(until=8.0)
+    pods = plane.api.list(KIND_POD)
+    assert len(pods) == 2
+    assert all(p.status.phase == RUNNING and p.spec.node_name
+               for p in pods)
+
+
+# ---------------------------------------------------------------------------
+# Injector composition
+# ---------------------------------------------------------------------------
+
+
+def test_two_injectors_compose_on_one_run():
+    api = ChaosAPIServer(seed=5)
+    eng = SimEngine()
+    cloud = ChaosCloudTPUAPI(5, clock=eng.now)
+    api_chaos = APIChaosInjector(api, [(2.0, 3.0)], conflict_rate=0.5,
+                                 transient_rate=0.25)
+    cloud_chaos = CloudChaosInjector(cloud, [(2.0, 4.0), (8.0, 1.0)],
+                                     machine_class="tpu-v5e", zone="z0")
+    install_all(eng, [api_chaos, cloud_chaos])
+
+    probes = {}
+
+    def stockout_open() -> bool:
+        return (cloud._stockout_until.get(("tpu-v5e", "z0"), 0.0)
+                > eng.now())
+
+    def probe(label, t):
+        probes[(label, t)] = (api._conflict_rate, stockout_open())
+
+    for t in (1.0, 2.5, 3.5, 4.5, 5.5, 8.5, 9.5):
+        eng.at(t, (lambda when=t: probe("probe", when)),
+               priority=PRIO_SAMPLE, label="probe")
+    eng.run()
+
+    assert probes[("probe", 1.0)] == (0.0, False)
+    assert probes[("probe", 2.5)] == (0.5, True)     # both windows open
+    assert probes[("probe", 3.5)] == (0.5, True)
+    assert probes[("probe", 4.5)] == (0.5, True)
+    assert probes[("probe", 5.5)] == (0.0, True)     # api closed at 5.0
+    assert probes[("probe", 8.5)] == (0.0, True)     # second cloud window
+    assert probes[("probe", 9.5)] == (0.0, False)
+    assert cloud_chaos.opened == 2 and cloud_chaos.closed == 2
+
+
+# ---------------------------------------------------------------------------
+# Worst-week smoke: conservation + SLO verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_worst_week_smoke_conserves_and_explains():
+    cfg = WorstWeekConfig(seed=0).smoke()
+    report = WorstWeek(cfg).run(wall_clock=lambda: 0.0)
+    assert report["ledger"]["conservation_ok"]
+    assert report["ledger"]["conservation_delta"] == 0.0
+    # every registered objective must be judged — a missing verdict
+    # means an SLO silently fell out of the evaluation loop
+    judged = {v["objective"] for v in report["slo"]["verdicts"]}
+    assert judged == {"sim_fleet_util_floor", "sim_serve_wait_p99",
+                      "sim_train_wait_p99", "sim_research_wait_p99",
+                      "sim_node_kill_rate"}
+    assert report["unexplained_breaches"] == 0
+    assert report["jobs"]["completed"] > 0
+    assert report["events"] > 0
+
+
+def test_worst_week_is_deterministic_per_seed():
+    cfg = WorstWeekConfig(seed=1).smoke()
+    a = WorstWeek(cfg).run(wall_clock=lambda: 0.0)
+    b = WorstWeek(cfg).run(wall_clock=lambda: 0.0)
+    for k in ("events", "jobs", "kills", "utilization", "wait_p99_s",
+              "ledger", "slo", "breaches"):
+        assert a[k] == b[k], k
+
+
+def test_what_if_hosts_forecast_reports_deltas():
+    from nos_tpu.sim.worstweek import parse_what_if, run_what_if
+
+    assert parse_what_if("hosts=+120") == {"hosts_delta": 120}
+    assert parse_what_if("hosts=-60") == {"hosts_delta": -60}
+    with pytest.raises(ValueError):
+        parse_what_if("quota=train:0.9,serve:0.3")   # fracs must sum to 1
+    with pytest.raises(ValueError):
+        parse_what_if("chips=+8")                    # unknown knob
+
+    cfg = WorstWeekConfig(seed=0).smoke()
+    base = WorstWeek(cfg).run(wall_clock=lambda: 0.0)
+    out = run_what_if(cfg, "hosts=+120", base_report=base,
+                      wall_clock=lambda: 0.0)
+    assert out["delta"]["hosts"] == 120
+    assert set(out["delta"]["wait_p99_s"]) == {"train", "serve",
+                                               "research"}
+
+
+# ---------------------------------------------------------------------------
+# The bench stdout contract: ONE JSON document
+# ---------------------------------------------------------------------------
+
+
+def test_stdout_contract_one_json_document(capsys):
+    """Everything printed under the swap lands on stderr; exactly one
+    JSON document reaches the real stdout — the contract every bench
+    main and ``python -m nos_tpu.sim`` are parsed under."""
+    with stdout_to_stderr() as real_stdout:
+        print("library noise")            # must NOT reach stdout
+        print("progress: 50%")
+        emit({"ok": True, "n": 3}, real_stdout)
+    captured = capsys.readouterr()
+    assert "library noise" in captured.err
+    assert "progress: 50%" in captured.err
+    lines = [ln for ln in captured.out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    assert json.loads(lines[0]) == {"ok": True, "n": 3}
+
+
+def test_sim_cli_smoke_emits_one_json_and_gates(capsys, tmp_path):
+    from nos_tpu.sim.__main__ import main
+
+    report_path = tmp_path / "sim-report.json"
+    rc = main(["--smoke", "--report", str(report_path)],
+              wall_clock=lambda: 0.0)
+    captured = capsys.readouterr()
+    lines = [ln for ln in captured.out.splitlines() if ln.strip()]
+    assert len(lines) == 1               # the one-document contract
+    report = json.loads(lines[0])
+    assert rc == 0
+    assert report["ledger"]["conservation_ok"]
+    assert conservation_ok is not None   # re-exported invariant exists
+    artifact = json.loads(report_path.read_text())
+    assert artifact["scenario"] == report["scenario"]
