@@ -1,0 +1,583 @@
+"""Request-path bench: the inference data plane end to end on the
+serving control plane (docs/serving.md, "The request path").
+
+Builds on bench_serving's cluster (12 slice + 2 timeshare v5e hosts,
+real scheduler/partitioners/agents/quota, batch + best-effort filler
+soaking idle chips) and replaces the aggregate requests-in-flight
+annotation stamp with the REAL request path (nos_tpu/requests):
+
+    chat    prefill/decode DISAGGREGATED — chat-prefill on 1x2 slices
+            (compute for prompt processing), chat-decode on 1x1 slices
+            (KV-heavy MHA model, ~8k KV tokens per replica); sessions
+            sticky to their decode replica
+    embed   aggregated on 8gb timeshare replicas; prompt-only requests
+            complete at prefill
+
+Requests are individual seeded arrivals (sim ArrivalSource thinning a
+DiurnalTrace rate — bursty, peak-hour millions of users compressed
+onto the bench clock); the router places them by KV occupancy with
+session affinity, sheds-with-retry on saturation, and publishes each
+replica's occupancy through ANNOT_SERVING_LOAD so the replica
+autoscaler scales on KV PRESSURE (target ~0.55 reserved) instead of a
+requests-in-flight estimate.
+
+Falsifiable invariants:
+
+  - per-request p99 (phase=total) < 10 s at peak diurnal load, judged
+    by the SLO engine next to schedule latency;
+  - ZERO serving preemption victims while requests flow;
+  - KV-pressure autoscaling holds mean decode occupancy under the 0.9
+    ceiling for >= 90% of post-warmup samples through bursts;
+  - the router-saturation curve (offered load vs goodput/p99/shed on
+    fixed replicas) shows goodput plateau at capacity, not collapse;
+  - OFF MEANS OFF: a router-disabled run journals the byte-identical
+    decision sequence of plain bench_serving (check_byte_identity —
+    bench_serving's smoke asserts it).
+
+Time is virtual; one seed's shortened trace is the CI gate (--smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import math
+import random
+import time
+
+import bench_serving as bs
+from nos_tpu.api import constants as C
+from nos_tpu.kube.client import APIServer, KIND_NODE, KIND_POD
+from nos_tpu.kube.objects import PENDING, RUNNING
+from nos_tpu.obs import scoped as obs_scoped
+from nos_tpu.obs.journal import DecisionJournal
+from nos_tpu.obs.slo import LATENCY, SLOEngine, SLOObjective
+from nos_tpu.obs.timeseries import TimeSeriesSampler
+from nos_tpu.requests import (
+    ModelProfile, Request, RequestCostModel, RouterService, ServingRouter,
+)
+from nos_tpu.serving import ReplicaAutoscaler, ServingService, replica_load
+from nos_tpu.serving.trace import DiurnalTrace
+from nos_tpu.sim import ArrivalSource, SimEngine, emit, write_report
+from nos_tpu.testing.factory import make_pod, make_tpu_node
+
+REQUEST_P99_TARGET_S = 10.0
+REQUEST_MIN_EVENTS = 5
+OCC_CEILING = 0.9           # fleet-mean decode occupancy the
+OCC_WITHIN = 0.9            # autoscaler must hold >= this fraction
+LOAD_SCALE = 1.0
+
+# Chat: deliberately KV-heavy (full MHA, no GQA — every head caches)
+# so decode replicas hold ~8k KV tokens (~20 mid-size streams) and KV
+# pressure, not request count, is the binding constraint.  Weights at
+# 12 GB leave 4 GB of KV on a 16 GB 1x1 replica.
+CHAT_MODEL = ModelProfile(
+    name="chat-7b-mha", num_layers=32, num_heads=32, num_kv_heads=32,
+    head_dim=128, intermediate_size=14336, weights_gb=12.0)
+# Embed: small encoder, prompt-only (output_tokens=1 completes at
+# prefill), served aggregated from 8gb timeshare replicas.
+EMBED_MODEL = ModelProfile(
+    name="embed-1b", num_layers=12, num_heads=16, num_kv_heads=16,
+    head_dim=64, intermediate_size=4096, weights_gb=2.0)
+
+ROUTER_SERVICES = (
+    RouterService(
+        name="chat", namespace="serve",
+        prefill_service="chat-prefill", decode_service="chat-decode",
+        model=CHAT_MODEL,
+        prefill_costs=RequestCostModel(
+            profile=CHAT_MODEL, device_kind="v5e", chips=2,
+            hbm_gb=16.0, mfu=0.4),
+        decode_costs=RequestCostModel(
+            profile=CHAT_MODEL, device_kind="v5e", chips=1,
+            hbm_gb=16.0),
+        # the retry ladder (0.3 + 0.6 + ... = 4.5 s) must outlast a
+        # KV-pressure scale-up round trip (publish -> reconcile ->
+        # schedule -> admit), or bursts shed work the fleet could
+        # absorb two seconds later
+        max_queue_per_replica=16, max_retries=5, retry_backoff_s=0.3,
+        session_idle_s=30.0),
+    RouterService(
+        name="embed", namespace="serve", model=EMBED_MODEL,
+        prefill_costs=RequestCostModel(
+            profile=EMBED_MODEL, device_kind="v5e", chips=1,
+            hbm_gb=8.0, mfu=0.2, hbm_efficiency=0.4),
+        max_queue_per_replica=32, max_retries=3, retry_backoff_s=0.25,
+        session_idle_s=20.0),
+)
+
+# Per-role ServingService entries: the disaggregation maps to DIFFERENT
+# slice shapes — prefill gets 1x2 (compute), decode 1x1 (KV).  The
+# autoscaler target is the published load signal's setpoint: ~0.55
+# reserved-KV fraction for decode/aggregated pools, ~0.5 queue
+# saturation for the prefill pool.
+ROUTER_AUTOSCALED = (
+    ServingService(name="chat-prefill", namespace="serve",
+                   slice_shape="1x2", min_replicas=1, max_replicas=3,
+                   target_load_per_replica=0.5,
+                   scale_up_cooldown_s=0.2, scale_down_cooldown_s=10.0,
+                   down_hysteresis=0.2),
+    ServingService(name="chat-decode", namespace="serve",
+                   slice_shape="1x1", min_replicas=2, max_replicas=12,
+                   target_load_per_replica=0.55,
+                   scale_up_cooldown_s=0.2, scale_down_cooldown_s=10.0,
+                   down_hysteresis=0.2),
+    ServingService(name="embed", namespace="serve", timeshare_gb=8,
+                   min_replicas=1, max_replicas=8,
+                   target_load_per_replica=0.55,
+                   scale_up_cooldown_s=0.2, scale_down_cooldown_s=12.0,
+                   down_hysteresis=0.2),
+)
+
+# request shape draws (per-service RNGs, consumed only inside
+# engine-ordered arrival callbacks — deterministic per seed)
+CHAT_PROMPT = (64, 512)
+CHAT_OUTPUT = (16, 96)
+CHAT_SESSIONS = 600
+EMBED_PROMPT = (32, 256)
+# Little's-law divisor turning the trace's requests-in-flight into an
+# arrival rate; must match the service_time_s each trace was built with.
+SERVICE_TIME_S = {"chat": 0.5, "embed": 1.0}
+
+
+def request_traces(seed: int) -> dict[str, DiurnalTrace]:
+    """Arrival-rate curves (requests/s = load_at/service_time), sized
+    so steady peak wants ~6 decode replicas and 3x bursts push the
+    band toward its max — the autoscaler has real work."""
+    return {
+        "serve/chat": DiurnalTrace(
+            seed=seed * 11 + 3, period_s=120.0,
+            base_users=150_000.0, peak_users=900_000.0,
+            requests_per_user_per_s=2e-5, service_time_s=0.5,
+            burst_rate_per_s=1.0 / 40.0, burst_multiplier=3.0,
+            burst_duration_s=8.0),
+        "serve/embed": DiurnalTrace(
+            seed=seed * 11 + 4, period_s=150.0, phase_s=60.0,
+            base_users=800_000.0, peak_users=4_800_000.0,
+            requests_per_user_per_s=1e-5, service_time_s=1.0,
+            burst_rate_per_s=1.0 / 55.0, burst_multiplier=2.5,
+            burst_duration_s=10.0),
+    }
+
+
+def slo_objectives() -> list[SLOObjective]:
+    return bs.slo_objectives() + [
+        SLOObjective(name="request-latency", kind=LATENCY,
+                     metric="nos_tpu_request_latency_seconds",
+                     target=REQUEST_P99_TARGET_S,
+                     labels={"phase": "total"}, each_label="service",
+                     compliance=0.99, quantile=0.99,
+                     min_events=REQUEST_MIN_EVENTS),
+    ]
+
+
+class Sim(bs.Sim):
+    """bench_serving's cluster with the request data plane on top.
+    ``router_enabled=False`` constructs the parent UNCHANGED — every
+    override delegates immediately, so the journal is byte-identical
+    to plain bench_serving (check_byte_identity pins it)."""
+
+    def __init__(self, seed: int = 0, *, router_enabled: bool = True,
+                 load_scale: float = LOAD_SCALE) -> None:
+        super().__init__(seed)
+        self.router: ServingRouter | None = None
+        if not router_enabled:
+            return
+        clock = self.eng.now
+        self.load_scale = load_scale
+        # per-role services replace the aggregate ones end to end
+        self.autoscaler = ReplicaAutoscaler(
+            self.api, ROUTER_AUTOSCALED, clock=clock)
+        self.replica_series = {svc.key: []
+                               for svc in ROUTER_AUTOSCALED}
+        self.occ_series: dict[str, list[tuple[float, float]]] = {
+            svc.key: [] for svc in ROUTER_SERVICES}
+        self.router = ServingRouter(
+            self.api, ROUTER_SERVICES, clock=clock,
+            publish_every_ticks=bs.STAMP_EVERY_TICKS,
+            keep_completed=True)
+        self.req_traces = request_traces(seed)
+        self._req_rng = {svc.name: random.Random(seed * 1000 + i * 7)
+                         for i, svc in enumerate(ROUTER_SERVICES)}
+        self._rid = 0
+        self.slo_engine = SLOEngine(
+            TimeSeriesSampler(clock=clock, maxlen=4096),
+            slo_objectives(),
+            fast_window_s=bs.SLO_FAST_WINDOW_S,
+            slow_window_s=bs.SLO_SLOW_WINDOW_S, clock=clock)
+
+    # -- arrivals -----------------------------------------------------------
+    def _arrive(self, svc: RouterService, t: float) -> None:
+        rng = self._req_rng[svc.name]
+        self._rid += 1
+        if svc.name == "chat":
+            req = Request("chat", f"chat-r{self._rid}",
+                          f"chat-s{rng.randrange(CHAT_SESSIONS)}",
+                          rng.randrange(*CHAT_PROMPT),
+                          rng.randrange(*CHAT_OUTPUT), t)
+        else:
+            # embeds are sessionless one-shots: prompt only
+            req = Request("embed", f"embed-r{self._rid}",
+                          f"embed-r{self._rid}",
+                          rng.randrange(*EMBED_PROMPT), 1, t)
+        assert self.router is not None
+        self.router.submit(svc.key, req)
+
+    def _arrival_sources(self) -> list[ArrivalSource]:
+        out = []
+        for svc in ROUTER_SERVICES:
+            trace = self.req_traces[svc.key]
+            scale = self.load_scale / SERVICE_TIME_S[svc.name]
+
+            def rate(t: float, trace=trace, scale=scale) -> float:
+                return trace.load_at(t) * scale
+
+            # thinning bound: the trace is a pure function of t, so a
+            # coarse scan over the horizon (bursts last >= 4 s) finds
+            # the true peak; the 1.05 pad keeps rate_fn strictly under
+            peak = max(rate(i * 0.5)
+                       for i in range(int(bs.TRACE_S * 2) + 2)) * 1.05
+            out.append(ArrivalSource(
+                seed=self.seed * 31 + len(out), rate_fn=rate,
+                fn=(lambda t, svc=svc: self._arrive(svc, t)),
+                peak_rate=peak, until=bs.TRACE_S,
+                label=f"req-{svc.name}"))
+        return out
+
+    # -- overrides (router mode only; otherwise delegate) -------------------
+    def _stamp_loads(self) -> None:
+        if self.router is None:
+            return super()._stamp_loads()
+        # the router's publish loop owns the load signal
+
+    def _record_serving_binds(self) -> None:
+        if self.router is None:
+            return super()._record_serving_binds()
+        for svc in ROUTER_AUTOSCALED:
+            for p in self.api.list(
+                    KIND_POD, namespace=svc.namespace,
+                    label_selector={C.LABEL_SERVICE: svc.name}):
+                if not p.spec.node_name \
+                        or p.metadata.name in self._serving_seen:
+                    continue
+                self._serving_seen.add(p.metadata.name)
+                if self.eng.now() < bs.WARMUP_S:
+                    continue
+                self.serving_latencies.append(
+                    self.eng.now() - p.metadata.creation_timestamp)
+
+    def _track_replicas(self) -> None:
+        if self.router is None:
+            return super()._track_replicas()
+        for svc in ROUTER_AUTOSCALED:
+            pods = self.api.list(
+                KIND_POD, namespace=svc.namespace,
+                label_selector={C.LABEL_SERVICE: svc.name},
+                filter_fn=lambda p: p.status.phase in (PENDING, RUNNING))
+            load = sum(replica_load(p) for p in pods)
+            desired = min(svc.max_replicas, max(
+                svc.min_replicas,
+                math.ceil(load / svc.target_load_per_replica)))
+            self.replica_series[svc.key].append(
+                (round(self.eng.now(), 2), round(load, 2), len(pods),
+                 desired))
+        for svc in ROUTER_SERVICES:
+            occs = self.router.pool_occupancies(svc.key)
+            # the KV ceiling is judged on the pool that HOLDS streams
+            pool = occs.get("decode") or occs.get("prefill") or []
+            if pool:
+                self.occ_series[svc.key].append(
+                    (round(self.eng.now(), 2),
+                     round(sum(pool) / len(pool), 4)))
+
+    def _tracking_stats(self) -> dict:
+        if self.router is None:
+            return super()._tracking_stats()
+        out: dict[str, dict] = {}
+        for svc in ROUTER_AUTOSCALED:
+            rows = [r for r in self.replica_series[svc.key]
+                    if r[0] >= bs.WARMUP_S]
+            if not rows:
+                out[svc.key] = {"samples": 0}
+                continue
+            within = sum(1 for _, _, live, desired in rows
+                         if live >= desired - 1)
+            out[svc.key] = {
+                "samples": len(rows),
+                "within_one": round(within / len(rows), 4),
+                "replicas_min": min(r[2] for r in rows),
+                "replicas_max": max(r[2] for r in rows),
+            }
+        return out
+
+    def _tick(self) -> None:
+        if self.router is None:
+            return super()._tick()
+        self._tick_no += 1
+        tick = self._tick_no
+        self._complete_finished()
+        self._spawn()
+        # the router ticks BEFORE the autoscaler so a fresh occupancy
+        # stamp (its publish cadence == the old stamp cadence) is what
+        # reconcile reads
+        self.router.tick(bs.TICK_S)
+        self.autoscaler.reconcile()
+        t0 = time.perf_counter()
+        self.scheduler.run_cycle()
+        self.cycle_wall_ms.append((time.perf_counter() - t0) * 1e3)
+        self._requeue_evicted()
+        self.slice_ctl.process_if_ready()
+        self.ts_ctl.process_if_ready()
+        for a in list(self.agents.values()):
+            a.tick()
+        self.eq_reconciler.reconcile_all()
+        self._record_serving_binds()
+        self._record_batch_binds()
+        if tick % bs.STAMP_EVERY_TICKS == 0:
+            self._track_replicas()
+        self._sample_utilization()
+        if self.eng.now() >= bs.WARMUP_S:
+            self.slo_engine.tick()
+
+    def run(self) -> dict:
+        if self.router is None:
+            return super().run()
+        for src in self._arrival_sources():
+            src.install(self.eng)
+        return super().run()
+
+    # -- report -------------------------------------------------------------
+    def _request_stats(self) -> dict:
+        assert self.router is not None
+        pct = bs.percentile
+        out: dict[str, dict] = {}
+        stats = self.router.stats()
+        for svc in ROUTER_SERVICES:
+            reqs = [r for r in self.router.completed_requests(svc.key)
+                    if r.finished is not None
+                    and r.created >= bs.WARMUP_S]
+            total = [r.finished - r.created for r in reqs]
+            ttft = [r.prefill_done - r.created for r in reqs
+                    if r.prefill_done is not None]
+            occ = [o for t, o in self.occ_series[svc.key]
+                   if t >= bs.WARMUP_S]
+            under = (sum(1 for o in occ if o <= OCC_CEILING) / len(occ)
+                     if occ else None)
+            out[svc.key] = {
+                **stats[svc.key],
+                "completed_post_warmup": len(reqs),
+                "p50_s": pct(total, 0.50, 3),
+                "p99_s": pct(total, 0.99, 3),
+                "ttft_p99_s": pct(ttft, 0.99, 3),
+                "occupancy_mean_max": (round(max(occ), 4) if occ
+                                       else None),
+                "occupancy_under_ceiling": (round(under, 4)
+                                            if under is not None
+                                            else None),
+            }
+        return out
+
+    def _report(self) -> dict:
+        out = super()._report()
+        if self.router is not None:
+            out["requests"] = self._request_stats()
+            out["request_p99_target_s"] = REQUEST_P99_TARGET_S
+        return out
+
+
+# -- router-saturation curve -------------------------------------------------
+# Fixed replica fleet, flat offered rate per point: the curve isolates
+# ROUTER + replica capacity (goodput plateau, p99 blow-up, shed onset)
+# from the autoscaler, which the main trace exercises.
+CURVE_DECODE_REPLICAS = 6
+CURVE_PREFILL_REPLICAS = 2
+CURVE_BASE_RPS = 30.0
+CURVE_TICK_S = 0.05
+
+
+def saturation_point(seed: int, scale: float,
+                     trace_s: float = 60.0) -> dict:
+    eng = SimEngine()
+    api = APIServer()
+    api.create(KIND_NODE, make_tpu_node("curve-host", pod_id="pod-0"))
+    chat = ROUTER_SERVICES[0]
+    for i in range(CURVE_PREFILL_REPLICAS):
+        api.create(KIND_POD, make_pod(
+            name=f"chat-prefill-{i}", namespace="serve", phase=RUNNING,
+            node_name="curve-host",
+            labels={C.LABEL_SERVICE: chat.prefill_label,
+                    C.LABEL_TIER: C.TIER_SERVING}))
+    for i in range(CURVE_DECODE_REPLICAS):
+        api.create(KIND_POD, make_pod(
+            name=f"chat-decode-{i}", namespace="serve", phase=RUNNING,
+            node_name="curve-host",
+            labels={C.LABEL_SERVICE: chat.decode_service,
+                    C.LABEL_TIER: C.TIER_SERVING}))
+    router = ServingRouter(api, (chat,), clock=eng.now,
+                           keep_completed=True)
+    rng = random.Random(seed * 997 + 13)
+    rid = [0]
+
+    def arrive(t: float) -> None:
+        rid[0] += 1
+        router.submit("serve/chat", Request(
+            "chat", f"r{rid[0]}", f"s{rng.randrange(CHAT_SESSIONS)}",
+            rng.randrange(*CHAT_PROMPT), rng.randrange(*CHAT_OUTPUT),
+            t))
+
+    rate = CURVE_BASE_RPS * scale
+    ArrivalSource(seed=seed * 53 + 1, rate_fn=lambda t: rate,
+                  fn=arrive, peak_rate=rate * 1.01, until=trace_s,
+                  label="curve-req").install(eng)
+    eng.tick_loop(CURVE_TICK_S, lambda: router.tick(CURVE_TICK_S),
+                  until=trace_s, label="router-tick")
+    eng.run()
+    stats = router.stats()["serve/chat"]
+    lats = [r.finished - r.created
+            for r in router.completed_requests("serve/chat")
+            if r.finished is not None]
+    return {
+        "load_scale": scale,
+        "offered_rps": round(stats["submitted"] / trace_s, 2),
+        "goodput_rps": round(stats["completed"] / trace_s, 2),
+        "shed": stats["shed"],
+        "retried": stats["retried"],
+        "p50_s": bs.percentile(lats, 0.50, 3),
+        "p99_s": bs.percentile(lats, 0.99, 3),
+    }
+
+
+def saturation_curve(seed: int = 0,
+                     scales=(0.5, 1.0, 1.5, 2.0, 3.0)) -> list[dict]:
+    return [saturation_point(seed, s) for s in scales]
+
+
+# -- off means off -----------------------------------------------------------
+@contextlib.contextmanager
+def _short_trace(trace_s: float, warmup_s: float):
+    """Temporarily shorten bench_serving's module-global trace (the
+    run_smoke pattern, reused for byte-identity and smoke runs)."""
+    prev = (bs.TRACE_S, bs.WARMUP_S, bs.SLO_FAST_WINDOW_S,
+            bs.SLO_SLOW_WINDOW_S, bs.SERVING_MIN_EVENTS)
+    bs.TRACE_S, bs.WARMUP_S = trace_s, warmup_s
+    bs.SLO_FAST_WINDOW_S = min(bs.SLO_FAST_WINDOW_S, trace_s / 6)
+    bs.SLO_SLOW_WINDOW_S = min(bs.SLO_SLOW_WINDOW_S, trace_s / 2)
+    bs.SERVING_MIN_EVENTS = 1
+    try:
+        yield
+    finally:
+        (bs.TRACE_S, bs.WARMUP_S, bs.SLO_FAST_WINDOW_S,
+         bs.SLO_SLOW_WINDOW_S, bs.SERVING_MIN_EVENTS) = prev
+
+
+def _journaled_trace(make_sim) -> list:
+    """Run a sim under its OWN decision journal; normalize records to
+    the (category, subject, sorted attrs) byte-identity basis (the
+    bench_capacity off-means-off pattern)."""
+    sim = make_sim()
+    journal = DecisionJournal(maxlen=200_000, clock=sim.eng.now)
+    with obs_scoped(journal=journal):
+        sim.run()
+    return [(r.category, r.subject,
+             tuple(sorted((k, str(v)) for k, v in r.attrs.items()
+                          if k != "plan_id")))
+            for r in journal.events()]
+
+
+def check_byte_identity(trace_s: float = 30.0,
+                        warmup_s: float = 10.0) -> tuple[bool, str]:
+    """Off means off: a router-disabled Sim must journal the EXACT
+    decision sequence of plain bench_serving — importing the request
+    plane and threading its hooks through the subclass cannot perturb
+    the annotation-driven path."""
+    with _short_trace(trace_s, warmup_s):
+        base = _journaled_trace(lambda: bs.Sim(seed=0))
+        off = _journaled_trace(
+            lambda: Sim(seed=0, router_enabled=False))
+    if base == off:
+        return True, f"{len(base)} records identical"
+    for i, (ra, rb) in enumerate(zip(base, off)):
+        if ra != rb:
+            return False, f"first divergence at record {i}: {ra} vs {rb}"
+    return False, f"length mismatch: {len(base)} vs {len(off)}"
+
+
+# -- entry points ------------------------------------------------------------
+def run_full(seed: int = 0) -> dict:
+    sim = Sim(seed=seed)
+    out = sim.run()
+    out["saturation_curve"] = saturation_curve(seed)
+    identical, detail = check_byte_identity()
+    out["byte_identity"] = {"ok": identical, "detail": detail}
+    assert identical, f"router-disabled not byte-identical: {detail}"
+    return out
+
+
+def run_smoke() -> dict:
+    """The request-path regression gate (scripts/check.sh): one seed,
+    shortened trace.  Asserts the tentpole invariants end to end;
+    byte-identity runs from bench_serving's smoke (its path is the one
+    being protected).  Raises AssertionError on regression."""
+    t0 = time.perf_counter()
+    with _short_trace(90.0, 30.0):
+        sim = Sim(seed=0)
+        result = sim.run()
+    result["saturation_curve"] = saturation_curve(0, scales=(1.0, 2.5))
+    wall = time.perf_counter() - t0
+
+    assert result["serving"]["preempted"] == 0, \
+        f"{result['serving']['preempted']} serving preemption victim(s)"
+    reqs = result["requests"]
+    for key, r in reqs.items():
+        assert r["completed_post_warmup"] > 0, f"no requests: {key}"
+        assert r["p99_s"] is not None \
+            and r["p99_s"] < REQUEST_P99_TARGET_S, \
+            f"{key} request p99 {r['p99_s']}s >= {REQUEST_P99_TARGET_S}s"
+    chat = reqs["serve/chat"]
+    assert chat["occupancy_under_ceiling"] is not None \
+        and chat["occupancy_under_ceiling"] >= OCC_WITHIN, \
+        f"KV occupancy over {OCC_CEILING} ceiling too often: " \
+        f"{chat['occupancy_under_ceiling']}"
+    verdicts = [v for v in result["slo"]["verdicts"]
+                if v["objective"] == "request-latency"]
+    assert verdicts, "no request-latency SLO verdict"
+    assert any(v["value"] is not None for v in verdicts), \
+        "request-latency verdict never judged real events"
+    for v in verdicts:
+        assert not v["breached"], f"request SLO breached: {v}"
+    curve = result["saturation_curve"]
+    assert curve[-1]["offered_rps"] > curve[0]["offered_rps"], \
+        "saturation curve not ordered by offered load"
+    assert all(p["goodput_rps"] > 0 for p in curve), \
+        f"router produced no goodput: {curve}"
+    assert wall < 480.0, f"smoke took {wall:.1f}s (> 480s bound)"
+    return {
+        "smoke": "ok",
+        "wall_s": round(wall, 1),
+        "serving_preempted": result["serving"]["preempted"],
+        "requests": reqs,
+        "saturation_curve": curve,
+        "tracking": result["serving"]["tracking"],
+        "slo": result["slo"],
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="inference request data-plane bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1-seed shortened-trace request-path gate")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests-report", default="",
+                    help="also write the request block to this file "
+                         "(CI uploads it as an artifact)")
+    args = ap.parse_args(argv)
+    out = run_smoke() if args.smoke else run_full(args.seed)
+    write_report(args.requests_report,
+                 {k: v for k, v in out.items() if k != "per_seed"},
+                 note="requests report")
+    emit(out)
+
+
+if __name__ == "__main__":
+    main()

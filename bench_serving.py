@@ -718,8 +718,18 @@ def run_smoke() -> dict:
             f"{svc_key} tracked within one replica only " \
             f"{stats['within_one']:.0%} of samples"
     assert wall < 300.0, f"smoke trace took {wall:.1f}s (> 300s bound)"
+    # off means off: the request data plane (bench_requests.Sim with
+    # the router disabled) must journal the byte-identical decision
+    # sequence of this bench — the plane's existence cannot perturb
+    # the annotation-driven path it replaces.  Lazy import: this bench
+    # is the protected side, that one the overlay.
+    import bench_requests
+
+    identical, detail = bench_requests.check_byte_identity()
+    assert identical, f"router-disabled path not byte-identical: {detail}"
     return {
         "smoke": "ok",
+        "byte_identity": detail,
         "wall_s": round(wall, 1),
         "serving_binds": serving["binds"],
         "serving_p99_ms": serving["p99_ms"],

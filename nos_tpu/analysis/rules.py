@@ -136,8 +136,8 @@ class InjectableClock(Rule):
     # whole simulated fleet (wall-time measurement enters via an
     # injected wall_clock reference only).
     scope = ("nos_tpu/capacity/", "nos_tpu/controllers/", "nos_tpu/obs/",
-             "nos_tpu/partitioning/", "nos_tpu/scheduler/",
-             "nos_tpu/serving/", "nos_tpu/sim/")
+             "nos_tpu/partitioning/", "nos_tpu/requests/",
+             "nos_tpu/scheduler/", "nos_tpu/serving/", "nos_tpu/sim/")
 
     BANNED_DOTTED = frozenset({
         "time.time", "time.time_ns", "time.sleep",

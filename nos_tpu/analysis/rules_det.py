@@ -66,7 +66,8 @@ class UnorderedIterationHazard(Rule):
     title = "unordered-collection iteration feeds an order-sensitive decision"
     scope = ("nos_tpu/scheduler/", "nos_tpu/partitioning/",
              "nos_tpu/capacity/", "nos_tpu/controllers/",
-             "nos_tpu/serving/", "nos_tpu/quota/", "nos_tpu/sim/")
+             "nos_tpu/serving/", "nos_tpu/quota/", "nos_tpu/sim/",
+             "nos_tpu/requests/")
 
     SET_CONSTRUCTORS = frozenset({"set", "frozenset"})
     #: methods that return a set when their receiver is one

@@ -307,6 +307,48 @@ class AutoscalerConfig(ManagerConfig):
 
 
 @dataclasses.dataclass
+class RouterConfig(ManagerConfig):
+    """Request-router main config (nos_tpu/requests).  The `services`
+    list holds one mapping per routed inference service (keys =
+    RouterService fields plus nested `model:` / `prefill:` / `decode:`
+    cost blocks); each entry is validated through RouterService itself
+    so chart/config and code cannot drift — the AutoscalerConfig
+    pattern.  Off by default: with ``enabled`` false the router is
+    never constructed and the serving plane reads exactly as it did
+    before the request data plane existed (bench_serving.py pins the
+    journal byte-identical)."""
+
+    enabled: bool = False
+    tick_interval_s: float = 0.05
+    publish_every_ticks: int = 5
+    # Replica-stepping worker threads; 0/1 = in-line.  The journal is
+    # byte-identical across worker counts (obs/journal.py
+    # JournalCapture; tests/test_requests.py pins it).
+    workers: int = 0
+    services: list = dataclasses.field(default_factory=list)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.tick_interval_s <= 0:
+            raise ConfigError("tick_interval_s must be positive")
+        if self.publish_every_ticks < 1:
+            raise ConfigError("publish_every_ticks must be >= 1")
+        if self.workers < 0:
+            raise ConfigError("workers must be >= 0")
+        if not isinstance(self.services, list):
+            raise ConfigError("services must be a list of mappings")
+        from nos_tpu.requests.router import RouterService
+
+        for i, raw in enumerate(self.services):
+            if not isinstance(raw, dict):
+                raise ConfigError(f"services[{i}] must be a mapping")
+            try:
+                RouterService.from_mapping(raw)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"services[{i}]: {e}") from e
+
+
+@dataclasses.dataclass
 class ProvisionerConfig(ManagerConfig):
     """Capacity-provisioner main config (nos_tpu/capacity).  Off by
     default: with ``enabled`` false the binary exits without

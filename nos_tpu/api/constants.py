@@ -298,6 +298,15 @@ def heartbeat_annotation(family: str = "slice") -> str:
 # Absent/garbage = 0 (an unreporting replica claims no load).
 ANNOT_SERVING_LOAD = f"{GROUP}/serving-load"
 
+# Active-session count for a serving replica, published by the request
+# router (nos_tpu/requests/router.py) next to the load signal.  The
+# replica autoscaler's scale-down prefers zero-session (drained)
+# replicas before least-loaded ones, so scale-in never kills a live
+# session while an idle replica exists.  Absent/garbage = 0 — a
+# routerless deployment (annotation never stamped) keeps the historical
+# pending-first/least-loaded victim order exactly.
+ANNOT_SERVING_SESSIONS = f"{GROUP}/serving-sessions"
+
 # Reported device-plugin generation for timeshare nodes: replaces the
 # reference's blind time.Sleep(devicePluginDelaySeconds)
 # (mps/partitioner.go:99-100) with a generation-stamped readiness handshake.

@@ -85,6 +85,20 @@ def _find_waste_block(payload: dict) -> dict | None:
     return None
 
 
+def _find_requests_block(payload: dict) -> dict | None:
+    """The per-service request-path stats inside any payload shape we
+    serve: a bench_requests report carrying "requests" (rows keyed by
+    service, written by its Sim._request_stats) at top level or nested
+    under the utilization block."""
+    for holder in (payload, payload.get("utilization", {})):
+        block = holder.get("requests") if isinstance(holder, dict) \
+            else None
+        if isinstance(block, dict) and block and \
+                all(isinstance(row, dict) for row in block.values()):
+            return block
+    return None
+
+
 def _rejecting_plugin(journal: list[dict], slo_class: str) -> str:
     """Newest pod-rejected record of this workload class → its plugin
     (or the dominant per-node reason): the one-command join from an SLO
@@ -103,6 +117,52 @@ def _rejecting_plugin(journal: list[dict], slo_class: str) -> str:
             return str(top).split(":")[0]
         return attrs.get("reason") or "unknown"
     return ""
+
+
+def _request_breach_cause(journal: list[dict], service: str
+                          ) -> list[str]:
+    """Join a request-latency breach to its cause, mirroring the
+    breach→rejecting-plugin join: a REQUEST_SHED record for the service
+    means the router is saturating (admission queues still full after
+    the retry ladder); an autoscaler scale-up for one of the service's
+    pools means KV pressure with capacity already on the way; neither
+    on record points at the scheduler path instead (new replicas
+    pending placement)."""
+    def _mine(rec: dict) -> bool:
+        subj = str(rec.get("subject", ""))
+        return subj.split("/")[-1] == service or service in subj
+
+    shed: dict | None = None
+    scale: dict | None = None
+    for rec in reversed(journal):
+        cat = rec.get("category")
+        if shed is None and cat == J.REQUEST_SHED and _mine(rec):
+            shed = rec
+        elif scale is None and cat == J.AUTOSCALE and _mine(rec) \
+                and rec.get("attrs", {}).get("direction") == "up":
+            scale = rec
+        if shed is not None and scale is not None:
+            break
+    lines: list[str] = []
+    if shed is not None:
+        a = shed.get("attrs", {})
+        lines.append(
+            f"router saturation: {shed.get('subject')} shed "
+            f"rid={a.get('rid')} phase={a.get('phase')} after "
+            f"{a.get('retries')} retries — replicas full past the "
+            "retry ladder")
+    if scale is not None:
+        a = scale.get("attrs", {})
+        lines.append(
+            f"scale-up in flight: {scale.get('subject')} "
+            f"+{a.get('count')} replica(s) — KV pressure, capacity "
+            "catching up")
+    if not lines:
+        lines.append(
+            "no shed or scale-up on record — suspect the scheduler "
+            "path: check the serving tier's schedule-latency verdict "
+            "and `explain pod` a pending replica")
+    return lines
 
 
 def cmd_slo(payload: dict) -> int:
@@ -136,6 +196,10 @@ def cmd_slo(payload: dict) -> int:
         print(line)
         if v.get("breached"):
             breached += 1
+            if v.get("metric") == "nos_tpu_request_latency_seconds":
+                for cause in _request_breach_cause(journal, cls):
+                    print(f"         {cause}")
+                continue
             plugin = _rejecting_plugin(journal, cls)
             if plugin:
                 print(f"         rejecting plugin for class {cls}: "
@@ -277,6 +341,22 @@ def cmd_top(payload: dict) -> int:
             print(f"  {cls:<20} {pending[cls]}")
     else:
         print("pending by class: none")
+    reqs_block = _find_requests_block(payload)
+    if reqs_block:
+        trace_s = payload.get("trace_seconds")
+        print("requests by service:")
+        print("  service             req/s  ttft-p99  p99(s)  kv-occ"
+              "  shed")
+        for key in sorted(reqs_block):
+            row = reqs_block[key]
+            rate = None
+            if isinstance(trace_s, (int, float)) and trace_s > 0:
+                rate = float(row.get("completed", 0)) / trace_s
+            print(f"  {key:<18} {_fmt(rate):>6} "
+                  f"{_fmt(row.get('ttft_p99_s'), 3):>9} "
+                  f"{_fmt(row.get('p99_s'), 3):>7} "
+                  f"{_fmt(row.get('occupancy_mean_max')):>7} "
+                  f"{row.get('shed', 0):>5}")
     block = _find_slo_block(payload)
     _print_tier_rows(pending, block)
     if block is not None and block.get("verdicts"):

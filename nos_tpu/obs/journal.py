@@ -108,6 +108,14 @@ PROVISION_FAILED = "provision-failed"
 PROVISION_STOCKOUT = "provision-stockout"
 SPARE_BORROWED = "spare-borrowed"
 SCALE_DOWN = "scale-down"
+# Request data plane (nos_tpu/requests): a request is SHED when every
+# candidate replica's admission queue stayed full through the router's
+# retry budget (service, session and retry count recorded — the router
+# journals the DECISION to drop, never the millions of routine routes);
+# SESSION_MIGRATED records a live session re-homed because its replica
+# vanished (scale-down, node loss), with the old and new replica.
+REQUEST_SHED = "request-shed"
+SESSION_MIGRATED = "session-migrated"
 
 
 class DecisionRecord:

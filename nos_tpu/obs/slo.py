@@ -421,4 +421,13 @@ def default_objectives() -> list[SLOObjective]:
             name="actuation-latency", kind=LATENCY,
             metric="nos_tpu_actuation_latency_seconds",
             target=30.0, each_label="pool"),
+        # Request data plane (nos_tpu/requests): end-to-end per-request
+        # latency, fanned out per service.  Judged next to schedule
+        # latency — a deployment without the router simply never
+        # observes the metric and the objective reads not-yet-observable.
+        SLOObjective(
+            name="request-latency", kind=LATENCY,
+            metric="nos_tpu_request_latency_seconds",
+            target=10.0, labels={"phase": "total"},
+            each_label="service", min_events=5),
     ]

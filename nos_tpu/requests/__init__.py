@@ -1,0 +1,44 @@
+"""Inference request data plane: the path from user to chip.
+
+Everything below the pod boundary the serving tier previously abstracted
+as "load annotation goes up, replica count comes down" is modeled here
+request by request (docs/serving.md):
+
+- ``costs`` — roofline-priced prefill/decode split: compute-bound
+  prompt processing, memory-bound token generation, and the KV capacity
+  the HBM budget leaves after weights;
+- ``replica`` — a continuous-batching replica: bounded admission queue,
+  reserve-ahead KV occupancy, prefill/decode time-sharing, and the
+  disaggregation handoff seam;
+- ``router`` — session-affine, KV-aware routing with shed-with-retry,
+  prefill/decode pool split, and the downward-API publication loop the
+  replica autoscaler scales on.
+
+The plane is deterministic end to end: time is injected, request
+streams are seeded arrival processes (sim/trace.py), and a journal from
+a routed run is byte-identical across source installation order and
+router worker counts (tests/test_requests.py).
+"""
+
+from .costs import (
+    HBM_BYTES_PER_S, ModelProfile, RequestCostModel, hbm_bandwidth_for,
+)
+from .replica import ContinuousBatchingReplica, Request
+from .router import (
+    PHASE_DECODE, PHASE_PREFILL, PHASE_TOTAL, RouterService,
+    ServingRouter,
+)
+
+__all__ = [
+    "HBM_BYTES_PER_S",
+    "ModelProfile",
+    "RequestCostModel",
+    "hbm_bandwidth_for",
+    "ContinuousBatchingReplica",
+    "Request",
+    "PHASE_DECODE",
+    "PHASE_PREFILL",
+    "PHASE_TOTAL",
+    "RouterService",
+    "ServingRouter",
+]

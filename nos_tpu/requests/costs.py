@@ -1,0 +1,154 @@
+"""Prefill/decode cost split derived from the roofline tables.
+
+The continuous-batching replica model needs per-request service times
+with the ONE asymmetry that makes LLM serving hard: **prefill is
+compute-bound, decode is memory-bound**.  Both sides are priced from
+``ops/roofline.py``'s peak table plus the public HBM bandwidth specs —
+the same single-source-of-truth posture bench_compute takes for MFU
+(two cost tables disagreeing would make the request bench
+unfalsifiable):
+
+- **prefill** — processing a P-token prompt runs ~``2 * params`` FLOPs
+  per token (forward only; the matmul inventory mirrors
+  ``roofline.model_flops_per_step`` minus the 3x backward factor), so
+  ``prefill_seconds = P * flops_per_token / (chips * peak * mfu)``;
+- **decode** — one continuous-batching step reads the full weights
+  once plus every resident KV entry and emits ONE token for every
+  active request, so the step time is
+  ``(weights + kv_bytes) / (chips * bandwidth * efficiency)`` — near
+  constant in batch size, which is exactly why batching decodes pays;
+- **KV capacity** — the HBM left after weights, divided by the
+  per-token KV footprint (2 tensors x layers x kv_heads x head_dim x
+  dtype bytes).  Occupancy against this capacity is the replica's real
+  load signal (router.py publishes it through ANNOT_SERVING_LOAD).
+
+Everything here is a pure function of its arguments — no clocks, no
+randomness — so replica timing is a deterministic function of the
+request stream (noslint N002/N011 discipline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from nos_tpu.ops.roofline import peak_for
+
+#: Nominal HBM bandwidth (bytes/s) per chip, matched by substring
+#: against the device kind exactly like ``roofline.PEAK_TFLOPS`` (the
+#: public Cloud TPU specs; more specific needles precede the bare "v5").
+HBM_BYTES_PER_S = {"v6e": 1640e9, "trillium": 1640e9,
+                   "v5p": 2765e9,
+                   "v5e": 819e9, "v5litepod": 819e9, "v5 lite": 819e9,
+                   "v5": 819e9,
+                   "v4": 1228e9}
+DEFAULT_HBM_BYTES_PER_S = 819e9
+
+
+def hbm_bandwidth_for(device_kind: str) -> float:
+    """Nominal HBM bytes/s for a device_kind string."""
+    kind = device_kind.lower()
+    return next((v for k, v in HBM_BYTES_PER_S.items() if k in kind),
+                DEFAULT_HBM_BYTES_PER_S)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProfile:
+    """Decode-relevant model shape (duck-typed like LlamaConfig in
+    ``roofline.model_flops_per_step``: no jax import needed).  The
+    fields are exactly what prices a request: the matmul inventory for
+    prefill FLOPs, the KV geometry for decode bytes, and the resident
+    weight footprint."""
+
+    name: str
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    vocab_size: int = 32_000
+    weights_gb: float = 8.0
+    kv_dtype_bytes: int = 2     # bf16 KV cache
+
+    def __post_init__(self) -> None:
+        if min(self.num_layers, self.num_heads, self.num_kv_heads,
+               self.head_dim, self.intermediate_size) <= 0:
+            raise ValueError(f"profile {self.name}: dims must be > 0")
+        if self.weights_gb <= 0 or self.kv_dtype_bytes <= 0:
+            raise ValueError(
+                f"profile {self.name}: weights_gb and kv_dtype_bytes "
+                f"must be > 0")
+
+    @property
+    def hidden_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    def kv_bytes_per_token(self) -> int:
+        """Resident KV footprint of ONE cached token: K and V, every
+        layer, every kv head."""
+        return (2 * self.num_layers * self.num_kv_heads * self.head_dim
+                * self.kv_dtype_bytes)
+
+    def flops_per_token(self) -> float:
+        """Forward-only FLOPs to process one token: 2 FLOPs per matmul
+        parameter (the ``model_flops_per_step`` inventory without the
+        3x backward factor; attention scores are second-order for the
+        prompt lengths serving sees and are priced into ``mfu``)."""
+        h = self.hidden_size
+        per_layer_mm = (
+            h * self.num_heads * self.head_dim                    # q
+            + 2 * h * self.num_kv_heads * self.head_dim           # k, v
+            + self.num_heads * self.head_dim * h                  # o
+            + 3 * h * self.intermediate_size                      # mlp
+        )
+        n_mm = self.num_layers * per_layer_mm + self.vocab_size * h
+        return 2.0 * n_mm
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestCostModel:
+    """Prices one replica's work (module docstring).  ``chips`` is the
+    replica's slice size — the per-role ServingService mapping gives a
+    disaggregated prefill pool bigger slices (more compute) than the
+    decode pool without touching the model profile."""
+
+    profile: ModelProfile
+    device_kind: str = "v5e"
+    chips: int = 1
+    hbm_gb: float = 16.0
+    mfu: float = 0.4            # achieved fraction of peak in prefill
+    hbm_efficiency: float = 0.8  # achieved fraction of peak bandwidth
+
+    def __post_init__(self) -> None:
+        if self.chips <= 0:
+            raise ValueError("chips must be > 0")
+        if not 0.0 < self.mfu <= 1.0:
+            raise ValueError("mfu must be in (0, 1]")
+        if not 0.0 < self.hbm_efficiency <= 1.0:
+            raise ValueError("hbm_efficiency must be in (0, 1]")
+        if self.hbm_gb * self.chips <= self.profile.weights_gb:
+            raise ValueError(
+                f"{self.profile.name}: weights ({self.profile.weights_gb}"
+                f" GB) leave no KV room in {self.hbm_gb * self.chips} GB")
+
+    def prefill_seconds(self, prompt_tokens: int) -> float:
+        """Compute-bound prompt processing time."""
+        flops = prompt_tokens * self.profile.flops_per_token()
+        peak = peak_for(self.device_kind) * self.chips * self.mfu
+        return flops / peak
+
+    def decode_step_seconds(self, resident_kv_tokens: int) -> float:
+        """One continuous-batching decode step (one token for EVERY
+        active request): full weights pass + resident KV read,
+        memory-bound."""
+        bytes_read = (self.profile.weights_gb * 2**30
+                      + resident_kv_tokens
+                      * self.profile.kv_bytes_per_token())
+        bw = (hbm_bandwidth_for(self.device_kind) * self.chips
+              * self.hbm_efficiency)
+        return bytes_read / bw
+
+    def kv_capacity_tokens(self) -> int:
+        """KV slots in the HBM left after weights."""
+        free = (self.hbm_gb * self.chips - self.profile.weights_gb) \
+            * 2**30
+        return int(free // self.profile.kv_bytes_per_token())

@@ -13,7 +13,10 @@ Three pieces open the serving tier end to end (docs/serving.md):
   drives through the real control plane.
 """
 
-from .autoscaler import ReplicaAutoscaler, ServingService
+from .autoscaler import (
+    ReplicaAutoscaler, ServingService, replica_load, replica_sessions,
+)
 from .trace import DiurnalTrace
 
-__all__ = ["ReplicaAutoscaler", "ServingService", "DiurnalTrace"]
+__all__ = ["ReplicaAutoscaler", "ServingService", "DiurnalTrace",
+           "replica_load", "replica_sessions"]

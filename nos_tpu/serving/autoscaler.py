@@ -161,6 +161,19 @@ def replica_load(pod: Pod) -> float:
     return value
 
 
+def replica_sessions(pod: Pod) -> int:
+    """The replica's router-published active-session count
+    (ANNOT_SERVING_SESSIONS); absent/garbage/negative = 0, so a
+    routerless deployment reads every replica as drained and keeps the
+    historical least-loaded victim order."""
+    raw = pod.metadata.annotations.get(C.ANNOT_SERVING_SESSIONS, "")
+    try:
+        value = int(float(raw))
+    except ValueError:
+        return 0
+    return max(0, value)
+
+
 @guarded_by("_lock", "_services", "_last_scale", "_seq")
 class ReplicaAutoscaler:
     """Reconcile serving services toward their load signal (module
@@ -309,10 +322,13 @@ class ReplicaAutoscaler:
 
     def _scale_down(self, svc: ServingService, pods: list[Pod],
                     count: int, now: float) -> int:
-        # cheapest victims first: replicas that never bound, then the
-        # least-loaded running ones (their in-flight work is smallest)
+        # cheapest victims first: replicas that never bound, then
+        # DRAINED running ones (zero router-published sessions — killing
+        # them cuts no live stream), then the least-loaded (their
+        # in-flight work is smallest)
         doomed = sorted(
             pods, key=lambda p: (p.status.phase == RUNNING,
+                                 replica_sessions(p) > 0,
                                  replica_load(p), p.metadata.name))
         deleted = 0
         for pod in doomed[:count]:

@@ -54,13 +54,14 @@ if ! env JAX_PLATFORMS=cpu python -m pytest tests/test_interleave.py \
     rc=1
 fi
 
-echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, capacity/, analysis/, sim/, testing/{lockcheck,interleave})"
+echo "==> mypy (strict: topology/, partitioning/core/, utils/, scheduler/, obs/, serving/, requests/, capacity/, analysis/, sim/, testing/{lockcheck,interleave})"
 if python -c "import mypy" 2>/dev/null; then
     # mypy.ini pins the per-package strictness tiers
     if ! python -m mypy --config-file mypy.ini \
             nos_tpu/topology nos_tpu/partitioning/core nos_tpu/utils \
             nos_tpu/scheduler nos_tpu/obs nos_tpu/serving \
-            nos_tpu/capacity nos_tpu/analysis nos_tpu/sim \
+            nos_tpu/requests nos_tpu/capacity nos_tpu/analysis \
+            nos_tpu/sim \
             nos_tpu/testing/lockcheck.py nos_tpu/testing/interleave.py; then
         rc=1
     fi
@@ -101,6 +102,13 @@ fi
 echo "==> bench_serving.py --smoke (serving gate: class=serving buckets, zero serving preemptions, p99 < 100 ms)"
 if ! env JAX_PLATFORMS=cpu python bench_serving.py --smoke \
         --serving-report "${SERVING_REPORT_PATH:-/tmp/nos_tpu_serving_report.json}" \
+        > /dev/null; then
+    rc=1
+fi
+
+echo "==> bench_requests.py --smoke (request gate: per-request p99 < SLO, zero serving preemptions, KV occupancy under ceiling, saturation curve)"
+if ! env JAX_PLATFORMS=cpu python bench_requests.py --smoke \
+        --requests-report "${REQUESTS_REPORT_PATH:-/tmp/nos_tpu_requests_report.json}" \
         > /dev/null; then
     rc=1
 fi

@@ -192,6 +192,39 @@ class TestScaling:
         assert names[0] in left
         assert len(left) == 1
 
+    def test_scale_down_prefers_drained_replicas_over_least_loaded(self):
+        """Session-aware victim order: a RUNNING replica with ZERO
+        router-published sessions is shed before a lighter-loaded one
+        still carrying live streams — killing the drained replica cuts
+        no stream (the router's ANNOT_SERVING_SESSIONS loop)."""
+        h = Harness()
+        h.autoscaler.reconcile()
+        h.stamp(25.0)
+        h.now[0] = 1.0
+        h.autoscaler.reconcile()
+        pods = sorted(p.metadata.name for p in h.replicas())
+        assert len(pods) == 3
+        from nos_tpu.kube.objects import RUNNING
+
+        def mark(name, load, sessions):
+            def mutate(p):
+                p.status.phase = RUNNING
+                p.spec.node_name = "host-0"
+                p.metadata.annotations[C.ANNOT_SERVING_LOAD] = str(load)
+                p.metadata.annotations[C.ANNOT_SERVING_SESSIONS] = \
+                    str(sessions)
+            h.api.patch(KIND_POD, name, "serve", mutate=mutate)
+        mark(pods[0], 5.0, 3)       # streaming
+        mark(pods[1], 1.0, 2)       # least loaded, still streaming
+        mark(pods[2], 6.0, 0)       # drained: the right victim
+        h.now[0] = 2.0
+        # desired ceil(12/10) = 2, headroom 12 <= 2*10*0.8: shed ONE
+        h.autoscaler.reconcile()
+        left = {p.metadata.name for p in h.replicas()}
+        assert pods[2] not in left, \
+            "the drained replica must be shed first"
+        assert pods[0] in left and pods[1] in left
+
     def test_status_configmap_published(self):
         h = Harness()
         h.autoscaler.reconcile()
